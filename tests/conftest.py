@@ -1,0 +1,36 @@
+"""Test config: force an 8-device virtual CPU mesh so sharding semantics are
+tested without TPU hardware (SURVEY.md §4: multi-host semantics via CPU
+mesh; reference uses torch-elastic multiprocess, test_utils.py:232-270)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The environment may pre-register a TPU PJRT plugin (sitecustomize) whose
+# backend init blocks without real hardware; drop it so CPU-only tests
+# never touch it.
+try:
+    import jax
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(params=[True, False], ids=["batching_on", "batching_off"])
+def toggle_batching(request):
+    """Run snapshot tests with batching on and off (reference
+    tests/conftest.py:17-20)."""
+    from torchsnapshot_tpu import knobs
+
+    with knobs.override_disable_batching(not request.param):
+        yield request.param

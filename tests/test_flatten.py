@@ -1,0 +1,76 @@
+"""Flatten/inflate round-trip tests (reference tests/test_flatten.py)."""
+
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.flatten import flatten, inflate
+
+
+class Leaf:
+    def __init__(self, v):
+        self.v = v
+
+    def __eq__(self, other):
+        return isinstance(other, Leaf) and self.v == other.v
+
+
+def test_roundtrip_nested():
+    obj = {
+        "a": [1, 2, {"b": Leaf(3)}],
+        "c": OrderedDict([("x", Leaf(1)), ("y", (Leaf(2), Leaf(3)))]),
+        "d": Leaf(4),
+        5: Leaf(5),
+    }
+    manifest, flattened = flatten(obj)
+    assert inflate(manifest, flattened) == obj
+
+
+def test_key_escaping():
+    obj = {"a/b": Leaf(1), "a%2Fb": Leaf(2), "%": Leaf(3)}
+    manifest, flattened = flatten(obj)
+    assert len(flattened) == 3
+    assert inflate(manifest, flattened) == obj
+
+
+def test_unflattenable_dict_is_leaf():
+    # non-str/int keys -> whole dict is a leaf
+    obj = {"outer": {(1, 2): "x"}}
+    manifest, flattened = flatten(obj)
+    assert flattened["outer"] == {(1, 2): "x"}
+    assert inflate(manifest, flattened) == obj
+
+
+def test_bool_keys_not_flattened():
+    obj = {True: "x"}
+    _, flattened = flatten(obj)
+    assert flattened[""] == obj
+
+
+def test_colliding_encoded_keys_not_flattened():
+    obj = {"1": Leaf(1), 1: Leaf(2)}
+    manifest, flattened = flatten(obj)
+    assert flattened[""] == obj
+    assert inflate(manifest, flattened) == obj
+
+
+def test_prefix():
+    obj = {"w": Leaf(1), "b": [Leaf(2)]}
+    manifest, flattened = flatten(obj, prefix="model/0")
+    assert set(flattened) == {"model/0/w", "model/0/b/0"}
+    assert inflate(manifest, flattened, prefix="model/0") == obj
+
+
+def test_empty_containers():
+    obj = {"a": [], "b": {}, "c": ()}
+    manifest, flattened = flatten(obj)
+    assert flattened == {}
+    assert inflate(manifest, flattened) == obj
+
+
+def test_tuple_vs_list_distinguished():
+    obj = {"t": (1, 2), "l": [1, 2]}
+    manifest, flattened = flatten(obj)
+    out = inflate(manifest, flattened)
+    assert isinstance(out["t"], tuple) and isinstance(out["l"], list)

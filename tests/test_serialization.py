@@ -1,0 +1,103 @@
+"""Serialization round-trips across dtypes + the safe object codec
+(reference tests/test_serialization.py)."""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from torchsnapshot_tpu import knobs
+from torchsnapshot_tpu.serialization import (
+    BUFFER_PROTOCOL,
+    PICKLE_OBJECT,
+    SAFE_OBJECT,
+    array_as_memoryview,
+    array_from_buffer,
+    deserialize_object,
+    dtype_to_string,
+    serialize_object,
+    string_to_dtype,
+)
+
+ALL_DTYPES = [
+    np.float16, np.float32, np.float64,
+    np.int8, np.int16, np.int32, np.int64,
+    np.uint8, np.uint16, np.uint32, np.uint64,
+    np.bool_, np.complex64, np.complex128,
+    ml_dtypes.bfloat16, ml_dtypes.float8_e4m3fn, ml_dtypes.float8_e5m2,
+]
+
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES, ids=lambda d: np.dtype(d).name)
+def test_array_roundtrip(dtype):
+    rng = np.random.default_rng(0)
+    arr = rng.standard_normal((16, 7)).astype(dtype)
+    s = dtype_to_string(arr.dtype)
+    assert string_to_dtype(s) == np.dtype(dtype)
+    mv = array_as_memoryview(arr)
+    assert mv.nbytes == arr.nbytes
+    back = array_from_buffer(bytes(mv), s, arr.shape)
+    np.testing.assert_array_equal(np.asarray(back), arr)
+
+
+def test_memoryview_is_zero_copy():
+    arr = np.arange(10, dtype=np.float32)
+    mv = array_as_memoryview(arr)
+    arr[0] = 42.0
+    assert np.frombuffer(mv, dtype=np.float32)[0] == 42.0
+
+
+def test_noncontiguous_array():
+    arr = np.arange(24, dtype=np.int32).reshape(4, 6).T
+    mv = array_as_memoryview(arr)
+    back = array_from_buffer(bytes(mv), "int32", (6, 4))
+    np.testing.assert_array_equal(back, arr)
+
+
+@pytest.mark.parametrize(
+    "obj",
+    [
+        None, True, 7, -(2**100), 3.5, "str", b"bytes",
+        [1, [2, 3]], (1, (2,)), {1, 2}, frozenset([3]),
+        {"a": 1, 2: "b", (1, 2): "c"},
+        complex(1, -2),
+        np.float32(1.5),
+        np.arange(6).reshape(2, 3),
+    ],
+    ids=repr,
+)
+def test_safe_codec_roundtrip(obj):
+    payload, tag = serialize_object(obj)
+    assert tag == SAFE_OBJECT
+    back = deserialize_object(payload, tag)
+    if isinstance(obj, np.ndarray):
+        np.testing.assert_array_equal(back, obj)
+    else:
+        assert back == obj and type(back) is type(obj)
+
+
+def test_bfloat16_ndarray_in_object():
+    arr = np.arange(8, dtype=ml_dtypes.bfloat16)
+    payload, tag = serialize_object({"x": arr})
+    back = deserialize_object(payload, tag)
+    assert back["x"].dtype == arr.dtype
+    np.testing.assert_array_equal(back["x"], arr)
+
+
+class _Custom:
+    def __init__(self, v):
+        self.v = v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def test_pickle_fallback_gated():
+    payload, tag = serialize_object(_Custom(3))
+    assert tag == PICKLE_OBJECT
+    assert deserialize_object(payload, tag) == _Custom(3)
+    with knobs.override_allow_pickle_objects(False):
+        with pytest.raises(TypeError):
+            serialize_object(_Custom(3))
+        with pytest.raises(RuntimeError):
+            deserialize_object(payload, tag)

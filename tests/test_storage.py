@@ -1,0 +1,56 @@
+"""Storage plugin tests: fs + memory, ranged reads, registry
+(reference tests/test_fs_storage_plugin.py etc.)."""
+
+import pytest
+
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage import url_to_storage_plugin
+from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+from torchsnapshot_tpu.storage.memory import MemoryStoragePlugin, reset_namespace
+
+
+@pytest.fixture(params=["fs", "memory"])
+def plugin(request, tmp_path):
+    if request.param == "fs":
+        yield FSStoragePlugin(root=str(tmp_path))
+    else:
+        reset_namespace("test")
+        yield MemoryStoragePlugin(namespace="test")
+        reset_namespace("test")
+
+
+def test_write_read_delete(plugin):
+    data = bytes(range(256)) * 10
+    plugin.sync_write(WriteIO(path="a/b/c", buf=data))
+    rio = ReadIO(path="a/b/c")
+    plugin.sync_read(rio)
+    assert bytes(rio.buf) == data
+
+    rio = ReadIO(path="a/b/c", byte_range=[100, 356])
+    plugin.sync_read(rio)
+    assert bytes(rio.buf) == data[100:356]
+
+    import asyncio
+
+    asyncio.run(plugin.delete("a/b/c"))
+    with pytest.raises(Exception):
+        plugin.sync_read(ReadIO(path="a/b/c"))
+
+
+def test_memoryview_write(plugin):
+    data = memoryview(b"hello world")
+    plugin.sync_write(WriteIO(path="mv", buf=data))
+    rio = ReadIO(path="mv")
+    plugin.sync_read(rio)
+    assert bytes(rio.buf) == b"hello world"
+
+
+def test_url_scheme_dispatch(tmp_path):
+    p = url_to_storage_plugin(str(tmp_path))
+    assert isinstance(p, FSStoragePlugin)
+    p = url_to_storage_plugin(f"fs://{tmp_path}")
+    assert isinstance(p, FSStoragePlugin)
+    p = url_to_storage_plugin("memory://ns1")
+    assert isinstance(p, MemoryStoragePlugin)
+    with pytest.raises(RuntimeError, match="no storage plugin"):
+        url_to_storage_plugin("bogus://x")

@@ -1,0 +1,196 @@
+"""Small-write coalescing (slabs) and ranged-read merging.
+
+Reference: torchsnapshot/batcher.py:51-486.  Write requests smaller than the
+slab threshold (128MB knob) whose manifest entries carry a byte-range field
+are packed into slab objects written as one storage op; the entries are
+re-pointed at ``(slab_location, byte_range)``.  On read, multiple ranged
+reads of the same location are merged into one spanning read whose consumer
+slices and feeds the original consumers (reference batcher.py:387-478).
+
+All byte sizes are exactly known at plan time (buffer-protocol staging cost
+== serialized size), so entries can be re-pointed before staging happens —
+same property the reference relies on.
+
+The reference's GPU-slab variant (pack on device + single DtoH,
+batcher.py:104-162) has a TPU analogue — bitcast-to-uint8 + concatenate as
+one XLA op followed by a single transfer; planned for ops/ (not yet
+implemented — sub-buffers are currently staged individually and packed on
+host).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import Executor
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import knobs
+from .io_types import BufferConsumer, BufferStager, ReadReq, WriteReq
+from .manifest import ArrayEntry, ChunkedArrayEntry, Entry, ShardedArrayEntry
+
+
+class BatchedBufferStager(BufferStager):
+    """Stage every sub-buffer concurrently, then pack into one slab
+    (reference BatchedBufferStager, batcher.py:51-103)."""
+
+    def __init__(self, stagers: List[Tuple[BufferStager, int]], total: int):
+        self.stagers = stagers
+        self.total = total
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> memoryview:
+        slab = bytearray(self.total)
+        offset = 0
+        bufs = await asyncio.gather(
+            *(s.stage_buffer(executor) for s, _ in self.stagers)
+        )
+        for (_, cost), buf in zip(self.stagers, bufs):
+            view = memoryview(buf).cast("B")
+            assert view.nbytes == cost, (view.nbytes, cost)
+            slab[offset : offset + cost] = view
+            offset += cost
+        self.stagers = []
+        return memoryview(slab)
+
+    def get_staging_cost_bytes(self) -> int:
+        # sub-buffers + slab are alive simultaneously during packing
+        return 2 * self.total
+
+
+def _byte_range_targets(entries: Dict[str, Entry]) -> Dict[str, Any]:
+    """location → the manifest record whose (location, byte_range) must be
+    re-pointed when its blob moves into a slab."""
+    targets: Dict[str, Any] = {}
+    for entry in entries.values():
+        if isinstance(entry, ArrayEntry):
+            targets[entry.location] = entry
+        elif isinstance(entry, ChunkedArrayEntry):
+            for chunk in entry.chunks:
+                targets[chunk.location] = chunk
+        elif isinstance(entry, ShardedArrayEntry):
+            for shard in entry.shards:
+                targets[shard.location] = shard
+    return targets
+
+
+def batch_write_requests(
+    entries: Dict[str, Entry], write_reqs: List[WriteReq], rank: int
+) -> Tuple[Dict[str, Entry], List[WriteReq]]:
+    """Coalesce small array writes into ≥slab-threshold objects (reference
+    batch_write_requests, batcher.py:204-355)."""
+    threshold = knobs.get_slab_size_threshold_bytes()
+    targets = _byte_range_targets(entries)
+    small: List[Tuple[WriteReq, int]] = []
+    rest: List[WriteReq] = []
+    for wr in write_reqs:
+        cost = wr.buffer_stager.get_staging_cost_bytes()
+        if wr.path in targets and 0 < cost < threshold:
+            small.append((wr, cost))
+        else:
+            rest.append(wr)
+    if len(small) < 2:
+        return entries, write_reqs
+
+    small.sort(key=lambda x: x[0].path)  # deterministic slab layout
+    slabs: List[List[Tuple[WriteReq, int]]] = []
+    cur: List[Tuple[WriteReq, int]] = []
+    cur_bytes = 0
+    for wr, cost in small:
+        cur.append((wr, cost))
+        cur_bytes += cost
+        if cur_bytes >= threshold:
+            slabs.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        slabs.append(cur)
+
+    new_reqs = list(rest)
+    for i, slab in enumerate(slabs):
+        slab_location = f"{rank}/batched.{i}"
+        offset = 0
+        stagers: List[Tuple[BufferStager, int]] = []
+        for wr, cost in slab:
+            record = targets[wr.path]
+            record.location = slab_location
+            record.byte_range = [offset, offset + cost]
+            stagers.append((wr.buffer_stager, cost))
+            offset += cost
+        new_reqs.append(
+            WriteReq(
+                path=slab_location,
+                buffer_stager=BatchedBufferStager(stagers, offset),
+            )
+        )
+    return entries, new_reqs
+
+
+class _MergedRangeConsumer(BufferConsumer):
+    """Feed one spanning read into the original ranged consumers
+    (reference BatchedBufferConsumer, batcher.py:358-386)."""
+
+    def __init__(self, base: int, subs: List[Tuple[ReadReq, int, int]]):
+        self.base = base
+        self.subs = subs
+
+    async def consume_buffer(
+        self, buf: Any, executor: Optional[Executor] = None
+    ) -> None:
+        view = memoryview(buf).cast("B")
+        for req, start, end in self.subs:
+            await req.buffer_consumer.consume_buffer(
+                view[start - self.base : end - self.base], executor
+            )
+
+    def get_consuming_cost_bytes(self) -> int:
+        # the spanning buffer is what actually occupies host memory
+        span = max(e for _, _, e in self.subs) - self.base
+        return max(
+            span,
+            sum(
+                req.buffer_consumer.get_consuming_cost_bytes()
+                for req, _, _ in self.subs
+            ),
+        )
+
+
+def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
+    """Merge ranged reads of the same location into one spanning read
+    (reference batch_read_requests, batcher.py:387-478)."""
+    by_path: Dict[str, List[ReadReq]] = {}
+    out: List[ReadReq] = []
+    for rr in read_reqs:
+        if rr.byte_range is not None:
+            by_path.setdefault(rr.path, []).append(rr)
+        else:
+            out.append(rr)
+    max_gap = 1 << 20  # don't span holes larger than 1MB between ranges
+    for path, reqs in by_path.items():
+        if len(reqs) == 1:
+            out.append(reqs[0])
+            continue
+        reqs.sort(key=lambda r: r.byte_range[0])
+        run: List[ReadReq] = []
+
+        def flush() -> None:
+            if not run:
+                return
+            if len(run) == 1:
+                out.append(run[0])
+            else:
+                lo = run[0].byte_range[0]
+                hi = max(r.byte_range[1] for r in run)
+                subs = [(r, r.byte_range[0], r.byte_range[1]) for r in run]
+                out.append(
+                    ReadReq(
+                        path=path,
+                        byte_range=[lo, hi],
+                        buffer_consumer=_MergedRangeConsumer(lo, subs),
+                    )
+                )
+            run.clear()
+
+        for r in reqs:
+            if run and r.byte_range[0] - max(x.byte_range[1] for x in run) > max_gap:
+                flush()
+            run.append(r)
+        flush()
+    return out

@@ -1,0 +1,261 @@
+"""Distributed control plane: object gathers, barriers, and a KV store.
+
+TPU-native replacement for the reference's two-channel design
+(pg_wrapper.py:17-91 NCCL/Gloo collectives + dist_store.py:24-196 TCPStore):
+on JAX, *both* channels collapse into the coordination-service KV store —
+``jax.distributed``'s client exposes key_value_set / blocking_key_value_get /
+wait_at_barrier, which (a) carries small control-plane objects fine and
+(b) never touches ICI, so it is safe from the async-snapshot background
+thread (the reference's "no collectives in this method" constraint,
+snapshot.py:1010, holds by construction).
+
+Implementations:
+- ``LocalCoordinator``  — single process, no-ops.
+- ``JaxCoordinator``    — multi-controller via jax.distributed's KV client.
+- ``FileCoordinator``   — shared-filesystem KV for multi-process CPU tests
+  (the analogue of the reference's file-based c10d rendezvous in
+  test_utils.py:188-243).
+
+All gathers/barriers are built on four KV primitives (set/get/delete/
+barrier), so the three backends share the same semantics by construction.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import time
+import uuid
+from base64 import b64decode, b64encode
+from typing import Any, List, Optional
+
+from .serialization import deserialize_object, serialize_object
+
+_DEFAULT_TIMEOUT_S = 600.0
+
+
+class Coordinator(abc.ABC):
+    """Uniform control-plane interface (reference PGWrapper,
+    pg_wrapper.py:17-91)."""
+
+    @property
+    @abc.abstractmethod
+    def rank(self) -> int: ...
+
+    @property
+    @abc.abstractmethod
+    def world_size(self) -> int: ...
+
+    @abc.abstractmethod
+    def kv_set(self, key: str, value: str) -> None: ...
+
+    @abc.abstractmethod
+    def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
+        """Blocking get: waits until the key exists."""
+
+    @abc.abstractmethod
+    def kv_try_get(self, key: str) -> Optional[str]: ...
+
+    @abc.abstractmethod
+    def _barrier_impl(self, name: str, timeout_s: float) -> None: ...
+
+    def barrier(
+        self, name: Optional[str] = None, timeout_s: float = _DEFAULT_TIMEOUT_S
+    ) -> None:
+        """Barrier; auto-names from the per-instance op counter when no name
+        is given (coordination calls happen in identical program order on
+        every rank).  Explicit names must be globally unique per use — JAX
+        barrier ids are single-use."""
+        self._barrier_impl(name or self._next_uid("bar"), timeout_s)
+
+    # ---- derived object-level ops --------------------------------------
+
+    def _encode(self, obj: Any) -> str:
+        payload, tag = serialize_object(obj)
+        return tag + ":" + b64encode(payload).decode("ascii")
+
+    def _decode(self, s: str) -> Any:
+        tag, payload = s.split(":", 1)
+        return deserialize_object(b64decode(payload.encode("ascii")), tag)
+
+    def _next_uid(self, op: str) -> str:
+        # Every rank performs coordination calls in the same program order,
+        # so a per-instance counter yields matching keys across ranks.
+        n = getattr(self, "_op_counter", 0)
+        self._op_counter = n + 1
+        return f"{op}/{n}"
+
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        """Gather an object from every rank (reference
+        pg_wrapper.py all_gather_object)."""
+        if self.world_size == 1:
+            return [obj]
+        uid = self._next_uid("ag")
+        self.kv_set(f"{uid}/{self.rank}", self._encode(obj))
+        out = [self._decode(self.kv_get(f"{uid}/{r}")) for r in range(self.world_size)]
+        self.barrier(f"{uid}/done")
+        return out
+
+    def broadcast_object(self, obj: Any, src: int = 0) -> Any:
+        """Broadcast an object from ``src`` (reference
+        pg_wrapper.py broadcast_object_list)."""
+        if self.world_size == 1:
+            return obj
+        uid = self._next_uid("bc")
+        if self.rank == src:
+            self.kv_set(uid, self._encode(obj))
+            result = obj
+        else:
+            result = self._decode(self.kv_get(uid))
+        self.barrier(f"{uid}/done")
+        return result
+
+
+class LocalCoordinator(Coordinator):
+    """Single-process fallback (reference PGWrapper(pg=None) branch)."""
+
+    def __init__(self) -> None:
+        self._kv: dict = {}
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def world_size(self) -> int:
+        return 1
+
+    def kv_set(self, key: str, value: str) -> None:
+        self._kv[key] = value
+
+    def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
+        return self._kv[key]
+
+    def kv_try_get(self, key: str) -> Optional[str]:
+        return self._kv.get(key)
+
+    def _barrier_impl(self, name: str, timeout_s: float) -> None:
+        pass
+
+
+class JaxCoordinator(Coordinator):
+    """Multi-controller coordination over jax.distributed's KV service.
+
+    Requires ``jax.distributed.initialize()`` to have been called (the
+    norm on multi-host TPU pods).
+    """
+
+    def __init__(self, namespace: Optional[str] = None) -> None:
+        import jax
+
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "jax.distributed is not initialized; use LocalCoordinator "
+                "for single-process runs"
+            )
+        self._client = client
+        self._rank = jax.process_index()
+        self._world = jax.process_count()
+        self._ns = namespace or "tsnp"
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world
+
+    def _k(self, key: str) -> str:
+        return f"{self._ns}/{key}"
+
+    def kv_set(self, key: str, value: str) -> None:
+        self._client.key_value_set(self._k(key), value)
+
+    def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
+        return self._client.blocking_key_value_get(
+            self._k(key), int(timeout_s * 1000)
+        )
+
+    def kv_try_get(self, key: str) -> Optional[str]:
+        try:
+            return self._client.key_value_try_get(self._k(key))
+        except Exception:
+            return None
+
+    def _barrier_impl(self, name: str, timeout_s: float) -> None:
+        self._client.wait_at_barrier(self._k(name), int(timeout_s * 1000))
+
+
+class FileCoordinator(Coordinator):
+    """Shared-directory KV + barriers for multi-process tests on one host."""
+
+    def __init__(self, root: str, rank: int, world_size: int, poll_s: float = 0.01):
+        self.root = root
+        self._rank = rank
+        self._world = world_size
+        self._poll_s = poll_s
+        os.makedirs(root, exist_ok=True)
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "%2F"))
+
+    def kv_set(self, key: str, value: str) -> None:
+        path = self._path(key)
+        tmp = path + f".tmp.{uuid.uuid4().hex}"
+        with open(tmp, "w") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
+        deadline = time.monotonic() + timeout_s
+        path = self._path(key)
+        while True:
+            try:
+                with open(path, "r") as f:
+                    return f.read()
+            except FileNotFoundError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"kv_get timed out waiting for {key!r}")
+                time.sleep(self._poll_s)
+
+    def kv_try_get(self, key: str) -> Optional[str]:
+        try:
+            with open(self._path(key), "r") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def _barrier_impl(self, name: str, timeout_s: float) -> None:
+        # two-phase: everyone arrives, rank 0 releases
+        # (reference LinearBarrier, dist_store.py:91-196)
+        self.kv_set(f"{name}/arrive/{self._rank}", "1")
+        if self._rank == 0:
+            for r in range(self._world):
+                self.kv_get(f"{name}/arrive/{r}", timeout_s)
+            self.kv_set(f"{name}/depart", "1")
+        else:
+            self.kv_get(f"{name}/depart", timeout_s)
+
+
+def get_default_coordinator() -> Coordinator:
+    """JaxCoordinator when jax.distributed is initialized, else local."""
+    try:
+        from jax._src import distributed
+
+        if distributed.global_state.client is not None:
+            return JaxCoordinator()
+    except Exception:
+        pass
+    return LocalCoordinator()

@@ -1,0 +1,80 @@
+"""Event fan-out: registered handlers + entry-point discovery.
+
+Reference: torchsnapshot/event_handlers.py:23-60.  Every public API call is
+bracketed with an event carrying a unique id, duration and success flag
+(reference call sites snapshot.py:174-179 etc.).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+import uuid
+from typing import Callable, Iterator, List
+
+from .event import Event
+
+logger = logging.getLogger(__name__)
+
+_ENTRY_POINT_GROUP = "torchsnapshot_tpu.event_handlers"
+_handlers: List[Callable[[Event], None]] = []
+_entry_point_handlers: List[Callable[[Event], None]] = []
+_entry_points_loaded = False
+
+
+def register_event_handler(handler: Callable[[Event], None]) -> None:
+    _handlers.append(handler)
+
+
+def unregister_event_handler(handler: Callable[[Event], None]) -> None:
+    _handlers.remove(handler)
+
+
+def _load_entry_point_handlers() -> None:
+    global _entry_points_loaded
+    if _entry_points_loaded:
+        return
+    _entry_points_loaded = True
+    try:
+        from importlib.metadata import entry_points
+
+        eps = entry_points()
+        group = (
+            eps.select(group=_ENTRY_POINT_GROUP)
+            if hasattr(eps, "select")
+            else eps.get(_ENTRY_POINT_GROUP, [])
+        )
+        for ep in group:
+            try:
+                _entry_point_handlers.append(ep.load())
+            except Exception:
+                logger.exception("failed to load event handler %r", ep.name)
+    except Exception:
+        pass
+
+
+def _fire(event: Event) -> None:
+    _load_entry_point_handlers()
+    for handler in _handlers + _entry_point_handlers:
+        try:
+            handler(event)
+        except Exception:
+            logger.exception("event handler raised for %r", event.name)
+
+
+@contextlib.contextmanager
+def log_event(event: Event) -> Iterator[Event]:
+    """Bracket an operation: fires the event on exit with unique_id,
+    duration and is_success attached."""
+    event.metadata.setdefault("unique_id", uuid.uuid4().hex)
+    begin = time.monotonic()
+    try:
+        yield event
+        event.metadata["is_success"] = True
+    except BaseException:
+        event.metadata["is_success"] = False
+        raise
+    finally:
+        event.metadata["duration_s"] = time.monotonic() - begin
+        _fire(event)

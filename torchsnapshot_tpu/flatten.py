@@ -1,0 +1,133 @@
+"""Reversible flattening of nested containers into logical paths.
+
+TPU-native analogue of the reference's flatten/inflate (torchsnapshot/
+flatten.py:20-226).  Nested dict/OrderedDict/list/tuple structures are
+flattened into a ``{logical_path: leaf}`` mapping plus a manifest of
+container entries that makes the flattening exactly reversible.
+
+Logical paths join keys with ``/``; ``/`` and ``%`` inside string keys are
+percent-escaped (reference flatten.py:215-226).  Dicts are only flattened
+when all keys are str/int and no two keys collide after encoding; otherwise
+the whole dict is treated as a leaf object (reference
+flatten.py:144-176).
+
+Compared to the reference we additionally flatten tuples (JAX pytrees are
+tuple-heavy) and treat any pytree-registered leaf the same way.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Tuple, Union
+
+from .manifest import (
+    DictEntry,
+    Entry,
+    ListEntry,
+    Manifest,
+    OrderedDictEntry,
+    TupleEntry,
+    is_container_entry,
+)
+
+
+def _encode(key: str) -> str:
+    return key.replace("%", "%25").replace("/", "%2F")
+
+
+def _decode(key: str) -> str:
+    return key.replace("%2F", "/").replace("%25", "%")
+
+
+def _should_flatten_dict(d: dict) -> bool:
+    # Only flatten dicts whose keys are unambiguously encodable
+    # (reference flatten.py:144-176).
+    encoded = set()
+    for k in d.keys():
+        if isinstance(k, bool) or not isinstance(k, (str, int)):
+            return False
+        e = _encode(str(k))
+        if e in encoded:
+            return False
+        encoded.add(e)
+    return True
+
+
+def _join(prefix: str, key: str) -> str:
+    return f"{prefix}/{key}" if prefix else key
+
+
+def flatten(obj: Any, prefix: str = "") -> Tuple[Manifest, Dict[str, Any]]:
+    """Flatten ``obj`` into (container manifest, {logical_path: leaf}).
+
+    Reference: torchsnapshot/flatten.py:20-76.
+    """
+    manifest: Manifest = {}
+    flattened: Dict[str, Any] = {}
+    _flatten_inplace(obj, prefix, manifest, flattened)
+    return manifest, flattened
+
+
+def _flatten_inplace(
+    obj: Any, prefix: str, manifest: Manifest, flattened: Dict[str, Any]
+) -> None:
+    if isinstance(obj, (list, tuple)) and not hasattr(obj, "_fields"):
+        manifest[prefix] = TupleEntry() if isinstance(obj, tuple) else ListEntry()
+        for idx, v in enumerate(obj):
+            _flatten_inplace(v, _join(prefix, str(idx)), manifest, flattened)
+    elif isinstance(obj, dict) and _should_flatten_dict(obj):
+        keys: List[Union[str, int]] = list(obj.keys())
+        if isinstance(obj, OrderedDict):
+            manifest[prefix] = OrderedDictEntry(keys=keys)
+        else:
+            manifest[prefix] = DictEntry(keys=keys)
+        for k, v in obj.items():
+            _flatten_inplace(v, _join(prefix, _encode(str(k))), manifest, flattened)
+    else:
+        flattened[prefix] = obj
+
+
+def inflate(
+    manifest: Manifest, flattened: Dict[str, Any], prefix: str = ""
+) -> Any:
+    """Rebuild the nested object from a container manifest + flat leaves.
+
+    Reference: torchsnapshot/flatten.py:79-143.
+    """
+    if prefix:
+        manifest = {
+            (k[len(prefix) + 1 :] if k != prefix else ""): v
+            for k, v in manifest.items()
+            if k == prefix or k.startswith(prefix + "/")
+        }
+        flattened = {
+            (k[len(prefix) + 1 :] if k != prefix else ""): v
+            for k, v in flattened.items()
+            if k == prefix or k.startswith(prefix + "/")
+        }
+    return _inflate_path("", manifest, flattened)
+
+
+def _inflate_path(path: str, manifest: Manifest, flattened: Dict[str, Any]) -> Any:
+    if path in manifest and is_container_entry(manifest[path]):
+        entry: Entry = manifest[path]
+        if isinstance(entry, DictEntry):
+            out: Any = OrderedDict() if isinstance(entry, OrderedDictEntry) else {}
+            for k in entry.keys:
+                child = _join(path, _encode(str(k)))
+                out[k] = _inflate_path(child, manifest, flattened)
+            return out
+        else:  # ListEntry / TupleEntry
+            items = []
+            idx = 0
+            while True:
+                child = _join(path, str(idx))
+                if child in manifest or child in flattened:
+                    items.append(_inflate_path(child, manifest, flattened))
+                    idx += 1
+                else:
+                    break
+            return tuple(items) if isinstance(entry, TupleEntry) else items
+    if path in flattened:
+        return flattened[path]
+    raise KeyError(f"logical path {path!r} missing from both manifest and leaves")

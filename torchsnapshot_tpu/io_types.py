@@ -1,0 +1,133 @@
+"""Core I/O contracts: cost-annotated deferred work items + storage plugin ABC.
+
+This is the load-bearing abstraction of the whole design, mirrored from the
+reference (torchsnapshot/io_types.py:24-120):
+
+- ``BufferStager``: deferred "produce the bytes" (device→host transfer +
+  serialize), annotated with its peak host-memory cost so the scheduler can
+  admit work under a budget.
+- ``BufferConsumer``: the read-side dual — "consume these bytes" (deserialize
+  + place into the target array/object).
+- ``WriteReq``/``ReadReq`` bind a storage path to a stager/consumer;
+  ``ReadReq`` carries an optional byte range for ranged reads.
+- ``StoragePlugin``: async write/read/delete/close against a storage backend.
+
+On TPU the stager's device→host copy is ``jax.Array.copy_to_host_async()``
+per addressable shard followed by ``np.asarray`` in a worker thread — XLA
+transfers complete on their own stream, so cost accounting hooks transfer
+completion, not task creation (see scheduler.py).
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+from concurrent.futures import Executor
+from dataclasses import dataclass, field
+from typing import Any, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Future(Generic[T]):
+    """A placeholder for a value produced after read execution completes
+    (reference io_types.py Future)."""
+
+    __slots__ = ("obj", "_done")
+
+    def __init__(self, obj: Optional[T] = None) -> None:
+        self.obj = obj
+        self._done = False
+
+    def set(self, obj: T) -> None:
+        self.obj = obj
+        self._done = True
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+
+class BufferStager(abc.ABC):
+    """Deferred producer of a write buffer (reference io_types.py:24-38)."""
+
+    @abc.abstractmethod
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> Any:
+        """Produce the bytes to write (bytes / memoryview). May launch
+        device→host transfers; heavy host work should run on ``executor``."""
+
+    @abc.abstractmethod
+    def get_staging_cost_bytes(self) -> int:
+        """Peak host memory consumed while the staged buffer is alive."""
+
+
+class BufferConsumer(abc.ABC):
+    """Read-side dual of BufferStager (reference io_types.py:41-56)."""
+
+    @abc.abstractmethod
+    async def consume_buffer(
+        self, buf: Any, executor: Optional[Executor] = None
+    ) -> None:
+        """Deserialize ``buf`` and place the result into its target."""
+
+    @abc.abstractmethod
+    def get_consuming_cost_bytes(self) -> int:
+        """Peak host memory consumed while the read buffer is alive."""
+
+
+@dataclass
+class WriteReq:
+    path: str
+    buffer_stager: BufferStager
+
+
+@dataclass
+class ReadReq:
+    path: str
+    buffer_consumer: BufferConsumer
+    byte_range: Optional[List[int]] = None  # [start, end)
+
+
+@dataclass
+class WriteIO:
+    path: str
+    buf: Any  # bytes | memoryview
+
+
+@dataclass
+class ReadIO:
+    path: str
+    byte_range: Optional[List[int]] = None
+    buf: Any = field(default=None)  # filled by the plugin
+
+
+class StoragePlugin(abc.ABC):
+    """Async storage backend (reference io_types.py:80-120)."""
+
+    @abc.abstractmethod
+    async def write(self, write_io: WriteIO) -> None: ...
+
+    @abc.abstractmethod
+    async def read(self, read_io: ReadIO) -> None: ...
+
+    @abc.abstractmethod
+    async def delete(self, path: str) -> None: ...
+
+    async def close(self) -> None:
+        pass
+
+    # Sync convenience wrappers (reference io_types.py:107-120)
+    def sync_write(self, write_io: WriteIO) -> None:
+        from .utils.asyncio_utils import run_in_fresh_loop
+
+        run_in_fresh_loop(self.write(write_io))
+
+    def sync_read(self, read_io: ReadIO) -> None:
+        from .utils.asyncio_utils import run_in_fresh_loop
+
+        run_in_fresh_loop(self.read(read_io))
+
+    def sync_close(self) -> None:
+        from .utils.asyncio_utils import run_in_fresh_loop
+
+        run_in_fresh_loop(self.close())

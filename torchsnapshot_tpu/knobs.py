@@ -1,0 +1,136 @@
+"""Tunable knobs with env-var overrides and context-manager test hooks.
+
+TPU-native rebuild of the reference's config surface (torchsnapshot/knobs.py:23-132):
+every constant is overridable via a ``TORCHSNAPSHOT_TPU_`` environment variable,
+and every knob has a context-manager override for tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+_ENV_PREFIX = "TORCHSNAPSHOT_TPU_"
+
+# Names (reference: torchsnapshot/knobs.py:23-38)
+_MAX_CHUNK_SIZE_BYTES = "MAX_CHUNK_SIZE_BYTES"
+_MAX_SHARD_SIZE_BYTES = "MAX_SHARD_SIZE_BYTES"
+_SLAB_SIZE_THRESHOLD_BYTES = "SLAB_SIZE_THRESHOLD_BYTES"
+_MAX_PER_RANK_IO_CONCURRENCY = "MAX_PER_RANK_IO_CONCURRENCY"
+_DISABLE_BATCHING = "DISABLE_BATCHING"
+_PER_RANK_MEMORY_BUDGET_BYTES = "PER_RANK_MEMORY_BUDGET_BYTES"
+_ALLOW_PICKLE_OBJECTS = "ALLOW_PICKLE_OBJECTS"
+_STAGING_THREADS = "STAGING_THREADS"
+
+_DEFAULTS = {
+    # Arrays larger than this are chunked along dim 0 for pipelined I/O
+    # (reference default 512MB, knobs.py:41-46).
+    _MAX_CHUNK_SIZE_BYTES: 512 * 1024 * 1024,
+    # Per-shard subdivision limit for sharded arrays (reference knobs.py:48-53).
+    _MAX_SHARD_SIZE_BYTES: 512 * 1024 * 1024,
+    # Write requests smaller than this are coalesced into slabs
+    # (reference 128MB, knobs.py:55-60).
+    _SLAB_SIZE_THRESHOLD_BYTES: 128 * 1024 * 1024,
+    # Concurrent storage ops per process (reference 16, knobs.py:62-67).
+    _MAX_PER_RANK_IO_CONCURRENCY: 16,
+    _DISABLE_BATCHING: 0,
+    _PER_RANK_MEMORY_BUDGET_BYTES: 0,  # 0 = auto (see scheduler)
+    # Objects that the safe codec can't encode fall back to pickle only when
+    # this is on (default on, for parity with the reference's torch.save path;
+    # reading a pickle payload always requires it).
+    _ALLOW_PICKLE_OBJECTS: 1,
+    # Threads for D2H + serialize staging work (reference 4, scheduler.py:32).
+    _STAGING_THREADS: 4,
+}
+
+_OVERRIDES: dict = {}
+
+
+def _get_int(name: str) -> int:
+    if name in _OVERRIDES:
+        return int(_OVERRIDES[name])
+    env = os.environ.get(_ENV_PREFIX + name)
+    if env is not None:
+        return int(env)
+    return int(_DEFAULTS[name])
+
+
+def get_max_chunk_size_bytes() -> int:
+    return _get_int(_MAX_CHUNK_SIZE_BYTES)
+
+
+def get_max_shard_size_bytes() -> int:
+    return _get_int(_MAX_SHARD_SIZE_BYTES)
+
+
+def get_slab_size_threshold_bytes() -> int:
+    return _get_int(_SLAB_SIZE_THRESHOLD_BYTES)
+
+
+def get_max_per_rank_io_concurrency() -> int:
+    return _get_int(_MAX_PER_RANK_IO_CONCURRENCY)
+
+
+def is_batching_disabled() -> bool:
+    return bool(_get_int(_DISABLE_BATCHING))
+
+
+def get_per_rank_memory_budget_bytes() -> Optional[int]:
+    v = _get_int(_PER_RANK_MEMORY_BUDGET_BYTES)
+    return v if v > 0 else None
+
+
+def is_pickle_allowed() -> bool:
+    return bool(_get_int(_ALLOW_PICKLE_OBJECTS))
+
+
+def get_staging_threads() -> int:
+    return max(1, _get_int(_STAGING_THREADS))
+
+
+@contextlib.contextmanager
+def _override(name: str, value) -> Iterator[None]:
+    # Context-manager override, mirroring reference knobs.py:84-132.
+    had = name in _OVERRIDES
+    prev = _OVERRIDES.get(name)
+    _OVERRIDES[name] = value
+    try:
+        yield
+    finally:
+        if had:
+            _OVERRIDES[name] = prev
+        else:
+            _OVERRIDES.pop(name, None)
+
+
+def override_max_chunk_size_bytes(value: int):
+    return _override(_MAX_CHUNK_SIZE_BYTES, value)
+
+
+def override_max_shard_size_bytes(value: int):
+    return _override(_MAX_SHARD_SIZE_BYTES, value)
+
+
+def override_slab_size_threshold_bytes(value: int):
+    return _override(_SLAB_SIZE_THRESHOLD_BYTES, value)
+
+
+def override_max_per_rank_io_concurrency(value: int):
+    return _override(_MAX_PER_RANK_IO_CONCURRENCY, value)
+
+
+def override_disable_batching(value: bool):
+    return _override(_DISABLE_BATCHING, int(value))
+
+
+def override_per_rank_memory_budget_bytes(value: int):
+    return _override(_PER_RANK_MEMORY_BUDGET_BYTES, value)
+
+
+def override_allow_pickle_objects(value: bool):
+    return _override(_ALLOW_PICKLE_OBJECTS, int(value))
+
+
+def override_staging_threads(value: int):
+    return _override(_STAGING_THREADS, value)

@@ -1,0 +1,45 @@
+"""Replicated-write load balancing across ranks.
+
+Reference: torchsnapshot/partitioner.py:67-213.  The reference all_gathers
+entry metadata, has rank 0 compute a greedy partition, and broadcasts the
+result (partitioner.py:170-192).  Here the partition is a *pure
+deterministic function* of its inputs, so in JAX's multi-controller model
+every process computes the identical assignment locally — the only
+communication needed is one small all_gather of per-rank pre-load bytes
+(non-replicated write volume), matching the reference's pre-load counting
+(partitioner.py:266-270).
+
+Note: sharded jax.Arrays (including fully-replicated ones) never reach this
+partitioner — their dedup+balance happens in the sharded preparer from the
+globally-known sharding metadata with zero communication
+(preparers/sharded.py).  This module only balances *host-side* replicated
+state: numpy arrays, objects, chunked host arrays declared replicated via
+glob patterns.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+
+def partition_replicated_writes(
+    items: Sequence[Tuple[str, int]],
+    world_size: int,
+    preloads: Sequence[int] = (),
+) -> Dict[str, int]:
+    """Assign each replicated logical path to exactly one writer rank.
+
+    ``items``: (logical_path, nbytes) — must be identical on every rank
+    (replication is the caller's invariant).  ``preloads``: per-rank bytes
+    already being written for non-replicated state.  Greedy: largest item
+    first to the least-loaded rank; ties broken by rank for determinism.
+    """
+    loads: List[int] = list(preloads) if preloads else [0] * world_size
+    if len(loads) != world_size:
+        raise ValueError(f"preloads len {len(loads)} != world_size {world_size}")
+    assignment: Dict[str, int] = {}
+    for path, nbytes in sorted(items, key=lambda kv: (-kv[1], kv[0])):
+        writer = min(range(world_size), key=lambda r: (loads[r], r))
+        assignment[path] = writer
+        loads[writer] += nbytes
+    return assignment

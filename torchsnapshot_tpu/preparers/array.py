@@ -1,0 +1,442 @@
+"""Array preparer: write/read planning for host arrays and single-device
+``jax.Array``s, plus the chunked variant for big arrays.
+
+Reference: torchsnapshot/io_preparers/tensor.py:50-409 and
+io_preparers/chunked_tensor.py:36-128.  TPU-native differences:
+
+- The device→host copy is ``jax.Array.copy_to_host_async()`` (launched at
+  staging-admission time on XLA's transfer stream) followed by
+  ``np.asarray`` in a worker thread — the analogue of the reference's CUDA
+  DtoH in a thread pool with the GIL released
+  (io_preparers/tensor.py:249-255).
+- Chunked staging slices the array **on device** (bounded HBM copy) so host
+  memory stays bounded by the chunk size while D2H overlaps storage I/O.
+- Defensive copies for async snapshots apply only to *host* arrays
+  (numpy/torch): a jax.Array is immutable, so its staged bytes can never be
+  mutated by training — the reference's hardest async-safety problem
+  (io_preparers/tensor.py:283-307) disappears by construction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import Executor
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from .. import knobs
+from ..io_types import BufferConsumer, BufferStager, Future, ReadReq, WriteReq
+from ..manifest import ArrayEntry, ChunkedArrayEntry, Shard
+from ..serialization import (
+    BUFFER_PROTOCOL,
+    array_as_memoryview,
+    array_from_buffer,
+    dtype_to_string,
+    serialized_size_bytes,
+    string_to_dtype,
+)
+
+
+def _is_torch_tensor(obj: Any) -> bool:
+    return type(obj).__module__.split(".")[0] == "torch"
+
+
+def _is_jax_array(obj: Any) -> bool:
+    mod = type(obj).__module__.split(".")[0]
+    if mod not in ("jax", "jaxlib"):
+        return False
+    import jax
+
+    return isinstance(obj, jax.Array)
+
+
+def is_array_like(obj: Any) -> bool:
+    if isinstance(obj, np.ndarray):
+        return True
+    if _is_jax_array(obj):
+        return True
+    if _is_torch_tensor(obj):
+        import torch
+
+        return isinstance(obj, torch.Tensor)
+    return False
+
+
+def _to_host_view(obj: Any) -> np.ndarray:
+    """Zero-copy host view when possible (torch CPU → numpy shares memory)."""
+    if isinstance(obj, np.ndarray):
+        return obj
+    if _is_torch_tensor(obj):
+        return obj.detach().cpu().numpy()
+    raise TypeError(type(obj))
+
+
+def array_nbytes(obj: Any) -> int:
+    return serialized_size_bytes(obj.shape, obj.dtype)
+
+
+def array_dtype_str(obj: Any) -> str:
+    return dtype_to_string(obj.dtype)
+
+
+class JaxArrayBufferStager(BufferStager):
+    """Stage a (slice of a) single-device/replicated jax.Array: launch the
+    async D2H transfer, then materialize to numpy in a worker thread."""
+
+    def __init__(self, arr: Any, index: Optional[Tuple] = None, nbytes: int = 0):
+        self.arr = arr
+        self.index = index
+        self.nbytes = nbytes or array_nbytes(arr)
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> memoryview:
+        a = self.arr if self.index is None else self.arr[self.index]
+        try:
+            a.copy_to_host_async()
+        except Exception:
+            pass  # some array types (fully replicated committed) may decline
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            np_arr = await loop.run_in_executor(executor, np.asarray, a)
+        else:
+            np_arr = np.asarray(a)
+        self.arr = None  # drop the device ref as early as possible
+        return array_as_memoryview(np_arr)
+
+    def get_staging_cost_bytes(self) -> int:
+        return self.nbytes
+
+
+class HostArrayBufferStager(BufferStager):
+    """Stage a host (numpy / torch CPU) array. For async snapshots, take a
+    defensive copy at staging time: the caller may mutate the source before
+    storage I/O completes (reference io_preparers/tensor.py:283-307)."""
+
+    def __init__(self, arr: np.ndarray, defensive_copy: bool):
+        self.arr = arr
+        self.defensive_copy = defensive_copy
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> memoryview:
+        arr = self.arr
+        if self.defensive_copy:
+            loop = asyncio.get_running_loop()
+            if executor is not None:
+                arr = await loop.run_in_executor(executor, np.copy, arr)
+            else:
+                arr = np.copy(arr)
+            self.arr = None
+        return array_as_memoryview(arr)
+
+    def get_staging_cost_bytes(self) -> int:
+        return self.arr.nbytes if self.arr is not None else 0
+
+
+def materialize_into_template(np_arr: np.ndarray, obj_out: Any) -> Any:
+    """Place host data into/onto the restore template.
+
+    - numpy template: in-place copy (casts if needed) — keeps the 1× memory
+      property of the reference's in-place load (snapshot.py:743-753).
+    - torch CPU template: in-place copy through the shared-memory view.
+    - jax template: ``device_put`` honoring the template's sharding (the
+      result is a new immutable array).
+    - no template: a fresh numpy array.
+    """
+    if obj_out is None:
+        return np_arr.copy()
+    if isinstance(obj_out, np.ndarray):
+        np.copyto(obj_out, np_arr.reshape(obj_out.shape), casting="unsafe")
+        return obj_out
+    if _is_torch_tensor(obj_out):
+        import torch
+
+        view = obj_out.detach().cpu().numpy()
+        np.copyto(view, np_arr.reshape(view.shape), casting="unsafe")
+        return obj_out
+    if _is_jax_array(obj_out):
+        import jax
+
+        if np.dtype(np_arr.dtype) != np.dtype(obj_out.dtype):
+            np_arr = np_arr.astype(obj_out.dtype)
+        return jax.device_put(np_arr.reshape(obj_out.shape), obj_out.sharding)
+    # Template is some other leaf (e.g. a Python scalar where the saved
+    # state had a traced jax scalar, like TrainState.step before/after the
+    # first jitted step). Behave like "no template": return fresh host data.
+    return np_arr.copy()
+
+
+class ArrayBufferConsumer(BufferConsumer):
+    def __init__(self, entry: ArrayEntry, obj_out: Any, fut: Future):
+        self.entry = entry
+        self.obj_out = obj_out
+        self.fut = fut
+
+    async def consume_buffer(
+        self, buf: Any, executor: Optional[Executor] = None
+    ) -> None:
+        np_arr = array_from_buffer(
+            buf, self.entry.dtype, tuple(self.entry.shape)
+        )
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            result = await loop.run_in_executor(
+                executor, materialize_into_template, np_arr, self.obj_out
+            )
+        else:
+            result = materialize_into_template(np_arr, self.obj_out)
+        self.fut.set(result)
+
+    def get_consuming_cost_bytes(self) -> int:
+        return serialized_size_bytes(self.entry.shape, string_to_dtype(self.entry.dtype))
+
+
+class _TiledConsumer(BufferConsumer):
+    """Consume one byte-range tile into a region of the target host buffer
+    (reference prepare_read_tiled, io_preparers/tensor.py:128-181)."""
+
+    def __init__(
+        self,
+        target_flat: np.ndarray,
+        elem_range: Tuple[int, int],
+        countdown: "_Countdown",
+        tile_bytes: int,
+        dtype: str,
+    ):
+        self.target_flat = target_flat
+        self.elem_range = elem_range
+        self.countdown = countdown
+        self.tile_bytes = tile_bytes
+        self.dtype = dtype
+
+    async def consume_buffer(
+        self, buf: Any, executor: Optional[Executor] = None
+    ) -> None:
+        start, end = self.elem_range
+        np_arr = array_from_buffer(buf, self.dtype, (end - start,))
+        np.copyto(self.target_flat[start:end], np_arr, casting="unsafe")
+        self.countdown.step()
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self.tile_bytes
+
+
+class _Countdown:
+    """Run ``on_zero`` after N consume steps complete (consumers all run on
+    the scheduler's single loop thread, so a plain counter suffices)."""
+
+    def __init__(self, n: int, on_zero) -> None:
+        self.n = n
+        self.on_zero = on_zero
+
+    def step(self) -> None:
+        self.n -= 1
+        if self.n == 0:
+            self.on_zero()
+
+
+class ArrayIOPreparer:
+    """Reference TensorIOPreparer (io_preparers/tensor.py:50-126)."""
+
+    @staticmethod
+    def prepare_write(
+        obj: Any, location: str, replicated: bool, is_async_snapshot: bool
+    ) -> Tuple[ArrayEntry, List[WriteReq]]:
+        entry = ArrayEntry(
+            location=location,
+            serializer=BUFFER_PROTOCOL,
+            dtype=array_dtype_str(obj),
+            shape=list(obj.shape),
+            replicated=replicated,
+        )
+        if _is_jax_array(obj):
+            stager: BufferStager = JaxArrayBufferStager(obj)
+        else:
+            stager = HostArrayBufferStager(
+                _to_host_view(obj), defensive_copy=is_async_snapshot
+            )
+        return entry, [WriteReq(path=location, buffer_stager=stager)]
+
+    @staticmethod
+    def prepare_read(
+        entry: ArrayEntry,
+        obj_out: Any = None,
+        buffer_size_limit_bytes: Optional[int] = None,
+    ) -> Tuple[List[ReadReq], Future]:
+        fut: Future = Future()
+        total = serialized_size_bytes(entry.shape, string_to_dtype(entry.dtype))
+        itemsize = string_to_dtype(entry.dtype).itemsize
+        can_tile = (
+            buffer_size_limit_bytes is not None
+            and total > buffer_size_limit_bytes
+            and entry.byte_range is None
+            and (obj_out is None or isinstance(obj_out, np.ndarray)
+                 or _is_torch_tensor(obj_out))
+        )
+        if can_tile:
+            # Tile the flat element range so host memory stays O(limit).
+            if obj_out is None:
+                target = np.empty(
+                    tuple(entry.shape), dtype=string_to_dtype(entry.dtype)
+                )
+            elif isinstance(obj_out, np.ndarray):
+                target = obj_out
+            else:
+                target = obj_out.detach().cpu().numpy()
+            target_flat = target.reshape(-1)
+            n_elems = target_flat.shape[0]
+            elems_per_tile = max(1, buffer_size_limit_bytes // itemsize)
+            countdown = _Countdown(
+                n=(n_elems + elems_per_tile - 1) // elems_per_tile,
+                on_zero=lambda: fut.set(
+                    target if obj_out is None or isinstance(obj_out, np.ndarray)
+                    else obj_out
+                ),
+            )
+            read_reqs: List[ReadReq] = []
+            for start in range(0, n_elems, elems_per_tile):
+                end = min(start + elems_per_tile, n_elems)
+                read_reqs.append(
+                    ReadReq(
+                        path=entry.location,
+                        byte_range=[start * itemsize, end * itemsize],
+                        buffer_consumer=_TiledConsumer(
+                            target_flat=target_flat,
+                            elem_range=(start, end),
+                            countdown=countdown,
+                            tile_bytes=(end - start) * itemsize,
+                            dtype=entry.dtype,
+                        ),
+                    )
+                )
+            return read_reqs, fut
+        return (
+            [
+                ReadReq(
+                    path=entry.location,
+                    byte_range=list(entry.byte_range) if entry.byte_range else None,
+                    buffer_consumer=ArrayBufferConsumer(entry, obj_out, fut),
+                )
+            ],
+            fut,
+        )
+
+
+def _chunk_dim0(shape: List[int], dtype: Any, max_chunk_bytes: int) -> List[Tuple[int, int]]:
+    """Row ranges [(start, end), ...] such that each chunk ≤ max_chunk_bytes
+    (reference chunk_tensor, io_preparers/chunked_tensor.py:36-65)."""
+    if not shape or shape[0] == 0:
+        return [(0, shape[0] if shape else 0)]
+    row_bytes = serialized_size_bytes(shape[1:], dtype) if len(shape) > 1 else np.dtype(dtype).itemsize
+    rows_per_chunk = max(1, max_chunk_bytes // max(1, row_bytes))
+    return [
+        (r, min(r + rows_per_chunk, shape[0]))
+        for r in range(0, shape[0], rows_per_chunk)
+    ]
+
+
+class ChunkedArrayIOPreparer:
+    """Reference ChunkedTensorIOPreparer (io_preparers/chunked_tensor.py)."""
+
+    @staticmethod
+    def prepare_write(
+        obj: Any, location: str, replicated: bool, is_async_snapshot: bool
+    ) -> Tuple[ChunkedArrayEntry, List[WriteReq]]:
+        dtype = obj.dtype
+        shape = list(obj.shape)
+        ndim = len(shape)
+        chunks: List[Shard] = []
+        write_reqs: List[WriteReq] = []
+        for (r0, r1) in _chunk_dim0(shape, dtype, knobs.get_max_chunk_size_bytes()):
+            chunk_location = f"{location}_{r0}_{r1}"
+            sizes = [r1 - r0] + shape[1:]
+            chunks.append(
+                Shard(
+                    offsets=[r0] + [0] * (ndim - 1),
+                    sizes=sizes,
+                    location=chunk_location,
+                )
+            )
+            nbytes = serialized_size_bytes(sizes, dtype)
+            if _is_jax_array(obj):
+                stager: BufferStager = JaxArrayBufferStager(
+                    obj, index=(slice(r0, r1),), nbytes=nbytes
+                )
+            else:
+                stager = HostArrayBufferStager(
+                    _to_host_view(obj)[r0:r1], defensive_copy=is_async_snapshot
+                )
+            write_reqs.append(WriteReq(path=chunk_location, buffer_stager=stager))
+        entry = ChunkedArrayEntry(
+            dtype=array_dtype_str(obj),
+            shape=shape,
+            chunks=chunks,
+            replicated=replicated,
+        )
+        return entry, write_reqs
+
+    @staticmethod
+    def prepare_read(
+        entry: ChunkedArrayEntry,
+        obj_out: Any = None,
+        buffer_size_limit_bytes: Optional[int] = None,
+    ) -> Tuple[List[ReadReq], Future]:
+        fut: Future = Future()
+        dtype = string_to_dtype(entry.dtype)
+        # Host-side assembly buffer; written into by each chunk's consumer.
+        if isinstance(obj_out, np.ndarray) and obj_out.dtype == dtype:
+            host_buf = obj_out
+        else:
+            host_buf = np.empty(tuple(entry.shape), dtype=dtype)
+
+        def on_done() -> None:
+            if host_buf is obj_out:
+                fut.set(obj_out)
+            else:
+                fut.set(materialize_into_template(host_buf, obj_out))
+
+        countdown = _Countdown(n=len(entry.chunks), on_zero=on_done)
+        read_reqs: List[ReadReq] = []
+        for chunk in entry.chunks:
+            r0 = chunk.offsets[0]
+            r1 = r0 + chunk.sizes[0]
+            read_reqs.append(
+                ReadReq(
+                    path=chunk.location,
+                    byte_range=list(chunk.byte_range) if chunk.byte_range else None,
+                    buffer_consumer=_ChunkConsumer(
+                        host_buf=host_buf,
+                        row_range=(r0, r1),
+                        sizes=list(chunk.sizes),
+                        dtype=entry.dtype,
+                        countdown=countdown,
+                    ),
+                )
+            )
+        return read_reqs, fut
+
+
+class _ChunkConsumer(BufferConsumer):
+    def __init__(self, host_buf, row_range, sizes, dtype, countdown):
+        self.host_buf = host_buf
+        self.row_range = row_range
+        self.sizes = sizes
+        self.dtype = dtype
+        self.countdown = countdown
+
+    async def consume_buffer(
+        self, buf: Any, executor: Optional[Executor] = None
+    ) -> None:
+        r0, r1 = self.row_range
+        np_arr = array_from_buffer(buf, self.dtype, tuple(self.sizes))
+
+        def copy() -> None:
+            np.copyto(self.host_buf[r0:r1], np_arr, casting="unsafe")
+
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            await loop.run_in_executor(executor, copy)
+        else:
+            copy()
+        self.countdown.step()
+
+    def get_consuming_cost_bytes(self) -> int:
+        return serialized_size_bytes(self.sizes, string_to_dtype(self.dtype))

@@ -1,0 +1,81 @@
+"""Hyperrectangle (box) algebra for shard overlap / resharding.
+
+Reference: the overlap-region math in torchsnapshot/io_preparers/
+sharded_tensor.py:80-127 (`_shards_get_overlap_region_wrt_saved_tensor`) and
+`_OverlappingRegion.get_views` (:285-298), generalized to N-d boxes given by
+(offsets, sizes) — the same algebra covers ShardedTensor, DTensor and any
+``jax.sharding.NamedSharding`` layout, including one array dim sharded over
+multiple mesh axes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+# A box is (offsets, sizes), one entry per dim.
+Box = Tuple[Tuple[int, ...], Tuple[int, ...]]
+
+
+def make_box(offsets: Sequence[int], sizes: Sequence[int]) -> Box:
+    return tuple(int(o) for o in offsets), tuple(int(s) for s in sizes)
+
+
+def index_to_box(index: Tuple, shape: Sequence[int]) -> Box:
+    """Normalize a jax indexing tuple (from
+    ``Sharding.devices_indices_map``) into a box."""
+    offsets: List[int] = []
+    sizes: List[int] = []
+    index = tuple(index) + (slice(None),) * (len(shape) - len(index))
+    for idx, dim in zip(index, shape):
+        if isinstance(idx, slice):
+            start, stop, step = idx.indices(int(dim))
+            if step != 1:
+                raise ValueError(f"strided shard index unsupported: {idx}")
+            offsets.append(start)
+            sizes.append(stop - start)
+        else:  # int index — treat as size-1 slice
+            offsets.append(int(idx))
+            sizes.append(1)
+    return tuple(offsets), tuple(sizes)
+
+
+def box_nelems(box: Box) -> int:
+    n = 1
+    for s in box[1]:
+        n *= s
+    return n
+
+
+def box_intersect(a: Box, b: Box) -> Optional[Box]:
+    offsets: List[int] = []
+    sizes: List[int] = []
+    for (ao, as_), (bo, bs) in zip(zip(*a), zip(*b)):
+        lo = max(ao, bo)
+        hi = min(ao + as_, bo + bs)
+        if hi <= lo:
+            return None
+        offsets.append(lo)
+        sizes.append(hi - lo)
+    return tuple(offsets), tuple(sizes)
+
+
+def relative_slices(inner: Box, outer: Box) -> Tuple[slice, ...]:
+    """Slices selecting ``inner`` within an array whose region is ``outer``."""
+    return tuple(
+        slice(io - oo, io - oo + isz)
+        for io, isz, oo in zip(inner[0], inner[1], outer[0])
+    )
+
+
+def is_dim0_slab(inner: Box, outer: Box) -> bool:
+    """True iff ``inner`` spans the full extent of ``outer`` in every dim
+    except (possibly) dim 0 — i.e. it is a contiguous row-range of the
+    C-contiguous blob storing ``outer``."""
+    for d, (io, isz, oo, osz) in enumerate(
+        zip(inner[0], inner[1], outer[0], outer[1])
+    ):
+        if d == 0:
+            continue
+        if io != oo or isz != osz:
+            return False
+    return True

@@ -1,0 +1,351 @@
+"""Budgeted async execution engine for write/read pipelines.
+
+TPU-native analogue of the reference scheduler (torchsnapshot/
+scheduler.py:222-463).  Same discipline:
+
+- Write path: ``ready_for_staging → staging → ready_for_io → io → done``.
+  A request is admitted to staging iff its cost fits the remaining host
+  memory budget, or the pipeline is empty (guaranteed progress for oversized
+  items) (reference scheduler.py:266-277).  The budget is debited by the
+  declared staging cost and corrected to the actual buffer size once staging
+  completes (reference scheduler.py:308-312).
+- Concurrent storage ops are capped per process (default 16,
+  knobs.get_max_per_rank_io_concurrency; reference scheduler.py:279-290).
+- Once all staging completes, control returns to the caller with a
+  ``PendingIOWork`` while storage I/O keeps draining — this is what makes
+  ``async_take`` "unblock after staging" fall out of the same code path
+  (reference scheduler.py:299,334-339).
+- Read path is the mirror image: admit reads under the consuming-cost
+  budget, chain each completed read into a consume task (reference
+  scheduler.py:386-446).
+
+Design difference vs the reference: instead of nesting event loops in the
+caller's thread, the pipeline runs on a dedicated event-loop *thread* owned
+by the scheduler.  The training thread regains control the moment staging
+finishes; residual I/O keeps running on the loop thread with no involvement
+from the caller — which is exactly the execution model async snapshots need
+on TPU (the background work never issues collectives, so it can never race
+with XLA's ICI traffic).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Awaitable, Callable, List, Optional
+
+import psutil
+
+from . import knobs
+from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+
+logger = logging.getLogger(__name__)
+
+_MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
+_AVAILABLE_MEMORY_MULTIPLIER = 0.6
+
+
+def get_process_memory_budget_bytes(local_process_count: int = 1) -> int:
+    """Host-memory budget for staging (reference scheduler.py:47-67)."""
+    override = knobs.get_per_rank_memory_budget_bytes()
+    if override is not None:
+        return override
+    available = psutil.virtual_memory().available
+    budget = int(available * _AVAILABLE_MEMORY_MULTIPLIER / max(1, local_process_count))
+    return min(budget, _MAX_PER_RANK_MEMORY_BUDGET_BYTES)
+
+
+class _LoopThread:
+    """A dedicated event-loop thread that outlives the submitting call."""
+
+    def __init__(self, name: str = "tsnp-io-loop") -> None:
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def submit(self, coro: Awaitable) -> concurrent.futures.Future:
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def shutdown(self) -> None:
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join()
+        self.loop.close()
+
+
+class _Budget:
+    def __init__(self, total: int) -> None:
+        self.total = total
+        self.used = 0
+
+    def fits(self, cost: int) -> bool:
+        return self.used + cost <= self.total
+
+    def debit(self, cost: int) -> None:
+        self.used += cost
+
+    def credit(self, cost: int) -> None:
+        self.used -= cost
+
+
+class _WritePipeline:
+    """One write request's journey through the pipeline (reference
+    scheduler.py:70-97)."""
+
+    __slots__ = ("write_req", "staging_cost", "buf", "buf_size")
+
+    def __init__(self, write_req: WriteReq) -> None:
+        self.write_req = write_req
+        self.staging_cost = write_req.buffer_stager.get_staging_cost_bytes()
+        self.buf = None
+        self.buf_size = 0
+
+
+class PendingIOWork:
+    """Handle for storage I/O still draining after staging completed
+    (reference PendingIOWork, scheduler.py:196-216)."""
+
+    def __init__(
+        self,
+        fut: concurrent.futures.Future,
+        loop_thread: _LoopThread,
+        executor: ThreadPoolExecutor,
+        stats: dict,
+    ) -> None:
+        self._fut = fut
+        self._loop_thread = loop_thread
+        self._executor = executor
+        self._stats = stats
+        self._completed = False
+
+    def sync_complete(self) -> None:
+        if self._completed:
+            return
+        try:
+            self._fut.result()
+        finally:
+            self._completed = True
+            self._executor.shutdown(wait=False)
+            self._loop_thread.shutdown()
+        elapsed = self._stats.get("end_ts", time.monotonic()) - self._stats["begin_ts"]
+        gb = self._stats["bytes_written"] / 1e9
+        if elapsed > 0 and gb > 0:
+            logger.info(
+                "Wrote %.3f GB in %.2fs (%.2f GB/s)", gb, elapsed, gb / elapsed
+            )
+
+    @property
+    def bytes_written(self) -> int:
+        return self._stats["bytes_written"]
+
+
+async def _execute_write_pipelines(
+    pipelines: List[_WritePipeline],
+    storage: StoragePlugin,
+    budget: _Budget,
+    executor: ThreadPoolExecutor,
+    staging_done: threading.Event,
+    stats: dict,
+) -> None:
+    ready_for_staging = deque(pipelines)
+    ready_for_io: deque = deque()
+    staging_tasks: set = set()
+    io_tasks: set = set()
+    io_concurrency = knobs.get_max_per_rank_io_concurrency()
+
+    async def stage_one(p: _WritePipeline) -> _WritePipeline:
+        p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
+        p.buf_size = len(memoryview(p.buf).cast("B")) if p.buf is not None else 0
+        return p
+
+    async def write_one(p: _WritePipeline) -> _WritePipeline:
+        await storage.write(WriteIO(path=p.write_req.path, buf=p.buf))
+        return p
+
+    def dispatch_staging() -> None:
+        # Admit under budget; if nothing is in flight and nothing staged,
+        # admit one oversized item to guarantee progress
+        # (reference scheduler.py:266-277).
+        while ready_for_staging:
+            cost = ready_for_staging[0].staging_cost
+            pipeline_empty = not staging_tasks and not io_tasks and not ready_for_io
+            if budget.fits(cost) or pipeline_empty:
+                p = ready_for_staging.popleft()
+                budget.debit(p.staging_cost)
+                staging_tasks.add(asyncio.ensure_future(stage_one(p)))
+            else:
+                break
+
+    def dispatch_io() -> None:
+        while ready_for_io and len(io_tasks) < io_concurrency:
+            p = ready_for_io.popleft()
+            io_tasks.add(asyncio.ensure_future(write_one(p)))
+
+    try:
+        while ready_for_staging or staging_tasks or ready_for_io or io_tasks:
+            dispatch_staging()
+            dispatch_io()
+            if not staging_tasks and not io_tasks:
+                continue
+            done, _ = await asyncio.wait(
+                staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                if task in staging_tasks:
+                    staging_tasks.discard(task)
+                    p = task.result()
+                    # correct declared cost to actual buffer size
+                    # (reference scheduler.py:308-312)
+                    budget.credit(p.staging_cost - p.buf_size)
+                    ready_for_io.append(p)
+                else:
+                    io_tasks.discard(task)
+                    p = task.result()
+                    stats["bytes_written"] += p.buf_size
+                    budget.credit(p.buf_size)
+                    p.buf = None
+            if not ready_for_staging and not staging_tasks:
+                staging_done.set()
+        stats["end_ts"] = time.monotonic()
+        staging_done.set()
+    except BaseException:
+        staging_done.set()  # unblock the waiting caller; error surfaces via fut
+        for t in staging_tasks | io_tasks:
+            t.cancel()
+        raise
+
+
+def sync_execute_write_reqs(
+    write_reqs: List[WriteReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> PendingIOWork:
+    """Stage all write requests under the memory budget; return once staging
+    completes, with residual storage I/O draining in the background
+    (reference sync_execute_write_reqs, scheduler.py:342-357)."""
+    executor = ThreadPoolExecutor(
+        max_workers=knobs.get_staging_threads(), thread_name_prefix="tsnp-staging"
+    )
+    # Largest-first staging keeps the budget well-packed and starts the
+    # biggest D2H transfers earliest.
+    pipelines = sorted(
+        (_WritePipeline(wr) for wr in write_reqs),
+        key=lambda p: p.staging_cost,
+        reverse=True,
+    )
+    budget = _Budget(memory_budget_bytes)
+    staging_done = threading.Event()
+    stats = {"bytes_written": 0, "begin_ts": time.monotonic()}
+    loop_thread = _LoopThread()
+    fut = loop_thread.submit(
+        _execute_write_pipelines(
+            pipelines, storage, budget, executor, staging_done, stats
+        )
+    )
+    while not staging_done.wait(timeout=0.05):
+        if fut.done():
+            break
+    pending = PendingIOWork(fut, loop_thread, executor, stats)
+    if fut.done() and fut.exception() is not None:
+        pending.sync_complete()  # raises
+    return pending
+
+
+class _ReadPipeline:
+    __slots__ = ("read_req", "consuming_cost", "buf")
+
+    def __init__(self, read_req: ReadReq) -> None:
+        self.read_req = read_req
+        self.consuming_cost = read_req.buffer_consumer.get_consuming_cost_bytes()
+        self.buf = None
+
+
+async def _execute_read_pipelines(
+    pipelines: List[_ReadPipeline],
+    storage: StoragePlugin,
+    budget: _Budget,
+    executor: ThreadPoolExecutor,
+) -> None:
+    ready_for_io = deque(pipelines)
+    io_tasks: set = set()
+    consume_tasks: set = set()
+    io_concurrency = knobs.get_max_per_rank_io_concurrency()
+
+    async def read_one(p: _ReadPipeline) -> _ReadPipeline:
+        read_io = ReadIO(path=p.read_req.path, byte_range=p.read_req.byte_range)
+        await storage.read(read_io)
+        p.buf = read_io.buf
+        return p
+
+    async def consume_one(p: _ReadPipeline) -> _ReadPipeline:
+        await p.read_req.buffer_consumer.consume_buffer(p.buf, executor)
+        p.buf = None
+        return p
+
+    try:
+        while ready_for_io or io_tasks or consume_tasks:
+            # admit reads under the consuming-cost budget
+            # (reference scheduler.py:386-446)
+            while ready_for_io and len(io_tasks) < io_concurrency:
+                cost = ready_for_io[0].consuming_cost
+                pipeline_empty = not io_tasks and not consume_tasks
+                if budget.fits(cost) or pipeline_empty:
+                    p = ready_for_io.popleft()
+                    budget.debit(p.consuming_cost)
+                    io_tasks.add(asyncio.ensure_future(read_one(p)))
+                else:
+                    break
+            if not io_tasks and not consume_tasks:
+                continue
+            done, _ = await asyncio.wait(
+                io_tasks | consume_tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                if task in io_tasks:
+                    io_tasks.discard(task)
+                    consume_tasks.add(
+                        asyncio.ensure_future(consume_one(task.result()))
+                    )
+                else:
+                    consume_tasks.discard(task)
+                    p = task.result()
+                    budget.credit(p.consuming_cost)
+    except BaseException:
+        for t in io_tasks | consume_tasks:
+            t.cancel()
+        raise
+
+
+def sync_execute_read_reqs(
+    read_reqs: List[ReadReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> None:
+    """Execute read requests under the memory budget (reference
+    sync_execute_read_reqs, scheduler.py:449-463)."""
+    executor = ThreadPoolExecutor(
+        max_workers=knobs.get_staging_threads(), thread_name_prefix="tsnp-consume"
+    )
+    pipelines = [_ReadPipeline(rr) for rr in read_reqs]
+    budget = _Budget(memory_budget_bytes)
+    loop_thread = _LoopThread(name="tsnp-read-loop")
+    fut = loop_thread.submit(
+        _execute_read_pipelines(pipelines, storage, budget, executor)
+    )
+    try:
+        fut.result()
+    finally:
+        executor.shutdown(wait=False)
+        loop_thread.shutdown()

@@ -1,0 +1,218 @@
+"""Zero-copy array (de)serialization + a safe object codec.
+
+TPU-native analogue of the reference's serialization layer
+(torchsnapshot/serialization.py:34-477), redesigned for JAX host buffers:
+
+- Arrays are stored as raw little-endian C-contiguous bytes; dtype/shape live
+  in the manifest.  ``memoryview`` over the numpy buffer gives zero-copy
+  writes (reference ``tensor_as_memoryview``, serialization.py:177-251).
+- bfloat16 (and fp8 variants) are first-class via ``ml_dtypes`` — no
+  UntypedStorage tricks needed: numpy handles the buffer protocol for these
+  extension dtypes directly.
+- The object fallback is NOT pickle-by-default: we use a self-describing
+  msgpack codec covering containers/primitives/numpy scalars+arrays
+  (reference uses torch.save/pickle, serialization.py:268-275).  Arbitrary
+  objects fall back to pickle only when the ``ALLOW_PICKLE_OBJECTS`` knob is
+  on; payloads are tagged so readers can refuse pickles.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Tuple
+
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    _ML_DTYPES = {
+        "bfloat16": np.dtype(ml_dtypes.bfloat16),
+        "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+        "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+        "float8_e4m3fnuz": np.dtype(getattr(ml_dtypes, "float8_e4m3fnuz", ml_dtypes.float8_e4m3fn)),
+        "int4": np.dtype(ml_dtypes.int4),
+        "uint4": np.dtype(ml_dtypes.uint4),
+    }
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    _ML_DTYPES = {}
+
+from . import knobs
+
+# Serializer tags recorded in the manifest (reference Serializer enum,
+# serialization.py:155-159).
+BUFFER_PROTOCOL = "buffer_protocol"
+SAFE_OBJECT = "safe_object"  # msgpack codec
+PICKLE_OBJECT = "pickle"
+
+# dtype-string table (reference serialization.py:34-110). We use numpy dtype
+# names directly; ml_dtypes extension dtypes keep their canonical names.
+_STD_DTYPES = [
+    "float16", "float32", "float64",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "bool", "complex64", "complex128",
+]
+
+
+def dtype_to_string(dtype: Any) -> str:
+    dt = np.dtype(dtype)
+    for name, mdt in _ML_DTYPES.items():
+        if dt == mdt:
+            return name
+    name = dt.name
+    if name in _STD_DTYPES:
+        return name
+    raise ValueError(f"unsupported dtype for serialization: {dtype!r}")
+
+
+def string_to_dtype(s: str) -> np.dtype:
+    if s in _ML_DTYPES:
+        return _ML_DTYPES[s]
+    if s in _STD_DTYPES:
+        return np.dtype(s)
+    raise ValueError(f"unknown serialized dtype: {s!r}")
+
+
+def array_as_memoryview(arr: np.ndarray) -> memoryview:
+    """Zero-copy view of a host array's bytes (contiguous + little-endian
+    normalized; copies only when layout requires it)."""
+    if arr.dtype.byteorder == ">":  # big-endian: normalize (rare)
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    try:
+        return memoryview(arr).cast("B")
+    except (ValueError, TypeError):
+        # Extension dtypes (bfloat16, fp8, ...) don't implement the buffer
+        # protocol; a uint8 view of the same memory does.
+        return memoryview(arr.reshape(-1).view(np.uint8))
+
+
+def array_from_buffer(buf: Any, dtype_str: str, shape: Tuple[int, ...]) -> np.ndarray:
+    """Zero-copy reconstruction from raw bytes (reference
+    tensor_from_memoryview, serialization.py:254-265). The returned array
+    shares memory with ``buf`` and is read-only if ``buf`` is."""
+    dtype = string_to_dtype(dtype_str)
+    arr = np.frombuffer(buf, dtype=dtype)
+    return arr.reshape(shape)
+
+
+def serialized_size_bytes(shape, dtype: Any) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * np.dtype(dtype).itemsize
+
+
+# ---------------------------------------------------------------------------
+# Safe object codec (msgpack with extension types). Covers: None, bool, int,
+# float, str, bytes, list, tuple, set, frozenset, dict (any hashable encodable
+# keys), complex, numpy scalars and ndarrays (incl. bfloat16 via raw-bytes ext).
+# ---------------------------------------------------------------------------
+
+import msgpack
+
+_EXT_TUPLE = 1
+_EXT_SET = 2
+_EXT_FROZENSET = 3
+_EXT_COMPLEX = 4
+_EXT_NDARRAY = 5
+_EXT_NPSCALAR = 6
+_EXT_BIGINT = 7
+_EXT_DICT_NONSTR = 8  # dict with non-string keys: list of [k, v] pairs
+
+
+def _default(obj: Any) -> Any:
+    if isinstance(obj, tuple):
+        return msgpack.ExtType(_EXT_TUPLE, _pack(list(obj)))
+    if isinstance(obj, set):
+        return msgpack.ExtType(_EXT_SET, _pack(sorted(obj, key=repr)))
+    if isinstance(obj, frozenset):
+        return msgpack.ExtType(_EXT_FROZENSET, _pack(sorted(obj, key=repr)))
+    if isinstance(obj, complex):
+        return msgpack.ExtType(_EXT_COMPLEX, _pack([obj.real, obj.imag]))
+    if isinstance(obj, np.ndarray):
+        payload = _pack(
+            [dtype_to_string(obj.dtype), list(obj.shape),
+             array_as_memoryview(obj).tobytes()]
+        )
+        return msgpack.ExtType(_EXT_NDARRAY, payload)
+    if isinstance(obj, np.generic):
+        arr = np.asarray(obj)
+        payload = _pack([dtype_to_string(arr.dtype), arr.tobytes()])
+        return msgpack.ExtType(_EXT_NPSCALAR, payload)
+    if isinstance(obj, int):
+        # out-of-range ints reach here (msgpack caps at 64-bit)
+        return msgpack.ExtType(_EXT_BIGINT, str(obj).encode())
+    if isinstance(obj, dict):
+        # only reached when strict_map_key rejects: encode as pair list
+        return msgpack.ExtType(_EXT_DICT_NONSTR, _pack([[k, v] for k, v in obj.items()]))
+    raise TypeError(f"unencodable object of type {type(obj)}")
+
+
+def _ext_hook(code: int, data: bytes) -> Any:
+    if code == _EXT_TUPLE:
+        return tuple(_unpack(data))
+    if code == _EXT_SET:
+        return set(_unpack(data))
+    if code == _EXT_FROZENSET:
+        return frozenset(_unpack(data))
+    if code == _EXT_COMPLEX:
+        re, im = _unpack(data)
+        return complex(re, im)
+    if code == _EXT_NDARRAY:
+        dtype_str, shape, raw = _unpack(data)
+        return array_from_buffer(raw, dtype_str, tuple(shape)).copy()
+    if code == _EXT_NPSCALAR:
+        dtype_str, raw = _unpack(data)
+        return np.frombuffer(raw, dtype=string_to_dtype(dtype_str))[0]
+    if code == _EXT_BIGINT:
+        return int(data.decode())
+    if code == _EXT_DICT_NONSTR:
+        return {k: v for k, v in _unpack(data)}
+    return msgpack.ExtType(code, data)
+
+
+def _pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, default=_default, strict_types=True, use_bin_type=True)
+
+
+def _unpack(data: Any) -> Any:
+    return msgpack.unpackb(
+        data, ext_hook=_ext_hook, raw=False, strict_map_key=False
+    )
+
+
+def serialize_object(obj: Any) -> Tuple[bytes, str]:
+    """Serialize an arbitrary object; returns (payload, serializer_tag).
+
+    Tries the safe msgpack codec first; falls back to pickle when the knob
+    allows (reference object path uses torch.save unconditionally,
+    io_preparers/object.py:69-82)."""
+    try:
+        return _pack(obj), SAFE_OBJECT
+    except (TypeError, ValueError, OverflowError):
+        pass
+    if not knobs.is_pickle_allowed():
+        raise TypeError(
+            f"object of type {type(obj)} is not encodable by the safe codec "
+            "and ALLOW_PICKLE_OBJECTS is disabled"
+        )
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue(), PICKLE_OBJECT
+
+
+def deserialize_object(payload: Any, serializer: str) -> Any:
+    if serializer == SAFE_OBJECT:
+        return _unpack(bytes(payload))
+    if serializer == PICKLE_OBJECT:
+        if not knobs.is_pickle_allowed():
+            raise RuntimeError(
+                "snapshot contains a pickle payload but ALLOW_PICKLE_OBJECTS "
+                "is disabled"
+            )
+        return pickle.loads(bytes(payload))
+    raise ValueError(f"unknown object serializer: {serializer!r}")

@@ -1,0 +1,511 @@
+"""The user-facing Snapshot API: take / async_take / restore / read_object.
+
+TPU-native rebuild of the reference's top layer (torchsnapshot/
+snapshot.py:112-1072).  The orchestration mirrors the reference call stacks
+(SURVEY §3) with JAX-native replacements:
+
+- control plane (path coalescing, key gathers, manifests) goes through a
+  ``Coordinator`` — the jax.distributed KV service, not NCCL collectives,
+- device→host staging is XLA async transfer inside the budgeted scheduler,
+- the commit point is identical: ``.snapshot_metadata`` written by rank 0
+  only after every rank finished its writes (reference snapshot.py:202-209)
+  — a snapshot without it is by definition incomplete (snapshot.py:849-854),
+- ``async_take`` returns once staging completes; storage I/O drains on the
+  scheduler's loop thread and a background thread runs the commit barrier
+  purely over KV — no collectives, so it can never race with training's
+  ICI traffic (the reference's constraint at snapshot.py:1010 holds by
+  construction).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import knobs
+from .batcher import batch_read_requests, batch_write_requests
+from .coordination import Coordinator, get_default_coordinator
+from .event import Event
+from .event_handlers import log_event
+from .flatten import flatten, inflate
+from .io_types import Future, ReadReq, WriteIO, WriteReq
+from .manifest import (
+    MANIFEST_VERSION,
+    Entry,
+    Manifest,
+    PrimitiveEntry,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    entry_from_dict,
+    is_container_entry,
+)
+from .manifest_ops import consolidate_manifests, get_manifest_for_rank
+from .partitioner import partition_replicated_writes
+from .preparers import path_is_replicated, prepare_read, prepare_write
+from .scheduler import (
+    PendingIOWork,
+    get_process_memory_budget_bytes,
+    sync_execute_read_reqs,
+    sync_execute_write_reqs,
+)
+from .stateful import RNGState, Stateful
+from .storage import url_to_storage_plugin
+
+logger = logging.getLogger(__name__)
+
+SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
+AppState = Dict[str, Stateful]
+
+
+def _validate_app_state(app_state: Dict[str, Any]) -> None:
+    # reference snapshot.py:672-690
+    for key, value in app_state.items():
+        if not (hasattr(value, "state_dict") and hasattr(value, "load_state_dict")):
+            raise TypeError(
+                f"app_state[{key!r}] (type {type(value)}) does not implement "
+                "the Stateful protocol (state_dict/load_state_dict); wrap "
+                "plain values in StateDict or pytrees in PyTreeState"
+            )
+
+
+class Snapshot:
+    def __init__(
+        self, path: str, coordinator: Optional[Coordinator] = None
+    ) -> None:
+        self.path = path
+        self._coordinator = coordinator or get_default_coordinator()
+        self._metadata_cache: Optional[SnapshotMetadata] = None
+
+    # ------------------------------------------------------------------ take
+
+    @classmethod
+    def take(
+        cls,
+        path: str,
+        app_state: AppState,
+        replicated: Sequence[str] = (),
+        coordinator: Optional[Coordinator] = None,
+    ) -> "Snapshot":
+        """Synchronous distributed save (reference Snapshot.take,
+        snapshot.py:112-228)."""
+        coordinator = coordinator or get_default_coordinator()
+        with log_event(
+            Event("take", {"path": path, "rank": coordinator.rank})
+        ):
+            metadata, pending_io, storage, commit_uid = cls._take_impl(
+                path, app_state, replicated, coordinator, is_async=False
+            )
+            pending_io.sync_complete()
+            # commit: all ranks done writing → rank 0 writes metadata
+            # (reference snapshot.py:202-209)
+            coordinator.barrier()
+            if coordinator.rank == 0:
+                storage.sync_write(
+                    WriteIO(
+                        path=SNAPSHOT_METADATA_FNAME,
+                        buf=metadata.to_yaml().encode(),
+                    )
+                )
+            coordinator.barrier()
+            storage.sync_close()
+        snapshot = cls(path, coordinator)
+        snapshot._metadata_cache = metadata
+        return snapshot
+
+    @classmethod
+    def async_take(
+        cls,
+        path: str,
+        app_state: AppState,
+        replicated: Sequence[str] = (),
+        coordinator: Optional[Coordinator] = None,
+    ) -> "PendingSnapshot":
+        """Unblock-early save: returns once all state is staged in host
+        memory; storage I/O + commit happen in the background (reference
+        Snapshot.async_take, snapshot.py:229-318)."""
+        coordinator = coordinator or get_default_coordinator()
+        with log_event(
+            Event("async_take", {"path": path, "rank": coordinator.rank})
+        ):
+            metadata, pending_io, storage, commit_uid = cls._take_impl(
+                path, app_state, replicated, coordinator, is_async=True
+            )
+        return PendingSnapshot(
+            path=path,
+            metadata=metadata,
+            pending_io_work=pending_io,
+            storage=storage,
+            coordinator=coordinator,
+            commit_uid=commit_uid,
+        )
+
+    @classmethod
+    def _take_impl(
+        cls,
+        path: str,
+        app_state: AppState,
+        replicated: Sequence[str],
+        coordinator: Coordinator,
+        is_async: bool,
+    ) -> Tuple[SnapshotMetadata, PendingIOWork, Any, str]:
+        # reference _take_impl, snapshot.py:517-635
+        rank, world = coordinator.rank, coordinator.world_size
+        _validate_app_state(app_state)
+
+        # path + replicated coalescing across ranks
+        # (reference _coalesce_path_and_replicated, snapshot.py:858-894)
+        path0 = coordinator.broadcast_object(path, src=0)
+        if path0 != path:
+            logger.warning(
+                "rank %d: snapshot path %r differs from rank 0's %r; using "
+                "rank 0's", rank, path, path0
+            )
+            path = path0
+        if world > 1:
+            gathered_globs = coordinator.all_gather_object(sorted(set(replicated)))
+            replicated_globs = sorted(
+                set(gathered_globs[0]).intersection(*map(set, gathered_globs[1:]))
+            )
+            if set(replicated) != set(replicated_globs):
+                logger.warning(
+                    "rank %d: replicated globs differ across ranks; using the "
+                    "intersection %r", rank, replicated_globs
+                )
+        else:
+            replicated_globs = sorted(set(replicated))
+
+        storage = url_to_storage_plugin(path)
+
+        # gather the global key list; serialize per-key state_dict() calls
+        # with barriers in case a Stateful's state_dict performs collectives
+        # (reference _gather_keys, snapshot.py:552-568)
+        local_keys = sorted(app_state.keys())
+        if world > 1:
+            global_keys = sorted(
+                set().union(*coordinator.all_gather_object(local_keys))
+            )
+        else:
+            global_keys = local_keys
+        manifest: Manifest = {}
+        flattened: Dict[str, Any] = {}
+        for key in global_keys:
+            if key in app_state:
+                m, f = flatten(app_state[key].state_dict(), prefix=key)
+                manifest.update(m)
+                flattened.update(f)
+            if world > 1:
+                coordinator.barrier()
+
+        # plan writes per leaf (reference prepare_write dispatch,
+        # io_preparer.py:82-147)
+        entries: Dict[str, Entry] = {}
+        write_reqs: List[WriteReq] = []
+        repl_reqs: Dict[str, List[WriteReq]] = {}
+        repl_items: List[Tuple[str, int]] = []
+        local_bytes = 0
+        for lpath in sorted(flattened.keys()):
+            obj = flattened[lpath]
+            repl = path_is_replicated(lpath, replicated_globs)
+            entry, reqs = prepare_write(
+                obj=obj,
+                logical_path=lpath,
+                rank=rank,
+                replicated=repl,
+                is_async_snapshot=is_async,
+                process_index=rank,
+                process_count=world,
+            )
+            entries[lpath] = entry
+            cost = sum(r.buffer_stager.get_staging_cost_bytes() for r in reqs)
+            if repl and not isinstance(entry, ShardedArrayEntry):
+                repl_reqs[lpath] = reqs
+                repl_items.append((lpath, cost))
+            else:
+                write_reqs.extend(reqs)
+                local_bytes += cost
+
+        # balance replicated host-state writes across ranks
+        # (reference partition_write_reqs, partitioner.py:216-310)
+        if repl_items:
+            preloads = (
+                coordinator.all_gather_object(local_bytes)
+                if world > 1
+                else [local_bytes]
+            )
+            assignment = partition_replicated_writes(repl_items, world, preloads)
+            for lpath, reqs in repl_reqs.items():
+                if assignment[lpath] == rank:
+                    write_reqs.extend(reqs)
+                else:
+                    # Only the writer keeps the entry: batching may re-point
+                    # the writer's entry at a slab location, and the global
+                    # manifest must carry exactly the written copy
+                    # (consolidation dedups replicated entries to one rank).
+                    del entries[lpath]
+
+        # coalesce small writes into slabs (reference batcher.py:204-355)
+        if not knobs.is_batching_disabled():
+            entries, write_reqs = batch_write_requests(entries, write_reqs, rank)
+
+        # gather per-rank manifests; every rank can build the global view
+        # deterministically (reference _gather_manifest, snapshot.py:948-961)
+        local_manifest_d = {
+            lpath: e.to_dict() for lpath, e in {**manifest, **entries}.items()
+        }
+        if world > 1:
+            gathered_manifests = coordinator.all_gather_object(local_manifest_d)
+        else:
+            gathered_manifests = [local_manifest_d]
+        global_manifest = consolidate_manifests(
+            [
+                {k: entry_from_dict(v) for k, v in md.items()}
+                for md in gathered_manifests
+            ]
+        )
+        metadata = SnapshotMetadata(
+            version=MANIFEST_VERSION, world_size=world, manifest=global_manifest
+        )
+
+        commit_uid = coordinator._next_uid("commit")
+        budget = get_process_memory_budget_bytes()
+        pending_io = sync_execute_write_reqs(write_reqs, storage, budget, rank)
+        return metadata, pending_io, storage, commit_uid
+
+    # --------------------------------------------------------------- restore
+
+    @property
+    def metadata(self) -> SnapshotMetadata:
+        # reference snapshot.py:96-110,842-854
+        if self._metadata_cache is None:
+            from .io_types import ReadIO
+
+            storage = url_to_storage_plugin(self.path)
+            try:
+                read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+                storage.sync_read(read_io)
+            except Exception as e:
+                raise RuntimeError(
+                    f"failed to read {SNAPSHOT_METADATA_FNAME} under "
+                    f"{self.path!r} — the snapshot is incomplete or was "
+                    f"aborted before commit ({e!r})"
+                ) from e
+            finally:
+                storage.sync_close()
+            self._metadata_cache = SnapshotMetadata.from_yaml(
+                bytes(read_io.buf).decode()
+            )
+        return self._metadata_cache
+
+    def get_manifest(self) -> Dict[str, Entry]:
+        return dict(self.metadata.manifest)
+
+    def restore(self, app_state: AppState, strict: bool = True) -> None:
+        """Distributed load/reshard into the given app state (reference
+        Snapshot.restore, snapshot.py:319-396)."""
+        coordinator = self._coordinator
+        rank, world = coordinator.rank, coordinator.world_size
+        _validate_app_state(app_state)
+        with log_event(Event("restore", {"path": self.path, "rank": rank})):
+            metadata = self.metadata
+            manifest_for_rank = get_manifest_for_rank(metadata, rank)
+            storage = url_to_storage_plugin(self.path)
+            local_keys = sorted(app_state.keys())
+            if world > 1:
+                global_keys = sorted(
+                    set().union(*coordinator.all_gather_object(local_keys))
+                )
+            else:
+                global_keys = local_keys
+            # RNG state is restored last so earlier restores cannot perturb
+            # it (reference snapshot.py:371-381)
+            global_keys.sort(key=lambda k: isinstance(app_state.get(k), RNGState))
+            try:
+                for key in global_keys:
+                    if key in app_state:
+                        self._load_stateful(
+                            key, app_state[key], manifest_for_rank, storage,
+                            strict, rank,
+                        )
+                    if world > 1:
+                        coordinator.barrier()
+            finally:
+                storage.sync_close()
+
+    def _load_stateful(
+        self,
+        key: str,
+        stateful: Any,
+        manifest_for_rank: Manifest,
+        storage: Any,
+        strict: bool,
+        rank: int,
+    ) -> None:
+        # reference _load_stateful, snapshot.py:727-782
+        key_manifest = {
+            p: e
+            for p, e in manifest_for_rank.items()
+            if p == key or p.startswith(key + "/")
+        }
+        if not key_manifest:
+            if strict:
+                raise KeyError(
+                    f"app_state key {key!r} not found in snapshot manifest"
+                )
+            logger.warning("skipping %r: not in snapshot", key)
+            return
+        # current state provides in-place/sharding templates
+        # (reference snapshot.py:754-762)
+        _, targets = flatten(stateful.state_dict(), prefix=key)
+
+        container_entries: Manifest = {}
+        read_reqs: List[ReadReq] = []
+        futures: Dict[str, Future] = {}
+        for lpath, entry in key_manifest.items():
+            if is_container_entry(entry):
+                container_entries[lpath] = entry
+                continue
+            reqs, fut = prepare_read(entry, obj_out=targets.get(lpath))
+            read_reqs.extend(reqs)
+            futures[lpath] = fut
+        if not knobs.is_batching_disabled():
+            read_reqs = batch_read_requests(read_reqs)
+        budget = get_process_memory_budget_bytes()
+        sync_execute_read_reqs(read_reqs, storage, budget, rank)
+        restored = {lpath: fut.obj for lpath, fut in futures.items()}
+        state_dict = inflate(container_entries, restored, prefix=key)
+        stateful.load_state_dict(state_dict)
+
+    # ----------------------------------------------------------- read_object
+
+    def read_object(
+        self,
+        path: str,
+        obj_out: Optional[Any] = None,
+        memory_budget_bytes: Optional[int] = None,
+    ) -> Any:
+        """Random access to a single object: ``path`` is
+        ``"<rank>/<logical_path>"`` (reference Snapshot.read_object,
+        snapshot.py:397-501)."""
+        with log_event(Event("read_object", {"path": path})):
+            rank_str, _, lpath = path.partition("/")
+            manifest = get_manifest_for_rank(self.metadata, int(rank_str))
+            if lpath not in manifest:
+                raise KeyError(f"{lpath!r} not in snapshot manifest")
+            entry = manifest[lpath]
+            if isinstance(entry, PrimitiveEntry):
+                return entry.get_value()
+            reqs, fut = prepare_read(
+                entry, obj_out=obj_out, buffer_size_limit_bytes=memory_budget_bytes
+            )
+            storage = url_to_storage_plugin(self.path)
+            try:
+                sync_execute_read_reqs(
+                    reqs,
+                    storage,
+                    memory_budget_bytes or get_process_memory_budget_bytes(),
+                    rank=0,
+                )
+            finally:
+                storage.sync_close()
+            return fut.obj
+
+
+class PendingSnapshot:
+    """Handle for an in-flight async snapshot (reference PendingSnapshot,
+    snapshot.py:962-1065).
+
+    The background thread performs storage-I/O drain + a KV-only commit
+    barrier: every rank reports done-or-error under the commit uid; rank 0
+    writes ``.snapshot_metadata`` iff every rank succeeded, then releases
+    the barrier.  Metadata is NEVER written on failure (asserted by
+    fault-injection tests, reference tests/test_async_take.py:96-117).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        metadata: SnapshotMetadata,
+        pending_io_work: PendingIOWork,
+        storage: Any,
+        coordinator: Coordinator,
+        commit_uid: str,
+    ) -> None:
+        self.path = path
+        self._metadata = metadata
+        self._pending_io_work = pending_io_work
+        self._storage = storage
+        self._coordinator = coordinator
+        self._commit_uid = commit_uid
+        self._exc: Optional[BaseException] = None
+        self._snapshot: Optional[Snapshot] = None
+        self._thread = threading.Thread(
+            target=self._complete_snapshot, name="tsnp-commit", daemon=True
+        )
+        self._thread.start()
+
+    def _complete_snapshot(self) -> None:
+        # KV ops only — never collectives, never uid-counter-based gathers
+        # (those belong to the foreground thread's program order)
+        coord = self._coordinator
+        uid = self._commit_uid
+        rank, world = coord.rank, coord.world_size
+        status = "ok"
+        try:
+            self._pending_io_work.sync_complete()
+        except BaseException as e:  # noqa: BLE001
+            self._exc = e
+            status = f"err:{e!r}"
+        try:
+            coord.kv_set(f"{uid}/arrive/{rank}", status)
+            if rank == 0:
+                # ALWAYS set the depart key, even if the metadata write
+                # itself raises — otherwise peers block until timeout with
+                # a misleading error.
+                try:
+                    statuses = [
+                        coord.kv_get(f"{uid}/arrive/{r}") for r in range(world)
+                    ]
+                    failed = [s for s in statuses if s != "ok"]
+                    if not failed:
+                        self._storage.sync_write(
+                            WriteIO(
+                                path=SNAPSHOT_METADATA_FNAME,
+                                buf=self._metadata.to_yaml().encode(),
+                            )
+                        )
+                        depart = "ok"
+                    else:
+                        depart = f"peers failed: {failed}"
+                except BaseException as e:  # noqa: BLE001
+                    depart = f"rank 0 commit failed: {e!r}"
+                    coord.kv_set(f"{uid}/depart", depart)
+                    raise
+                coord.kv_set(f"{uid}/depart", depart)
+            depart = coord.kv_get(f"{uid}/depart")
+            if depart != "ok" and self._exc is None:
+                self._exc = RuntimeError(
+                    f"async snapshot commit failed: {depart}"
+                )
+        except BaseException as e:  # noqa: BLE001
+            if self._exc is None:
+                self._exc = e
+        finally:
+            try:
+                self._storage.sync_close()
+            except Exception:
+                pass
+
+    def wait(self) -> Snapshot:
+        """Block until the background commit finishes; re-raise any error
+        (reference snapshot.py:1056-1065)."""
+        self._thread.join()
+        if self._exc is not None:
+            raise self._exc
+        if self._snapshot is None:
+            self._snapshot = Snapshot(self.path, self._coordinator)
+            self._snapshot._metadata_cache = self._metadata
+        return self._snapshot
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()

@@ -1,0 +1,95 @@
+"""The checkpointable-object protocol and built-in helpers.
+
+Reference: torchsnapshot/stateful.py:15-23 (duck-typed protocol),
+state_dict.py:15-29 (StateDict), rng_state.py:15-47 (RNGState).
+
+JAX is functional, so alongside the mutable-protocol helpers we provide
+``PyTreeState``: a wrapper that makes any pytree (flax/optax train states,
+raw param dicts, ...) checkpointable by holding it as a replaceable
+reference — the idiomatic JAX equivalent of in-place ``load_state_dict``.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import UserDict
+from typing import Any, Dict, Protocol, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class Stateful(Protocol):
+    def state_dict(self) -> Dict[str, Any]: ...
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None: ...
+
+
+class StateDict(UserDict):
+    """Dict wrapper making plain values checkpointable (reference
+    state_dict.py:15-29)."""
+
+    def state_dict(self) -> Dict[str, Any]:
+        return self.data
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self.data.update(state_dict)
+
+
+class PyTreeState:
+    """Checkpointable wrapper around an arbitrary JAX pytree.
+
+    ``state_dict`` flattens the tree to a leaf list (saved leaf-by-leaf, so
+    jax.Array leaves keep their shardings as restore templates);
+    ``load_state_dict`` rebuilds the tree with the *current* treedef, which
+    doubles as a structural-compatibility check on restore.
+    """
+
+    def __init__(self, tree: Any) -> None:
+        self.tree = tree
+
+    def state_dict(self) -> Dict[str, Any]:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self.tree)
+        return {"leaves": leaves}
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        import jax
+
+        treedef = jax.tree_util.tree_structure(self.tree)
+        leaves = state_dict["leaves"]
+        if treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"cannot load {len(leaves)} leaves into a tree with "
+                f"{treedef.num_leaves} leaves"
+            )
+        self.tree = jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class RNGState:
+    """Captures/restores host RNG state (python ``random`` + global numpy).
+
+    Reference rng_state.py:15-47 captures torch's global RNG; JAX's RNG is
+    explicit (PRNG keys are ordinary arrays in the app state), so only host
+    RNGs need capturing here.
+    """
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "python": random.getstate(),
+            "numpy": np.random.get_state(),
+        }
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        random.setstate(_as_tuple(state_dict["python"]))
+        np.random.set_state(_as_tuple(state_dict["numpy"]))
+
+
+def _as_tuple(v: Any) -> Any:
+    # random.setstate requires tuples incl. nested ones
+    if isinstance(v, list):
+        return tuple(_as_tuple(x) for x in v)
+    if isinstance(v, tuple):
+        return tuple(_as_tuple(x) for x in v)
+    return v

@@ -1,0 +1,58 @@
+"""Storage plugin registry: URL scheme → plugin.
+
+Reference: torchsnapshot/storage_plugin.py:20-80.  Supported out of the box:
+``fs://`` (default for bare paths), ``memory://`` (tests), ``gs://`` and
+``s3://`` (lazily imported so their client libraries stay optional).
+Third-party plugins register via the ``torchsnapshot_tpu.storage_plugins``
+entry-point group.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..io_types import StoragePlugin
+
+_ENTRY_POINT_GROUP = "torchsnapshot_tpu.storage_plugins"
+
+
+def url_to_storage_plugin(url_path: str) -> StoragePlugin:
+    if "://" in url_path:
+        scheme, path = url_path.split("://", 1)
+        scheme = scheme or "fs"
+    else:
+        scheme, path = "fs", url_path
+
+    if scheme == "fs":
+        from .fs import FSStoragePlugin
+
+        return FSStoragePlugin(root=path)
+    if scheme == "memory":
+        from .memory import MemoryStoragePlugin
+
+        return MemoryStoragePlugin(namespace=path)
+    if scheme == "gs":
+        from .gcs import GCSStoragePlugin
+
+        return GCSStoragePlugin(path=path)
+    if scheme == "s3":
+        from .s3 import S3StoragePlugin
+
+        return S3StoragePlugin(path=path)
+
+    # entry-point registry (reference storage_plugin.py:56-67)
+    try:
+        from importlib.metadata import entry_points
+
+        eps = entry_points()
+        group = (
+            eps.select(group=_ENTRY_POINT_GROUP)
+            if hasattr(eps, "select")
+            else eps.get(_ENTRY_POINT_GROUP, [])
+        )
+        for ep in group:
+            if ep.name == scheme:
+                return ep.load()(path)
+    except Exception:
+        pass
+    raise RuntimeError(f"no storage plugin registered for scheme {scheme!r}")

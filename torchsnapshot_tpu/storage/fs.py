@@ -1,0 +1,47 @@
+"""Local/posix filesystem storage plugin.
+
+Reference: torchsnapshot/storage_plugins/fs.py:21-62 (aiofiles-based).
+Ranged reads are served with seek + bounded read so `read_object` under a
+memory budget only touches the requested bytes.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+import aiofiles
+import aiofiles.os
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+
+class FSStoragePlugin(StoragePlugin):
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self._dirs_created: set = set()
+
+    def _full(self, path: str) -> str:
+        return os.path.join(self.root, path)
+
+    async def write(self, write_io: WriteIO) -> None:
+        full = self._full(write_io.path)
+        d = os.path.dirname(full)
+        if d not in self._dirs_created:
+            os.makedirs(d, exist_ok=True)
+            self._dirs_created.add(d)
+        async with aiofiles.open(full, "wb") as f:
+            await f.write(write_io.buf)
+
+    async def read(self, read_io: ReadIO) -> None:
+        full = self._full(read_io.path)
+        async with aiofiles.open(full, "rb") as f:
+            if read_io.byte_range is None:
+                read_io.buf = await f.read()
+            else:
+                start, end = read_io.byte_range
+                await f.seek(start)
+                read_io.buf = await f.read(end - start)
+
+    async def delete(self, path: str) -> None:
+        await aiofiles.os.remove(self._full(path))

@@ -1,0 +1,119 @@
+"""Google Cloud Storage plugin — the primary TPU target.
+
+Reference: torchsnapshot/storage_plugins/gcs.py:49-277.  Reimplemented on
+``google-cloud-storage`` (sync client driven from a thread pool, since the
+scheduler caps in-flight storage ops anyway) with the reference's two key
+behaviors:
+
+- ranged reads via ``download_as_bytes(start, end)`` so ``read_object``
+  under a memory budget fetches only the requested bytes,
+- a **collective-progress retry strategy** (reference gcs.py:221-277):
+  rather than a fixed per-op deadline, all concurrent ops share a deadline
+  that is refreshed whenever *any* op completes — an op only gives up when
+  the whole pipeline has made no progress for the window, so transient
+  per-connection stalls don't fail a 30-minute snapshot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import random
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+logger = logging.getLogger(__name__)
+
+_PROGRESS_WINDOW_S = 120.0
+_MAX_ATTEMPTS = 6
+
+
+class _CollectiveProgressRetry:
+    """Shared-deadline retry: any completion anywhere refreshes the clock
+    (reference _RetryStrategy, gcs.py:221-277)."""
+
+    def __init__(self, window_s: float = _PROGRESS_WINDOW_S) -> None:
+        self.window_s = window_s
+        self.last_progress = time.monotonic()
+
+    def record_progress(self) -> None:
+        self.last_progress = time.monotonic()
+
+    def should_retry(self, attempt: int) -> bool:
+        if attempt >= _MAX_ATTEMPTS:
+            return False
+        return (time.monotonic() - self.last_progress) < self.window_s
+
+    async def backoff(self, attempt: int) -> None:
+        await asyncio.sleep(min(2**attempt, 32) * (0.5 + random.random()))
+
+
+class GCSStoragePlugin(StoragePlugin):
+    def __init__(self, path: str, num_threads: int = 16) -> None:
+        try:
+            from google.cloud import storage as gcs
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError(
+                "gs:// support requires google-cloud-storage"
+            ) from e
+        bucket_name, _, self.prefix = path.partition("/")
+        self._client = gcs.Client()
+        self._bucket = self._client.bucket(bucket_name)
+        self._executor = ThreadPoolExecutor(
+            max_workers=num_threads, thread_name_prefix="tsnp-gcs"
+        )
+        self._retry = _CollectiveProgressRetry()
+
+    def _blob_name(self, path: str) -> str:
+        return f"{self.prefix}/{path}" if self.prefix else path
+
+    async def _with_retry(self, fn, op_name: str):
+        loop = asyncio.get_running_loop()
+        attempt = 0
+        while True:
+            try:
+                result = await loop.run_in_executor(self._executor, fn)
+                self._retry.record_progress()
+                return result
+            except Exception as e:  # noqa: BLE001
+                attempt += 1
+                if not self._retry.should_retry(attempt):
+                    raise
+                logger.warning(
+                    "GCS %s failed (attempt %d, retrying): %r",
+                    op_name, attempt, e,
+                )
+                await self._retry.backoff(attempt)
+
+    async def write(self, write_io: WriteIO) -> None:
+        blob = self._bucket.blob(self._blob_name(write_io.path))
+        data = bytes(write_io.buf)
+
+        def upload() -> None:
+            # resumable upload kicks in automatically above the chunk-size
+            # threshold; crc32c is checked server-side
+            blob.upload_from_string(data, checksum="crc32c")
+
+        await self._with_retry(upload, f"write {write_io.path}")
+
+    async def read(self, read_io: ReadIO) -> None:
+        blob = self._bucket.blob(self._blob_name(read_io.path))
+        if read_io.byte_range is None:
+            fn = functools.partial(blob.download_as_bytes)
+        else:
+            start, end = read_io.byte_range
+            fn = functools.partial(
+                blob.download_as_bytes, start=start, end=end - 1
+            )
+        read_io.buf = await self._with_retry(fn, f"read {read_io.path}")
+
+    async def delete(self, path: str) -> None:
+        blob = self._bucket.blob(self._blob_name(path))
+        await self._with_retry(blob.delete, f"delete {path}")
+
+    async def close(self) -> None:
+        self._executor.shutdown(wait=False)

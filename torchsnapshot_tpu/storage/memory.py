@@ -1,0 +1,42 @@
+"""In-memory storage plugin for tests and planner-level benchmarks.
+
+No reference analogue (the reference tests subclass the FS plugin for fault
+injection, tests/test_async_take.py:27-66); a process-global in-memory
+backend makes fault-injection and byte-range assertions cheaper still.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+_NAMESPACES: Dict[str, Dict[str, bytes]] = {}
+_LOCK = threading.Lock()
+
+
+def reset_namespace(namespace: str) -> None:
+    with _LOCK:
+        _NAMESPACES.pop(namespace, None)
+
+
+class MemoryStoragePlugin(StoragePlugin):
+    def __init__(self, namespace: str) -> None:
+        self.namespace = namespace
+        with _LOCK:
+            self._store = _NAMESPACES.setdefault(namespace, {})
+
+    async def write(self, write_io: WriteIO) -> None:
+        self._store[write_io.path] = bytes(write_io.buf)
+
+    async def read(self, read_io: ReadIO) -> None:
+        data = self._store[read_io.path]
+        if read_io.byte_range is None:
+            read_io.buf = data
+        else:
+            start, end = read_io.byte_range
+            read_io.buf = data[start:end]
+
+    async def delete(self, path: str) -> None:
+        del self._store[path]

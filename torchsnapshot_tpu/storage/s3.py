@@ -1,0 +1,106 @@
+"""S3 storage plugin.
+
+Reference: torchsnapshot/storage_plugins/s3.py:18-79 (aiobotocore with HTTP
+Range reads).  This environment ships no S3 client library; the plugin
+lazily binds to whichever of ``aiobotocore`` / ``boto3`` / ``s3fs`` is
+installed and raises a clear error otherwise.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from concurrent.futures import ThreadPoolExecutor
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+
+class S3StoragePlugin(StoragePlugin):
+    def __init__(self, path: str, num_threads: int = 16) -> None:
+        self.bucket, _, self.prefix = path.partition("/")
+        self._backend = None
+        try:
+            import boto3
+
+            self._backend = boto3.client("s3")
+        except ImportError:
+            try:
+                import s3fs
+
+                self._backend = s3fs.S3FileSystem()
+                self._is_fs = True
+            except ImportError:
+                raise RuntimeError(
+                    "s3:// support requires boto3 or s3fs; neither is "
+                    "installed"
+                ) from None
+        self._is_fs = not hasattr(self._backend, "put_object")
+        self._executor = ThreadPoolExecutor(
+            max_workers=num_threads, thread_name_prefix="tsnp-s3"
+        )
+
+    def _key(self, path: str) -> str:
+        return f"{self.prefix}/{path}" if self.prefix else path
+
+    async def _run(self, fn):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, fn
+        )
+
+    async def write(self, write_io: WriteIO) -> None:
+        data = bytes(write_io.buf)
+        if self._is_fs:
+            full = f"{self.bucket}/{self._key(write_io.path)}"
+            await self._run(functools.partial(self._backend.pipe, full, data))
+        else:
+            await self._run(
+                functools.partial(
+                    self._backend.put_object,
+                    Bucket=self.bucket,
+                    Key=self._key(write_io.path),
+                    Body=data,
+                )
+            )
+
+    async def read(self, read_io: ReadIO) -> None:
+        key = self._key(read_io.path)
+        if self._is_fs:
+            full = f"{self.bucket}/{key}"
+            if read_io.byte_range is None:
+                read_io.buf = await self._run(
+                    functools.partial(self._backend.cat_file, full)
+                )
+            else:
+                start, end = read_io.byte_range
+                read_io.buf = await self._run(
+                    functools.partial(
+                        self._backend.cat_file, full, start=start, end=end
+                    )
+                )
+        else:
+            kwargs = {"Bucket": self.bucket, "Key": key}
+            if read_io.byte_range is not None:
+                start, end = read_io.byte_range
+                kwargs["Range"] = f"bytes={start}-{end - 1}"
+            resp = await self._run(
+                functools.partial(self._backend.get_object, **kwargs)
+            )
+            read_io.buf = await self._run(resp["Body"].read)
+
+    async def delete(self, path: str) -> None:
+        key = self._key(path)
+        if self._is_fs:
+            await self._run(
+                functools.partial(
+                    self._backend.rm_file, f"{self.bucket}/{key}"
+                )
+            )
+        else:
+            await self._run(
+                functools.partial(
+                    self._backend.delete_object, Bucket=self.bucket, Key=key
+                )
+            )
+
+    async def close(self) -> None:
+        self._executor.shutdown(wait=False)

@@ -1,0 +1,29 @@
+"""Event-loop helpers: run coroutines from sync code, including inside
+Jupyter/async contexts (reference torchsnapshot/asyncio_utils.py:14-159).
+
+Instead of vendoring nest-asyncio's re-entrant monkey patch, we run the
+coroutine on a dedicated short-lived loop in a helper thread when a loop is
+already running in the caller's thread — simpler, and safe with JAX (no
+global loop state is mutated).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import Any, Coroutine
+
+
+def run_in_fresh_loop(coro: Coroutine) -> Any:
+    """Run ``coro`` to completion and return its result, regardless of
+    whether the calling thread already has a running event loop."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    # A loop is running (e.g. Jupyter). Run on a private loop in a thread.
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="tsnp-loop"
+    ) as pool:
+        return pool.submit(asyncio.run, coro).result()

@@ -1,0 +1,106 @@
+"""Async snapshot tests: unblock-after-staging, error propagation through
+wait(), and the no-metadata-on-failure guarantee (reference
+tests/test_async_take.py:27-117)."""
+
+import asyncio
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+
+class SlowFSStoragePlugin(FSStoragePlugin):
+    """Delays every write (reference SlowFSStoragePlugin)."""
+
+    delay_s = 0.3
+
+    async def write(self, write_io):
+        await asyncio.sleep(self.delay_s)
+        await super().write(write_io)
+
+
+class FaultyFSStoragePlugin(FSStoragePlugin):
+    """Fails late — after a delay — so staging completes first and the
+    error must surface through wait() (reference FaultyFSStoragePlugin)."""
+
+    async def write(self, write_io):
+        await asyncio.sleep(0.2)
+        raise RuntimeError("injected storage failure")
+
+
+@pytest.fixture
+def patch_storage(monkeypatch):
+    def patch(plugin_cls):
+        def factory(url):
+            path = url.split("://", 1)[-1]
+            return plugin_cls(root=path)
+
+        import torchsnapshot_tpu.snapshot as snapshot_mod
+
+        monkeypatch.setattr(snapshot_mod, "url_to_storage_plugin", factory)
+
+    return patch
+
+
+def _app_state():
+    return {
+        "app": StateDict(
+            w=np.arange(4096, dtype=np.float32),
+            b=np.ones(16, dtype=np.float32),
+            step=3,
+        )
+    }
+
+
+def test_async_take_unblocks_before_io_done(tmp_path, patch_storage):
+    patch_storage(SlowFSStoragePlugin)
+    t0 = time.monotonic()
+    pending = Snapshot.async_take(str(tmp_path / "s"), _app_state())
+    blocked = time.monotonic() - t0
+    # returns after staging; the slow write (>=0.3s/object) happens after
+    assert not pending.done() or blocked < SlowFSStoragePlugin.delay_s
+    snap = pending.wait()
+    assert os.path.exists(str(tmp_path / "s" / SNAPSHOT_METADATA_FNAME))
+    dest = StateDict(w=np.zeros(4096, np.float32), b=np.zeros(16, np.float32), step=0)
+    snap.restore({"app": dest})
+    assert dest["step"] == 3
+    np.testing.assert_array_equal(dest["w"], np.arange(4096, dtype=np.float32))
+
+
+def test_async_take_error_via_wait_and_no_metadata(tmp_path, patch_storage):
+    patch_storage(FaultyFSStoragePlugin)
+    pending = Snapshot.async_take(str(tmp_path / "s"), _app_state())
+    with pytest.raises(RuntimeError, match="injected storage failure"):
+        pending.wait()
+    # the commit point was never reached (reference test_async_take.py:96-117)
+    assert not os.path.exists(str(tmp_path / "s" / SNAPSHOT_METADATA_FNAME))
+    with pytest.raises(RuntimeError, match="incomplete"):
+        _ = Snapshot(str(tmp_path / "s")).metadata
+
+
+def test_async_take_source_mutation_safe(tmp_path, patch_storage):
+    """Mutating host state right after async_take returns must not corrupt
+    the snapshot (defensive copies; reference io_preparers/tensor.py:283-307)."""
+    patch_storage(SlowFSStoragePlugin)  # guarantee mutation beats the write
+    arr = np.arange(1024, dtype=np.float64)
+    state = StateDict(w=arr)
+    pending = Snapshot.async_take(str(tmp_path / "s"), {"app": state})
+    arr[:] = -1.0  # mutate immediately, possibly before I/O finished
+    snap = pending.wait()
+    out = snap.read_object("0/app/w")
+    np.testing.assert_array_equal(out, np.arange(1024, dtype=np.float64))
+
+
+def test_two_async_takes_sequential(tmp_path):
+    s1 = Snapshot.async_take(str(tmp_path / "a"), _app_state())
+    s1.wait()
+    s2 = Snapshot.async_take(str(tmp_path / "b"), _app_state())
+    s2.wait()
+    for p in ("a", "b"):
+        assert os.path.exists(str(tmp_path / p / SNAPSHOT_METADATA_FNAME))

@@ -74,3 +74,56 @@ def test_tuple_vs_list_distinguished():
     manifest, flattened = flatten(obj)
     out = inflate(manifest, flattened)
     assert isinstance(out["t"], tuple) and isinstance(out["l"], list)
+
+
+def test_inflate_allow_missing_skips_dict_keys():
+    obj = {"a": Leaf(1), "b": Leaf(2)}
+    manifest, flattened = flatten(obj)
+    del flattened["b"]
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        inflate(manifest, flattened)
+    out = inflate(manifest, flattened, allow_missing=True)
+    assert out == {"a": Leaf(1)}
+
+
+def test_inflate_allow_missing_skips_empty_nested_container():
+    # a nested dict whose leaves are all missing must be skipped entirely,
+    # not restored as an empty shell
+    obj = {"optim": {"m": Leaf(1), "v": Leaf(2)}, "w": Leaf(3)}
+    manifest, flattened = flatten(obj)
+    del flattened["optim/m"]
+    del flattened["optim/v"]
+    out = inflate(manifest, flattened, allow_missing=True)
+    assert out == {"w": Leaf(3)}
+    assert "optim" not in out
+
+
+def test_inflate_allow_missing_keeps_genuinely_empty_containers():
+    obj = {"empty_d": {}, "empty_l": [], "w": Leaf(1)}
+    manifest, flattened = flatten(obj)
+    out = inflate(manifest, flattened, allow_missing=True)
+    assert out == obj
+
+
+def test_inflate_allow_missing_list_elements():
+    obj = {"l": [Leaf(0), Leaf(1), Leaf(2)]}
+    manifest, flattened = flatten(obj)
+    del flattened["l/0"]  # missing element in the middle of the index space
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        inflate(manifest, flattened)
+    out = inflate(manifest, flattened, allow_missing=True)
+    assert out == {"l": [Leaf(1), Leaf(2)]}
+
+
+def test_inflate_strict_detects_truncated_list():
+    obj = {"l": [Leaf(0), Leaf(1)]}
+    manifest, flattened = flatten(obj)
+    del flattened["l/1"]  # trailing element lost
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        inflate(manifest, flattened)

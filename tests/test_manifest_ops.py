@@ -1,0 +1,137 @@
+"""Per-rank manifest views, shard merging, elasticity, consolidation
+(reference tests/test_manifest.py planner-level cases)."""
+
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    DictEntry,
+    PrimitiveEntry,
+    Shard,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+)
+from torchsnapshot_tpu.manifest_ops import (
+    consolidate_manifests,
+    get_manifest_for_rank,
+    merge_sharded_entries,
+)
+
+
+def _arr(location, replicated=False):
+    return ArrayEntry(location, "buffer_protocol", "float32", [4], replicated)
+
+
+def _sharded(rows):
+    return ShardedArrayEntry(
+        dtype="float32",
+        shape=[8, 4],
+        shards=[
+            Shard(offsets=[r, 0], sizes=[n, 4], location=f"sharded/w.{r}")
+            for r, n in rows
+        ],
+    )
+
+
+def test_per_rank_view_basic():
+    md = SnapshotMetadata(
+        version="0.1.0",
+        world_size=2,
+        manifest={
+            "0/app": DictEntry(keys=["w", "s"]),
+            "0/app/w": _arr("0/app/w"),
+            "0/app/s": PrimitiveEntry("int", "1", replicated=False),
+            "1/app": DictEntry(keys=["w", "s"]),
+            "1/app/w": _arr("1/app/w"),
+            "1/app/s": PrimitiveEntry("int", "2", replicated=False),
+        },
+    )
+    v0 = get_manifest_for_rank(md, 0)
+    assert v0["app/w"].location == "0/app/w"
+    assert v0["app/s"].get_value() == 1
+    v1 = get_manifest_for_rank(md, 1)
+    assert v1["app/w"].location == "1/app/w"
+
+
+def test_replicated_visible_to_all_ranks():
+    md = SnapshotMetadata(
+        version="0.1.0",
+        world_size=2,
+        manifest={
+            "0/app": DictEntry(keys=["w"]),
+            "0/app/w": _arr("replicated/app/w", replicated=True),
+            "1/app": DictEntry(keys=["w"]),
+        },
+    )
+    v1 = get_manifest_for_rank(md, 1)
+    assert v1["app/w"].location == "replicated/app/w"
+
+
+def test_world_growth_new_rank_gets_rank0_view():
+    md = SnapshotMetadata(
+        version="0.1.0",
+        world_size=2,
+        manifest={
+            "0/app": DictEntry(keys=["w", "x", "local"]),
+            "0/app/w": _arr("replicated/app/w", replicated=True),
+            "0/app/x": _sharded([(0, 4)]),
+            "0/app/local": _arr("0/app/local"),
+            "1/app": DictEntry(keys=["w", "x", "local"]),
+            "1/app/x": _sharded([(4, 4)]),
+            "1/app/local": _arr("1/app/local"),
+        },
+    )
+    v5 = get_manifest_for_rank(md, 5)  # rank beyond saved world size
+    assert v5["app/w"].location == "replicated/app/w"
+    # merged shards from all ranks
+    assert len(v5["app/x"].shards) == 2
+    # rank-local non-replicated state is not inherited
+    assert "app/local" not in v5
+    # containers available for inflate
+    assert v5["app"].keys == ["w", "x", "local"]
+
+
+def test_merge_dedups_replica_boxes():
+    a = _sharded([(0, 4)])
+    b = _sharded([(0, 4), (4, 4)])
+    merged = merge_sharded_entries([a, b])
+    assert [tuple(s.offsets) for s in merged.shards] == [(0, 0), (4, 0)]
+
+
+def test_sharded_merge_across_ranks_on_restore_view():
+    md = SnapshotMetadata(
+        version="0.1.0",
+        world_size=2,
+        manifest={
+            "0/app": DictEntry(keys=["x"]),
+            "0/app/x": _sharded([(0, 4)]),
+            "1/app": DictEntry(keys=["x"]),
+            "1/app/x": _sharded([(4, 4)]),
+        },
+    )
+    for rank in (0, 1):
+        v = get_manifest_for_rank(md, rank)
+        assert len(v["app/x"].shards) == 2
+
+
+def test_consolidate_keeps_one_replicated_copy():
+    m0 = {"app/w": _arr("replicated/app/w", replicated=True), "app/l": _arr("0/app/l")}
+    m1 = {"app/w": _arr("replicated/app/w", replicated=True), "app/l": _arr("1/app/l")}
+    g = consolidate_manifests([m0, m1])
+    assert "0/app/w" in g and "1/app/w" not in g
+    assert "0/app/l" in g and "1/app/l" in g
+
+
+def test_consolidate_respects_writer_rank_for_batched_replicated():
+    # rank 1 wrote the replicated entry (possibly re-pointed at its slab);
+    # rank 0 dropped its copy — the global manifest must carry rank 1's
+    m0 = {"app": DictEntry(keys=["w"])}
+    m1 = {
+        "app": DictEntry(keys=["w"]),
+        "app/w": ArrayEntry(
+            "1/batched.0", "buffer_protocol", "float32", [4], True, [0, 16]
+        ),
+    }
+    g = consolidate_manifests([m0, m1])
+    assert g["1/app/w"].location == "1/batched.0"
+    md = SnapshotMetadata(version="0.1.0", world_size=2, manifest=g)
+    v0 = get_manifest_for_rank(md, 0)
+    assert v0["app/w"].location == "1/batched.0"

@@ -1,0 +1,41 @@
+"""Replicated-write partitioner tests (reference tests/test_partitioner.py)."""
+
+from torchsnapshot_tpu.partitioner import partition_replicated_writes
+
+
+def test_deterministic_across_calls():
+    items = [(f"p{i}", (i * 37) % 100 + 1) for i in range(50)]
+    a = partition_replicated_writes(items, 4)
+    b = partition_replicated_writes(list(reversed(items)), 4)
+    assert a == b  # input order must not matter
+
+
+def test_balanced():
+    items = [(f"p{i}", 100) for i in range(40)]
+    assignment = partition_replicated_writes(items, 8)
+    loads = [0] * 8
+    for p, r in assignment.items():
+        loads[r] += 100
+    assert max(loads) - min(loads) == 0
+
+
+def test_preloads_bias_assignment():
+    # rank 0 already carries heavy non-replicated load -> gets less
+    items = [(f"p{i}", 10) for i in range(10)]
+    assignment = partition_replicated_writes(items, 2, preloads=[1000, 0])
+    counts = [0, 0]
+    for r in assignment.values():
+        counts[r] += 1
+    assert counts[1] == 10  # all go to the idle rank
+
+
+def test_single_rank():
+    items = [("a", 5), ("b", 6)]
+    assert partition_replicated_writes(items, 1) == {"a": 0, "b": 0}
+
+
+def test_bad_preloads_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        partition_replicated_writes([("a", 1)], 2, preloads=[0])

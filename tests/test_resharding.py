@@ -1,0 +1,170 @@
+"""Resharding matrix tests: save under spec A on mesh M1, restore under
+spec B on mesh M2 — planner-level, executed through the memory storage
+plugin (reference tests/test_sharded_tensor_resharding.py:28-110 runs a
+5x5 spec matrix with world_size=1; here the 8 virtual CPU devices make the
+multi-device cases real)."""
+
+import itertools
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs
+from torchsnapshot_tpu.manifest import ShardedArrayEntry
+from torchsnapshot_tpu.preparers.sharded import (
+    ShardedArrayIOPreparer,
+    assign_box_writers,
+    is_multi_device_jax_array,
+)
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+SPECS = [
+    ("2x4", ("a", "b"), P("a", "b")),
+    ("2x4", ("a", "b"), P("b", "a")),
+    ("2x4", ("a", "b"), P(("a", "b"), None)),  # one dim over two axes
+    ("2x4", ("a", "b"), P(None, "b")),         # partially replicated
+    ("2x4", ("a", "b"), P(None, None)),        # fully replicated
+    ("8", ("x",), P("x", None)),
+    ("8", ("x",), P(None, "x")),
+    ("4", ("x",), P("x", None)),
+]
+
+
+def _make(spec_def, value):
+    shape_s, names, spec = spec_def
+    shape = tuple(int(c) for c in shape_s.split("x"))
+    mesh = _mesh(shape, names)
+    return jax.device_put(value, NamedSharding(mesh, spec))
+
+
+@pytest.mark.parametrize("src", range(len(SPECS)), ids=lambda i: f"src{i}")
+@pytest.mark.parametrize("dst", range(len(SPECS)), ids=lambda i: f"dst{i}")
+def test_reshard_matrix(tmp_path, src, dst):
+    value = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    arr = _make(SPECS[src], value)
+    Snapshot.take(f"memory://reshard_{src}_{dst}", {"app": StateDict(w=arr)})
+    tmpl = _make(SPECS[dst], np.zeros_like(value))
+    dest = StateDict(w=tmpl)
+    Snapshot(f"memory://reshard_{src}_{dst}").restore({"app": dest})
+    np.testing.assert_array_equal(np.asarray(dest["w"]), value)
+    assert dest["w"].sharding == tmpl.sharding
+
+
+def test_sharded_to_numpy_and_back(tmp_path):
+    value = np.arange(64, dtype=np.float32).reshape(8, 8)
+    arr = _make(SPECS[0], value)
+    Snapshot.take(str(tmp_path / "s"), {"app": StateDict(w=arr)})
+    # sharded -> full numpy template
+    dest = StateDict(w=np.zeros((8, 8), dtype=np.float32))
+    Snapshot(str(tmp_path / "s")).restore({"app": dest})
+    np.testing.assert_array_equal(dest["w"], value)
+    # numpy save -> sharded template
+    Snapshot.take(str(tmp_path / "s2"), {"app": StateDict(w=value)})
+    tmpl = _make(SPECS[1], np.zeros_like(value))
+    dest2 = StateDict(w=tmpl)
+    Snapshot(str(tmp_path / "s2")).restore({"app": dest2})
+    np.testing.assert_array_equal(np.asarray(dest2["w"]), value)
+
+
+def test_sharded_no_template_returns_numpy(tmp_path):
+    value = np.arange(32, dtype=np.int32).reshape(4, 8)
+    arr = _make(SPECS[7], value.astype(np.int32))
+    snap = Snapshot.take(str(tmp_path / "s"), {"app": StateDict(w=arr)})
+    out = snap.read_object("0/app/w")
+    np.testing.assert_array_equal(out, value)
+
+
+def test_shard_subdivision(tmp_path):
+    # max shard size forces each device shard to split
+    with knobs.override_max_shard_size_bytes(64):
+        value = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+        arr = _make(SPECS[5], value)  # 8-way dim0: 2x8 f32 shards = 64B each
+        snap = Snapshot.take(str(tmp_path / "s"), {"app": StateDict(w=arr)})
+        entry = snap.get_manifest()["0/app/w"]
+        assert isinstance(entry, ShardedArrayEntry)
+        dest = StateDict(w=_make(SPECS[6], np.zeros_like(value)))
+        snap.restore({"app": dest})
+        np.testing.assert_array_equal(np.asarray(dest["w"]), value)
+
+
+def test_uneven_saved_boxes_planner_level(tmp_path):
+    # This JAX version rejects uneven NamedShardings end-to-end, but
+    # snapshots written elsewhere may contain uneven shard boxes; the
+    # overlap algebra must still reshard them. Planner-level: hand-build an
+    # uneven-box entry, serve reads from the memory plugin, restore into an
+    # even 8-way template.
+    from torchsnapshot_tpu.io_types import WriteIO
+    from torchsnapshot_tpu.manifest import Shard
+    from torchsnapshot_tpu.preparers import prepare_read
+    from torchsnapshot_tpu.scheduler import sync_execute_read_reqs
+    from torchsnapshot_tpu.storage.memory import (
+        MemoryStoragePlugin,
+        reset_namespace,
+    )
+
+    reset_namespace("uneven")
+    storage = MemoryStoragePlugin("uneven")
+    value = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    rows = [(0, 5), (5, 10), (10, 13), (13, 16)]  # uneven: 5,5,3,3
+    shards = []
+    for r0, r1 in rows:
+        loc = f"sharded/w.{r0}_0.{r1 - r0}_4"
+        storage.sync_write(WriteIO(path=loc, buf=value[r0:r1].tobytes()))
+        shards.append(Shard(offsets=[r0, 0], sizes=[r1 - r0, 4], location=loc))
+    entry = ShardedArrayEntry(
+        dtype="float32", shape=[16, 4], shards=shards
+    )
+    tmpl = _make(("8", ("x",), P("x", None)), np.zeros_like(value))
+    reqs, fut = prepare_read(entry, obj_out=tmpl)
+    sync_execute_read_reqs(reqs, storage, 1 << 30, rank=0)
+    np.testing.assert_array_equal(np.asarray(fut.obj), value)
+    assert fut.obj.sharding == tmpl.sharding
+
+
+def test_replicated_array_written_once(tmp_path):
+    # fully replicated over 8 devices: exactly one unique box, one write
+    value = np.arange(16, dtype=np.float32)
+    mesh = _mesh((8,), ("x",))
+    arr = jax.device_put(value, NamedSharding(mesh, P(None)))
+    assert is_multi_device_jax_array(arr)
+    entry, write_reqs = ShardedArrayIOPreparer.prepare_write(
+        arr, "app/w", process_index=0, process_count=1
+    )
+    assert len(write_reqs) == 1
+    assert len(entry.shards) == 1
+    assert entry.shards[0].offsets == [0] and entry.shards[0].sizes == [16]
+
+
+def test_assign_box_writers_balances():
+    # synthetic: 8 boxes each addressable by 2 of 4 processes
+    class Dev:
+        def __init__(self, p):
+            self.process_index = p
+
+    boxes = {}
+    for i in range(8):
+        box = ((i * 4,), (4,))
+        boxes[box] = [Dev(i % 4), Dev((i + 1) % 4)]
+    assignment = assign_box_writers(boxes, itemsize=4, process_count=4)
+    counts = [0] * 4
+    for box, writer in assignment.items():
+        assert writer in {d.process_index for d in boxes[box]}
+        counts[writer] += 1
+    assert max(counts) - min(counts) <= 1  # balanced
+
+
+def test_mesh_metadata_recorded(tmp_path):
+    value = np.zeros((8, 8), dtype=np.float32)
+    arr = _make(SPECS[2], value)  # P(("a","b"), None)
+    snap = Snapshot.take(str(tmp_path / "s"), {"app": StateDict(w=arr)})
+    entry = snap.get_manifest()["0/app/w"]
+    assert entry.mesh_axis_names == ["a", "b"]
+    assert entry.mesh_shape == [2, 4]
+    assert entry.spec == [["a", "b"], None]

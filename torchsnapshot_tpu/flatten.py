@@ -72,7 +72,11 @@ def _flatten_inplace(
     obj: Any, prefix: str, manifest: Manifest, flattened: Dict[str, Any]
 ) -> None:
     if isinstance(obj, (list, tuple)) and not hasattr(obj, "_fields"):
-        manifest[prefix] = TupleEntry() if isinstance(obj, tuple) else ListEntry()
+        manifest[prefix] = (
+            TupleEntry(length=len(obj))
+            if isinstance(obj, tuple)
+            else ListEntry(length=len(obj))
+        )
         for idx, v in enumerate(obj):
             _flatten_inplace(v, _join(prefix, str(idx)), manifest, flattened)
     elif isinstance(obj, dict) and _should_flatten_dict(obj):
@@ -88,9 +92,17 @@ def _flatten_inplace(
 
 
 def inflate(
-    manifest: Manifest, flattened: Dict[str, Any], prefix: str = ""
+    manifest: Manifest,
+    flattened: Dict[str, Any],
+    prefix: str = "",
+    allow_missing: bool = False,
 ) -> Any:
     """Rebuild the nested object from a container manifest + flat leaves.
+
+    ``allow_missing=True`` skips dict keys whose subtree has no entries —
+    used by non-strict elastic restores where a grown world's new ranks see
+    rank 0's containers but not its per-rank leaves (reference
+    handle_sharded_tensor_elasticity, manifest_ops.py:180-249).
 
     Reference: torchsnapshot/flatten.py:79-143.
     """
@@ -105,29 +117,73 @@ def inflate(
             for k, v in flattened.items()
             if k == prefix or k.startswith(prefix + "/")
         }
-    return _inflate_path("", manifest, flattened)
+    return _inflate_path("", manifest, flattened, allow_missing)
 
 
-def _inflate_path(path: str, manifest: Manifest, flattened: Dict[str, Any]) -> Any:
+def _inflate_path(
+    path: str,
+    manifest: Manifest,
+    flattened: Dict[str, Any],
+    allow_missing: bool = False,
+) -> Any:
     if path in manifest and is_container_entry(manifest[path]):
         entry: Entry = manifest[path]
         if isinstance(entry, DictEntry):
             out: Any = OrderedDict() if isinstance(entry, OrderedDictEntry) else {}
             for k in entry.keys:
                 child = _join(path, _encode(str(k)))
-                out[k] = _inflate_path(child, manifest, flattened)
+                if allow_missing and not _subtree_present(
+                    child, manifest, flattened
+                ):
+                    continue
+                out[k] = _inflate_path(child, manifest, flattened, allow_missing)
             return out
         else:  # ListEntry / TupleEntry
             items = []
-            idx = 0
-            while True:
+            for idx in range(entry.length):
                 child = _join(path, str(idx))
                 if child in manifest or child in flattened:
-                    items.append(_inflate_path(child, manifest, flattened))
-                    idx += 1
+                    items.append(
+                        _inflate_path(child, manifest, flattened, allow_missing)
+                    )
+                elif allow_missing:
+                    continue
                 else:
-                    break
+                    raise KeyError(
+                        f"list element {child!r} missing from manifest/leaves"
+                    )
             return tuple(items) if isinstance(entry, TupleEntry) else items
     if path in flattened:
         return flattened[path]
     raise KeyError(f"logical path {path!r} missing from both manifest and leaves")
+
+
+def _subtree_present(
+    path: str, manifest: Manifest, flattened: Dict[str, Any]
+) -> bool:
+    """True iff inflating ``path`` would produce real data: a leaf exists at
+    or under it, or it is a genuinely empty container. A container whose
+    leaves are all absent (e.g. per-rank state invisible to a grown world's
+    new rank) is NOT present — its key is skipped entirely rather than
+    restored as an empty shell."""
+    if path in flattened:
+        return True
+    entry = manifest.get(path)
+    if entry is None:
+        prefix = path + "/"
+        return any(k.startswith(prefix) for k in flattened)
+    if isinstance(entry, DictEntry):
+        if not entry.keys:
+            return True
+        return any(
+            _subtree_present(_join(path, _encode(str(k))), manifest, flattened)
+            for k in entry.keys
+        )
+    if isinstance(entry, ListEntry):
+        if entry.length == 0:
+            return True
+        return any(
+            _subtree_present(_join(path, str(i)), manifest, flattened)
+            for i in range(entry.length)
+        )
+    return False

@@ -273,16 +273,23 @@ class OrderedDictEntry(DictEntry):
 
 @dataclass(init=False)
 class ListEntry(Entry):
-    def __init__(self, type: str = "list") -> None:
+    """List container; records its length so partial/elastic restores can
+    distinguish a missing element from the end of the list (the reference's
+    ListEntry relies on index scanning alone)."""
+
+    length: int
+
+    def __init__(self, length: int = 0, type: str = "list") -> None:
         super().__init__(type=type)
+        self.length = length
 
 
 class TupleEntry(ListEntry):
     """Tuples are first-class containers here (JAX pytrees are tuple-heavy;
     the reference only handles dict/list/OrderedDict)."""
 
-    def __init__(self) -> None:
-        super().__init__(type="tuple")
+    def __init__(self, length: int = 0) -> None:
+        super().__init__(length=length, type="tuple")
 
 
 Manifest = Dict[str, Entry]
@@ -334,9 +341,9 @@ def entry_from_dict(d: Dict[str, Any]) -> Entry:
     if t == "OrderedDict":
         return OrderedDictEntry(keys=list(d["keys"]))
     if t == "list":
-        return ListEntry()
+        return ListEntry(length=int(d.get("length", 0)))
     if t == "tuple":
-        return TupleEntry()
+        return TupleEntry(length=int(d.get("length", 0)))
     raise ValueError(f"unknown manifest entry type: {t!r}")
 
 

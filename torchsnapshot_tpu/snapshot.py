@@ -373,8 +373,23 @@ class Snapshot:
         budget = get_process_memory_budget_bytes()
         sync_execute_read_reqs(read_reqs, storage, budget, rank)
         restored = {lpath: fut.obj for lpath, fut in futures.items()}
-        state_dict = inflate(container_entries, restored, prefix=key)
-        stateful.load_state_dict(state_dict)
+        state_dict = inflate(
+            container_entries, restored, prefix=key, allow_missing=not strict
+        )
+        # propagate strict to load_state_dict when the stateful accepts it
+        # (reference snapshot.py:775-778 for nn.Module)
+        import inspect
+
+        try:
+            accepts_strict = "strict" in inspect.signature(
+                stateful.load_state_dict
+            ).parameters
+        except (TypeError, ValueError):
+            accepts_strict = False
+        if accepts_strict:
+            stateful.load_state_dict(state_dict, strict=strict)
+        else:
+            stateful.load_state_dict(state_dict)
 
     # ----------------------------------------------------------- read_object
 

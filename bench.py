@@ -1,0 +1,74 @@
+"""Checkpoint save-throughput benchmark (the reference's headline number).
+
+Mirrors benchmarks/ddp/README.md:9-24: wall-time to persist a replicated
+model from device memory to local FS.  Reference baseline: 20GB from one
+A100 to local FS in ~13.91s ≈ 1.44 GB/s/chip (single-rank row; see
+BASELINE.md).  Here: a bf16 parameter pytree on one TPU chip, staged via
+async XLA D2H under the memory budget and written through the fs plugin.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_GBPS = 20.0 / 13.91  # reference: 1x1 GPU, local FS
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import PyTreeState, Snapshot
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    # ~4GB bf16 on TPU; small on CPU fallback so the script always works
+    n_arrays, elems = (32, 64 * 1024 * 1024) if on_tpu else (8, 1024 * 1024)
+
+    @jax.jit
+    def make(i):
+        return (jnp.arange(elems, dtype=jnp.float32) * (i + 1)).astype(
+            jnp.bfloat16
+        )
+
+    params = {f"layer{i}/w": make(i) for i in range(n_arrays)}
+    jax.block_until_ready(params)
+    total_gb = n_arrays * elems * 2 / 1e9
+
+    root = tempfile.mkdtemp(prefix="tsnp_bench_")
+    try:
+        # warm-up on a small slice to exclude one-time costs
+        Snapshot.take(
+            os.path.join(root, "warm"),
+            {"m": PyTreeState({"w": params["layer0/w"]})},
+        )
+        t0 = time.perf_counter()
+        Snapshot.take(os.path.join(root, "snap"), {"m": PyTreeState(params)})
+        elapsed = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    gbps = total_gb / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "ckpt_save_throughput_local_fs",
+                "value": round(gbps, 3),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Resumable training with torchsnapshot_tpu.
+
+TPU-native counterpart of the reference's examples/simple_example.py:50-84:
+a progress counter + RNG state live in app_state next to the model, the
+latest snapshot is taken every epoch, and on restart training resumes from
+wherever the snapshot left off — bitwise identical.
+
+Run:  python examples/simple_example.py /tmp/my_ckpt
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax.training import train_state
+
+from torchsnapshot_tpu import PyTreeState, RNGState, Snapshot, StateDict
+
+NUM_EPOCHS = 4
+STEPS_PER_EPOCH = 8
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(16)(nn.relu(nn.Dense(64)(x)))
+
+
+def make_state(seed: int):
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(seed), jnp.ones((1, 32)))
+    return train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adam(1e-3)
+    )
+
+
+@jax.jit
+def train_step(ts, x, y):
+    def loss_fn(p):
+        return jnp.mean((ts.apply_fn(p, x) - y) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(ts.params)
+    return ts.apply_gradients(grads=grads), loss
+
+
+def main(ckpt_path: str) -> None:
+    app_state = {
+        "model": PyTreeState(make_state(seed=0)),
+        "progress": StateDict(epochs=0),
+        "rng": RNGState(),
+    }
+
+    # resume if a committed snapshot exists
+    if os.path.exists(os.path.join(ckpt_path, ".snapshot_metadata")):
+        Snapshot(ckpt_path).restore(app_state)
+        print(f"resumed at epoch {app_state['progress']['epochs']}")
+
+    while app_state["progress"]["epochs"] < NUM_EPOCHS:
+        ts = app_state["model"].tree
+        for _ in range(STEPS_PER_EPOCH):
+            x = np.random.rand(16, 32).astype(np.float32)
+            y = np.random.rand(16, 16).astype(np.float32)
+            ts, loss = train_step(ts, x, y)
+        app_state["model"].tree = ts
+        app_state["progress"]["epochs"] += 1
+        # async: training resumes as soon as staging completes
+        pending = Snapshot.async_take(ckpt_path, app_state)
+        print(f"epoch {app_state['progress']['epochs']}: loss={float(loss):.5f}")
+        pending.wait()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/tsnp_example_ckpt")

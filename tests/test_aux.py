@@ -1,0 +1,147 @@
+"""Auxiliary subsystems: events, RSS profiler, tricks, host offload,
+test utils (SURVEY.md §2 rows 21-26)."""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import (
+    Event,
+    Snapshot,
+    StateDict,
+    register_event_handler,
+    unregister_event_handler,
+)
+from torchsnapshot_tpu.rss_profiler import measure_rss_deltas
+from torchsnapshot_tpu.test_utils import assert_state_dict_eq, rand_array
+
+
+def test_events_bracket_take_restore(tmp_path):
+    events = []
+    handler = events.append
+    register_event_handler(handler)
+    try:
+        Snapshot.take(str(tmp_path / "s"), {"app": StateDict(x=1)})
+        Snapshot(str(tmp_path / "s")).restore({"app": StateDict(x=0)})
+    finally:
+        unregister_event_handler(handler)
+    names = [e.name for e in events]
+    assert "take" in names and "restore" in names
+    for e in events:
+        assert e.metadata["is_success"] is True
+        assert "duration_s" in e.metadata and "unique_id" in e.metadata
+
+
+def test_event_failure_marked(tmp_path):
+    events = []
+    register_event_handler(events.append)
+    try:
+        with pytest.raises(RuntimeError):
+            Snapshot(str(tmp_path / "missing")).restore({"app": StateDict(x=0)})
+    finally:
+        unregister_event_handler(events.append)
+    restores = [e for e in events if e.name == "restore"]
+    assert restores and restores[0].metadata["is_success"] is False
+
+
+def test_rss_profiler_measures_allocation():
+    deltas = []
+    with measure_rss_deltas(deltas, interval_s=0.01):
+        blob = np.ones(50 * 1024 * 1024 // 8)  # ~50MB
+        blob += 1
+    assert max(deltas) > 20 * 1024 * 1024
+    del blob
+
+
+def test_assert_state_dict_eq():
+    a = {"x": np.arange(4.0), "y": [1, (2, "s")], "z": 1.5}
+    b = {"x": np.arange(4.0), "y": [1, (2, "s")], "z": 1.5}
+    assert_state_dict_eq(a, b)
+    b["x"] = np.arange(4.0) + 1e-3
+    with pytest.raises(AssertionError):
+        assert_state_dict_eq(a, b)
+
+
+@pytest.mark.parametrize(
+    "dtype", ["float32", "bfloat16", "int8", "uint16", "bool"]
+)
+def test_rand_array_dtypes(dtype):
+    import ml_dtypes
+
+    dt = (
+        np.dtype(ml_dtypes.bfloat16) if dtype == "bfloat16" else np.dtype(dtype)
+    )
+    arr = rand_array((8, 3), dt, seed=1)
+    assert arr.shape == (8, 3) and arr.dtype == dt
+
+
+def test_torch_ddp_adapter(tmp_path):
+    torch = pytest.importorskip("torch")
+    from torchsnapshot_tpu.tricks import TorchModuleAdapter
+
+    model = torch.nn.Linear(4, 2)
+    wrapped = torch.nn.Sequential()  # simulate DDP wrapper naming
+    ddp_like = torch.nn.Module()
+    ddp_like.module = model
+
+    adapter = TorchModuleAdapter(ddp_like)
+    sd = adapter.state_dict()
+    assert all(not k.startswith("module.") for k in sd)
+
+    Snapshot.take(str(tmp_path / "s"), {"model": adapter})
+    model2 = torch.nn.Linear(4, 2)
+    ddp_like2 = torch.nn.Module()
+    ddp_like2.module = model2
+    Snapshot(str(tmp_path / "s")).restore({"model": TorchModuleAdapter(ddp_like2)})
+    for p1, p2 in zip(model.parameters(), model2.parameters()):
+        assert torch.equal(p1, p2)
+
+
+def test_torch_module_roundtrip_plain(tmp_path):
+    torch = pytest.importorskip("torch")
+    from torchsnapshot_tpu.tricks import TorchModuleAdapter, TorchOptimizerAdapter
+
+    model = torch.nn.Sequential(torch.nn.Linear(8, 4), torch.nn.Linear(4, 2))
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    out = model(torch.ones(2, 8)).sum()
+    out.backward()
+    opt.step()
+
+    Snapshot.take(
+        str(tmp_path / "s"),
+        {"model": TorchModuleAdapter(model), "opt": TorchOptimizerAdapter(opt)},
+    )
+    model2 = torch.nn.Sequential(torch.nn.Linear(8, 4), torch.nn.Linear(4, 2))
+    opt2 = torch.optim.Adam(model2.parameters(), lr=1e-3)
+    Snapshot(str(tmp_path / "s")).restore(
+        {"model": TorchModuleAdapter(model2), "opt": TorchOptimizerAdapter(opt2)}
+    )
+    for p1, p2 in zip(model.parameters(), model2.parameters()):
+        assert torch.equal(p1, p2)
+    assert opt.state_dict()["param_groups"] == opt2.state_dict()["param_groups"]
+
+
+def test_host_offload_fallbacks():
+    from torchsnapshot_tpu import host_offload
+
+    import jax.numpy as jnp
+
+    arr = jnp.ones(8)
+    # CPU backend: helpers must degrade gracefully
+    out = host_offload.offload_to_host(arr)
+    back = host_offload.to_device(out)
+    np.testing.assert_array_equal(np.asarray(back), np.ones(8))
+
+
+def test_torch_tensor_chunked_save(tmp_path):
+    torch = pytest.importorskip("torch")
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.manifest import ChunkedArrayEntry
+
+    with knobs.override_max_chunk_size_bytes(256):
+        t = torch.arange(0, 256, dtype=torch.float32).reshape(16, 16)  # 1KB
+        snap = Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=t)})
+        entry = snap.get_manifest()["0/m/w"]
+        assert isinstance(entry, ChunkedArrayEntry)
+        dest = StateDict(w=torch.zeros(16, 16))
+        snap.restore({"m": dest})
+        assert torch.equal(dest["w"], t)

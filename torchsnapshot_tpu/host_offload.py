@@ -1,0 +1,57 @@
+"""Host-offloaded array support (the TPU answer to UVM embeddings).
+
+Reference: torchsnapshot/uvm_tensor.py:13-45 wraps fbgemm's CUDA
+unified-virtual-memory ops so giant torchrec embedding tables living in
+host memory can be checkpointed without device round-trips.  On TPU the
+equivalent is explicit host offload via ``jax`` memory kinds
+(``pinned_host``): arrays placed there are addressable from the host, so
+staging them is a zero-copy ``np.asarray`` instead of a D2H transfer — the
+preparers handle them transparently; this module provides the placement
+helpers and feature detection, with no-op fallbacks when the runtime lacks
+the memories API (same graceful-degradation contract as the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_HOST_KINDS = ("pinned_host", "unpinned_host")
+
+
+def host_memory_supported() -> bool:
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+        return any(k in kinds for k in _HOST_KINDS)
+    except Exception:
+        return False
+
+
+def is_host_offloaded(arr: Any) -> bool:
+    try:
+        return arr.sharding.memory_kind in _HOST_KINDS
+    except Exception:
+        return False
+
+
+def offload_to_host(arr: Any):
+    """Move an array to pinned host memory (no-op passthrough when the
+    runtime doesn't support it)."""
+    import jax
+
+    if not host_memory_supported():
+        return arr
+    sharding = arr.sharding.with_memory_kind("pinned_host")
+    return jax.device_put(arr, sharding)
+
+
+def to_device(arr: Any):
+    """Bring a host-offloaded array back to device HBM."""
+    import jax
+
+    if not is_host_offloaded(arr):
+        return arr
+    sharding = arr.sharding.with_memory_kind("device")
+    return jax.device_put(arr, sharding)

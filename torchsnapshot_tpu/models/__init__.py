@@ -1,0 +1,1 @@
+from .transformer import TransformerLM, TransformerConfig, make_train_state, train_step  # noqa: F401

@@ -1,0 +1,6 @@
+from .mesh import (  # noqa: F401
+    build_mesh,
+    ensure_cpu_devices,
+    param_sharding_rules,
+    shard_pytree,
+)

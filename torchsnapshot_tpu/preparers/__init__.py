@@ -67,6 +67,13 @@ def prepare_write(
         )
 
     if is_array_like(obj):
+        # Normalize torch tensors to a host numpy view ONCE here (zero-copy
+        # for CPU tensors, a single transfer otherwise) so the size check,
+        # the chunked path and the stager never re-materialize.
+        from .array import _is_torch_tensor, _to_host_view
+
+        if _is_torch_tensor(obj):
+            obj = _to_host_view(obj)
         namespace = "replicated" if replicated else str(rank)
         location = f"{namespace}/{logical_path}"
         if array_nbytes(obj) > knobs.get_max_chunk_size_bytes():

@@ -73,10 +73,14 @@ def _to_host_view(obj: Any) -> np.ndarray:
 
 
 def array_nbytes(obj: Any) -> int:
+    if _is_torch_tensor(obj):
+        obj = _to_host_view(obj)
     return serialized_size_bytes(obj.shape, obj.dtype)
 
 
 def array_dtype_str(obj: Any) -> str:
+    if _is_torch_tensor(obj):
+        obj = _to_host_view(obj)
     return dtype_to_string(obj.dtype)
 
 

@@ -342,8 +342,11 @@ class _ShardConsumer(BufferConsumer):
 
         def scatter() -> None:
             for inter, lbox in self.overlaps:
-                s = src[relative_slices(inter, self.read_box)]
-                d = self.buffers[lbox][relative_slices(inter, lbox)]
+                s_sl = relative_slices(inter, self.read_box)
+                d_sl = relative_slices(inter, lbox)
+                # 0-d boxes: arr[()] yields a scalar, not a view — use [...]
+                s = src[s_sl] if s_sl else src[...]
+                d = self.buffers[lbox][d_sl] if d_sl else self.buffers[lbox][...]
                 np.copyto(d, s, casting="unsafe")
 
         loop = asyncio.get_running_loop()

@@ -149,6 +149,39 @@ class PendingIOWork:
         return self._stats["bytes_written"]
 
 
+_PROGRESS_INTERVAL_S = 10.0
+
+
+class _WriteReporter:
+    """Periodic pipeline progress log (reference _WriteReporter,
+    scheduler.py:98-177: stageable/staging/writable/writing counts, budget
+    usage, GB written)."""
+
+    def __init__(self, budget: "_Budget", stats: dict) -> None:
+        self.budget = budget
+        self.stats = stats
+        self.last_ts = time.monotonic()
+
+    def maybe_report(
+        self, stageable: int, staging: int, writable: int, writing: int
+    ) -> None:
+        now = time.monotonic()
+        if now - self.last_ts < _PROGRESS_INTERVAL_S:
+            return
+        self.last_ts = now
+        logger.info(
+            "write pipeline: %d stage-able | %d staging | %d writable | "
+            "%d writing | budget %.1f/%.1f MB | %.2f GB written",
+            stageable,
+            staging,
+            writable,
+            writing,
+            self.budget.used / 1e6,
+            self.budget.total / 1e6,
+            self.stats["bytes_written"] / 1e9,
+        )
+
+
 async def _execute_write_pipelines(
     pipelines: List[_WritePipeline],
     storage: StoragePlugin,
@@ -162,6 +195,7 @@ async def _execute_write_pipelines(
     staging_tasks: set = set()
     io_tasks: set = set()
     io_concurrency = knobs.get_max_per_rank_io_concurrency()
+    reporter = _WriteReporter(budget, stats)
 
     async def stage_one(p: _WritePipeline) -> _WritePipeline:
         p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
@@ -195,10 +229,20 @@ async def _execute_write_pipelines(
         while ready_for_staging or staging_tasks or ready_for_io or io_tasks:
             dispatch_staging()
             dispatch_io()
+            reporter.maybe_report(
+                len(ready_for_staging),
+                len(staging_tasks),
+                len(ready_for_io),
+                len(io_tasks),
+            )
             if not staging_tasks and not io_tasks:
                 continue
+            # timeout keeps the reporter ticking through long stalls (e.g.
+            # one giant storage write in flight)
             done, _ = await asyncio.wait(
-                staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
+                staging_tasks | io_tasks,
+                return_when=asyncio.FIRST_COMPLETED,
+                timeout=_PROGRESS_INTERVAL_S,
             )
             for task in done:
                 if task in staging_tasks:

@@ -1,0 +1,18 @@
+"""Framework adapters ("tricks").
+
+Reference: torchsnapshot/tricks/{ddp,fsdp,deepspeed}.py.  The TPU-native
+mapping:
+
+- DDP's "strip the ``module.`` prefix" trick → ``TorchModuleAdapter``
+  (works for torch CPU modules checkpointed through this library).
+- FSDP's optimizer-state routing → unnecessary on JAX: optimizer state is
+  an ordinary pytree whose leaves carry their own NamedShardings; the
+  sharded preparer handles them like any other array (SURVEY §2.1 row 5:
+  "no special casing needed under GSPMD").  ``FlaxTrainStateAdapter`` is a
+  thin convenience over PyTreeState.
+- DeepSpeed ZeRO-3's engine monkey-patch → same story: a fully-sharded
+  optax state checkpoints through the ShardedArray path unchanged.
+"""
+
+from .flax_adapters import FlaxTrainStateAdapter  # noqa: F401
+from .torch_module import TorchModuleAdapter, TorchOptimizerAdapter  # noqa: F401

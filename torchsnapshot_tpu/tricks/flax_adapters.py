@@ -1,0 +1,22 @@
+"""Flax/optax conveniences over PyTreeState."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..stateful import PyTreeState
+
+
+class FlaxTrainStateAdapter(PyTreeState):
+    """Checkpoint a flax TrainState; exposes step separately so resumable
+    loops can read it without touching params (mirrors the reference's
+    examples/simple_example.py progress pattern)."""
+
+    @property
+    def step(self) -> int:
+        import numpy as np
+
+        return int(np.asarray(self.tree.step))
+
+    def state_dict(self) -> Dict[str, Any]:
+        return super().state_dict()

@@ -1,0 +1,64 @@
+"""Adapters for torch (CPU) modules/optimizers.
+
+Reference: torchsnapshot/tricks/ddp.py:17-47 — a DDP-wrapped module saves
+keys prefixed with ``module.``; the adapter strips the prefix on save and
+re-adds it on load so checkpoints interchange between wrapped and
+unwrapped models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+_DDP_PREFIX = "module."
+
+
+class TorchModuleAdapter:
+    def __init__(self, module: Any) -> None:
+        self.module = module
+
+    def state_dict(self) -> Dict[str, Any]:
+        sd = self.module.state_dict()
+        if all(k.startswith(_DDP_PREFIX) for k in sd):
+            sd = {k[len(_DDP_PREFIX) :]: v for k, v in sd.items()}
+        return sd
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        own = self.module.state_dict()
+        if own and all(k.startswith(_DDP_PREFIX) for k in own):
+            state_dict = {
+                k if k.startswith(_DDP_PREFIX) else _DDP_PREFIX + k: v
+                for k, v in state_dict.items()
+            }
+        self.module.load_state_dict(state_dict, strict=strict)
+
+
+class TorchOptimizerAdapter:
+    """Routes optimizer state through the optimizer's own (de)hydration —
+    and converts numpy leaves back to torch tensors on load: when the
+    restoring optimizer has no state yet (fresh process), the snapshot has
+    no tensor templates to restore into, so array leaves come back as
+    numpy (the FSDP-trick analogue, reference tricks/fsdp.py:39-51)."""
+
+    def __init__(self, optimizer: Any) -> None:
+        self.optimizer = optimizer
+
+    def state_dict(self) -> Dict[str, Any]:
+        return self.optimizer.state_dict()
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        import numpy as np
+        import torch
+
+        def conv(x: Any) -> Any:
+            if isinstance(x, np.ndarray):
+                return torch.from_numpy(np.ascontiguousarray(x))
+            if isinstance(x, dict):
+                return {k: conv(v) for k, v in x.items()}
+            if isinstance(x, list):
+                return [conv(v) for v in x]
+            if isinstance(x, tuple):
+                return tuple(conv(v) for v in x)
+            return x
+
+        self.optimizer.load_state_dict(conv(state_dict))

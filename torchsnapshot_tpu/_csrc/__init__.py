@@ -1,0 +1,122 @@
+"""Native extension loader: builds fastio.so on first use (g++, cached),
+falls back to pure Python silently when no toolchain is available.
+
+Bindings are ctypes (no pybind11 in the image); all entry points release
+the GIL for the duration of the syscall chain, so the scheduler's worker
+threads overlap I/O properly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fastio.cpp")
+_SO = os.path.join(_HERE, "fastio.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    # Compile to a process-unique temp file and os.rename into place:
+    # atomic on posix, so concurrent first-use across processes (the
+    # multi-process tests spawn several) can never observe a half-written
+    # .so — worst case they each build once and the last rename wins.
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _SO)
+        return True
+    except Exception as e:  # noqa: BLE001
+        logger.debug("fastio build failed (falling back to Python): %r", e)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
+    try:
+        return ctypes.CDLL(_SO)
+    except OSError as e:
+        logger.debug("fastio load failed: %r", e)
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The fastio library, or None when unavailable."""
+    global _lib, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        lib = None
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(
+            _SRC
+        ):
+            lib = _try_load()
+        if lib is None:
+            # stale, absent, or unloadable (e.g. foreign-platform binary):
+            # rebuild once and retry
+            if not _build():
+                return None
+            lib = _try_load()
+            if lib is None:
+                return None
+        lib.tsnp_write_file.restype = ctypes.c_int
+        lib.tsnp_write_file.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int,
+        ]
+        lib.tsnp_read_file.restype = ctypes.c_int64
+        lib.tsnp_read_file.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.tsnp_file_size.restype = ctypes.c_int64
+        lib.tsnp_file_size.argtypes = [ctypes.c_char_p]
+        lib.tsnp_crc32c.restype = ctypes.c_uint32
+        lib.tsnp_crc32c.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_uint32,
+        ]
+        _lib = lib
+        return _lib
+
+
+def _buffer_address(view: memoryview) -> int:
+    # zero-copy pointer even for read-only buffers
+    import numpy as np
+
+    return np.frombuffer(view, dtype=np.uint8).ctypes.data
+
+
+def crc32c(data, seed: int = 0) -> Optional[int]:
+    """crc32c via the native lib; None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    view = memoryview(data).cast("B")
+    if view.nbytes == 0:
+        return int(lib.tsnp_crc32c(None, 0, seed))
+    return int(lib.tsnp_crc32c(_buffer_address(view), view.nbytes, seed))

@@ -1,0 +1,140 @@
+// Native file-I/O engine for the fs storage plugin.
+//
+// The reference delegates its native needs to PyTorch's C++ (TCPStore, CUDA
+// copies — SURVEY §2.9); this repo's runtime equivalent is this small
+// library: single-syscall-chain file writes/reads that run entirely outside
+// the GIL (called via ctypes from scheduler worker threads), plus a
+// slice-by-8 crc32c for blob integrity.
+//
+// Build: g++ -O3 -shared -fPIC -o fastio.so fastio.cpp  (see build_ext.py)
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Write buf[0:size] to path (create/truncate). Returns 0 on success,
+// -errno on failure. fsync_mode: 0 = none (page-cache, benchmark mode),
+// 1 = fdatasync before close (durability).
+int tsnp_write_file(const char *path, const void *buf, int64_t size,
+                    int fsync_mode) {
+  int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+  if (fd < 0)
+    return -errno;
+  const char *p = static_cast<const char *>(buf);
+  int64_t remaining = size;
+  while (remaining > 0) {
+    ssize_t n = write(fd, p, static_cast<size_t>(remaining));
+    if (n < 0) {
+      if (errno == EINTR)
+        continue;
+      int err = errno;
+      close(fd);
+      return -err;
+    }
+    p += n;
+    remaining -= n;
+  }
+  int rc = 0;
+  if (fsync_mode == 1 && fdatasync(fd) != 0)
+    rc = -errno;
+  if (close(fd) != 0 && rc == 0)
+    rc = -errno;
+  return rc;
+}
+
+// Read length bytes at offset from path into buf. offset<0 means 0;
+// length<0 means "to EOF" (caller must size buf via tsnp_file_size).
+// Returns bytes read, or -errno.
+int64_t tsnp_read_file(const char *path, void *buf, int64_t offset,
+                       int64_t length) {
+  int fd = open(path, O_RDONLY | O_CLOEXEC);
+  if (fd < 0)
+    return -errno;
+  if (offset > 0 && lseek(fd, static_cast<off_t>(offset), SEEK_SET) < 0) {
+    int err = errno;
+    close(fd);
+    return -err;
+  }
+  char *p = static_cast<char *>(buf);
+  int64_t total = 0;
+  while (length < 0 || total < length) {
+    size_t want = length < 0 ? (1u << 20) : static_cast<size_t>(length - total);
+    if (want > (1u << 20))
+      want = 1u << 20;
+    ssize_t n = read(fd, p + total, want);
+    if (n < 0) {
+      if (errno == EINTR)
+        continue;
+      int err = errno;
+      close(fd);
+      return -err;
+    }
+    if (n == 0)
+      break;
+    total += n;
+  }
+  close(fd);
+  return total;
+}
+
+int64_t tsnp_file_size(const char *path) {
+  struct stat st;
+  if (stat(path, &st) != 0)
+    return -errno;
+  return static_cast<int64_t>(st.st_size);
+}
+
+// crc32c (Castagnoli), slice-by-8.
+static uint32_t crc32c_table[8][256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+  const uint32_t poly = 0x82f63b78u;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++)
+      crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    crc32c_table[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = crc32c_table[0][i];
+    for (int s = 1; s < 8; s++) {
+      crc = crc32c_table[0][crc & 0xff] ^ (crc >> 8);
+      crc32c_table[s][i] = crc;
+    }
+  }
+  crc32c_init_done = true;
+}
+
+uint32_t tsnp_crc32c(const void *buf, int64_t size, uint32_t seed) {
+  if (!crc32c_init_done)
+    crc32c_init();
+  uint32_t crc = ~seed;
+  const uint8_t *p = static_cast<const uint8_t *>(buf);
+  while (size >= 8) {
+    uint64_t chunk;
+    memcpy(&chunk, p, 8);
+    crc ^= static_cast<uint32_t>(chunk);
+    uint32_t hi = static_cast<uint32_t>(chunk >> 32);
+    crc = crc32c_table[7][crc & 0xff] ^ crc32c_table[6][(crc >> 8) & 0xff] ^
+          crc32c_table[5][(crc >> 16) & 0xff] ^ crc32c_table[4][crc >> 24] ^
+          crc32c_table[3][hi & 0xff] ^ crc32c_table[2][(hi >> 8) & 0xff] ^
+          crc32c_table[1][(hi >> 16) & 0xff] ^ crc32c_table[0][hi >> 24];
+    p += 8;
+    size -= 8;
+  }
+  while (size > 0) {
+    crc = crc32c_table[0][(crc ^ *p) & 0xff] ^ (crc >> 8);
+    p++;
+    size--;
+  }
+  return ~crc;
+}
+
+}  // extern "C"

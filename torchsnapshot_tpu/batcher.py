@@ -12,15 +12,16 @@ All byte sizes are exactly known at plan time (buffer-protocol staging cost
 same property the reference relies on.
 
 The reference's GPU-slab variant (pack on device + single DtoH,
-batcher.py:104-162) has a TPU analogue — bitcast-to-uint8 + concatenate as
-one XLA op followed by a single transfer; planned for ops/ (not yet
-implemented — sub-buffers are currently staged individually and packed on
-host).
+batcher.py:104-162) has a TPU analogue here: when every slab member is a
+device jax.Array, the slab is packed on device (bitcast-to-uint8 +
+concatenate as one XLA op, ops/device_pack.py) and fetched in a single
+transfer, with host-side packing as the fallback.
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
 from concurrent.futures import Executor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -28,32 +29,74 @@ from . import knobs
 from .io_types import BufferConsumer, BufferStager, ReadReq, WriteReq
 from .manifest import ArrayEntry, ChunkedArrayEntry, Entry, ShardedArrayEntry
 
+logger = logging.getLogger(__name__)
+
 
 class BatchedBufferStager(BufferStager):
-    """Stage every sub-buffer concurrently, then pack into one slab
-    (reference BatchedBufferStager, batcher.py:51-103)."""
+    """Stage sub-buffers into one slab (reference BatchedBufferStager,
+    batcher.py:51-103).
+
+    When every member is a device jax.Array, the slab is packed ON DEVICE
+    (bitcast+concat, one XLA op) and fetched with a single transfer — the
+    TPU analogue of the reference's GPU slab (batcher.py:104-162), with
+    host-side fallback on any failure (ditto its OOM fallback,
+    batcher.py:144-152)."""
 
     def __init__(self, stagers: List[Tuple[BufferStager, int]], total: int):
         self.stagers = stagers
         self.total = total
+        from .preparers.array import JaxArrayBufferStager
+
+        self._all_jax = all(
+            isinstance(s, JaxArrayBufferStager) for s, _ in stagers
+        )
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> memoryview:
+        if self._all_jax:
+            try:
+                return await self._stage_device_packed(executor)
+            except Exception:  # fall back to host-side packing
+                logger.debug("device slab pack failed; host fallback", exc_info=True)
+        # Host fallback stages members SEQUENTIALLY so peak memory stays at
+        # slab + one member — matching get_staging_cost_bytes regardless of
+        # which path ran.
         slab = bytearray(self.total)
         offset = 0
-        bufs = await asyncio.gather(
-            *(s.stage_buffer(executor) for s, _ in self.stagers)
-        )
-        for (_, cost), buf in zip(self.stagers, bufs):
+        for s, cost in self.stagers:
+            buf = await s.stage_buffer(executor)
             view = memoryview(buf).cast("B")
             assert view.nbytes == cost, (view.nbytes, cost)
             slab[offset : offset + cost] = view
             offset += cost
+            del buf, view
         self.stagers = []
         return memoryview(slab)
 
+    async def _stage_device_packed(
+        self, executor: Optional[Executor]
+    ) -> memoryview:
+        from .ops.device_pack import pack_arrays_to_host
+
+        arrays = [
+            s.arr if s.index is None else s.arr[s.index] for s, _ in self.stagers
+        ]
+        loop = asyncio.get_running_loop()
+        if executor is not None:
+            slab = await loop.run_in_executor(
+                executor, pack_arrays_to_host, arrays
+            )
+        else:
+            slab = pack_arrays_to_host(arrays)
+        if slab.nbytes != self.total:
+            raise ValueError(f"packed {slab.nbytes} != expected {self.total}")
+        self.stagers = []
+        return memoryview(slab).cast("B")
+
     def get_staging_cost_bytes(self) -> int:
-        # sub-buffers + slab are alive simultaneously during packing
-        return 2 * self.total
+        # covers both paths: device pack holds just the slab (1x); the
+        # sequential host fallback holds slab + one member at a time
+        max_member = max((c for _, c in self.stagers), default=0)
+        return self.total + max_member
 
 
 def _byte_range_targets(entries: Dict[str, Entry]) -> Dict[str, Any]:

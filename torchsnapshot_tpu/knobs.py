@@ -22,6 +22,8 @@ _DISABLE_BATCHING = "DISABLE_BATCHING"
 _PER_RANK_MEMORY_BUDGET_BYTES = "PER_RANK_MEMORY_BUDGET_BYTES"
 _ALLOW_PICKLE_OBJECTS = "ALLOW_PICKLE_OBJECTS"
 _STAGING_THREADS = "STAGING_THREADS"
+_ENABLE_NATIVE_EXT = "ENABLE_NATIVE_EXT"
+_FS_VERIFY_WRITES = "FS_VERIFY_WRITES"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -42,6 +44,11 @@ _DEFAULTS = {
     _ALLOW_PICKLE_OBJECTS: 1,
     # Threads for D2H + serialize staging work (reference 4, scheduler.py:32).
     _STAGING_THREADS: 4,
+    # Use the C++ fastio extension for fs storage when it builds/loads.
+    _ENABLE_NATIVE_EXT: 1,
+    # Verify every fs write by re-reading and crc32c-comparing (native
+    # backend only; catches torn/corrupted local writes at save time).
+    _FS_VERIFY_WRITES: 0,
 }
 
 _OVERRIDES: dict = {}
@@ -89,6 +96,14 @@ def get_staging_threads() -> int:
     return max(1, _get_int(_STAGING_THREADS))
 
 
+def is_native_ext_enabled() -> bool:
+    return bool(_get_int(_ENABLE_NATIVE_EXT))
+
+
+def is_fs_verify_writes() -> bool:
+    return bool(_get_int(_FS_VERIFY_WRITES))
+
+
 @contextlib.contextmanager
 def _override(name: str, value) -> Iterator[None]:
     # Context-manager override, mirroring reference knobs.py:84-132.
@@ -134,3 +149,11 @@ def override_allow_pickle_objects(value: bool):
 
 def override_staging_threads(value: int):
     return _override(_STAGING_THREADS, value)
+
+
+def override_enable_native_ext(value: bool):
+    return _override(_ENABLE_NATIVE_EXT, int(value))
+
+
+def override_fs_verify_writes(value: bool):
+    return _override(_FS_VERIFY_WRITES, int(value))

@@ -1,0 +1,54 @@
+"""Device-side slab packing: bitcast + concatenate as ONE compiled XLA op,
+then a single device→host transfer.
+
+TPU-native analogue of the reference's GPU batched stager, which packs
+small GPU tensors into one GPU buffer to amortize DtoH launch overhead
+(reference batcher.py:104-162).  On TPU the win is the same: one big DMA
+instead of many small ones, and the pack itself runs at HBM bandwidth.
+XLA caches the compiled pack per shape-tuple, so steady-state checkpoints
+(same model every time) pay compilation once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+
+def _pack(arrays: List[Any]):
+    import jax.numpy as jnp
+    from jax import lax
+
+    parts = []
+    for a in arrays:
+        flat = a.reshape(-1)
+        if flat.dtype == jnp.bool_:
+            flat = flat.astype(jnp.uint8)  # bool serializes as one byte
+        elif jnp.issubdtype(flat.dtype, jnp.complexfloating):
+            # complex bytes are interleaved (real, imag) component pairs
+            flat = jnp.stack([flat.real, flat.imag], axis=-1).reshape(-1)
+        if flat.dtype != jnp.uint8:
+            flat = lax.bitcast_convert_type(flat, jnp.uint8).reshape(-1)
+        parts.append(flat)
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+_pack_jit = None
+
+
+def pack_arrays_to_host(arrays: List[Any]) -> np.ndarray:
+    """Pack device arrays into one uint8 host buffer (C-order bytes of each
+    array, concatenated). Raises on dtypes XLA can't bitcast — callers fall
+    back to per-array staging."""
+    global _pack_jit
+    import jax
+
+    if _pack_jit is None:
+        _pack_jit = jax.jit(_pack)
+    packed = _pack_jit(arrays)
+    try:
+        packed.copy_to_host_async()
+    except Exception:
+        pass
+    return np.asarray(packed)

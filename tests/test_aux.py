@@ -145,3 +145,31 @@ def test_torch_tensor_chunked_save(tmp_path):
         dest = StateDict(w=torch.zeros(16, 16))
         snap.restore({"m": dest})
         assert torch.equal(dest["w"], t)
+
+
+def test_orbax_interop_roundtrip(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu.tricks.orbax_interop import (
+        export_to_orbax,
+        import_from_orbax,
+        migrate_orbax_to_snapshot,
+        migrate_snapshot_to_orbax,
+    )
+
+    tree = {
+        "params": {"w": jnp.arange(12.0).reshape(3, 4), "b": jnp.ones(4)},
+        "step": np.int64(7),
+    }
+    export_to_orbax(str(tmp_path / "orbax_ckpt"), tree)
+    back = import_from_orbax(str(tmp_path / "orbax_ckpt"))
+    np.testing.assert_array_equal(np.asarray(back["params"]["w"]), np.asarray(tree["params"]["w"]))
+
+    migrate_orbax_to_snapshot(str(tmp_path / "orbax_ckpt"), str(tmp_path / "snap"))
+    snap_w = Snapshot(str(tmp_path / "snap")).read_object("0/state/params/w")
+    np.testing.assert_array_equal(np.asarray(snap_w), np.asarray(tree["params"]["w"]))
+
+    migrate_snapshot_to_orbax(str(tmp_path / "snap"), str(tmp_path / "orbax2"))
+    back2 = import_from_orbax(str(tmp_path / "orbax2"))
+    np.testing.assert_array_equal(np.asarray(back2["params"]["b"]), np.ones(4))

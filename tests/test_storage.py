@@ -54,3 +54,31 @@ def test_url_scheme_dispatch(tmp_path):
     assert isinstance(p, MemoryStoragePlugin)
     with pytest.raises(RuntimeError, match="no storage plugin"):
         url_to_storage_plugin("bogus://x")
+
+
+def test_memoryview_stream():
+    from torchsnapshot_tpu.utils.memoryview_stream import MemoryviewStream
+
+    data = bytes(range(256))
+    s = MemoryviewStream(memoryview(data))
+    assert s.read(10) == data[:10]
+    assert s.tell() == 10
+    s.seek(0)
+    assert s.read() == data
+    s.seek(-6, 2)
+    assert s.read(100) == data[-6:]
+    s.seek(0)
+    buf = bytearray(300)
+    n = s.readinto(buf)
+    assert n == 256 and bytes(buf[:256]) == data
+    assert len(s) == 256
+
+
+def test_gcs_plugin_importable():
+    # construction requires credentials; class import must not
+    from torchsnapshot_tpu.storage.gcs import GCSStoragePlugin, _CollectiveProgressRetry
+
+    r = _CollectiveProgressRetry(window_s=0.5)
+    assert r.should_retry(1)
+    r.last_progress -= 100
+    assert not r.should_retry(1)

@@ -90,13 +90,21 @@ class GCSStoragePlugin(StoragePlugin):
                 await self._retry.backoff(attempt)
 
     async def write(self, write_io: WriteIO) -> None:
+        from ..utils.memoryview_stream import MemoryviewStream
+
         blob = self._bucket.blob(self._blob_name(write_io.path))
-        data = bytes(write_io.buf)
+        view = memoryview(write_io.buf).cast("B")
 
         def upload() -> None:
-            # resumable upload kicks in automatically above the chunk-size
-            # threshold; crc32c is checked server-side
-            blob.upload_from_string(data, checksum="crc32c")
+            # zero-copy: stream straight from the staged buffer; resumable
+            # upload kicks in automatically above the chunk-size threshold
+            # and crc32c is verified server-side
+            blob.upload_from_file(
+                MemoryviewStream(view),
+                size=view.nbytes,
+                rewind=True,
+                checksum="crc32c",
+            )
 
         await self._with_retry(upload, f"write {write_io.path}")
 

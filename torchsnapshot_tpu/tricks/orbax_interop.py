@@ -1,0 +1,114 @@
+"""Orbax interop: migrate checkpoints between orbax and torchsnapshot_tpu.
+
+Most existing JAX training setups checkpoint with orbax; these helpers let
+a user switch frameworks (either direction) without retraining — the role
+the reference's DeepSpeed/FSDP tricks play for users migrating between
+torch checkpoint formats (tricks/deepspeed.py:19-103).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def export_to_orbax(path: str, tree: Any) -> None:
+    """Write a pytree as an orbax StandardCheckpoint."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), tree)
+
+
+def import_from_orbax(path: str, template: Optional[Any] = None) -> Any:
+    """Read an orbax StandardCheckpoint into a pytree; ``template`` (a
+    matching pytree of arrays/ShapeDtypeStructs with shardings) drives
+    placement, mirroring Snapshot.restore's template semantics."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if template is not None:
+            return ckptr.restore(os.path.abspath(path), template)
+        return ckptr.restore(os.path.abspath(path))
+
+
+def migrate_orbax_to_snapshot(
+    orbax_path: str, snapshot_path: str, key: str = "state"
+) -> None:
+    """orbax checkpoint → torchsnapshot_tpu snapshot (one app-state key)."""
+    from ..snapshot import Snapshot
+    from ..stateful import PyTreeState, StateDict
+
+    tree = import_from_orbax(orbax_path)
+    # keep the named structure in the manifest when the root is a dict
+    # (so snapshot paths read "state/params/w", not "state/leaves/3")
+    stateful = StateDict(tree) if isinstance(tree, dict) else PyTreeState(tree)
+    Snapshot.take(snapshot_path, {key: stateful})
+
+
+def migrate_snapshot_to_orbax(
+    snapshot_path: str, orbax_path: str, key: str = "state"
+) -> None:
+    """torchsnapshot_tpu snapshot → orbax checkpoint (one app-state key).
+
+    Exports **rank 0's view** (plus all replicated and merged sharded
+    entries). Per-rank state saved exclusively by other ranks is not part
+    of that view; a warning is emitted when any exists under ``key``.
+    """
+    import logging
+
+    from ..flatten import inflate
+    from ..manifest import is_container_entry
+    from ..manifest_ops import get_manifest_for_rank
+    from ..preparers import prepare_read
+    from ..scheduler import get_process_memory_budget_bytes, sync_execute_read_reqs
+    from ..snapshot import Snapshot
+    from ..storage import url_to_storage_plugin
+
+    snap = Snapshot(snapshot_path)
+    metadata = snap.metadata
+    manifest = get_manifest_for_rank(metadata, 0)
+    if metadata.world_size > 1:
+        dropped = {
+            k.partition("/")[2]
+            for k in metadata.manifest
+            if not k.startswith("0/")
+        }
+        dropped = {
+            p
+            for p in dropped
+            if (p == key or p.startswith(key + "/")) and p not in manifest
+        }
+        if dropped:
+            logging.getLogger(__name__).warning(
+                "exporting rank 0's view only; %d per-rank entries from "
+                "other ranks are not included (e.g. %s)",
+                len(dropped),
+                sorted(dropped)[0],
+            )
+    # rebuild the key's subtree without templates (host arrays)
+
+    key_manifest = {
+        p: e for p, e in manifest.items() if p == key or p.startswith(key + "/")
+    }
+    if not key_manifest:
+        raise KeyError(f"{key!r} not in snapshot")
+    containers = {}
+    read_reqs = []
+    futures = {}
+    for lpath, entry in key_manifest.items():
+        if is_container_entry(entry):
+            containers[lpath] = entry
+            continue
+        reqs, fut = prepare_read(entry)
+        read_reqs.extend(reqs)
+        futures[lpath] = fut
+    storage = url_to_storage_plugin(snapshot_path)
+    try:
+        sync_execute_read_reqs(
+            read_reqs, storage, get_process_memory_budget_bytes(), rank=0
+        )
+    finally:
+        storage.sync_close()
+    tree = inflate(containers, {p: f.obj for p, f in futures.items()}, prefix=key)
+    export_to_orbax(orbax_path, tree)

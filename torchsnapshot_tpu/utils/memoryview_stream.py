@@ -1,0 +1,57 @@
+"""File-like adapter over a memoryview for zero-copy uploads.
+
+Reference: torchsnapshot/memoryview_stream.py:1-87 — cloud SDK upload APIs
+want a readable stream; wrapping the staged memoryview avoids copying the
+whole buffer into a bytes object first.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+
+class MemoryviewStream(io.RawIOBase):
+    def __init__(self, view) -> None:
+        self._view = memoryview(view).cast("B")
+        self._pos = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        n = min(len(b), self._view.nbytes - self._pos)
+        if n <= 0:
+            return 0
+        b[:n] = self._view[self._pos : self._pos + n]
+        self._pos += n
+        return n
+
+    def read(self, size: int = -1) -> bytes:
+        if size is None or size < 0:
+            size = self._view.nbytes - self._pos
+        n = min(size, self._view.nbytes - self._pos)
+        out = bytes(self._view[self._pos : self._pos + n])
+        self._pos += n
+        return out
+
+    def seek(self, pos: int, whence: int = io.SEEK_SET) -> int:
+        if whence == io.SEEK_SET:
+            self._pos = pos
+        elif whence == io.SEEK_CUR:
+            self._pos += pos
+        elif whence == io.SEEK_END:
+            self._pos = self._view.nbytes + pos
+        else:
+            raise ValueError(f"invalid whence {whence}")
+        self._pos = max(0, min(self._pos, self._view.nbytes))
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def __len__(self) -> int:
+        return self._view.nbytes

@@ -1,0 +1,88 @@
+"""Unblock-early async snapshots: eager host offload semantics.
+
+The TPU-native async_take returns after one batched device→pinned_host
+transfer plus eager defensive copies — before *staging* (client-RAM
+materialization) rather than after it (reference scheduler.py:299 blocks
+until staged because CUDA tensors are mutable).  These tests pin down the
+semantics on hosts without TPU memory kinds, where the offload degrades to
+the defensive-copy-only pass and jax arrays stay safe by immutability.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict, knobs
+from torchsnapshot_tpu.host_offload import eager_offload_write_reqs
+from torchsnapshot_tpu.preparers import prepare_write
+
+
+def _prepare(obj, lpath="app/w", is_async=True):
+    return prepare_write(
+        obj=obj,
+        logical_path=lpath,
+        rank=0,
+        replicated=False,
+        is_async_snapshot=is_async,
+        process_index=0,
+        process_count=1,
+    )
+
+
+def test_eager_offload_takes_defensive_copy_now():
+    src = np.arange(256, dtype=np.float32)
+    _, reqs = _prepare(src)
+    moved = eager_offload_write_reqs(reqs)
+    assert moved >= src.nbytes
+    src[:] = -1.0  # mutate after offload, before staging
+
+    import asyncio
+
+    buf = asyncio.new_event_loop().run_until_complete(
+        reqs[0].buffer_stager.stage_buffer()
+    )
+    staged = np.frombuffer(bytes(buf), dtype=np.float32)
+    np.testing.assert_array_equal(staged, np.arange(256, dtype=np.float32))
+
+
+def test_eager_offload_idempotent_and_sync_snapshots_uncopied():
+    # sync snapshots don't request defensive copies; offload must not
+    # copy them either (cost discipline of reference tensor.py:283-307)
+    src = np.arange(64, dtype=np.int32)
+    _, reqs = _prepare(src, is_async=False)
+    assert eager_offload_write_reqs(reqs) == 0
+    assert reqs[0].buffer_stager.arr is src
+
+
+def test_async_take_jax_state_round_trips(tmp_path):
+    import jax.numpy as jnp
+
+    params = {"w": jnp.arange(1024, dtype=jnp.float32), "b": jnp.ones((8,))}
+    pending = Snapshot.async_take(
+        str(tmp_path / "s"), {"model": PyTreeState(dict(params))}
+    )
+    # simulate a training step replacing the arrays immediately
+    params = {k: v * 0.0 for k, v in params.items()}
+    snap = pending.wait()
+    dest = PyTreeState({"w": jnp.zeros(1024), "b": jnp.zeros((8,))})
+    snap.restore({"model": dest})
+    np.testing.assert_array_equal(
+        np.asarray(dest.tree["w"]), np.arange(1024, dtype=np.float32)
+    )
+    np.testing.assert_array_equal(np.asarray(dest.tree["b"]), np.ones(8))
+
+
+@pytest.mark.parametrize("disable", [False, True])
+def test_async_take_round_trip_with_and_without_eager_staging(
+    tmp_path, disable
+):
+    src = np.arange(4096, dtype=np.float64)
+    with knobs.override_disable_eager_host_staging(disable):
+        pending = Snapshot.async_take(
+            str(tmp_path / "s"), {"app": StateDict(w=src.copy(), step=7)}
+        )
+        snap = pending.wait()
+    out = snap.read_object("0/app/w")
+    np.testing.assert_array_equal(out, src)
+    assert snap.read_object("0/app/step") == 7

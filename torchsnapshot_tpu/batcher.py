@@ -52,7 +52,10 @@ class BatchedBufferStager(BufferStager):
         )
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> memoryview:
-        if self._all_jax:
+        # Members already offloaded to host memory kind must NOT go through
+        # the device pack: computing (concat) on host-kind arrays is not a
+        # supported XLA path — copy them out individually instead.
+        if self._all_jax and not self._any_member_on_host():
             try:
                 return await self._stage_device_packed(executor)
             except Exception:  # fall back to host-side packing
@@ -71,6 +74,14 @@ class BatchedBufferStager(BufferStager):
             del buf, view
         self.stagers = []
         return memoryview(slab)
+
+    def _any_member_on_host(self) -> bool:
+        from .host_offload import is_host_offloaded
+
+        return any(
+            getattr(s, "arr", None) is not None and is_host_offloaded(s.arr)
+            for s, _ in self.stagers
+        )
 
     async def _stage_device_packed(
         self, executor: Optional[Executor]

@@ -13,9 +13,12 @@ the memories API (same graceful-degradation contract as the reference).
 
 from __future__ import annotations
 
-from typing import Any
+import logging
+from typing import Any, Iterator, List
 
 _HOST_KINDS = ("pinned_host", "unpinned_host")
+
+logger = logging.getLogger(__name__)
 
 
 def host_memory_supported() -> bool:
@@ -55,3 +58,127 @@ def to_device(arr: Any):
         return arr
     sharding = arr.sharding.with_memory_kind("device")
     return jax.device_put(arr, sharding)
+
+
+def _iter_stagers(write_reqs) -> Iterator[Any]:
+    """Yield every leaf buffer stager, looking through batched slabs."""
+    from .batcher import BatchedBufferStager
+
+    for wr in write_reqs:
+        st = wr.buffer_stager
+        if isinstance(st, BatchedBufferStager):
+            for member, _ in st.stagers:
+                yield member
+        else:
+            yield st
+
+
+def eager_offload_write_reqs(
+    write_reqs, budget_bytes: int | None = None
+) -> int:
+    """Make the pending write requests independent of device state NOW, in
+    one batched transfer — the TPU-native unblock point for ``async_take``.
+
+    The reference blocks ``async_take`` until every tensor is staged in
+    host RAM, because CUDA tensors are mutable and the next optimizer step
+    would corrupt unstaged data (io_preparers/tensor.py:283-307,
+    scheduler.py:299).  On TPU the equivalent safety point is much earlier
+    and much cheaper:
+
+    - device ``jax.Array``s are immutable, so *correctness* never requires
+      staging — but holding them pins HBM.  One batched ``device_put`` of
+      every pending device array to ``pinned_host`` moves them at DMA
+      bandwidth (the analogue of the reference's GPU slab + single DtoH,
+      batcher.py:104-162) and releases HBM as soon as training drops its
+      own references.
+    - mutable *host* arrays (numpy / torch CPU) get their defensive copies
+      taken here instead of lazily at staging-admission time.
+
+    After this returns, training may mutate anything; staging + storage
+    I/O proceed in the background from the offloaded copies.  Only whole
+    arrays are offloaded (``index is None``): computing on host-kind
+    arrays (e.g. slicing a >512MB chunked array) is not a supported XLA
+    path, so indexed stagers keep their device refs and stage lazily —
+    still safe by immutability.
+
+    ``budget_bytes`` caps the pinned-host memory claimed by the device
+    offload (callers pass a fraction of the scheduler's staging budget so
+    offloaded-but-unstaged pinned buffers plus in-flight staged copies
+    stay within host RAM).  Device arrays past the cap are skipped — they
+    stage lazily in the background, still safe by immutability, so the
+    unblock point is unaffected.  Mutable *host* arrays are always copied
+    regardless of the cap: their safety depends on the copy happening
+    before control returns to training.
+
+    Returns the number of bytes made training-independent.  Degrades to a
+    defensive-copy-only pass when the runtime lacks host memory kinds
+    (e.g. CPU meshes).
+    """
+    import numpy as np
+
+    from .preparers.array import (
+        HostArrayBufferStager,
+        JaxArrayBufferStager,
+        _is_jax_array,
+    )
+
+    by_array: dict = {}
+    host_stagers: List[Any] = []
+    for st in _iter_stagers(write_reqs):
+        if (
+            isinstance(st, JaxArrayBufferStager)
+            and st.index is None
+            and st.arr is not None
+            and _is_jax_array(st.arr)
+        ):
+            by_array.setdefault(id(st.arr), []).append(st)
+        elif (
+            isinstance(st, HostArrayBufferStager)
+            and st.defensive_copy
+            and st.arr is not None
+        ):
+            host_stagers.append(st)
+
+    moved = 0
+    if by_array:
+        import jax
+
+        arrays, shardings, keys = [], [], []
+        claimed = 0
+        for key, sts in by_array.items():
+            a = sts[0].arr
+            if is_host_offloaded(a):
+                continue
+            if budget_bytes is not None and claimed + a.nbytes > budget_bytes:
+                continue  # stage lazily; safe by immutability
+            try:
+                sh = a.sharding.with_memory_kind("pinned_host")
+            except Exception:
+                continue
+            arrays.append(a)
+            shardings.append(sh)
+            keys.append(key)
+            claimed += a.nbytes
+        if arrays:
+            try:
+                host_arrays = jax.device_put(arrays, shardings)
+                jax.block_until_ready(host_arrays)
+            except Exception:
+                logger.warning(
+                    "eager host offload unavailable; arrays will stage "
+                    "lazily (safe: jax.Array is immutable)",
+                    exc_info=True,
+                )
+                host_arrays = None
+            if host_arrays is not None:
+                for key, h in zip(keys, host_arrays):
+                    for st in by_array[key]:
+                        st.arr = h
+                    moved += h.nbytes
+
+    for st in host_stagers:
+        st.arr = np.copy(st.arr)
+        st.defensive_copy = False
+        st.owns_arr = True  # staging must drop the copy once consumed
+        moved += st.arr.nbytes
+    return moved

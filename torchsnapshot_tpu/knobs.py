@@ -24,6 +24,7 @@ _ALLOW_PICKLE_OBJECTS = "ALLOW_PICKLE_OBJECTS"
 _STAGING_THREADS = "STAGING_THREADS"
 _ENABLE_NATIVE_EXT = "ENABLE_NATIVE_EXT"
 _FS_VERIFY_WRITES = "FS_VERIFY_WRITES"
+_DISABLE_EAGER_HOST_STAGING = "DISABLE_EAGER_HOST_STAGING"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -49,6 +50,9 @@ _DEFAULTS = {
     # Verify every fs write by re-reading and crc32c-comparing (native
     # backend only; catches torn/corrupted local writes at save time).
     _FS_VERIFY_WRITES: 0,
+    # async_take unblocks after one batched device→pinned_host transfer
+    # instead of after full staging (see host_offload.eager_offload_write_reqs).
+    _DISABLE_EAGER_HOST_STAGING: 0,
 }
 
 _OVERRIDES: dict = {}
@@ -104,6 +108,10 @@ def is_fs_verify_writes() -> bool:
     return bool(_get_int(_FS_VERIFY_WRITES))
 
 
+def is_eager_host_staging_disabled() -> bool:
+    return bool(_get_int(_DISABLE_EAGER_HOST_STAGING))
+
+
 @contextlib.contextmanager
 def _override(name: str, value) -> Iterator[None]:
     # Context-manager override, mirroring reference knobs.py:84-132.
@@ -157,3 +165,7 @@ def override_enable_native_ext(value: bool):
 
 def override_fs_verify_writes(value: bool):
     return _override(_FS_VERIFY_WRITES, int(value))
+
+
+def override_disable_eager_host_staging(value: bool):
+    return _override(_DISABLE_EAGER_HOST_STAGING, int(value))

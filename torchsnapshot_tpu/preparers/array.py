@@ -119,6 +119,11 @@ class HostArrayBufferStager(BufferStager):
     def __init__(self, arr: np.ndarray, defensive_copy: bool):
         self.arr = arr
         self.defensive_copy = defensive_copy
+        # Set when the stager holds a private copy (eager offload took the
+        # defensive copy early); staging then drops the ref so the copy is
+        # freed as soon as its storage write completes, matching the
+        # scheduler's budget credits.
+        self.owns_arr = False
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> memoryview:
         arr = self.arr
@@ -128,6 +133,8 @@ class HostArrayBufferStager(BufferStager):
                 arr = await loop.run_in_executor(executor, np.copy, arr)
             else:
                 arr = np.copy(arr)
+            self.arr = None
+        elif self.owns_arr:
             self.arr = None
         return array_as_memoryview(arr)
 

@@ -274,10 +274,17 @@ def sync_execute_write_reqs(
     storage: StoragePlugin,
     memory_budget_bytes: int,
     rank: int,
+    wait_for_staging: bool = True,
 ) -> PendingIOWork:
     """Stage all write requests under the memory budget; return once staging
     completes, with residual storage I/O draining in the background
-    (reference sync_execute_write_reqs, scheduler.py:342-357)."""
+    (reference sync_execute_write_reqs, scheduler.py:342-357).
+
+    With ``wait_for_staging=False`` the call returns immediately and the
+    whole pipeline (staging + storage I/O) drains on the loop thread — used
+    by ``async_take`` after ``eager_offload_write_reqs`` has already made
+    every buffer independent of training state, which moves the unblock
+    point from staged-in-client-RAM to offloaded-to-TPU-host-RAM."""
     executor = ThreadPoolExecutor(
         max_workers=knobs.get_staging_threads(), thread_name_prefix="tsnp-staging"
     )
@@ -297,9 +304,10 @@ def sync_execute_write_reqs(
             pipelines, storage, budget, executor, staging_done, stats
         )
     )
-    while not staging_done.wait(timeout=0.05):
-        if fut.done():
-            break
+    if wait_for_staging:
+        while not staging_done.wait(timeout=0.05):
+            if fut.done():
+                break
     pending = PendingIOWork(fut, loop_thread, executor, stats)
     if fut.done() and fut.exception() is not None:
         pending.sync_complete()  # raises

@@ -10,11 +10,15 @@ snapshot.py:112-1072).  The orchestration mirrors the reference call stacks
 - the commit point is identical: ``.snapshot_metadata`` written by rank 0
   only after every rank finished its writes (reference snapshot.py:202-209)
   — a snapshot without it is by definition incomplete (snapshot.py:849-854),
-- ``async_take`` returns once staging completes; storage I/O drains on the
-  scheduler's loop thread and a background thread runs the commit barrier
-  purely over KV — no collectives, so it can never race with training's
-  ICI traffic (the reference's constraint at snapshot.py:1010 holds by
-  construction).
+- ``async_take`` returns as soon as the pending buffers are independent of
+  training state: one batched device→pinned_host transfer plus eager
+  defensive copies of mutable host arrays (host_offload.
+  eager_offload_write_reqs) — *before* staging, not after it like the
+  reference (its CUDA tensors are mutable; jax.Arrays are not).  Staging
+  and storage I/O drain on the scheduler's loop thread and a background
+  thread runs the commit barrier purely over KV — no collectives, so it
+  can never race with training's ICI traffic (the reference's constraint
+  at snapshot.py:1010 holds by construction).
 """
 
 from __future__ import annotations
@@ -121,9 +125,14 @@ class Snapshot:
         replicated: Sequence[str] = (),
         coordinator: Optional[Coordinator] = None,
     ) -> "PendingSnapshot":
-        """Unblock-early save: returns once all state is staged in host
-        memory; storage I/O + commit happen in the background (reference
-        Snapshot.async_take, snapshot.py:229-318)."""
+        """Unblock-early save (reference Snapshot.async_take,
+        snapshot.py:229-318).  Returns once the snapshot content is
+        independent of training state: device arrays are offloaded to
+        pinned host memory in one batched DMA transfer and mutable host
+        arrays are defensively copied.  Staging, storage I/O and the
+        commit all happen in the background.  With
+        TORCHSNAPSHOT_TPU_DISABLE_EAGER_HOST_STAGING=1 this reverts to
+        the reference semantics (return after staging completes)."""
         coordinator = coordinator or get_default_coordinator()
         with log_event(
             Event("async_take", {"path": path, "rank": coordinator.rank})
@@ -269,7 +278,28 @@ class Snapshot:
 
         commit_uid = coordinator._next_uid("commit")
         budget = get_process_memory_budget_bytes()
-        pending_io = sync_execute_write_reqs(write_reqs, storage, budget, rank)
+
+        # TPU-native unblock-early point: one batched device→pinned_host
+        # transfer (plus eager defensive copies of mutable host arrays)
+        # makes every pending buffer independent of training state, so the
+        # async path returns *before* staging instead of after it — the
+        # reference must wait for staged-in-host-RAM because CUDA tensors
+        # are mutable (reference scheduler.py:299, io_preparers/
+        # tensor.py:283-307); jax.Array immutability moves the safety
+        # point to the end of this call.
+        unblock_early = is_async and not knobs.is_eager_host_staging_disabled()
+        if unblock_early:
+            from .host_offload import eager_offload_write_reqs
+
+            # Cap the pinned-host claim at half the staging budget so
+            # offloaded-but-unstaged buffers plus in-flight staged copies
+            # stay within host RAM; arrays past the cap stage lazily in
+            # the background (safe: jax.Array is immutable).
+            eager_offload_write_reqs(write_reqs, budget_bytes=budget // 2)
+        pending_io = sync_execute_write_reqs(
+            write_reqs, storage, budget, rank,
+            wait_for_staging=not unblock_early,
+        )
         return metadata, pending_io, storage, commit_uid
 
     # --------------------------------------------------------------- restore

@@ -172,3 +172,43 @@ def test_distributed_primitive_mismatch_per_rank(tmp_path):
     snap = Snapshot(str(tmp_path / "snap"))
     assert snap.read_object("0/app/step") == 100
     assert snap.read_object("1/app/step") == 101
+
+
+def test_replication_verification_demotes_divergent_state(tmp_path):
+    """State matched by a replicated glob but differing across ranks must
+    be demoted to per-rank entries (fingerprint verification; reference
+    intersects per-rank path sets at snapshot.py:637-670 — here content
+    divergence is caught too), while genuinely identical state stays
+    replicated and each rank restores its own divergent copy."""
+    run_workers(
+        tmp_path,
+        2,
+        """
+        state = StateDict(
+            shared=np.arange(16, dtype=np.float32),       # truly replicated
+            drifted=np.full(4, float(rank)),              # diverged!
+        )
+        Snapshot.take(snap_dir, {"app": state},
+                      replicated=["app/*"], coordinator=coord)
+        """,
+    )
+    snap = Snapshot(str(tmp_path / "snap"))
+    manifest = snap.get_manifest()
+    # drifted was demoted: both ranks' copies exist
+    assert "0/app/drifted" in manifest and "1/app/drifted" in manifest
+    # shared stayed replicated: exactly one logical copy
+    shared_keys = [k for k in manifest if k.endswith("app/shared")]
+    assert len(shared_keys) == 1, shared_keys
+    # per-rank restore returns each rank's own drifted copy
+    kv2 = tmp_path / "kv2"
+    run_workers(
+        tmp_path,
+        2,
+        f"""
+        coord = FileCoordinator({str(kv2)!r}, rank, world)
+        dest = StateDict(shared=np.zeros(16, np.float32), drifted=np.zeros(4))
+        Snapshot(snap_dir, coordinator=coord).restore({{"app": dest}})
+        assert np.array_equal(dest["drifted"], np.full(4, float(rank))), dest["drifted"]
+        assert np.array_equal(dest["shared"], np.arange(16, dtype=np.float32))
+        """,
+    )

@@ -62,6 +62,76 @@ SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 AppState = Dict[str, Stateful]
 
 
+def _replication_fingerprint(obj: Any) -> Tuple:
+    """Cheap per-leaf fingerprint used to verify that state claimed
+    replicated actually matches across ranks (reference intersects the
+    per-rank *path* sets, snapshot.py:637-670; this additionally
+    fingerprints host-array content, the state most prone to silent
+    divergence — e.g. per-rank optimizer scalars).
+
+    - numpy / torch-CPU arrays: dtype, shape, crc32 of head+tail windows
+      (content check without hashing gigabytes);
+    - jax arrays: dtype + shape only — content verification would force a
+      device sync on the save path, and replication of jax arrays is
+      already explicit in their sharding;
+    - primitives: the value itself;
+    - anything else: type name only.
+    """
+    import zlib
+
+    import numpy as np
+
+    from .preparers.array import _is_jax_array, _is_torch_tensor, _to_host_view
+
+    if isinstance(obj, (int, float, bool, str, bytes, type(None))):
+        return ("prim", obj)
+    if _is_jax_array(obj):
+        return ("jax", str(obj.dtype), tuple(obj.shape))
+    if isinstance(obj, np.ndarray) or _is_torch_tensor(obj):
+        view = np.ascontiguousarray(_to_host_view(obj))
+        raw = view.view(np.uint8).reshape(-1)
+        window = 65536
+        crc = zlib.crc32(raw[:window].tobytes())
+        if raw.nbytes > window:
+            crc = zlib.crc32(raw[-window:].tobytes(), crc)
+        return ("arr", str(view.dtype), tuple(view.shape), crc)
+    return ("obj", type(obj).__name__)
+
+
+def _verify_replicated_paths(
+    flattened: Dict[str, Any],
+    replicated_globs: Sequence[str],
+    coordinator: Coordinator,
+) -> set:
+    """The set of logical paths that are *verifiably* replicated: matched
+    by the agreed globs on every rank, with identical fingerprints.
+    Mismatches are demoted to per-rank entries with a warning — a corrupt
+    'replicated' save (only one rank's copy persisted) is strictly worse
+    than a larger correct one."""
+    local = {
+        lpath: _replication_fingerprint(obj)
+        for lpath, obj in flattened.items()
+        if path_is_replicated(lpath, replicated_globs)
+    }
+    if coordinator.world_size <= 1:
+        return set(local)
+    gathered = coordinator.all_gather_object(local)
+    verified = set()
+    for lpath, fp in gathered[0].items():
+        if all(peer.get(lpath) == fp for peer in gathered[1:]):
+            verified.add(lpath)
+    demoted = set(local) - verified
+    if demoted:
+        logger.warning(
+            "rank %d: %d path(s) matched replicated globs but differ "
+            "across ranks; saving per-rank instead: %s",
+            coordinator.rank,
+            len(demoted),
+            sorted(demoted)[:10],
+        )
+    return verified
+
+
 def _validate_app_state(app_state: Dict[str, Any]) -> None:
     # reference snapshot.py:672-690
     for key, value in app_state.items():
@@ -213,9 +283,12 @@ class Snapshot:
         repl_reqs: Dict[str, List[WriteReq]] = {}
         repl_items: List[Tuple[str, int]] = []
         local_bytes = 0
+        verified_repl = _verify_replicated_paths(
+            flattened, replicated_globs, coordinator
+        )
         for lpath in sorted(flattened.keys()):
             obj = flattened[lpath]
-            repl = path_is_replicated(lpath, replicated_globs)
+            repl = lpath in verified_repl
             entry, reqs = prepare_write(
                 obj=obj,
                 logical_path=lpath,

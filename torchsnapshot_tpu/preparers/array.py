@@ -33,6 +33,8 @@ from ..serialization import (
     array_as_memoryview,
     array_from_buffer,
     dtype_to_string,
+    fast_copy,
+    fast_copyto,
     serialized_size_bytes,
     string_to_dtype,
 )
@@ -130,9 +132,9 @@ class HostArrayBufferStager(BufferStager):
         if self.defensive_copy:
             loop = asyncio.get_running_loop()
             if executor is not None:
-                arr = await loop.run_in_executor(executor, np.copy, arr)
+                arr = await loop.run_in_executor(executor, fast_copy, arr)
             else:
-                arr = np.copy(arr)
+                arr = fast_copy(arr)
             self.arr = None
         elif self.owns_arr:
             self.arr = None
@@ -155,13 +157,13 @@ def materialize_into_template(np_arr: np.ndarray, obj_out: Any) -> Any:
     if obj_out is None:
         return np_arr.copy()
     if isinstance(obj_out, np.ndarray):
-        np.copyto(obj_out, np_arr.reshape(obj_out.shape), casting="unsafe")
+        fast_copyto(obj_out, np_arr.reshape(obj_out.shape))
         return obj_out
     if _is_torch_tensor(obj_out):
         import torch
 
         view = obj_out.detach().cpu().numpy()
-        np.copyto(view, np_arr.reshape(view.shape), casting="unsafe")
+        fast_copyto(view, np_arr.reshape(view.shape))
         return obj_out
     if _is_jax_array(obj_out):
         import jax
@@ -223,7 +225,7 @@ class _TiledConsumer(BufferConsumer):
     ) -> None:
         start, end = self.elem_range
         np_arr = array_from_buffer(buf, self.dtype, (end - start,))
-        np.copyto(self.target_flat[start:end], np_arr, casting="unsafe")
+        fast_copyto(self.target_flat[start:end], np_arr)
         self.countdown.step()
 
     def get_consuming_cost_bytes(self) -> int:
@@ -440,7 +442,7 @@ class _ChunkConsumer(BufferConsumer):
         np_arr = array_from_buffer(buf, self.dtype, tuple(self.sizes))
 
         def copy() -> None:
-            np.copyto(self.host_buf[r0:r1], np_arr, casting="unsafe")
+            fast_copyto(self.host_buf[r0:r1], np_arr)
 
         loop = asyncio.get_running_loop()
         if executor is not None:

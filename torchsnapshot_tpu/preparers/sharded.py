@@ -38,6 +38,7 @@ from ..io_types import BufferConsumer, BufferStager, Future, ReadReq, WriteReq
 from ..manifest import Shard, ShardedArrayEntry
 from ..serialization import (
     array_from_buffer,
+    fast_copyto,
     serialized_size_bytes,
     string_to_dtype,
 )
@@ -347,7 +348,7 @@ class _ShardConsumer(BufferConsumer):
                 # 0-d boxes: arr[()] yields a scalar, not a view — use [...]
                 s = src[s_sl] if s_sl else src[...]
                 d = self.buffers[lbox][d_sl] if d_sl else self.buffers[lbox][...]
-                np.copyto(d, s, casting="unsafe")
+                fast_copyto(d, s)
 
         loop = asyncio.get_running_loop()
         if executor is not None:

@@ -106,6 +106,34 @@ def serialized_size_bytes(shape, dtype: Any) -> int:
     return n * np.dtype(dtype).itemsize
 
 
+_UINT_FOR_ITEMSIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def fast_copyto(dst: np.ndarray, src: np.ndarray) -> None:
+    """memcpy-speed ``np.copyto``. Same-dtype copies of extension dtypes
+    (ml_dtypes bfloat16/fp8) otherwise go through numpy's per-element cast
+    machinery at ~0.5 GB/s; routing them through a bit-identical
+    unsigned-integer view runs at memory bandwidth (~10x), including for
+    strided views. Falls back to casting ``np.copyto`` for dtype changes."""
+    if (
+        dst.dtype == src.dtype
+        and not dst.dtype.hasobject
+        and dst.dtype.itemsize in _UINT_FOR_ITEMSIZE
+    ):
+        u = _UINT_FOR_ITEMSIZE[dst.dtype.itemsize]
+        np.copyto(dst.view(u), src.view(u))
+    else:
+        np.copyto(dst, src, casting="unsafe")
+
+
+def fast_copy(src: np.ndarray) -> np.ndarray:
+    """``np.copy`` at memory bandwidth (same extension-dtype caveat as
+    :func:`fast_copyto`; ``np.copy`` of an ml_dtypes array is ~0.2 GB/s)."""
+    dst = np.empty(src.shape, dtype=src.dtype)
+    fast_copyto(dst, src)
+    return dst
+
+
 # ---------------------------------------------------------------------------
 # Safe object codec (msgpack with extension types). Covers: None, bool, int,
 # float, str, bytes, list, tuple, set, frozenset, dict (any hashable encodable

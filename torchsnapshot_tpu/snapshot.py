@@ -47,6 +47,7 @@ from .manifest import (
 from .manifest_ops import consolidate_manifests, get_manifest_for_rank
 from .partitioner import partition_replicated_writes
 from .preparers import path_is_replicated, prepare_read, prepare_write
+from .serialization import serialize_object
 from .scheduler import (
     PendingIOWork,
     get_process_memory_budget_bytes,
@@ -63,39 +64,66 @@ AppState = Dict[str, Stateful]
 
 
 def _replication_fingerprint(obj: Any) -> Tuple:
-    """Cheap per-leaf fingerprint used to verify that state claimed
-    replicated actually matches across ranks (reference intersects the
-    per-rank *path* sets, snapshot.py:637-670; this additionally
-    fingerprints host-array content, the state most prone to silent
-    divergence — e.g. per-rank optimizer scalars).
+    """Per-leaf fingerprint used to verify that state claimed replicated
+    actually matches across ranks (reference intersects the per-rank
+    *path* sets only, snapshot.py:637-670; this additionally fingerprints
+    content, the failure mode most prone to silent divergence — e.g.
+    per-rank optimizer scalars).
 
-    - numpy / torch-CPU arrays: dtype, shape, crc32 of head+tail windows
-      (content check without hashing gigabytes);
+    - numpy / torch-CPU arrays: dtype, shape + crc32 of the FULL buffer
+      (zlib.crc32 runs at ~3 GB/s; host replicated state is typically
+      small — large state is jax arrays). A sampled check would miss
+      divergence between windows, which is exactly the silent corruption
+      this exists to prevent. Non-contiguous arrays are CRC'd in row
+      blocks so the copy stays bounded.
     - jax arrays: dtype + shape only — content verification would force a
       device sync on the save path, and replication of jax arrays is
       already explicit in their sharding;
-    - primitives: the value itself;
-    - anything else: type name only.
+    - primitives: small values verbatim; floats by bit pattern (NaN would
+      never compare equal to itself); long str/bytes by length + crc32 so
+      multi-MB blobs never ride the coordination KV;
+    - anything else: crc32 of its serialized form (content-verified, not
+      just the type name).
     """
+    import struct
     import zlib
 
     import numpy as np
 
     from .preparers.array import _is_jax_array, _is_torch_tensor, _to_host_view
 
-    if isinstance(obj, (int, float, bool, str, bytes, type(None))):
-        return ("prim", obj)
+    if isinstance(obj, float):
+        return ("prim_f", struct.pack("<d", obj))
+    if isinstance(obj, (str, bytes)):
+        raw = obj.encode("utf-8", "surrogatepass") if isinstance(obj, str) else obj
+        if len(raw) > 4096:
+            return ("prim_big", type(obj).__name__, len(raw), zlib.crc32(raw))
+        return ("prim", type(obj).__name__, obj)
+    if isinstance(obj, (int, bool, type(None))):
+        # concrete type in the tag: True == 1 but bool-vs-int divergence
+        # across ranks must still demote
+        return ("prim", type(obj).__name__, obj)
     if _is_jax_array(obj):
         return ("jax", str(obj.dtype), tuple(obj.shape))
     if isinstance(obj, np.ndarray) or _is_torch_tensor(obj):
-        view = np.ascontiguousarray(_to_host_view(obj))
-        raw = view.view(np.uint8).reshape(-1)
-        window = 65536
-        crc = zlib.crc32(raw[:window].tobytes())
-        if raw.nbytes > window:
-            crc = zlib.crc32(raw[-window:].tobytes(), crc)
-        return ("arr", str(view.dtype), tuple(view.shape), crc)
-    return ("obj", type(obj).__name__)
+        view = _to_host_view(obj)
+        if view.flags["C_CONTIGUOUS"]:
+            crc = zlib.crc32(view.reshape(-1).view(np.uint8))
+        elif view.ndim >= 1 and view.shape[0] > 1:
+            crc = 0
+            rows_per = max(1, (16 << 20) // max(1, view[:1].nbytes))
+            for i in range(0, view.shape[0], rows_per):
+                block = np.ascontiguousarray(view[i : i + rows_per])
+                crc = zlib.crc32(block.reshape(-1).view(np.uint8), crc)
+        else:
+            block = np.ascontiguousarray(view)
+            crc = zlib.crc32(block.reshape(-1).view(np.uint8))
+        return ("arr", str(obj.dtype), tuple(obj.shape), crc)
+    try:
+        payload, _ = serialize_object(obj)
+        return ("obj", type(obj).__name__, len(payload), zlib.crc32(payload))
+    except Exception:
+        return ("obj", type(obj).__name__)
 
 
 def _verify_replicated_paths(
@@ -108,6 +136,10 @@ def _verify_replicated_paths(
     Mismatches are demoted to per-rank entries with a warning — a corrupt
     'replicated' save (only one rank's copy persisted) is strictly worse
     than a larger correct one."""
+    if not replicated_globs:
+        # nothing can match: skip the KV round-trip entirely (all ranks
+        # agree on the globs by this point, so all branch identically)
+        return set()
     local = {
         lpath: _replication_fingerprint(obj)
         for lpath, obj in flattened.items()

@@ -13,9 +13,10 @@ This benchmark measures both for ``async_take`` on a bf16 parameter
 pytree on one TPU chip:
 
 - ``value``         = payload / time-blocked (GB/s/chip).  The TPU-native
-  unblock point is one batched device→pinned_host DMA transfer
+  unblock point is the *dispatch* of one batched device→pinned_host DMA
   (host_offload.eager_offload_write_reqs) — safe because jax.Arrays are
-  immutable, so nothing can mutate the snapshot content afterwards.
+  immutable, so nothing can mutate the snapshot content afterwards; the
+  background pipeline blocks on the in-flight transfer when it stages.
 - ``total_s``       = wall time until the snapshot is fully committed
   (.snapshot_metadata written), storage I/O included.
 - ``vs_baseline``   = value / 1.44 GB/s (the reference's best published

@@ -73,6 +73,34 @@ def _iter_stagers(write_reqs) -> Iterator[Any]:
             yield st
 
 
+def _release_fallbacks_on_completion(host_arrays, stager_lists) -> None:
+    """Drop the stagers' device refs the moment the batched DMA completes,
+    so HBM is released as soon as training drops its own references — not
+    held for the whole background storage drain.  On transfer failure the
+    refs stay, and staging degrades to the device arrays."""
+    import threading
+
+    import jax
+
+    def _wait() -> None:
+        try:
+            jax.block_until_ready(host_arrays)
+        except Exception:
+            logger.warning(
+                "eager pinned-host offload failed after dispatch; device "
+                "refs retained for fallback staging",
+                exc_info=True,
+            )
+            return
+        for sts in stager_lists:
+            for st in sts:
+                st.fallback_arr = None
+
+    threading.Thread(
+        target=_wait, name="tsnp-offload-release", daemon=True
+    ).start()
+
+
 def eager_offload_write_reqs(
     write_reqs, budget_bytes: int | None = None
 ) -> int:
@@ -114,8 +142,7 @@ def eager_offload_write_reqs(
     defensive-copy-only pass when the runtime lacks host memory kinds
     (e.g. CPU meshes).
     """
-    import numpy as np
-
+    from .serialization import fast_copy
     from .preparers.array import (
         HostArrayBufferStager,
         JaxArrayBufferStager,
@@ -161,8 +188,14 @@ def eager_offload_write_reqs(
             claimed += a.nbytes
         if arrays:
             try:
+                # Dispatch ONE batched DMA and return without waiting for
+                # completion: jax.Arrays are immutable, so training can
+                # never corrupt the snapshot content, and the background
+                # staging's np.asarray blocks on the in-flight transfer
+                # naturally.  The unblock point is transfer *dispatch*,
+                # not transfer completion — HBM is released as the DMA
+                # drains, a fraction of a second later.
                 host_arrays = jax.device_put(arrays, shardings)
-                jax.block_until_ready(host_arrays)
             except Exception:
                 logger.warning(
                     "eager host offload unavailable; arrays will stage "
@@ -171,13 +204,21 @@ def eager_offload_write_reqs(
                 )
                 host_arrays = None
             if host_arrays is not None:
+                stager_lists = []
                 for key, h in zip(keys, host_arrays):
                     for st in by_array[key]:
+                        # Keep the original device ref as a staging
+                        # fallback: the dispatched transfer can still fail
+                        # asynchronously (pinned-host allocation), and the
+                        # immutable device array remains a valid source.
+                        st.fallback_arr = st.arr
                         st.arr = h
+                    stager_lists.append(by_array[key])
                     moved += h.nbytes
+                _release_fallbacks_on_completion(host_arrays, stager_lists)
 
     for st in host_stagers:
-        st.arr = np.copy(st.arr)
+        st.arr = fast_copy(st.arr)
         st.defensive_copy = False
         st.owns_arr = True  # staging must drop the copy once consumed
         moved += st.arr.nbytes

@@ -28,6 +28,8 @@ import numpy as np
 from .. import knobs
 from ..io_types import BufferConsumer, BufferStager, Future, ReadReq, WriteReq
 from ..manifest import ArrayEntry, ChunkedArrayEntry, Shard
+import logging
+
 from ..serialization import (
     BUFFER_PROTOCOL,
     array_as_memoryview,
@@ -38,6 +40,8 @@ from ..serialization import (
     serialized_size_bytes,
     string_to_dtype,
 )
+
+logger = logging.getLogger(__name__)
 
 
 def _is_torch_tensor(obj: Any) -> bool:
@@ -94,19 +98,44 @@ class JaxArrayBufferStager(BufferStager):
         self.arr = arr
         self.index = index
         self.nbytes = nbytes or array_nbytes(arr)
+        # Set by eager_offload_write_reqs when it re-points ``arr`` at an
+        # in-flight pinned-host copy: the original (immutable) device array,
+        # kept so an asynchronous offload failure (e.g. pinned-host
+        # allocation) degrades to staging straight from the device instead
+        # of failing the snapshot.  Cleared the moment the host copy
+        # materializes successfully.
+        self.fallback_arr: Any = None
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> memoryview:
-        a = self.arr if self.index is None else self.arr[self.index]
-        try:
-            a.copy_to_host_async()
-        except Exception:
-            pass  # some array types (fully replicated committed) may decline
         loop = asyncio.get_running_loop()
-        if executor is not None:
-            np_arr = await loop.run_in_executor(executor, np.asarray, a)
-        else:
-            np_arr = np.asarray(a)
-        self.arr = None  # drop the device ref as early as possible
+
+        def _materialize(src: Any) -> np.ndarray:
+            a = src if self.index is None else src[self.index]
+            try:
+                a.copy_to_host_async()
+            except Exception:
+                pass  # some array types (fully replicated committed) may decline
+            return np.asarray(a)
+
+        async def _run(src: Any) -> np.ndarray:
+            if executor is not None:
+                return await loop.run_in_executor(executor, _materialize, src)
+            return _materialize(src)
+
+        try:
+            np_arr = await _run(self.arr)
+        except Exception:
+            fallback = self.fallback_arr
+            if fallback is None:
+                raise
+            logger.warning(
+                "eager pinned-host offload failed asynchronously; staging "
+                "from the device array instead (safe: jax.Array is immutable)",
+                exc_info=True,
+            )
+            np_arr = await _run(fallback)
+        self.arr = None  # drop refs as early as possible
+        self.fallback_arr = None
         return array_as_memoryview(np_arr)
 
     def get_staging_cost_bytes(self) -> int:

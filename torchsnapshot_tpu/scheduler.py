@@ -117,22 +117,33 @@ class PendingIOWork:
 
     def __init__(
         self,
-        fut: concurrent.futures.Future,
+        fut: Optional[concurrent.futures.Future],
         loop_thread: _LoopThread,
         executor: ThreadPoolExecutor,
         stats: dict,
+        starter: Optional[Callable[[], concurrent.futures.Future]] = None,
     ) -> None:
         self._fut = fut
+        self._starter = starter
         self._loop_thread = loop_thread
         self._executor = executor
         self._stats = stats
         self._completed = False
 
+    def ensure_started(self) -> concurrent.futures.Future:
+        """Kick off the pipeline if construction deferred it (the
+        async_take path defers so the commit thread — not the caller's
+        blocked window — pays for pipeline spin-up and the GIL contention
+        of the first staging memcpys)."""
+        if self._fut is None:
+            self._fut = self._starter()
+        return self._fut
+
     def sync_complete(self) -> None:
         if self._completed:
             return
         try:
-            self._fut.result()
+            self.ensure_started().result()
         finally:
             self._completed = True
             self._executor.shutdown(wait=False)
@@ -284,7 +295,9 @@ def sync_execute_write_reqs(
     whole pipeline (staging + storage I/O) drains on the loop thread — used
     by ``async_take`` after ``eager_offload_write_reqs`` has already made
     every buffer independent of training state, which moves the unblock
-    point from staged-in-client-RAM to offloaded-to-TPU-host-RAM."""
+    point from staged-in-client-RAM to DMA-dispatched (the pipeline
+    itself kicks off lazily from the commit thread's sync_complete so the
+    caller's blocked window pays for nothing but planning + dispatch)."""
     executor = ThreadPoolExecutor(
         max_workers=knobs.get_staging_threads(), thread_name_prefix="tsnp-staging"
     )
@@ -299,15 +312,25 @@ def sync_execute_write_reqs(
     staging_done = threading.Event()
     stats = {"bytes_written": 0, "begin_ts": time.monotonic()}
     loop_thread = _LoopThread()
-    fut = loop_thread.submit(
-        _execute_write_pipelines(
-            pipelines, storage, budget, executor, staging_done, stats
+
+    def _start() -> concurrent.futures.Future:
+        return loop_thread.submit(
+            _execute_write_pipelines(
+                pipelines, storage, budget, executor, staging_done, stats
+            )
         )
-    )
-    if wait_for_staging:
-        while not staging_done.wait(timeout=0.05):
-            if fut.done():
-                break
+
+    if not wait_for_staging:
+        # Unblock-early path: every buffer is already independent of
+        # training state (eager_offload_write_reqs), so nothing here needs
+        # to run before control returns.  Defer the pipeline kick-off to
+        # the background thread that calls sync_complete().
+        return PendingIOWork(None, loop_thread, executor, stats, starter=_start)
+
+    fut = _start()
+    while not staging_done.wait(timeout=0.05):
+        if fut.done():
+            break
     pending = PendingIOWork(fut, loop_thread, executor, stats)
     if fut.done() and fut.exception() is not None:
         pending.sync_complete()  # raises

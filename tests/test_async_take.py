@@ -80,7 +80,7 @@ def test_async_take_error_via_wait_and_no_metadata(tmp_path, patch_storage):
         pending.wait()
     # the commit point was never reached (reference test_async_take.py:96-117)
     assert not os.path.exists(str(tmp_path / "s" / SNAPSHOT_METADATA_FNAME))
-    with pytest.raises(RuntimeError, match="incomplete"):
+    with pytest.raises(FileNotFoundError, match="not a committed snapshot"):
         _ = Snapshot(str(tmp_path / "s")).metadata
 
 

@@ -35,7 +35,7 @@ def test_event_failure_marked(tmp_path):
     events = []
     register_event_handler(events.append)
     try:
-        with pytest.raises(RuntimeError):
+        with pytest.raises(FileNotFoundError):
             Snapshot(str(tmp_path / "missing")).restore({"app": StateDict(x=0)})
     finally:
         unregister_event_handler(events.append)

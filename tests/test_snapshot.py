@@ -192,9 +192,14 @@ def test_restore_strict_missing_key(tmp_path):
 
 
 def test_missing_metadata_raises(tmp_path):
+    # missing outright → FileNotFoundError so resumable loops can
+    # `except FileNotFoundError` for cold starts; same contract for
+    # memory:// (and gs:// maps 404s the same way)
     snap = Snapshot(str(tmp_path / "nonexistent"))
-    with pytest.raises(RuntimeError, match="incomplete"):
+    with pytest.raises(FileNotFoundError, match="not a committed snapshot"):
         _ = snap.metadata
+    with pytest.raises(FileNotFoundError):
+        _ = Snapshot("memory://no_such_ns_xyz").metadata
 
 
 def test_dtype_cast_on_restore(tmp_path):

@@ -419,6 +419,15 @@ class Snapshot:
             try:
                 read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
                 storage.sync_read(read_io)
+            except FileNotFoundError as e:
+                # Missing outright (cold start / never committed) is
+                # distinguishable from unreadable, so resumable-training
+                # loops can `except FileNotFoundError` to cold-start.
+                raise FileNotFoundError(
+                    f"no {SNAPSHOT_METADATA_FNAME} under {self.path!r} — "
+                    f"not a committed snapshot (a snapshot without "
+                    f"metadata was aborted before commit)"
+                ) from e
             except Exception as e:
                 raise RuntimeError(
                     f"failed to read {SNAPSHOT_METADATA_FNAME} under "

@@ -79,7 +79,16 @@ class GCSStoragePlugin(StoragePlugin):
                 result = await loop.run_in_executor(self._executor, fn)
                 self._retry.record_progress()
                 return result
+            except FileNotFoundError:
+                raise
             except Exception as e:  # noqa: BLE001
+                # Missing objects are not transient: map to the same
+                # FileNotFoundError contract as the fs/memory plugins
+                # instead of burning the retry deadline on a 404.
+                if type(e).__name__ == "NotFound" or getattr(
+                    e, "code", None
+                ) == 404:
+                    raise FileNotFoundError(f"{op_name}: {e}") from e
                 attempt += 1
                 if not self._retry.should_retry(attempt):
                     raise

@@ -31,7 +31,12 @@ class MemoryStoragePlugin(StoragePlugin):
         self._store[write_io.path] = bytes(write_io.buf)
 
     async def read(self, read_io: ReadIO) -> None:
-        data = self._store[read_io.path]
+        try:
+            data = self._store[read_io.path]
+        except KeyError:
+            raise FileNotFoundError(
+                f"memory://{self.namespace}/{read_io.path}"
+            ) from None
         if read_io.byte_range is None:
             read_io.buf = data
         else:

@@ -82,9 +82,26 @@ class S3StoragePlugin(StoragePlugin):
             if read_io.byte_range is not None:
                 start, end = read_io.byte_range
                 kwargs["Range"] = f"bytes={start}-{end - 1}"
-            resp = await self._run(
-                functools.partial(self._backend.get_object, **kwargs)
-            )
+            try:
+                resp = await self._run(
+                    functools.partial(self._backend.get_object, **kwargs)
+                )
+            except Exception as e:
+                # Map missing keys to the same cold-start contract as the
+                # fs/memory/gcs plugins (botocore ClientError NoSuchKey /
+                # 404) so `except FileNotFoundError` works for s3:// too.
+                code = str(
+                    getattr(e, "response", {})
+                    .get("Error", {})
+                    .get("Code", "")
+                )
+                if code in ("NoSuchKey", "404") or type(e).__name__ in (
+                    "NoSuchKey",
+                ):
+                    raise FileNotFoundError(
+                        f"s3://{self.bucket}/{key}"
+                    ) from e
+                raise
             read_io.buf = await self._run(resp["Body"].read)
 
     async def delete(self, path: str) -> None:

@@ -7,6 +7,7 @@ multi-device cases real)."""
 import itertools
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -92,6 +93,24 @@ def test_shard_subdivision(tmp_path):
         dest = StateDict(w=_make(SPECS[6], np.zeros_like(value)))
         snap.restore({"app": dest})
         np.testing.assert_array_equal(np.asarray(dest["w"]), value)
+
+
+def test_uneven_jit_sharding_end_to_end(tmp_path):
+    # device_put rejects non-divisible NamedShardings, but jit's
+    # with_sharding_constraint pads (GSPMD): 6 rows over 4 devices gives
+    # four (3,5) local shards whose boxes over-cover the array. Saving
+    # must clip to the global shape and restore must round-trip.
+    f = jax.jit(
+        lambda x: jax.lax.with_sharding_constraint(
+            x, NamedSharding(_mesh((4,), ("x",)), P("x", None))
+        )
+    )
+    value = np.arange(6 * 5, dtype=np.float32).reshape(6, 5)
+    arr = f(jnp.asarray(value))
+    Snapshot.take(str(tmp_path / "u"), {"app": StateDict(w=arr)})
+    dest = StateDict(w=np.zeros_like(value))
+    Snapshot(str(tmp_path / "u")).restore({"app": dest})
+    np.testing.assert_array_equal(np.asarray(dest["w"]), value)
 
 
 def test_uneven_saved_boxes_planner_level(tmp_path):

@@ -25,6 +25,7 @@ _STAGING_THREADS = "STAGING_THREADS"
 _ENABLE_NATIVE_EXT = "ENABLE_NATIVE_EXT"
 _FS_VERIFY_WRITES = "FS_VERIFY_WRITES"
 _DISABLE_EAGER_HOST_STAGING = "DISABLE_EAGER_HOST_STAGING"
+_PALLAS_ATTENTION = "PALLAS_ATTENTION"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -53,18 +54,28 @@ _DEFAULTS = {
     # async_take unblocks after one batched device→pinned_host transfer
     # instead of after full staging (see host_offload.eager_offload_write_reqs).
     _DISABLE_EAGER_HOST_STAGING: 0,
+    # Use the pallas flash-attention kernel inside ring attention:
+    # "auto" = on for the CPU backend (interpret mode; what tests cover),
+    # off on TPU *by default* because tunneled/virtualized TPU attachments
+    # may not support Mosaic compilation; set to "1" on real TPU VMs.
+    _PALLAS_ATTENTION: "auto",
 }
 
 _OVERRIDES: dict = {}
 
 
-def _get_int(name: str) -> int:
+def _get_raw(name: str):
+    """Single resolution chain for every knob: override → env → default."""
     if name in _OVERRIDES:
-        return int(_OVERRIDES[name])
+        return _OVERRIDES[name]
     env = os.environ.get(_ENV_PREFIX + name)
     if env is not None:
-        return int(env)
-    return int(_DEFAULTS[name])
+        return env
+    return _DEFAULTS[name]
+
+
+def _get_int(name: str) -> int:
+    return int(_get_raw(name))
 
 
 def get_max_chunk_size_bytes() -> int:
@@ -110,6 +121,19 @@ def is_fs_verify_writes() -> bool:
 
 def is_eager_host_staging_disabled() -> bool:
     return bool(_get_int(_DISABLE_EAGER_HOST_STAGING))
+
+
+def use_pallas_attention() -> bool:
+    v = str(_get_raw(_PALLAS_ATTENTION)).lower()
+    if v in ("1", "true", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    # auto: pallas only where its compile path is known-good here —
+    # CPU interpret mode; real-TPU users opt in with "1"
+    import jax
+
+    return jax.default_backend() == "cpu"
 
 
 @contextlib.contextmanager
@@ -169,3 +193,7 @@ def override_fs_verify_writes(value: bool):
 
 def override_disable_eager_host_staging(value: bool):
     return _override(_DISABLE_EAGER_HOST_STAGING, int(value))
+
+
+def override_pallas_attention(value):
+    return _override(_PALLAS_ATTENTION, value)

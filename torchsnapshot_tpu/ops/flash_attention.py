@@ -1,0 +1,281 @@
+"""Pallas flash-attention kernel for the ring-attention hot path.
+
+The ring step's compute is one (q_shard, kv_shard) block-attention
+producing online-softmax partials (reference has no sequence-parallel
+code — SURVEY §5; this belongs to the framework's own long-context
+support, parallel/ring_attention.py).  The XLA fallback materializes the
+full [b, h, sq, sk] score matrix in HBM; this kernel tiles it through
+VMEM flash-attention style, so per-step memory is O(BQ x BK) instead of
+O(sq x sk) and the matmuls stay on the MXU back-to-back with the
+online-softmax VPU work.
+
+Layout: grid over (batch*heads, q_blocks); each program streams the
+kv-sequence in BK-sized blocks from VMEM, keeping a running (max,
+denominator, accumulator) triple in f32.  Sequence offsets (where this
+shard's rows/cols sit in the global sequence, needed for causal masking
+inside a ring step) arrive via scalar prefetch so the same compiled
+kernel serves every ring position.
+
+Outputs are the *partials* (pv, row_max, row_sumexp) rather than the
+normalized attention, exactly the contract the ring accumulator needs;
+``flash_attention`` also offers the standalone normalized form.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BQ = 128  # query rows per program
+_BK = 128  # kv rows per inner step
+_LANE = 128  # TPU lane width; head_dim padded up to a multiple
+
+_NEG_INF = float("-inf")
+
+try:  # pallas availability probe (older jax, exotic platforms)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+    PALLAS_AVAILABLE = False
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _attend_kernel(
+    offs_ref,  # SMEM scalar prefetch: [q_offset, k_offset, sk_real]
+    q_ref,  # [1, BQ, D]
+    k_ref,  # [1, SK, D]
+    v_ref,  # [1, SK, D]
+    out_ref,  # [1, BQ, D]
+    m_ref,  # [1, BQ]
+    l_ref,  # [1, BQ]
+    *,
+    causal: bool,
+    scale: float,
+    sk_pad: int,
+):
+    q_offset = offs_ref[0]
+    k_offset = offs_ref[1]
+    sk_real = offs_ref[2]
+    jq = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    d = q.shape[-1]
+
+    q_pos = q_offset + jq * _BQ + jax.lax.broadcasted_iota(
+        jnp.int32, (_BQ, _BK), 0
+    )
+
+    def body(kb, carry):
+        acc, m_run, l_run = carry
+        k_blk = k_ref[0, pl.ds(kb * _BK, _BK), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * _BK, _BK), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q,
+            k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        k_idx = kb * _BK + jax.lax.broadcasted_iota(
+            jnp.int32, (_BQ, _BK), 1
+        )
+        mask = k_idx < sk_real  # padded keys contribute nothing
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_offset + k_idx)
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+        m_blk = jnp.max(scores, axis=-1)  # [BQ]
+        m_new = jnp.maximum(m_run, m_blk)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - m_safe[:, None])
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
+        l_new = l_run * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p,
+            v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((_BQ, d), jnp.float32)
+    m0 = jnp.full((_BQ,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((_BQ,), jnp.float32)
+    acc, m_run, l_run = jax.lax.fori_loop(
+        0, sk_pad // _BK, body, (acc0, m0, l0)
+    )
+    out_ref[0] = acc
+    m_ref[0] = m_run
+    l_ref[0] = l_run
+
+
+def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "vma"))
+def _flash_partials_jit(
+    q, k, v, offs, *, causal: bool, scale: float, vma: tuple = ()
+):
+    """q/k/v: [bh, s, d] (already merged batch*heads).  Returns f32
+    partials (pv [bh, sq, d], m [bh, sq], l [bh, sq]).  ``vma`` names the
+    shard_map axes the operands vary over (required by pallas_call under
+    shard_map's varying-mesh-axes checking)."""
+    bh, sq, d0 = q.shape
+    sk = k.shape[1]
+    qp = _pad_to(_pad_to(q, 1, _BQ), 2, _LANE)
+    kp = _pad_to(_pad_to(k, 1, _BK), 2, _LANE)
+    vp = _pad_to(_pad_to(v, 1, _BK), 2, _LANE)
+    sq_pad, d = qp.shape[1], qp.shape[2]
+    sk_pad = kp.shape[1]
+    offs = jnp.concatenate(
+        [offs.astype(jnp.int32), jnp.array([sk], jnp.int32)]
+    )
+
+    grid = (bh, sq_pad // _BQ)
+    kernel = functools.partial(
+        _attend_kernel, causal=causal, scale=scale, sk_pad=sk_pad
+    )
+    out, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, _BQ, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((1, sk_pad, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((1, sk_pad, d), lambda i, j, offs: (i, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, _BQ, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((1, _BQ), lambda i, j, offs: (i, j)),
+                pl.BlockSpec((1, _BQ), lambda i, j, offs: (i, j)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (bh, sq_pad, d), jnp.float32, vma=frozenset(vma)
+            ),
+            jax.ShapeDtypeStruct(
+                (bh, sq_pad), jnp.float32, vma=frozenset(vma)
+            ),
+            jax.ShapeDtypeStruct(
+                (bh, sq_pad), jnp.float32, vma=frozenset(vma)
+            ),
+        ],
+        interpret=_use_interpret(),
+    )(offs, qp, kp, vp)
+    return out[:, :sq, :d0], m[:, :sq], l[:, :sq]
+
+
+def _partials_impl(q, k, v, qo, ko, causal: bool, scale: float, vma: tuple):
+    b, sq, h, d = q.shape
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    offs = jnp.stack([qo, ko]).astype(jnp.int32)
+    pv, m, l = _flash_partials_jit(
+        to_bh(q), to_bh(k), to_bh(v), offs,
+        causal=causal, scale=scale, vma=tuple(vma),
+    )
+    pv = pv.reshape(b, h, sq, d).transpose(0, 2, 1, 3).astype(v.dtype)
+    m = m.reshape(b, h, sq)
+    l = l.reshape(b, h, sq)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    return pv, m_safe, l
+
+
+@functools.lru_cache(maxsize=64)
+def _make_diff_partials(causal: bool, scale: float, vma: tuple):
+    """pallas_call has no autodiff rule; wrap the kernel in a custom_vjp
+    whose backward recomputes the block pair with XLA ops (same per-step
+    memory/compute as the non-pallas path — forward keeps the flash
+    tiling, training pays the old recompute cost on backward only)."""
+
+    @jax.custom_vjp
+    def f(q, k, v, qo, ko):
+        return _partials_impl(q, k, v, qo, ko, causal, scale, vma)
+
+    def fwd(q, k, v, qo, ko):
+        return _partials_impl(q, k, v, qo, ko, causal, scale, vma), (
+            q, k, v, qo, ko,
+        )
+
+    def bwd(res, cts):
+        q, k, v, qo, ko = res
+        from ..parallel.ring_attention import _block_attend
+
+        def xla_fn(q, k, v):
+            pv, m_safe, l, _ = _block_attend(
+                q, k, v,
+                q_offset=qo, k_offset=ko, causal=causal, scale=scale,
+            )
+            return pv, m_safe, l
+
+        _, vjp = jax.vjp(xla_fn, q, k, v)
+        dq, dk, dv = vjp(cts)
+        # integer offsets: cotangent type is float0
+        zero0 = lambda x: np.zeros(x.shape, dtype=jax.dtypes.float0)
+        return dq, dk, dv, zero0(qo), zero0(ko)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def flash_attention_partials(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset,
+    k_offset,
+    causal: bool,
+    scale: float,
+    vma: tuple = (),
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Drop-in for ring_attention's ``_block_attend`` contract.
+
+    q: [b, sq, h, d]; k/v: [b, sk, h, d].  Returns (pv [b, sq, h, d],
+    m_safe [b, h, sq], l [b, h, sq], valid [b, h, sq]).  Pass the
+    enclosing shard_map axis name(s) via ``vma`` when calling inside one.
+    """
+    # offsets stay integer end-to-end: float32 would round past 2^24,
+    # silently shifting the causal boundary at very long contexts
+    qo = jnp.asarray(q_offset, jnp.int32)
+    ko = jnp.asarray(k_offset, jnp.int32)
+    pv, m_safe, l = _make_diff_partials(causal, scale, tuple(vma))(
+        q, k, v, qo, ko
+    )
+    # a fully-masked row has every softmax term zeroed → l == 0; any
+    # unmasked row contributes exp(max - max) == 1 ≤ l
+    valid = l > 0.0
+    return pv, m_safe, l, valid
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+) -> jax.Array:
+    """Standalone normalized flash attention (single shard, no ring).
+
+    q/k/v: [b, s, h, d] → [b, s, h, d]."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    pv, _, l, valid = flash_attention_partials(
+        q, k, v, 0, 0, causal, scale
+    )
+    denom = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → 0 output
+    out = pv.astype(jnp.float32) / denom.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

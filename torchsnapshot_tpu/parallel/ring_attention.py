@@ -67,6 +67,24 @@ def ring_attention_shard(
     scale = 1.0 / (q.shape[-1] ** 0.5)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    from .. import knobs
+
+    if knobs.use_pallas_attention():
+        from ..ops.flash_attention import (
+            PALLAS_AVAILABLE,
+            flash_attention_partials,
+        )
+
+        attend = (
+            functools.partial(flash_attention_partials, vma=(axis_name,))
+            if PALLAS_AVAILABLE
+            else None
+        )
+    else:
+        attend = None
+    if attend is None:
+        attend = _block_attend
+
     # Derive the fresh carries FROM q so they inherit q's device-varying
     # axes (jax>=0.8 manual-axes typing requires scan carry in/out types,
     # including varying axes, to match exactly).
@@ -79,7 +97,7 @@ def ring_attention_shard(
     def step(carry, step_idx):
         acc, m_run, l_run, k_cur, v_cur = carry
         src = (my_idx - step_idx) % n  # whose block we currently hold
-        pv, m_blk, l_blk, valid = _block_attend(
+        pv, m_blk, l_blk, valid = attend(
             q, k_cur, v_cur,
             q_offset=my_idx * s_local,
             k_offset=src * s_local,
@@ -128,10 +146,27 @@ def ring_attention(
 
     try:
         from jax import shard_map  # jax >= 0.8
+
+        new_style = True
     except ImportError:  # pragma: no cover
         from jax.experimental.shard_map import shard_map
 
+        new_style = False
+
     spec = P(batch_axis, axis_name, None, None)
+    kwargs = {}
+    from .. import knobs
+    from ..ops.flash_attention import PALLAS_AVAILABLE
+
+    if knobs.use_pallas_attention() and PALLAS_AVAILABLE and new_style:
+        # pallas_call's interpret-mode discharge mixes varying and
+        # unvarying operands in its internal dynamic_slices, which trips
+        # shard_map's vma checker (jax suggests check_vma=False as the
+        # workaround); the numerics are covered by the dense-oracle tests.
+        # Gated exactly like the shard-level kernel selection so the
+        # plain XLA path keeps vma checking (and old-style shard_map,
+        # which lacks the kwarg, is never passed it).
+        kwargs["check_vma"] = False
     fn = shard_map(
         functools.partial(
             ring_attention_shard, axis_name=axis_name, causal=causal
@@ -139,6 +174,7 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **kwargs,
     )
     return fn(q, k, v)
 

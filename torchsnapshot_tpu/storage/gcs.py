@@ -82,12 +82,16 @@ class GCSStoragePlugin(StoragePlugin):
             except FileNotFoundError:
                 raise
             except Exception as e:  # noqa: BLE001
-                # Missing objects are not transient: map to the same
-                # FileNotFoundError contract as the fs/memory plugins
-                # instead of burning the retry deadline on a 404.
-                if type(e).__name__ == "NotFound" or getattr(
-                    e, "code", None
-                ) == 404:
+                # A 404 on a READ means the object is missing — map to the
+                # same FileNotFoundError contract as the fs/memory plugins
+                # instead of burning the retry deadline.  Writes/deletes
+                # keep retrying: a resumable-upload session GCS invalidated
+                # mid-upload also surfaces as 404, and a fresh attempt
+                # starts a new session and succeeds.
+                if op_name.startswith("read ") and (
+                    type(e).__name__ == "NotFound"
+                    or getattr(e, "code", None) == 404
+                ):
                     raise FileNotFoundError(f"{op_name}: {e}") from e
                 attempt += 1
                 if not self._retry.should_retry(attempt):

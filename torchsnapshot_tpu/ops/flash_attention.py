@@ -9,9 +9,11 @@ VMEM flash-attention style, so per-step memory is O(BQ x BK) instead of
 O(sq x sk) and the matmuls stay on the MXU back-to-back with the
 online-softmax VPU work.
 
-Layout: grid over (batch*heads, q_blocks); each program streams the
-kv-sequence in BK-sized blocks from VMEM, keeping a running (max,
-denominator, accumulator) triple in f32.  Sequence offsets (where this
+Layout: grid over (batch*heads, q_blocks, kv_blocks) with kv innermost —
+Mosaic walks it sequentially, so exactly one (BK, d) k/v block is
+VMEM-resident at a time (VMEM cost is O(BQ·d + BK·d) regardless of local
+sequence length) and the running (max, denominator, accumulator) triple
+lives in f32 VMEM scratch across kv steps.  Sequence offsets (where this
 shard's rows/cols sit in the global sequence, needed for causal masking
 inside a ring step) arrive via scalar prefetch so the same compiled
 kernel serves every ring position.
@@ -40,7 +42,12 @@ try:  # pallas availability probe (older jax, exotic platforms)
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    PALLAS_AVAILABLE = True
+    # the shard_map integration also needs the vma-aware APIs (jax>=0.8:
+    # ShapeDtypeStruct(..., vma=...) and shard_map(check_vma=...)); treat
+    # their absence as pallas-unavailable so every caller falls back to
+    # the XLA path together
+    jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+    PALLAS_AVAILABLE = hasattr(jax, "shard_map")
 except Exception:  # pragma: no cover
     pl = None
     pltpu = None
@@ -53,71 +60,80 @@ def _use_interpret() -> bool:
 
 def _attend_kernel(
     offs_ref,  # SMEM scalar prefetch: [q_offset, k_offset, sk_real]
-    q_ref,  # [1, BQ, D]
-    k_ref,  # [1, SK, D]
-    v_ref,  # [1, SK, D]
-    out_ref,  # [1, BQ, D]
+    q_ref,  # [1, BQ, D]      (revisited across the kv grid dim)
+    k_ref,  # [1, BK, D]      (one kv block resident at a time)
+    v_ref,  # [1, BK, D]
+    out_ref,  # [1, BQ, D]     (index_map ignores kv dim → stays in VMEM)
     m_ref,  # [1, BQ]
     l_ref,  # [1, BQ]
+    acc_sc,  # VMEM scratch [BQ, D]: running accumulator
+    m_sc,  # VMEM scratch [BQ]: running row max
+    l_sc,  # VMEM scratch [BQ]: running row sumexp
     *,
     causal: bool,
     scale: float,
-    sk_pad: int,
 ):
+    """One (q-block, kv-block) step of online-softmax attention.
+
+    The kv sequence is the LAST grid dimension, so Mosaic iterates it
+    innermost and sequentially; only one (BK, D) k/v block is resident in
+    VMEM at a time (VMEM stays O(BQ·D + BK·D) however long the local
+    sequence is), and the online (max, sumexp, acc) state lives in VMEM
+    scratch, persisting across kv steps of the same q block."""
     q_offset = offs_ref[0]
     k_offset = offs_ref[1]
     sk_real = offs_ref[2]
     jq = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
 
     q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
-    d = q.shape[-1]
+    k_blk = k_ref[0].astype(jnp.float32)  # [BK, D]
+    v_blk = v_ref[0].astype(jnp.float32)
 
+    scores = jax.lax.dot_general(
+        q,
+        k_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [BQ, BK]
     q_pos = q_offset + jq * _BQ + jax.lax.broadcasted_iota(
         jnp.int32, (_BQ, _BK), 0
     )
+    k_idx = kb * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
+    mask = k_idx < sk_real  # padded keys contribute nothing
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_offset + k_idx)
+    scores = jnp.where(mask, scores, _NEG_INF)
 
-    def body(kb, carry):
-        acc, m_run, l_run = carry
-        k_blk = k_ref[0, pl.ds(kb * _BK, _BK), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * _BK, _BK), :].astype(jnp.float32)
-        scores = jax.lax.dot_general(
-            q,
-            k_blk,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [BQ, BK]
-        k_idx = kb * _BK + jax.lax.broadcasted_iota(
-            jnp.int32, (_BQ, _BK), 1
-        )
-        mask = k_idx < sk_real  # padded keys contribute nothing
-        if causal:
-            mask = jnp.logical_and(mask, q_pos >= k_offset + k_idx)
-        scores = jnp.where(mask, scores, _NEG_INF)
-
-        m_blk = jnp.max(scores, axis=-1)  # [BQ]
-        m_new = jnp.maximum(m_run, m_blk)
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(scores - m_safe[:, None])
-        p = jnp.where(mask, p, 0.0)
-        corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
-        l_new = l_run * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
-            p,
-            v_blk,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return acc_new, m_new, l_new
-
-    acc0 = jnp.zeros((_BQ, d), jnp.float32)
-    m0 = jnp.full((_BQ,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((_BQ,), jnp.float32)
-    acc, m_run, l_run = jax.lax.fori_loop(
-        0, sk_pad // _BK, body, (acc0, m0, l0)
+    m_run, l_run = m_sc[:], l_sc[:]
+    m_blk = jnp.max(scores, axis=-1)  # [BQ]
+    m_new = jnp.maximum(m_run, m_blk)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - m_safe[:, None])
+    p = jnp.where(mask, p, 0.0)
+    corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
+    l_new = l_run * corr + jnp.sum(p, axis=-1)
+    acc_new = acc_sc[:] * corr[:, None] + jax.lax.dot_general(
+        p,
+        v_blk,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
-    out_ref[0] = acc
-    m_ref[0] = m_run
-    l_ref[0] = l_run
+    acc_sc[:] = acc_new
+    m_sc[:] = m_new
+    l_sc[:] = l_new
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _emit():
+        out_ref[0] = acc_sc[:]
+        m_ref[0] = m_sc[:]
+        l_ref[0] = l_sc[:]
 
 
 def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
@@ -149,24 +165,27 @@ def _flash_partials_jit(
         [offs.astype(jnp.int32), jnp.array([sk], jnp.int32)]
     )
 
-    grid = (bh, sq_pad // _BQ)
-    kernel = functools.partial(
-        _attend_kernel, causal=causal, scale=scale, sk_pad=sk_pad
-    )
+    grid = (bh, sq_pad // _BQ, sk_pad // _BK)
+    kernel = functools.partial(_attend_kernel, causal=causal, scale=scale)
     out, m, l = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, _BQ, d), lambda i, j, offs: (i, j, 0)),
-                pl.BlockSpec((1, sk_pad, d), lambda i, j, offs: (i, 0, 0)),
-                pl.BlockSpec((1, sk_pad, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((1, _BQ, d), lambda i, j, kb, offs: (i, j, 0)),
+                pl.BlockSpec((1, _BK, d), lambda i, j, kb, offs: (i, kb, 0)),
+                pl.BlockSpec((1, _BK, d), lambda i, j, kb, offs: (i, kb, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, _BQ, d), lambda i, j, offs: (i, j, 0)),
-                pl.BlockSpec((1, _BQ), lambda i, j, offs: (i, j)),
-                pl.BlockSpec((1, _BQ), lambda i, j, offs: (i, j)),
+                pl.BlockSpec((1, _BQ, d), lambda i, j, kb, offs: (i, j, 0)),
+                pl.BlockSpec((1, _BQ), lambda i, j, kb, offs: (i, j)),
+                pl.BlockSpec((1, _BQ), lambda i, j, kb, offs: (i, j)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((_BQ, d), jnp.float32),
+                pltpu.VMEM((_BQ,), jnp.float32),
+                pltpu.VMEM((_BQ,), jnp.float32),
             ],
         ),
         out_shape=[

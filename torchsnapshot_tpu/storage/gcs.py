@@ -82,13 +82,13 @@ class GCSStoragePlugin(StoragePlugin):
             except FileNotFoundError:
                 raise
             except Exception as e:  # noqa: BLE001
-                # A 404 on a READ means the object is missing — map to the
-                # same FileNotFoundError contract as the fs/memory plugins
-                # instead of burning the retry deadline.  Writes/deletes
+                # A 404 on a read/delete means the object is missing — map
+                # to the same FileNotFoundError contract as the fs/memory
+                # plugins instead of burning the retry deadline.  WRITES
                 # keep retrying: a resumable-upload session GCS invalidated
                 # mid-upload also surfaces as 404, and a fresh attempt
                 # starts a new session and succeeds.
-                if op_name.startswith("read ") and (
+                if not op_name.startswith("write ") and (
                     type(e).__name__ == "NotFound"
                     or getattr(e, "code", None) == 404
                 ):

@@ -1,0 +1,96 @@
+"""Random access: read_object across entry types, templates, budgets
+(reference tests/test_read_object.py)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict, knobs
+
+
+@pytest.fixture()
+def snap(tmp_path):
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("x",))
+    sharded = jax.device_put(
+        jnp.arange(1024 * 16, dtype=jnp.float32).reshape(1024, 16),
+        NamedSharding(mesh, P("x", None)),
+    )
+    state = StateDict(
+        w=sharded,
+        host=np.arange(64, dtype=np.int64),
+        step=41,
+        name="run-1",
+        ratio=0.25,
+        flag=True,
+        blob=b"\x00\x01",
+    )
+    Snapshot.take(str(tmp_path / "s"), {"app": state})
+    return Snapshot(str(tmp_path / "s")), sharded
+
+
+def test_primitives_inlined_in_metadata(snap):
+    s, _ = snap
+    assert s.read_object("0/app/step") == 41
+    assert s.read_object("0/app/name") == "run-1"
+    assert s.read_object("0/app/ratio") == 0.25
+    assert s.read_object("0/app/flag") is True
+    assert s.read_object("0/app/blob") == b"\x00\x01"
+
+
+def test_sharded_entry_without_template(snap):
+    s, src = snap
+    out = s.read_object("0/app/w")
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out, np.asarray(src))
+
+
+def test_sharded_entry_under_memory_budget(snap):
+    s, src = snap
+    out = s.read_object("0/app/w", memory_budget_bytes=4096)
+    np.testing.assert_array_equal(out, np.asarray(src))
+
+
+def test_host_array_into_template_in_place(snap):
+    s, _ = snap
+    tmpl = np.zeros(64, np.int64)
+    out = s.read_object("0/app/host", obj_out=tmpl)
+    np.testing.assert_array_equal(tmpl, np.arange(64))
+    assert out is tmpl
+
+
+def test_tiled_read_bounded_buffers(tmp_path):
+    # a 4MB array read under a 64KB budget must issue ranged sub-reads,
+    # none larger than the budget
+    big = np.arange(1 << 20, dtype=np.float32)
+    Snapshot.take(str(tmp_path / "t"), {"app": StateDict(w=big)})
+    s = Snapshot(str(tmp_path / "t"))
+
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+    ranges = []
+    orig = FSStoragePlugin.read
+
+    async def spy(self, read_io):
+        if read_io.byte_range is not None:
+            ranges.append(read_io.byte_range[1] - read_io.byte_range[0])
+        return await orig(self, read_io)
+
+    FSStoragePlugin.read = spy
+    try:
+        out = s.read_object("0/app/w", memory_budget_bytes=1 << 16)
+    finally:
+        FSStoragePlugin.read = orig
+    np.testing.assert_array_equal(out, big)
+    assert ranges and max(ranges) <= (1 << 16)
+
+
+def test_bad_paths_raise(snap):
+    s, _ = snap
+    with pytest.raises(KeyError, match="nope"):
+        s.read_object("0/app/nope")
+    with pytest.raises((KeyError, ValueError)):
+        s.read_object("notanint/app/w")

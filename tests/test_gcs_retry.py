@@ -1,0 +1,92 @@
+"""GCS collective-progress retry semantics with a fake client — no
+network (reference gcs.py:221-277 behavior, tested like reference
+tests/test_gcs_storage_plugin.py but headless)."""
+
+import asyncio
+
+import pytest
+
+from torchsnapshot_tpu.storage.gcs import _CollectiveProgressRetry
+
+
+def test_retry_allows_while_pipeline_progresses(monkeypatch):
+    r = _CollectiveProgressRetry(window_s=100.0)
+    now = [1000.0]
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.storage.gcs.time",
+        type("T", (), {"monotonic": staticmethod(lambda: now[0])}),
+    )
+    r.record_progress()
+    now[0] += 90
+    assert r.should_retry(1)  # within window
+    r.record_progress()  # someone else completed -> clock refreshed
+    now[0] += 90
+    assert r.should_retry(2)  # still within refreshed window
+    now[0] += 150
+    assert not r.should_retry(3)  # no progress anywhere for 150s
+
+
+def test_retry_caps_attempts(monkeypatch):
+    r = _CollectiveProgressRetry(window_s=1e9)
+    assert r.should_retry(5)
+    assert not r.should_retry(6)  # _MAX_ATTEMPTS
+
+
+def test_with_retry_semantics():
+    # drive _with_retry against fakes: transient errors retry and succeed,
+    # read-404 maps to FileNotFoundError without burning attempts,
+    # write-404 keeps retrying (invalidated resumable session)
+    from torchsnapshot_tpu.storage import gcs as gcs_mod
+
+    class FakePlugin:
+        def __init__(self):
+            self._retry = _CollectiveProgressRetry(window_s=100.0)
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(max_workers=2)
+        _with_retry = gcs_mod.GCSStoragePlugin._with_retry
+
+    class NotFound(Exception):
+        code = 404
+
+    async def run():
+        p = FakePlugin()
+        # flaky op: fails twice then succeeds
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("transient")
+            return b"ok"
+
+        async def no_sleep(attempt):
+            return None
+
+        p._retry.backoff = no_sleep
+        assert await p._with_retry(flaky, "write x") == b"ok"
+        assert calls["n"] == 3
+
+        # read 404 -> FileNotFoundError immediately (1 call)
+        calls404 = {"n": 0}
+
+        def missing():
+            calls404["n"] += 1
+            raise NotFound("gone")
+
+        with pytest.raises(FileNotFoundError):
+            await p._with_retry(missing, "read obj")
+        assert calls404["n"] == 1
+
+        # write 404 -> retried until attempts exhausted, original error
+        calls404w = {"n": 0}
+
+        def bad_session():
+            calls404w["n"] += 1
+            raise NotFound("session invalidated")
+
+        with pytest.raises(NotFound):
+            await p._with_retry(bad_session, "write obj")
+        assert calls404w["n"] > 1
+
+    asyncio.new_event_loop().run_until_complete(run())

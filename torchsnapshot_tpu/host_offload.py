@@ -18,6 +18,12 @@ from typing import Any, Iterator, List
 
 _HOST_KINDS = ("pinned_host", "unpinned_host")
 
+# Arrays below this size skip the eager pinned-host offload and stage
+# lazily from the (immutable) device array instead: per-array dispatch
+# overhead would dominate the async_take blocked window for trees with
+# thousands of small leaves.
+_EAGER_OFFLOAD_MIN_BYTES = 1 << 20
+
 logger = logging.getLogger(__name__)
 
 
@@ -175,6 +181,13 @@ def eager_offload_write_reqs(
         for key, sts in by_array.items():
             a = sts[0].arr
             if is_host_offloaded(a):
+                continue
+            if a.nbytes < _EAGER_OFFLOAD_MIN_BYTES:
+                # Tiny arrays: the per-array device_put dispatch costs more
+                # than it buys (HBM release timing is irrelevant at this
+                # size) and would dominate the blocked window for trees
+                # with thousands of small leaves.  Stage lazily — safe by
+                # immutability.
                 continue
             if budget_bytes is not None and claimed + a.nbytes > budget_bytes:
                 continue  # stage lazily; safe by immutability

@@ -1,0 +1,210 @@
+"""Take-path invariants: RNG preservation (reference _pop_rng_state,
+snapshot.py:532-574) and the replication-verification cost knob."""
+
+import random
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import RNGState, Snapshot, StateDict, knobs
+from torchsnapshot_tpu.snapshot import (
+    _replication_fingerprint,
+    _verify_replicated_paths,
+)
+
+
+class _RNGConsumer:
+    """A stateful whose state_dict() draws from both host RNG streams —
+    the hazard the take-path RNG invariant protects against."""
+
+    def state_dict(self):
+        return {"x": random.random(), "y": float(np.random.rand())}
+
+    def load_state_dict(self, state_dict):
+        pass
+
+
+def _np_state_equal(a, b) -> bool:
+    return (
+        a[0] == b[0]
+        and bool(np.array_equal(a[1], b[1]))
+        and a[2:] == b[2:]
+    )
+
+
+def test_take_preserves_rng_streams(tmp_path):
+    random.seed(123)
+    np.random.seed(456)
+    py_entry = random.getstate()
+    np_entry = np.random.get_state()
+
+    Snapshot.take(
+        str(tmp_path / "snap"),
+        {"rng": RNGState(), "zz_consumer": _RNGConsumer()},
+    )
+
+    # take() left both streams bit-identical despite the consumer
+    assert random.getstate() == py_entry
+    assert _np_state_equal(np.random.get_state(), np_entry)
+
+
+def test_saved_rng_state_is_entry_state(tmp_path):
+    """RNGState keys serialize the state captured at take ENTRY (not at
+    their loop position), so the saved stream is exact even when an
+    alphabetically-earlier stateful consumes RNG."""
+    random.seed(777)
+    np.random.seed(778)
+    py_entry = random.getstate()
+
+    snap = Snapshot.take(
+        str(tmp_path / "snap"),
+        # "aaa_consumer" sorts before "rng" and consumes RNG in its
+        # state_dict(); the entry-state substitution must still save the
+        # pre-consumption stream for "rng"
+        {"aaa_consumer": _RNGConsumer(), "rng": RNGState()},
+    )
+
+    random.setstate(py_entry)
+    expected_draw = random.random()
+
+    random.seed(999)  # scramble both streams
+    np.random.seed(999)
+    snap.restore({"rng": RNGState()})
+    assert random.random() == expected_draw
+
+
+class _ExtendedRNGState(RNGState):
+    """Subclass capturing an extra stream (the reference's RNGState also
+    captures torch's) — take must save via the INSTANCE, not substitute
+    a base-class capture."""
+
+    stream = [0.0]  # stands in for an extra global RNG stream
+
+    def state_dict(self):
+        d = super().state_dict()
+        d["extra"] = self.stream[0]
+        return d
+
+    def load_state_dict(self, state_dict):
+        super().load_state_dict(state_dict)
+        self.stream[0] = state_dict["extra"]
+
+
+def test_rng_subclass_state_is_honored(tmp_path):
+    _ExtendedRNGState.stream[0] = 42.0
+    snap = Snapshot.take(str(tmp_path / "snap"), {"rng": _ExtendedRNGState()})
+    # take must not perturb the extra stream either
+    assert _ExtendedRNGState.stream[0] == 42.0
+    _ExtendedRNGState.stream[0] = 7.0
+    snap.restore({"rng": _ExtendedRNGState()})
+    assert _ExtendedRNGState.stream[0] == 42.0
+
+
+class _FakeCoord:
+    """Two-rank coordinator double whose fingerprint/presence gather
+    returns the configured peer dict."""
+
+    def __init__(self, peer_fingerprints=None, world_size=2):
+        self.rank = 0
+        self.world_size = world_size
+        self.peer = peer_fingerprints
+        self.gather_payloads = []
+
+    def all_gather_object(self, local):
+        self.gather_payloads.append(local)
+        return [local, self.peer]
+
+
+def test_replication_verify_off_single_rank_skips_gather():
+    coord = _FakeCoord(world_size=1)
+    verified = _verify_replicated_paths(
+        {"a/x": np.zeros(4, np.float32), "a/y": 7}, ["a/*"], coord, "off"
+    )
+    assert verified == {"a/x", "a/y"}
+    assert not coord.gather_payloads
+
+
+def test_replication_verify_off_still_intersects_presence():
+    """off trusts content but must still agree on path PRESENCE: the
+    partitioner requires an identical item list on every rank, and a
+    path only one rank has would be silently dropped otherwise."""
+    coord = _FakeCoord(peer_fingerprints={"a/x": None})  # peer lacks a/y
+    verified = _verify_replicated_paths(
+        {"a/x": np.zeros(4, np.float32), "a/y": 7}, ["a/*"], coord, "off"
+    )
+    assert verified == {"a/x"}
+    # content was NOT fingerprinted (presence sentinels only)
+    assert coord.gather_payloads[-1] == {"a/x": None, "a/y": None}
+
+
+def test_replication_verify_mode_agreement():
+    """A rank with a divergent or invalid env var must not diverge the
+    protocol: strictest mode wins; invalid values fall back to full."""
+    from torchsnapshot_tpu.snapshot import (
+        _safe_replication_verify_mode,
+        _strictest_mode,
+    )
+
+    assert _strictest_mode(["off", "full"]) == "full"
+    assert _strictest_mode(["off", "shape"]) == "shape"
+    assert _strictest_mode(["off", "off"]) == "off"
+    with knobs.override_replication_verify("fulll"):  # typo'd env value
+        assert _safe_replication_verify_mode() == "full"
+    with knobs.override_replication_verify("shape"):
+        assert _safe_replication_verify_mode() == "shape"
+
+
+def test_replication_verify_shape_keeps_object_content_check():
+    """shape mode relaxes ARRAYS only: small non-array leaves (optimizer
+    scalars — the classic silent-drift case) keep their content check."""
+    assert _replication_fingerprint({"lr": 0.1}, "shape") != _replication_fingerprint(
+        {"lr": 0.2}, "shape"
+    )
+    # arrays do relax to dtype+shape
+    assert _replication_fingerprint(
+        np.zeros(4, np.float32), "shape"
+    ) == _replication_fingerprint(np.ones(4, np.float32), "shape")
+
+
+def test_replication_verify_shape_ignores_array_content():
+    flattened = {"a/x": np.zeros(4, np.float32)}
+    # peer has different CONTENT, same dtype/shape
+    peer_full = {"a/x": _replication_fingerprint(np.ones(4, np.float32), "full")}
+    peer_shape = {"a/x": _replication_fingerprint(np.ones(4, np.float32), "shape")}
+
+    assert (
+        _verify_replicated_paths(flattened, ["a/*"], _FakeCoord(peer_full), "full")
+        == set()
+    )
+    assert _verify_replicated_paths(
+        flattened, ["a/*"], _FakeCoord(peer_shape), "shape"
+    ) == {"a/x"}
+
+
+def test_replication_verify_shape_still_checks_shape():
+    flattened = {"a/x": np.zeros(4, np.float32)}
+    peer = {"a/x": _replication_fingerprint(np.zeros(8, np.float32), "shape")}
+    assert (
+        _verify_replicated_paths(flattened, ["a/*"], _FakeCoord(peer), "shape")
+        == set()
+    )
+
+
+def test_replication_verify_invalid_value():
+    with knobs.override_replication_verify("sometimes"):
+        with pytest.raises(ValueError):
+            knobs.get_replication_verify()
+
+
+def test_replication_verify_off_end_to_end(tmp_path):
+    """off mode trusts the glob: the whole take path works and the entry
+    is saved once (single-rank smoke covering the knob plumb-through)."""
+    with knobs.override_replication_verify("off"):
+        Snapshot.take(
+            str(tmp_path / "snap"),
+            {"app": StateDict(w=np.arange(8, dtype=np.float32))},
+            replicated=["app/*"],
+        )
+    snap = Snapshot(str(tmp_path / "snap"))
+    out = snap.read_object("0/app/w")
+    np.testing.assert_array_equal(out, np.arange(8, dtype=np.float32))

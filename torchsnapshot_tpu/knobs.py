@@ -26,6 +26,7 @@ _ENABLE_NATIVE_EXT = "ENABLE_NATIVE_EXT"
 _FS_VERIFY_WRITES = "FS_VERIFY_WRITES"
 _DISABLE_EAGER_HOST_STAGING = "DISABLE_EAGER_HOST_STAGING"
 _PALLAS_ATTENTION = "PALLAS_ATTENTION"
+_REPLICATION_VERIFY = "REPLICATION_VERIFY"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -59,6 +60,16 @@ _DEFAULTS = {
     # off on TPU *by default* because tunneled/virtualized TPU attachments
     # may not support Mosaic compilation; set to "1" on real TPU VMs.
     _PALLAS_ATTENTION: "auto",
+    # How thoroughly replicated-glob-matched host state is cross-checked
+    # before being deduplicated to one writer:
+    #   "full"  — dtype/shape + full-buffer crc32 (catches silent content
+    #             divergence, e.g. per-rank optimizer scalars),
+    #   "shape" — dtype/shape only (no content hash; O(1) per array —
+    #             for tens-of-GB replicated host state like embeddings),
+    #   "off"   — no content check; only path PRESENCE is still
+    #             intersected across ranks (the partitioner requires an
+    #             identical replicated item list on every rank).
+    _REPLICATION_VERIFY: "full",
 }
 
 _OVERRIDES: dict = {}
@@ -121,6 +132,16 @@ def is_fs_verify_writes() -> bool:
 
 def is_eager_host_staging_disabled() -> bool:
     return bool(_get_int(_DISABLE_EAGER_HOST_STAGING))
+
+
+def get_replication_verify() -> str:
+    v = str(_get_raw(_REPLICATION_VERIFY)).lower()
+    if v not in ("full", "shape", "off"):
+        raise ValueError(
+            f"TORCHSNAPSHOT_TPU_REPLICATION_VERIFY must be full|shape|off, "
+            f"got {v!r}"
+        )
+    return v
 
 
 def use_pallas_attention() -> bool:
@@ -197,3 +218,7 @@ def override_disable_eager_host_staging(value: bool):
 
 def override_pallas_attention(value):
     return _override(_PALLAS_ATTENTION, value)
+
+
+def override_replication_verify(value: str):
+    return _override(_REPLICATION_VERIFY, value)

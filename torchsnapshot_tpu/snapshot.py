@@ -63,12 +63,19 @@ SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 AppState = Dict[str, Stateful]
 
 
-def _replication_fingerprint(obj: Any) -> Tuple:
+def _replication_fingerprint(obj: Any, mode: str = "full") -> Tuple:
     """Per-leaf fingerprint used to verify that state claimed replicated
     actually matches across ranks (reference intersects the per-rank
     *path* sets only, snapshot.py:637-670; this additionally fingerprints
     content, the failure mode most prone to silent divergence — e.g.
     per-rank optimizer scalars).
+
+    ``mode`` (knob ``TORCHSNAPSHOT_TPU_REPLICATION_VERIFY``): "full" CRCs
+    array content; "shape" checks arrays by dtype+shape only (O(1) per
+    array — the knob exists for giant replicated host arrays; small
+    non-array leaves keep their content check in every mode, since
+    per-rank scalar drift is exactly what verification is for).  "off"
+    is handled by the caller (no fingerprinting at all).
 
     - numpy / torch-CPU arrays: dtype, shape + crc32 of the FULL buffer
       (zlib.crc32 runs at ~3 GB/s; host replicated state is typically
@@ -106,6 +113,8 @@ def _replication_fingerprint(obj: Any) -> Tuple:
     if _is_jax_array(obj):
         return ("jax", str(obj.dtype), tuple(obj.shape))
     if isinstance(obj, np.ndarray) or _is_torch_tensor(obj):
+        if mode == "shape":
+            return ("arr", str(obj.dtype), tuple(obj.shape))
         view = _to_host_view(obj)
         if view.flags["C_CONTIGUOUS"]:
             crc = zlib.crc32(view.reshape(-1).view(np.uint8))
@@ -126,10 +135,29 @@ def _replication_fingerprint(obj: Any) -> Tuple:
         return ("obj", type(obj).__name__)
 
 
+def _safe_replication_verify_mode() -> str:
+    """Resolve the knob WITHOUT raising: an invalid value on one rank must
+    not diverge the collective protocol mid-take — fall back to the
+    strict default with a warning instead."""
+    try:
+        return knobs.get_replication_verify()
+    except ValueError as e:
+        logger.warning("%s; falling back to 'full'", e)
+        return "full"
+
+
+def _strictest_mode(modes: Sequence[str]) -> str:
+    return (
+        "full" if "full" in modes
+        else ("shape" if "shape" in modes else "off")
+    )
+
+
 def _verify_replicated_paths(
     flattened: Dict[str, Any],
     replicated_globs: Sequence[str],
     coordinator: Coordinator,
+    mode: str,
 ) -> set:
     """The set of logical paths that are *verifiably* replicated: matched
     by the agreed globs on every rank, with identical fingerprints.
@@ -140,17 +168,25 @@ def _verify_replicated_paths(
         # nothing can match: skip the KV round-trip entirely (all ranks
         # agree on the globs by this point, so all branch identically)
         return set()
+    # "off" trusts content (fingerprint None) but still intersects path
+    # PRESENCE across ranks: the partitioner requires its item list
+    # identical on every rank, and a path only one rank has would
+    # otherwise be assigned to a rank that can't write it (silently
+    # dropping it from the snapshot).
     local = {
-        lpath: _replication_fingerprint(obj)
+        lpath: (
+            None if mode == "off" else _replication_fingerprint(obj, mode)
+        )
         for lpath, obj in flattened.items()
         if path_is_replicated(lpath, replicated_globs)
     }
     if coordinator.world_size <= 1:
         return set(local)
     gathered = coordinator.all_gather_object(local)
+    missing = object()
     verified = set()
     for lpath, fp in gathered[0].items():
-        if all(peer.get(lpath) == fp for peer in gathered[1:]):
+        if all(peer.get(lpath, missing) == fp for peer in gathered[1:]):
             verified.add(lpath)
     demoted = set(local) - verified
     if demoted:
@@ -264,6 +300,45 @@ class Snapshot:
         rank, world = coordinator.rank, coordinator.world_size
         _validate_app_state(app_state)
 
+        # Take must never perturb the host RNG streams, and the RNG state
+        # that gets *saved* must be the state at entry (reference
+        # _pop_rng_state, snapshot.py:532-574).  Mechanism: capture every
+        # RNGState instance's state NOW — via the instance, so subclasses
+        # capturing extra streams (e.g. torch's) are honored — and have
+        # the serialization loop below substitute these entry captures
+        # for those keys instead of re-calling state_dict() mid-loop.
+        # On exit each instance restores its own entry state, plus a base
+        # restore covering takes with no RNGState in app_state at all.
+        rng_at_entry = RNGState().state_dict()
+        rng_states_at_entry = {
+            k: v.state_dict()
+            for k, v in app_state.items()
+            if isinstance(v, RNGState)
+        }
+        try:
+            return cls._take_impl_inner(
+                path, app_state, replicated, coordinator, is_async,
+                rank, world, rng_states_at_entry,
+            )
+        finally:
+            for k, v in app_state.items():
+                if isinstance(v, RNGState):
+                    v.load_state_dict(rng_states_at_entry[k])
+            RNGState().load_state_dict(rng_at_entry)
+
+    @classmethod
+    def _take_impl_inner(
+        cls,
+        path: str,
+        app_state: AppState,
+        replicated: Sequence[str],
+        coordinator: Coordinator,
+        is_async: bool,
+        rank: int,
+        world: int,
+        rng_states_at_entry: Dict[str, Dict[str, Any]],
+    ) -> Tuple[SnapshotMetadata, PendingIOWork, Any, str]:
+
         # path + replicated coalescing across ranks
         # (reference _coalesce_path_and_replicated, snapshot.py:858-894)
         path0 = coordinator.broadcast_object(path, src=0)
@@ -273,8 +348,17 @@ class Snapshot:
                 "rank 0's", rank, path, path0
             )
             path = path0
+        # the verification mode rides the same gather as the globs: it
+        # gates what each rank contributes to the fingerprint gather, so
+        # it must be rank-agreed (strictest wins) without paying an extra
+        # KV round
+        local_mode = _safe_replication_verify_mode()
         if world > 1:
-            gathered_globs = coordinator.all_gather_object(sorted(set(replicated)))
+            gathered = coordinator.all_gather_object(
+                (sorted(set(replicated)), local_mode)
+            )
+            gathered_globs = [g for g, _ in gathered]
+            modes = [m for _, m in gathered]
             replicated_globs = sorted(
                 set(gathered_globs[0]).intersection(*map(set, gathered_globs[1:]))
             )
@@ -283,8 +367,16 @@ class Snapshot:
                     "rank %d: replicated globs differ across ranks; using the "
                     "intersection %r", rank, replicated_globs
                 )
+            verify_mode = _strictest_mode(modes)
+            if len(set(modes)) > 1:
+                logger.warning(
+                    "rank %d: REPLICATION_VERIFY differs across ranks (%s); "
+                    "using the strictest: %r",
+                    rank, sorted(set(modes)), verify_mode,
+                )
         else:
             replicated_globs = sorted(set(replicated))
+            verify_mode = local_mode
 
         storage = url_to_storage_plugin(path)
 
@@ -298,11 +390,24 @@ class Snapshot:
             )
         else:
             global_keys = local_keys
+        # RNGState keys serialize the state captured at take ENTRY
+        # (``rng_states_at_entry``, taken before any collective or
+        # storage init could touch the streams), so the saved stream is
+        # exact even when an alphabetically-earlier stateful's
+        # state_dict() consumes RNG.  Keys are NOT reordered: the
+        # barrier-aligned loop below must run in the same order on every
+        # rank, and a rank-local sort key (which keys are RNGState here)
+        # could diverge across ranks.
         manifest: Manifest = {}
         flattened: Dict[str, Any] = {}
         for key in global_keys:
             if key in app_state:
-                m, f = flatten(app_state[key].state_dict(), prefix=key)
+                state = (
+                    rng_states_at_entry[key]
+                    if key in rng_states_at_entry
+                    else app_state[key].state_dict()
+                )
+                m, f = flatten(state, prefix=key)
                 manifest.update(m)
                 flattened.update(f)
             if world > 1:
@@ -316,7 +421,7 @@ class Snapshot:
         repl_items: List[Tuple[str, int]] = []
         local_bytes = 0
         verified_repl = _verify_replicated_paths(
-            flattened, replicated_globs, coordinator
+            flattened, replicated_globs, coordinator, verify_mode
         )
         for lpath in sorted(flattened.keys()):
             obj = flattened[lpath]

@@ -39,6 +39,10 @@ class _CollectiveProgressRetry:
     def __init__(self, window_s: float = _PROGRESS_WINDOW_S) -> None:
         self.window_s = window_s
         self.last_progress = time.monotonic()
+        # private stream: backoff jitter (possibly on the async-commit
+        # background thread) must never perturb the global random state
+        # the take-path RNG invariant protects
+        self._rng = random.Random()
 
     def record_progress(self) -> None:
         self.last_progress = time.monotonic()
@@ -49,7 +53,7 @@ class _CollectiveProgressRetry:
         return (time.monotonic() - self.last_progress) < self.window_s
 
     async def backoff(self, attempt: int) -> None:
-        await asyncio.sleep(min(2**attempt, 32) * (0.5 + random.random()))
+        await asyncio.sleep(min(2**attempt, 32) * (0.5 + self._rng.random()))
 
 
 class GCSStoragePlugin(StoragePlugin):

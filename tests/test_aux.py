@@ -173,3 +173,47 @@ def test_orbax_interop_roundtrip(tmp_path):
     migrate_snapshot_to_orbax(str(tmp_path / "snap"), str(tmp_path / "orbax2"))
     back2 = import_from_orbax(str(tmp_path / "orbax2"))
     np.testing.assert_array_equal(np.asarray(back2["params"]["b"]), np.ones(4))
+
+
+def test_pallas_auto_is_off_on_cpu():
+    """'auto' must never turn interpret-mode pallas on for real CPU runs
+    (orders of magnitude slower than the XLA path); the probe-compile
+    path is TPU-only.  Tests opt in via override_pallas_attention."""
+    import jax
+
+    from torchsnapshot_tpu import knobs
+
+    assert jax.default_backend() == "cpu"
+    with knobs.override_pallas_attention("auto"):
+        assert knobs.use_pallas_attention() is False
+    with knobs.override_pallas_attention("1"):
+        assert knobs.use_pallas_attention() is True
+
+
+def test_pallas_probe_caches_verdict(monkeypatch):
+    from torchsnapshot_tpu.ops import flash_attention as fa
+
+    if not fa.PALLAS_AVAILABLE:
+        pytest.skip("pallas unavailable")
+    monkeypatch.setattr(fa, "_PROBE_VERDICT", None)
+    calls = []
+    real = fa.flash_attention
+    monkeypatch.setattr(
+        fa, "flash_attention", lambda *a, **k: (calls.append(1), real(*a, **k))[1]
+    )
+    assert fa.pallas_probe_ok() is True  # interpret mode compiles on CPU
+    assert fa.pallas_probe_ok() is True
+    assert len(calls) == 1  # probe ran once; verdict cached
+
+
+def test_pallas_probe_failure_falls_back(monkeypatch):
+    from torchsnapshot_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_PROBE_VERDICT", None)
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic unsupported on this attachment")
+
+    monkeypatch.setattr(fa, "flash_attention", boom)
+    assert fa.pallas_probe_ok() is False
+    assert fa.pallas_probe_ok() is False

@@ -129,6 +129,69 @@ def test_offload_failure_falls_back_to_device_array():
     assert st.arr is None and st.fallback_arr is None
 
 
+def test_small_leaves_offloaded_for_donation_safety(tmp_path):
+    """Sub-MB leaves ride the batched offload too: under
+    jit(donate_argnums=...) the next step DELETES the device buffers, so
+    any leaf left to stage lazily would fail.  After offload, deleting
+    every source array (what donation does) must not hurt the snapshot."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu.host_offload import host_memory_supported
+
+    if not host_memory_supported():
+        pytest.skip("runtime lacks host memory kinds")
+
+    src = jnp.arange(256, dtype=jnp.float32)  # 1KB — tiny
+    _, reqs = _prepare(src)
+    moved = eager_offload_write_reqs(reqs)
+    assert moved >= src.nbytes
+    st = reqs[0].buffer_stager
+    # wait for the release watcher to confirm the transfer landed
+    deadline = time.monotonic() + 5
+    while st.fallback_arr is not None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    src.delete()  # what the next donated training step does
+    buf = asyncio.new_event_loop().run_until_complete(st.stage_buffer())
+    np.testing.assert_array_equal(
+        np.frombuffer(bytes(buf), dtype=np.float32),
+        np.arange(256, dtype=np.float32),
+    )
+
+
+def test_deleted_source_array_fails_with_donation_diagnosis():
+    """A lazily-staged leaf whose buffer was donated away must fail with
+    a clear diagnosis, not XLA's bare 'Array has been deleted'."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu.preparers.array import JaxArrayBufferStager
+
+    src = jnp.arange(8, dtype=jnp.float32)
+    st = JaxArrayBufferStager(src)
+    src.delete()
+    with pytest.raises(RuntimeError, match="donate"):
+        asyncio.new_event_loop().run_until_complete(st.stage_buffer())
+
+
+def test_deleted_chunk_fails_with_chunk_diagnosis():
+    """Chunked (indexed) stagers never offload; their donation failure
+    must say so instead of blaming the offload budget."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu.preparers.array import JaxArrayBufferStager
+
+    src = jnp.arange(64, dtype=jnp.float32)
+    st = JaxArrayBufferStager(src, index=(slice(0, 8),), nbytes=32)
+    src.delete()
+    with pytest.raises(RuntimeError, match="chunk"):
+        asyncio.new_event_loop().run_until_complete(st.stage_buffer())
+
+
 def test_eager_offload_host_copy_uses_fast_path_for_extension_dtypes(
     monkeypatch,
 ):

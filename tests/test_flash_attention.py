@@ -1,8 +1,10 @@
 """Pallas flash-attention kernel vs the dense XLA oracle.
 
-Runs in interpret mode on CPU (knobs auto-enables pallas there); the
-same kernel compiles for TPU via Mosaic.  Oracle: dense_attention /
-_block_attend in parallel/ring_attention.py.
+Runs in interpret mode on CPU (flash_attention is called directly here,
+bypassing the knob — which resolves "auto" to OFF on CPU so production
+CPU runs never pay interpret-mode cost); the same kernel compiles for
+TPU via Mosaic, where "auto" probe-compiles once and caches the verdict.
+Oracle: dense_attention / _block_attend in parallel/ring_attention.py.
 """
 
 import numpy as np

@@ -18,14 +18,23 @@ def _qkv(b, s, h, d, dtype=jnp.float32, seed=0):
     return tuple(jax.random.normal(k, shape, dtype=dtype) for k in ks)
 
 
+@pytest.mark.parametrize("pallas", [False, True], ids=["xla", "pallas"])
 @pytest.mark.parametrize("causal", [True, False], ids=["causal", "full"])
 @pytest.mark.parametrize("sp", [2, 4, 8])
-def test_ring_matches_dense(causal, sp):
+def test_ring_matches_dense(causal, sp, pallas):
+    # "auto" resolves to off on CPU (interpret mode is for tests only),
+    # so the pallas path is opted into explicitly here
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.ops.flash_attention import PALLAS_AVAILABLE
+
+    if pallas and not PALLAS_AVAILABLE:
+        pytest.skip("pallas unavailable")
     mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
     q, k, v = _qkv(2, 32, 4, 16)
     sharding = NamedSharding(mesh, P(None, "sp", None, None))
     qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
-    out = ring_attention(qs, ks, vs, mesh, axis_name="sp", causal=causal)
+    with knobs.override_pallas_attention(int(pallas)):
+        out = ring_attention(qs, ks, vs, mesh, axis_name="sp", causal=causal)
     ref = dense_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
@@ -62,8 +71,15 @@ def test_ring_bf16():
     )
 
 
-def test_ring_grad_flows():
-    # differentiable end-to-end (scan + ppermute have transpose rules)
+@pytest.mark.parametrize("pallas", [False, True], ids=["xla", "pallas"])
+def test_ring_grad_flows(pallas):
+    # differentiable end-to-end (scan + ppermute have transpose rules;
+    # the pallas kernel differentiates through its custom_vjp)
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.ops.flash_attention import PALLAS_AVAILABLE
+
+    if pallas and not PALLAS_AVAILABLE:
+        pytest.skip("pallas unavailable")
     mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
     q, k, v = _qkv(1, 16, 2, 8)
     sharding = NamedSharding(mesh, P(None, "sp", None, None))
@@ -72,7 +88,8 @@ def test_ring_grad_flows():
     def loss(q, k, v):
         return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
 
-    g = jax.grad(loss)(qs, ks, vs)
+    with knobs.override_pallas_attention(int(pallas)):
+        g = jax.grad(loss)(qs, ks, vs)
     ref_g = jax.grad(lambda q, k, v: jnp.sum(dense_attention(q, k, v) ** 2))(
         q, k, v
     )

@@ -18,12 +18,6 @@ from typing import Any, Iterator, List
 
 _HOST_KINDS = ("pinned_host", "unpinned_host")
 
-# Arrays below this size skip the eager pinned-host offload and stage
-# lazily from the (immutable) device array instead: per-array dispatch
-# overhead would dominate the async_take blocked window for trees with
-# thousands of small leaves.
-_EAGER_OFFLOAD_MIN_BYTES = 1 << 20
-
 logger = logging.getLogger(__name__)
 
 
@@ -79,32 +73,72 @@ def _iter_stagers(write_reqs) -> Iterator[Any]:
             yield st
 
 
+_release_queue = None
+
+
+def _watch_releases(q) -> None:
+    """Single daemon loop multiplexing every pending release job by
+    polling ``is_ready()``: one hung transfer delays only its own
+    release (its device refs stay as staging fallbacks — the degrade
+    path), never blocks jobs queued after it, and being a daemon thread
+    never blocks interpreter exit.  Per-call threads would accumulate
+    without bound; a joined executor would hang shutdown."""
+    import queue as _queue
+
+    import jax
+
+    pending: List[Any] = []
+    while True:
+        try:
+            job = q.get(timeout=0.05 if pending else None)
+            pending.append(job)
+        except _queue.Empty:
+            pass
+        still: List[Any] = []
+        for host_arrays, stager_lists in pending:
+            try:
+                ready = all(
+                    a.is_ready() if hasattr(a, "is_ready") else True
+                    for a in host_arrays
+                )
+            except Exception:
+                ready = True  # error state resolves in block_until_ready
+            if not ready:
+                still.append((host_arrays, stager_lists))
+                continue
+            try:
+                jax.block_until_ready(host_arrays)
+            except Exception:
+                logger.warning(
+                    "eager pinned-host offload failed after dispatch; "
+                    "device refs retained for fallback staging",
+                    exc_info=True,
+                )
+                continue
+            for sts in stager_lists:
+                for st in sts:
+                    st.fallback_arr = None
+        pending = still
+
+
 def _release_fallbacks_on_completion(host_arrays, stager_lists) -> None:
     """Drop the stagers' device refs the moment the batched DMA completes,
     so HBM is released as soon as training drops its own references — not
     held for the whole background storage drain.  On transfer failure the
     refs stay, and staging degrades to the device arrays."""
-    import threading
+    global _release_queue
+    if _release_queue is None:
+        import queue
+        import threading
 
-    import jax
-
-    def _wait() -> None:
-        try:
-            jax.block_until_ready(host_arrays)
-        except Exception:
-            logger.warning(
-                "eager pinned-host offload failed after dispatch; device "
-                "refs retained for fallback staging",
-                exc_info=True,
-            )
-            return
-        for sts in stager_lists:
-            for st in sts:
-                st.fallback_arr = None
-
-    threading.Thread(
-        target=_wait, name="tsnp-offload-release", daemon=True
-    ).start()
+        _release_queue = queue.Queue()
+        threading.Thread(
+            target=_watch_releases,
+            args=(_release_queue,),
+            name="tsnp-offload-release",
+            daemon=True,
+        ).start()
+    _release_queue.put((host_arrays, stager_lists))
 
 
 def eager_offload_write_reqs(
@@ -144,6 +178,18 @@ def eager_offload_write_reqs(
     regardless of the cap: their safety depends on the copy happening
     before control returns to training.
 
+    **Donated train states**: under ``jit(..., donate_argnums=...)`` the
+    next training step DELETES the device buffers async_take left behind.
+    Offloaded arrays are safe (the pinned-host copy is independent), but
+    any leaf that stages lazily from the device array — one skipped by
+    ``budget_bytes``, any leaf when the runtime lacks host memory kinds,
+    and every CHUNK of an over-``max_chunk_size`` array (indexed stagers
+    slice on device and are never offloaded) — will find its buffer
+    deleted and the snapshot fails with a clear error (see
+    JaxArrayBufferStager).  With donation, call ``.wait()`` before the
+    next step; for non-chunked leaves a large enough offload budget also
+    suffices.
+
     Returns the number of bytes made training-independent.  Degrades to a
     defensive-copy-only pass when the runtime lacks host memory kinds
     (e.g. CPU meshes).
@@ -182,15 +228,13 @@ def eager_offload_write_reqs(
             a = sts[0].arr
             if is_host_offloaded(a):
                 continue
-            if a.nbytes < _EAGER_OFFLOAD_MIN_BYTES:
-                # Tiny arrays: the per-array device_put dispatch costs more
-                # than it buys (HBM release timing is irrelevant at this
-                # size) and would dominate the blocked window for trees
-                # with thousands of small leaves.  Stage lazily — safe by
-                # immutability.
-                continue
+            # Small arrays are offloaded too — they cost next to nothing
+            # inside the single batched device_put, and leaving them on
+            # device would break donated train states (the next step
+            # deletes the buffers they'd stage from).
             if budget_bytes is not None and claimed + a.nbytes > budget_bytes:
-                continue  # stage lazily; safe by immutability
+                continue  # stage lazily; safe by immutability (NOT under
+                # donation — see docstring)
             try:
                 sh = a.sharding.with_memory_kind("pinned_host")
             except Exception:

@@ -56,9 +56,11 @@ _DEFAULTS = {
     # instead of after full staging (see host_offload.eager_offload_write_reqs).
     _DISABLE_EAGER_HOST_STAGING: 0,
     # Use the pallas flash-attention kernel inside ring attention:
-    # "auto" = on for the CPU backend (interpret mode; what tests cover),
-    # off on TPU *by default* because tunneled/virtualized TPU attachments
-    # may not support Mosaic compilation; set to "1" on real TPU VMs.
+    # "auto" = off on CPU (interpret mode is orders of magnitude slower
+    # than the XLA fallback — tests opt in explicitly); on TPU, probe-
+    # compile a tiny kernel once and cache the verdict, so real TPU VMs
+    # get the kernel and tunneled/virtualized attachments that can't run
+    # Mosaic fall back cleanly.  "1"/"0" force it on/off.
     _PALLAS_ATTENTION: "auto",
     # How thoroughly replicated-glob-matched host state is cross-checked
     # before being deduplicated to one writer:
@@ -150,11 +152,16 @@ def use_pallas_attention() -> bool:
         return True
     if v in ("0", "false", "off"):
         return False
-    # auto: pallas only where its compile path is known-good here —
-    # CPU interpret mode; real-TPU users opt in with "1"
+    # auto: off on CPU (interpret mode would silently regress real CPU
+    # runs; tests opt in via override_pallas_attention); on accelerators,
+    # probe-compile once and cache the verdict
     import jax
 
-    return jax.default_backend() == "cpu"
+    if jax.default_backend() == "cpu":
+        return False
+    from .ops.flash_attention import pallas_probe_ok
+
+    return pallas_probe_ok()
 
 
 @contextlib.contextmanager

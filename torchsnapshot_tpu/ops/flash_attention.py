@@ -58,6 +58,37 @@ def _use_interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
+_PROBE_VERDICT = None
+
+
+def pallas_probe_ok() -> bool:
+    """Compile-and-run a minimal kernel once on the current backend and
+    cache the verdict — how knobs' "auto" decides whether this TPU
+    attachment actually supports Mosaic compilation (some tunneled /
+    virtualized TPU runtimes don't).  A failed probe logs and falls back
+    to the XLA attention path; it never raises."""
+    global _PROBE_VERDICT
+    if _PROBE_VERDICT is None:
+        if not PALLAS_AVAILABLE:
+            _PROBE_VERDICT = False
+        else:
+            try:
+                x = jnp.zeros((1, _BQ, 1, _LANE), jnp.bfloat16)
+                jax.block_until_ready(flash_attention(x, x, x, causal=True))
+                _PROBE_VERDICT = True
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pallas probe-compile failed on backend %r; ring "
+                    "attention will use the XLA fallback",
+                    jax.default_backend(),
+                    exc_info=True,
+                )
+                _PROBE_VERDICT = False
+    return _PROBE_VERDICT
+
+
 def _attend_kernel(
     offs_ref,  # SMEM scalar prefetch: [q_offset, k_offset, sk_real]
     q_ref,  # [1, BQ, D]      (revisited across the kv grid dim)

@@ -110,6 +110,34 @@ class JaxArrayBufferStager(BufferStager):
         loop = asyncio.get_running_loop()
 
         def _materialize(src: Any) -> np.ndarray:
+            is_deleted = getattr(src, "is_deleted", None)
+            if callable(is_deleted) and is_deleted():
+                # A training step deleted the buffer this write was going
+                # to stage from — the donate_argnums hazard.  Fail with a
+                # diagnosis instead of XLA's bare "Array has been deleted".
+                if self.index is not None:
+                    why = (
+                        "this leaf is a chunk of an array over "
+                        "MAX_CHUNK_SIZE_BYTES; chunks slice on device "
+                        "and always stage lazily. With donation, call "
+                        "pending.wait() before the next step (or raise "
+                        "the chunk-size knob so the array is offloaded "
+                        "whole)."
+                    )
+                else:
+                    why = (
+                        "this leaf staged lazily (eager-offload budget "
+                        "exceeded, or host memory kinds unavailable). "
+                        "Raise TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_"
+                        "BYTES, or call pending.wait() before the next "
+                        "step."
+                    )
+                raise RuntimeError(
+                    "device array was deleted before async-snapshot "
+                    "staging — usually jit(donate_argnums=...) donated "
+                    "the train state on the step after async_take. "
+                    "Offloaded leaves are immune; " + why
+                )
             a = src if self.index is None else src[self.index]
             try:
                 a.copy_to_host_async()

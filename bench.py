@@ -1,4 +1,4 @@
-"""Checkpoint benchmark: time-blocked-on-save (the north-star metric).
+"""Checkpoint benchmark: time-blocked-on-save + restore throughput.
 
 The reference's headline table (benchmarks/ddp/README.md:9-24) reports
 save wall-time for a replicated model; its best single-chip number is
@@ -6,23 +6,35 @@ save wall-time for a replicated model; its best single-chip number is
 north-star for this repo: "checkpoint save+restore GB/s/chip and
 time-blocked-on-save" — the latter is what the reference's own torchrec
 benchmark prints (benchmarks/torchrec/main.py:147-155), because what a
-training job actually pays for a checkpoint is the time the train loop is
-blocked, not the time storage I/O takes.
+training job actually pays for a checkpoint is the time the train loop
+is blocked, not the time storage I/O takes.
 
-This benchmark measures both for ``async_take`` on a bf16 parameter
-pytree on one TPU chip:
+Structure: a SUPERVISOR process retries a CHILD process, because TPU
+backend init over a tunneled attachment fails or hangs transiently (the
+whole of round 1's benchmark was lost to exactly one such failure).  The
+supervisor enforces per-attempt timeouts, backs off between attempts,
+and — win or lose — always prints ONE JSON line (on exhaustion: value 0
+plus the last error), so the driver always records a parseable result.
 
-- ``value``         = payload / time-blocked (GB/s/chip).  The TPU-native
-  unblock point is the *dispatch* of one batched device→pinned_host DMA
-  (host_offload.eager_offload_write_reqs) — safe because jax.Arrays are
-  immutable, so nothing can mutate the snapshot content afterwards; the
-  background pipeline blocks on the in-flight transfer when it stages.
-- ``total_s``       = wall time until the snapshot is fully committed
-  (.snapshot_metadata written), storage I/O included.
-- ``vs_baseline``   = value / 1.44 GB/s (the reference's best published
-  single-chip save throughput).
+Child metrics on one chip:
 
-Prints ONE JSON line.
+- ``value``            = payload / time-blocked for ``async_take``
+  (GB/s/chip).  The TPU-native unblock point is the *dispatch* of one
+  batched device→pinned_host DMA (host_offload.eager_offload_write_reqs)
+  — safe because jax.Arrays are immutable; the background pipeline
+  blocks on the in-flight transfer when it stages.
+- ``save_total_gbps``  = payload / wall-time-to-commit — directly
+  comparable to the reference's sync save numbers (storage included).
+- ``restore_gbps``     = payload / restore wall-time into fresh device
+  arrays.
+- ``attention``        = pallas flash kernel vs the XLA fallback on the
+  ring-attention block shape (VERDICT r1 #2: prove the kernel compiles
+  and runs under Mosaic on real hardware, with an honest speedup
+  number).  TPU only — CPU interpret mode is not a benchmark.
+
+Payload: bf16 arrays totalling min(8 GB, 35% of HBM) on TPU (adaptive so
+restore's 2x-payload device peak — zero templates + restored arrays —
+fits small-HBM parts), tiny on CPU so the script always completes fast.
 """
 
 from __future__ import annotations
@@ -30,68 +42,243 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
 BASELINE_GBPS = 20.0 / 13.91  # reference: 1 node x 1 GPU, local FS
+METRIC = "async_save_blocked_throughput"
+
+_SUPERVISOR_DEADLINE_S = 540
+_MAX_ATTEMPTS = 4
+_CHILD_TIMEOUT_S = 420
 
 
-def main() -> None:
+def _time_op(fn, iters: int = 5, warmup: int = 2) -> float:
+    """Median-free simple timing: best of ``iters`` after warmup."""
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _attention_bench() -> dict:
+    """Flash (pallas/Mosaic) vs XLA dense attention on one chip."""
     import jax
     import jax.numpy as jnp
 
+    from torchsnapshot_tpu.ops.flash_attention import (
+        PALLAS_AVAILABLE,
+        flash_attention,
+        pallas_probe_ok,
+    )
+    from torchsnapshot_tpu.parallel.ring_attention import dense_attention
+
+    if not PALLAS_AVAILABLE:
+        return {"pallas_compiled": False, "why": "pallas unavailable"}
+    if not pallas_probe_ok():
+        return {"pallas_compiled": False, "why": "probe-compile failed"}
+
+    b, s, h, d = 4, 2048, 8, 128
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (
+        jax.random.normal(kk, (b, s, h, d), jnp.bfloat16) for kk in keys
+    )
+    flash_s = _time_op(lambda: flash_attention(q, k, v, causal=True))
+    xla = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+    xla_s = _time_op(lambda: xla(q, k, v))
+    return {
+        "pallas_compiled": True,
+        "shape": [b, s, h, d],
+        "flash_ms": round(flash_s * 1e3, 3),
+        "xla_dense_ms": round(xla_s * 1e3, 3),
+        "flash_speedup": round(xla_s / flash_s, 3),
+    }
+
+
+def run_child() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from torchsnapshot_tpu import PyTreeState, Snapshot
 
+    t0 = time.perf_counter()
     dev = jax.devices()[0]
+    init_s = time.perf_counter() - t0
     on_tpu = dev.platform != "cpu"
-    # ~1GB bf16 on TPU; small on CPU so the script always completes fast
-    n_arrays, elems = (16, 32 * 1024 * 1024) if on_tpu else (8, 1024 * 1024)
+
+    n_arrays = 16
+    if on_tpu:
+        # restore peaks at ~2x payload on device (zero templates + the
+        # restored arrays), so cap the payload to 35% of HBM
+        try:
+            hbm = int(dev.memory_stats()["bytes_limit"])
+        except Exception:
+            hbm = 16 * 10**9
+        payload_bytes = min(int(8.6e9), int(hbm * 0.35))
+    else:
+        payload_bytes = 16 * 1024 * 1024
+    elems = payload_bytes // (n_arrays * 2)
+    elems -= elems % 1024
 
     @jax.jit
     def make(i):
-        return (jnp.arange(elems, dtype=jnp.float32) * (i + 1)).astype(
+        return (jnp.arange(elems, dtype=jnp.float32) * (i + 1.0)).astype(
             jnp.bfloat16
         )
 
-    params = {f"layer{i}/w": make(i) for i in range(n_arrays)}
+    import numpy as np
+
+    params = {
+        f"layer{i:02d}/w": make(np.float32(i)) for i in range(n_arrays)
+    }
     jax.block_until_ready(params)
     total_gb = n_arrays * elems * 2 / 1e9
 
     root = tempfile.mkdtemp(prefix="tsnp_bench_")
+    result = {
+        "metric": METRIC,
+        "unit": "GB/s/chip",
+        "platform": dev.platform,
+        "device": getattr(dev, "device_kind", str(dev)),
+        "payload_gb": round(total_gb, 3),
+        "backend_init_s": round(init_s, 2),
+        "baseline": "reference 20GB/13.91s save, 1xA100 local FS "
+        "(benchmarks/ddp/README.md:17)",
+    }
     try:
         # warm-up on a small slice to exclude one-time costs (compile
         # caches, thread pools, first-transfer setup)
+        warm = (jnp.arange(1024, dtype=jnp.float32)).astype(jnp.bfloat16)
         Snapshot.async_take(
-            os.path.join(root, "warm"),
-            {"m": PyTreeState({"w": params["layer0/w"]})},
+            os.path.join(root, "warm"), {"m": PyTreeState({"w": warm})}
         ).wait()
 
         t0 = time.perf_counter()
         pending = Snapshot.async_take(
-            os.path.join(root, "snap"), {"m": PyTreeState(params)}
+            os.path.join(root, "snap"), {"m": PyTreeState(dict(params))}
         )
         blocked_s = time.perf_counter() - t0
-        pending.wait()
+        snap = pending.wait()
         total_s = time.perf_counter() - t0
+
+        gbps = total_gb / blocked_s
+        result.update(
+            {
+                "value": round(gbps, 3),
+                "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+                "blocked_s": round(blocked_s, 4),
+                "save_total_s": round(total_s, 2),
+                "save_total_gbps": round(total_gb / total_s, 3),
+            }
+        )
+
+        # restore into fresh device arrays (drop the originals first so
+        # device memory peaks at templates + restored, not 3x)
+        zeros = jax.jit(lambda: jnp.zeros((elems,), jnp.bfloat16))
+        templates = {k: zeros() for k in params}
+        del params
+        jax.block_until_ready(templates)
+        dest = PyTreeState(templates)
+        t0 = time.perf_counter()
+        snap.restore({"m": dest})
+        jax.block_until_ready(dest.tree)
+        restore_s = time.perf_counter() - t0
+        result.update(
+            {
+                "restore_s": round(restore_s, 2),
+                "restore_gbps": round(total_gb / restore_s, 3),
+            }
+        )
+        # spot-check one leaf round-tripped
+        import ml_dtypes
+
+        got = np.asarray(dest.tree["layer03/w"][:16]).astype(np.float32)
+        want = (
+            (np.arange(16, dtype=np.float32) * 4.0)
+            .astype(ml_dtypes.bfloat16)
+            .astype(np.float32)
+        )
+        if not np.array_equal(got, want):
+            raise RuntimeError("restore round-trip mismatch")
+        del dest, templates
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
-    gbps = total_gb / blocked_s
+    if on_tpu:
+        try:
+            result["attention"] = _attention_bench()
+        except Exception as e:  # the headline metric survives regardless
+            result["attention"] = {
+                "pallas_compiled": False,
+                "why": f"bench error: {e!r}"[:300],
+            }
+
+    print(json.dumps(result))
+
+
+def main() -> None:
+    if "--child" in sys.argv:
+        run_child()
+        return
+
+    deadline = time.time() + _SUPERVISOR_DEADLINE_S
+    last_err = ""
+    attempt = 0
+    while attempt < _MAX_ATTEMPTS and time.time() < deadline - 30:
+        attempt += 1
+        budget = min(_CHILD_TIMEOUT_S, max(60, deadline - time.time()))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True,
+                text=True,
+                timeout=budget,
+            )
+            out, err, rc = proc.stdout, proc.stderr, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout or b"")
+            out = out.decode() if isinstance(out, bytes) else out
+            err, rc = f"child timed out after {budget:.0f}s", -1
+        # forward the child's JSON line even if it later crashed — but
+        # only a line that actually parses (a child killed mid-print
+        # leaves a truncated line that must not become the final output)
+        for line in reversed((out or "").strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    json.loads(line)
+                except ValueError:
+                    continue
+                print(line)
+                return
+        tail = "\n".join((err or "").strip().splitlines()[-8:])
+        last_err = f"rc={rc}: {tail}"[-1500:]
+        if attempt < _MAX_ATTEMPTS and time.time() < deadline - 90:
+            sys.stderr.write(
+                f"bench attempt {attempt} failed ({last_err[:200]}); "
+                f"retrying\n"
+            )
+            time.sleep(min(20 * attempt, max(1, deadline - time.time() - 60)))
+
+    # exhausted: still emit a parseable record for the driver
     print(
         json.dumps(
             {
-                "metric": "async_save_blocked_throughput",
-                "value": round(gbps, 3),
+                "metric": METRIC,
+                "value": 0.0,
                 "unit": "GB/s/chip",
-                "vs_baseline": round(gbps / BASELINE_GBPS, 3),
-                "payload_gb": round(total_gb, 3),
-                "blocked_s": round(blocked_s, 4),
-                "total_s": round(total_s, 2),
-                "baseline": "reference 20GB/13.91s save, 1xA100 local FS "
-                "(benchmarks/ddp/README.md:17)",
+                "vs_baseline": 0.0,
+                "error": last_err,
+                "attempts": attempt,
             }
         )
     )

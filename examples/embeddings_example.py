@@ -73,7 +73,7 @@ def main(root: str) -> None:
     # random access to one table under a small memory budget
     snap = Snapshot(path)
     ads = snap.read_object(
-        "0/embeddings/leaves/0", memory_budget_bytes=1 << 20
+        "0/embeddings/ads", memory_budget_bytes=1 << 20
     )
     assert ads.shape == TABLES["ads"], ads.shape
     print("budgeted read_object of a single table: OK")

@@ -1,0 +1,270 @@
+"""GCS chunked parallel transfer with a fake bucket — no network.
+
+Covers chunk split, per-part retry, compose (incl. hierarchical >32),
+reassembly on ranged parallel download, and idempotent delete
+(reference behaviors: storage_plugins/gcs.py:88-219, redesigned as
+parallel composite upload / parallel ranged download)."""
+
+import asyncio
+
+import pytest
+
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage.gcs import (
+    GCSStoragePlugin,
+    _CollectiveProgressRetry,
+)
+
+
+class NotFound(Exception):
+    code = 404
+
+
+class PreconditionFailed(Exception):
+    code = 412
+
+
+class RangeUnsatisfiable(Exception):
+    code = 416
+
+
+class FakeBlob:
+    def __init__(self, bucket, name):
+        self.bucket = bucket
+        self.name = name
+        self.size = None
+        self.generation = None
+
+    def upload_from_file(self, stream, size, rewind=True, checksum=None):
+        self.bucket.fail_hook("write", self.name)
+        self.bucket.data[self.name] = stream.read()
+        self.bucket.gens[self.name] = self.bucket.gens.get(self.name, 0) + 1
+        assert len(self.bucket.data[self.name]) == size
+
+    def download_as_bytes(self, start=None, end=None, if_generation_match=None):
+        self.bucket.fail_hook("read", self.name)
+        if self.name not in self.bucket.data:
+            raise NotFound(self.name)
+        if (
+            if_generation_match is not None
+            and if_generation_match != self.bucket.gens[self.name]
+        ):
+            raise PreconditionFailed(self.name)
+        buf = self.bucket.data[self.name]
+        if start is None:
+            return bytes(buf)
+        if start >= len(buf):
+            raise RangeUnsatisfiable(self.name)
+        return bytes(buf[start : end + 1])  # GCS end is inclusive
+
+    def reload(self):
+        if self.name not in self.bucket.data:
+            raise NotFound(self.name)
+        self.size = len(self.bucket.data[self.name])
+        self.generation = self.bucket.gens[self.name]
+
+    def compose(self, sources):
+        self.bucket.fail_hook("compose", self.name)
+        assert len(sources) <= 32, "compose limit exceeded"
+        self.bucket.data[self.name] = b"".join(
+            bytes(self.bucket.data[s.name]) for s in sources
+        )
+        self.bucket.gens[self.name] = self.bucket.gens.get(self.name, 0) + 1
+        self.bucket.compose_calls.append([s.name for s in sources])
+
+    def delete(self):
+        if self.name not in self.bucket.data:
+            raise NotFound(self.name)
+        del self.bucket.data[self.name]
+
+
+class FakeBucket:
+    def __init__(self):
+        self.data = {}
+        self.gens = {}
+        self.compose_calls = []
+        self.fail_hook = lambda op, name: None
+
+    def blob(self, name):
+        return FakeBlob(self, name)
+
+
+def make_plugin(chunk_bytes):
+    from concurrent.futures import ThreadPoolExecutor
+
+    p = GCSStoragePlugin.__new__(GCSStoragePlugin)
+    p.prefix = "run"
+    p._bucket = FakeBucket()
+    p._executor = ThreadPoolExecutor(max_workers=8)
+    p._retry = _CollectiveProgressRetry(window_s=100.0)
+    p._retry.backoff = lambda attempt: asyncio.sleep(0)
+    p._chunk_bytes = chunk_bytes
+    return p
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_small_blob_single_upload():
+    p = make_plugin(chunk_bytes=100)
+    run(p.write(WriteIO(path="obj", buf=b"x" * 50)))
+    assert p._bucket.data == {"run/obj": b"x" * 50}
+    assert p._bucket.compose_calls == []
+
+
+def test_chunked_write_splits_composes_and_cleans_up():
+    p = make_plugin(chunk_bytes=100)
+    payload = bytes(range(256)) * 2  # 512 bytes -> 6 parts
+    run(p.write(WriteIO(path="big", buf=payload)))
+    assert p._bucket.data == {"run/big": payload}  # parts deleted
+    assert len(p._bucket.compose_calls) == 1
+    assert len(p._bucket.compose_calls[0]) == 6
+
+
+def test_chunked_write_hierarchical_compose_over_32_parts():
+    p = make_plugin(chunk_bytes=10)
+    payload = bytes(i % 251 for i in range(400))  # 40 parts
+    run(p.write(WriteIO(path="huge", buf=payload)))
+    assert p._bucket.data == {"run/huge": payload}
+    # two level-0 composes (32+8) then one final
+    sizes = sorted(len(c) for c in p._bucket.compose_calls)
+    assert sizes == [2, 8, 32]
+
+
+def test_per_part_retry_only_resends_failed_part():
+    p = make_plugin(chunk_bytes=100)
+    fails = {"n": 0}
+    writes = []
+
+    def hook(op, name):
+        if op == "write":
+            writes.append(name)
+            if name.endswith("part-00002") and fails["n"] < 2:
+                fails["n"] += 1
+                raise ConnectionError("transient")
+
+    p._bucket.fail_hook = hook
+    payload = b"q" * 450  # 5 parts
+    run(p.write(WriteIO(path="big", buf=payload)))
+    assert p._bucket.data["run/big"] == payload
+    # part 2 sent 3x, others exactly once
+    assert writes.count("run/big.part-00002") == 3
+    for i in (0, 1, 3, 4):
+        assert writes.count(f"run/big.part-{i:05d}") == 1
+
+
+def test_chunked_read_reassembles():
+    p = make_plugin(chunk_bytes=100)
+    payload = bytes(i % 256 for i in range(512))
+    p._bucket.data["run/big"] = payload
+    p._bucket.gens["run/big"] = 1
+    io = ReadIO(path="big")
+    run(p.read(io))
+    assert bytes(io.buf) == payload
+
+
+def test_chunked_ranged_read():
+    p = make_plugin(chunk_bytes=100)
+    payload = bytes(i % 256 for i in range(1000))
+    p._bucket.data["run/big"] = payload
+    p._bucket.gens["run/big"] = 1
+    io = ReadIO(path="big", byte_range=[150, 650])  # 500B -> 5 ranges
+    run(p.read(io))
+    assert bytes(io.buf) == payload[150:650]
+
+
+def test_chunked_read_retries_failed_range():
+    p = make_plugin(chunk_bytes=100)
+    payload = bytes(i % 256 for i in range(300))
+    p._bucket.data["run/big"] = payload
+    p._bucket.gens["run/big"] = 1
+    fails = {"n": 0}
+    reads = []
+
+    def hook(op, name):
+        if op == "read":
+            reads.append(name)
+            if fails["n"] == 1:  # fail exactly the 2nd range request once
+                fails["n"] += 1
+                raise ConnectionError("transient")
+            if fails["n"] == 0:
+                fails["n"] += 1
+
+    p._bucket.fail_hook = hook
+    io = ReadIO(path="big")
+    run(p.read(io))
+    assert bytes(io.buf) == payload
+
+
+def test_read_missing_raises_filenotfound():
+    p = make_plugin(chunk_bytes=100)
+    with pytest.raises(FileNotFoundError):
+        run(p.read(ReadIO(path="nope")))
+
+
+def test_small_read_is_one_request():
+    p = make_plugin(chunk_bytes=100)
+    p._bucket.data["run/small"] = b"z" * 40
+    p._bucket.gens["run/small"] = 1
+    reads = []
+    p._bucket.fail_hook = lambda op, name: reads.append(op)
+    io = ReadIO(path="small")
+    run(p.read(io))
+    assert bytes(io.buf) == b"z" * 40
+    assert reads == ["read"]  # no stat round-trip for small blobs
+
+
+def test_empty_blob_read():
+    p = make_plugin(chunk_bytes=100)
+    p._bucket.data["run/empty"] = b""
+    p._bucket.gens["run/empty"] = 1
+    io = ReadIO(path="empty")
+    run(p.read(io))
+    assert bytes(io.buf) == b""
+
+
+def test_concurrent_overwrite_fails_loudly_not_spliced():
+    """Ranges are pinned to the stat generation: an overwrite mid-read
+    must error (precondition), never splice two generations."""
+    p = make_plugin(chunk_bytes=100)
+    payload = bytes(i % 256 for i in range(300))
+    p._bucket.data["run/big"] = payload
+    p._bucket.gens["run/big"] = 1
+    # overwrite the object (new generation) right after the stat
+    orig_reload = FakeBlob.reload
+
+    def reload_and_overwrite(self):
+        orig_reload(self)
+        self.bucket.data["run/big"] = bytes(300)  # new content
+        self.bucket.gens["run/big"] += 1  # new generation
+
+    try:
+        FakeBlob.reload = reload_and_overwrite
+        with pytest.raises(PreconditionFailed):
+            run(p.read(ReadIO(path="big")))
+    finally:
+        FakeBlob.reload = orig_reload
+
+
+def test_failed_chunked_write_sweeps_parts():
+    """Exhausted part retries must not leak manifest-invisible orphans."""
+    p = make_plugin(chunk_bytes=100)
+
+    def hook(op, name):
+        if op == "write" and name.endswith("part-00002"):
+            raise ConnectionError("permanently down")
+
+    p._bucket.fail_hook = hook
+    p._retry.window_s = 0.0  # exhaust immediately
+    with pytest.raises(ConnectionError):
+        run(p.write(WriteIO(path="big", buf=b"q" * 450)))
+    assert p._bucket.data == {}  # every uploaded part swept
+
+
+def test_delete_is_idempotent():
+    p = make_plugin(chunk_bytes=100)
+    p._bucket.data["run/obj"] = b"x"
+    run(p.delete("obj"))
+    assert "run/obj" not in p._bucket.data
+    run(p.delete("obj"))  # second delete: 404 -> success, no raise

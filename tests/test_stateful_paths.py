@@ -1,0 +1,188 @@
+"""PyTreeState named-path semantics: manifests carry real pytree names
+(ts/params/.../kernel — the role the reference's flatten layer plays,
+flatten.py:20), read_object is addressable, and the legacy leaf-list
+format still loads."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+from flax.training import train_state
+
+from torchsnapshot_tpu import PyTreeState, Snapshot
+
+
+class _MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(nn.relu(nn.Dense(8)(x)))
+
+
+def _make_state(seed):
+    m = _MLP()
+    params = m.init(jax.random.PRNGKey(seed), jnp.ones((2, 6)))
+    return train_state.TrainState.create(
+        apply_fn=m.apply, params=params, tx=optax.adam(1e-3)
+    )
+
+
+def test_manifest_has_named_paths(tmp_path):
+    ts = _make_state(0)
+    Snapshot.take(str(tmp_path / "s"), {"ts": PyTreeState(ts)})
+    manifest = Snapshot(str(tmp_path / "s")).get_manifest()
+    # flax TrainState → GetAttrKey("params") → DictKey("params")/...
+    assert any("ts/params/params/Dense_0/kernel" in k for k in manifest)
+    assert any(k.endswith("ts/step") for k in manifest)
+    assert not any("/leaves/" in k for k in manifest)
+
+
+def test_read_object_by_name(tmp_path):
+    ts = _make_state(1)
+    Snapshot.take(str(tmp_path / "s"), {"ts": PyTreeState(ts)})
+    snap = Snapshot(str(tmp_path / "s"))
+    got = snap.read_object("0/ts/params/params/Dense_0/kernel")
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(ts.params["params"]["Dense_0"]["kernel"])
+    )
+
+
+def test_round_trip_into_differently_seeded_state(tmp_path):
+    ts0 = _make_state(0)
+    snap = Snapshot.take(str(tmp_path / "s"), {"ts": PyTreeState(ts0)})
+    dest = PyTreeState(_make_state(7))
+    snap.restore({"ts": dest})
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ts0), jax.tree_util.tree_leaves(dest.tree)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_lists_render_as_indexed_names(tmp_path):
+    tree = {"stack": [jnp.zeros(3), jnp.ones(3)], "n": jnp.zeros(())}
+    Snapshot.take(str(tmp_path / "s"), {"t": PyTreeState(tree)})
+    manifest = Snapshot(str(tmp_path / "s")).get_manifest()
+    assert "0/t/stack/0" in manifest and "0/t/stack/1" in manifest
+
+
+def test_legacy_leaf_list_loads_positionally():
+    ts = PyTreeState({"a": np.zeros(2), "b": {"c": np.zeros(3)}})
+    legacy = {"leaves": [np.ones(2), np.full(3, 2.0)]}
+    ts.load_state_dict(legacy)
+    np.testing.assert_array_equal(ts.tree["a"], np.ones(2))
+    np.testing.assert_array_equal(ts.tree["b"]["c"], np.full(3, 2.0))
+
+
+def test_tree_actually_named_leaves_is_not_legacy():
+    # a user tree that coincides with the legacy envelope shape
+    ts = PyTreeState({"leaves": [np.zeros(2), np.zeros(3)]})
+    ts.load_state_dict({"leaves": [np.ones(2), np.full(3, 5.0)]})
+    np.testing.assert_array_equal(ts.tree["leaves"][0], np.ones(2))
+    np.testing.assert_array_equal(ts.tree["leaves"][1], np.full(3, 5.0))
+
+
+def test_strict_missing_path_raises_nonstrict_keeps_template():
+    ts = PyTreeState({"a": np.zeros(2), "b": np.full(3, 9.0)})
+    partial = {"a": np.ones(2)}
+    with pytest.raises(ValueError, match="missing"):
+        ts.load_state_dict(dict(partial), strict=True)
+    ts.load_state_dict(dict(partial), strict=False)
+    np.testing.assert_array_equal(ts.tree["a"], np.ones(2))
+    np.testing.assert_array_equal(ts.tree["b"], np.full(3, 9.0))  # kept
+
+
+def test_root_leaf_tree():
+    ts = PyTreeState(np.zeros(4))
+    sd = ts.state_dict()
+    assert set(sd.keys()) == {"__root__"}
+    ts.load_state_dict({"__root__": np.ones(4)})
+    np.testing.assert_array_equal(ts.tree, np.ones(4))
+
+
+def test_path_collision_raises(monkeypatch):
+    # standard containers can't produce colliding paths (jax rejects
+    # mixed-type dict keys), but custom pytree nodes could — the guard
+    # must refuse rather than silently overwrite
+    import torchsnapshot_tpu.stateful as stateful_mod
+
+    dk = jax.tree_util.DictKey
+    fake = [((dk("x"),), np.zeros(1)), ((dk("x"),), np.ones(1))]
+    monkeypatch.setattr(
+        jax.tree_util, "tree_flatten_with_path", lambda t: (fake, None)
+    )
+    with pytest.raises(ValueError, match="collide"):
+        stateful_mod._tree_path_keys({"any": 1})
+
+
+def test_strict_rejects_surplus_snapshot_leaves():
+    ts = PyTreeState({"a": np.zeros(2)})
+    with pytest.raises(ValueError, match="absent from template"):
+        ts.load_state_dict({"a": np.ones(2), "b": np.ones(3)}, strict=True)
+    # elastic shrink: surplus silently dropped
+    ts.load_state_dict({"a": np.ones(2), "b": np.ones(3)}, strict=False)
+    np.testing.assert_array_equal(ts.tree["a"], np.ones(2))
+
+
+def test_subtree_at_leaf_position_is_a_mismatch():
+    # snapshot has a CONTAINER where the template expects a leaf — must
+    # not silently install the dict as a leaf
+    ts = PyTreeState({"a": np.zeros(2)})
+    with pytest.raises(ValueError, match="mismatch"):
+        ts.load_state_dict({"a": {"b": np.ones(2)}}, strict=True)
+    ts.load_state_dict({"a": {"b": np.ones(2)}}, strict=False)
+    np.testing.assert_array_equal(ts.tree["a"], np.zeros(2))  # kept
+
+
+def test_legacy_snapshot_restore_keeps_sharding(tmp_path, monkeypatch):
+    """Restoring a pre-named-paths snapshot (manifest: ts/leaves/N) into
+    a sharded PyTreeState template must still use the template's leaves
+    — positionally — so device placement/sharding survives."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    tree = {
+        "w": jax.device_put(jnp.arange(16, dtype=jnp.float32), sharding),
+        "b": jnp.ones(4),
+    }
+
+    # write a snapshot in the legacy leaf-list format
+    monkeypatch.setattr(
+        PyTreeState,
+        "state_dict",
+        lambda self: {"leaves": jax.tree_util.tree_leaves(self.tree)},
+    )
+    Snapshot.take(str(tmp_path / "s"), {"ts": PyTreeState(tree)})
+    monkeypatch.undo()
+    manifest = Snapshot(str(tmp_path / "s")).get_manifest()
+    assert any("ts/leaves/" in p for p in manifest)  # genuinely legacy
+
+    dest = PyTreeState(
+        {
+            "w": jax.device_put(jnp.zeros(16, jnp.float32), sharding),
+            "b": jnp.zeros(4),
+        }
+    )
+    Snapshot(str(tmp_path / "s")).restore({"ts": dest})
+    # b sorts before w: positional mapping must still land correctly
+    np.testing.assert_array_equal(np.asarray(dest.tree["b"]), np.ones(4))
+    np.testing.assert_array_equal(
+        np.asarray(dest.tree["w"]), np.arange(16, dtype=np.float32)
+    )
+    assert dest.tree["w"].sharding.is_equivalent_to(sharding, 1)
+
+
+def test_elastic_restore_new_layer(tmp_path):
+    """Grow the model: restore a 2-layer snapshot into a 3-layer tree
+    with strict=False — saved layers load by NAME, the new layer keeps
+    its init (the per-path elasticity the named manifest enables)."""
+    small = {"l0": jnp.zeros(4), "l1": jnp.ones(4)}
+    snap = Snapshot.take(str(tmp_path / "s"), {"m": PyTreeState(small)})
+    grown = PyTreeState(
+        {"l0": jnp.full(4, 9.0), "l1": jnp.full(4, 9.0), "l2": jnp.full(4, 3.0)}
+    )
+    snap.restore({"m": grown}, strict=False)
+    np.testing.assert_array_equal(np.asarray(grown.tree["l0"]), np.zeros(4))
+    np.testing.assert_array_equal(np.asarray(grown.tree["l1"]), np.ones(4))
+    np.testing.assert_array_equal(np.asarray(grown.tree["l2"]), np.full(4, 3.0))

@@ -606,6 +606,7 @@ class Snapshot:
         # current state provides in-place/sharding templates
         # (reference snapshot.py:754-762)
         _, targets = flatten(stateful.state_dict(), prefix=key)
+        self._map_legacy_leaf_targets(key, stateful, key_manifest, targets)
 
         container_entries: Manifest = {}
         read_reqs: List[ReadReq] = []
@@ -639,6 +640,35 @@ class Snapshot:
             stateful.load_state_dict(state_dict, strict=strict)
         else:
             stateful.load_state_dict(state_dict)
+
+    @staticmethod
+    def _map_legacy_leaf_targets(
+        key: str, stateful: Any, key_manifest: Manifest, targets: Dict[str, Any]
+    ) -> None:
+        """Snapshots written before PyTreeState rendered NAMED paths store
+        leaves as ``<key>/leaves/<i>``; a current PyTreeState's named
+        targets would never match them, losing the in-place/sharding
+        templates (full-array host reads, no device placement).  Map the
+        template's leaves onto the legacy paths positionally — the same
+        order both formats derive from ``jax.tree_util`` flattening."""
+        import re
+
+        from .stateful import PyTreeState, _tree_path_keys
+
+        if not isinstance(stateful, PyTreeState):
+            return
+        pat = re.compile(re.escape(key) + r"/leaves/(\d+)$")
+        legacy = {
+            int(m.group(1)): p
+            for p in key_manifest
+            if (m := pat.fullmatch(p)) and not is_container_entry(key_manifest[p])
+        }
+        if not legacy or any(p in targets for p in legacy.values()):
+            return
+        pairs, _ = _tree_path_keys(stateful.tree)
+        for i, (_, leaf) in enumerate(pairs):
+            if i in legacy:
+                targets[legacy[i]] = leaf
 
     # ----------------------------------------------------------- read_object
 

@@ -36,35 +36,157 @@ class StateDict(UserDict):
         self.data.update(state_dict)
 
 
+_ROOT_LEAF_KEY = "__root__"
+
+
+def _path_entry_str(entry: Any) -> str:
+    """One pytree path entry → manifest path segment.
+
+    DictKey('wq') → 'wq', GetAttrKey('params') → 'params' (flax structs,
+    optax states), SequenceKey(3) → '3', FlattenedIndexKey(i) → str(i).
+    """
+    import jax
+
+    tu = jax.tree_util
+    if isinstance(entry, tu.DictKey):
+        return str(entry.key)
+    if isinstance(entry, tu.GetAttrKey):
+        return str(entry.name)
+    if isinstance(entry, tu.SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, tu.FlattenedIndexKey):
+        return str(entry.key)
+    return str(entry)
+
+
+def _tree_path_keys(tree: Any):
+    """[(path_key_strings, leaf), ...] in tree_flatten order, plus the
+    treedef.  Raises on two paths stringifying identically (e.g. a dict
+    with both 0 and "0" as keys) — silent overwrites would corrupt the
+    snapshot."""
+    import jax
+
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    seen = set()
+    for path, leaf in leaves_with_paths:
+        keys = tuple(_path_entry_str(p) for p in path) or (_ROOT_LEAF_KEY,)
+        if keys in seen:
+            raise ValueError(
+                f"pytree paths collide after stringification: {keys!r}"
+            )
+        seen.add(keys)
+        out.append((keys, leaf))
+    return out, treedef
+
+
+def _leaf_paths_of(node: Any, prefix: tuple = ()):
+    """Leaf paths of a nested state-dict (dicts/lists as containers)."""
+    if isinstance(node, dict):
+        for k, v in node.items():
+            yield from _leaf_paths_of(v, prefix + (str(k),))
+    elif isinstance(node, (list, tuple)):
+        for i, v in enumerate(node):
+            yield from _leaf_paths_of(v, prefix + (str(i),))
+    else:
+        yield prefix or (_ROOT_LEAF_KEY,)
+
+
 class PyTreeState:
     """Checkpointable wrapper around an arbitrary JAX pytree.
 
-    ``state_dict`` flattens the tree to a leaf list (saved leaf-by-leaf, so
-    jax.Array leaves keep their shardings as restore templates);
-    ``load_state_dict`` rebuilds the tree with the *current* treedef, which
-    doubles as a structural-compatibility check on restore.
+    ``state_dict`` renders the tree as a NESTED NAMED dict using
+    ``jax.tree_util.tree_flatten_with_path``, so manifests carry real
+    names — ``ts/params/layer0/wq`` — making ``read_object`` addressable
+    and per-path partial restore meaningful (the role the reference's
+    whole flatten layer plays, flatten.py:20).  jax.Array leaves keep
+    their shardings as restore templates.
+
+    ``load_state_dict`` maps the named dict back onto the *current*
+    tree's structure (a structural-compatibility check), keeping the
+    current leaf for paths missing from the snapshot when
+    ``strict=False`` (elastic restore).  Snapshots written by older
+    versions (flat ``{"leaves": [...]}``) load positionally.
     """
 
     def __init__(self, tree: Any) -> None:
         self.tree = tree
 
     def state_dict(self) -> Dict[str, Any]:
+        pairs, _ = _tree_path_keys(self.tree)
+        out: Dict[str, Any] = {}
+        for keys, leaf in pairs:
+            node = out
+            for k in keys[:-1]:
+                node = node.setdefault(k, {})
+            node[keys[-1]] = leaf
+        return out
+
+    def load_state_dict(
+        self, state_dict: Dict[str, Any], strict: bool = True
+    ) -> None:
         import jax
 
-        leaves = jax.tree_util.tree_leaves(self.tree)
-        return {"leaves": leaves}
+        if self._is_legacy_format(state_dict):
+            treedef = jax.tree_util.tree_structure(self.tree)
+            leaves = state_dict["leaves"]
+            if treedef.num_leaves != len(leaves):
+                raise ValueError(
+                    f"cannot load {len(leaves)} leaves into a tree with "
+                    f"{treedef.num_leaves} leaves"
+                )
+            self.tree = jax.tree_util.tree_unflatten(treedef, leaves)
+            return
 
-    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
-        import jax
+        pairs, treedef = _tree_path_keys(self.tree)
+        new_leaves = []
+        missing = []
+        consumed = set()
+        for keys, current in pairs:
+            node: Any = state_dict
+            try:
+                for k in keys:
+                    # sequence nodes appear when a snapshot predates the
+                    # dict-rendering of lists (or coincides with it)
+                    node = (
+                        node[int(k)]
+                        if isinstance(node, (list, tuple))
+                        else node[k]
+                    )
+                if isinstance(node, (dict, list, tuple)):
+                    # a CONTAINER where the template has a leaf is a
+                    # structural mismatch, not a loadable value
+                    raise KeyError(keys)
+                consumed.add(keys)
+            except (KeyError, TypeError, IndexError, ValueError):
+                missing.append("/".join(keys))
+                node = current  # elastic: keep the template's leaf
+            new_leaves.append(node)
+        if strict:
+            surplus = [
+                "/".join(p)
+                for p in _leaf_paths_of(state_dict)
+                if p not in consumed
+            ]
+            if missing or surplus:
+                raise ValueError(
+                    f"structure mismatch (pass strict=False for elastic "
+                    f"load): {len(missing)} template path(s) missing from "
+                    f"snapshot {missing[:5]}, {len(surplus)} snapshot "
+                    f"path(s) absent from template {surplus[:5]}"
+                )
+        self.tree = jax.tree_util.tree_unflatten(treedef, new_leaves)
 
-        treedef = jax.tree_util.tree_structure(self.tree)
-        leaves = state_dict["leaves"]
-        if treedef.num_leaves != len(leaves):
-            raise ValueError(
-                f"cannot load {len(leaves)} leaves into a tree with "
-                f"{treedef.num_leaves} leaves"
-            )
-        self.tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    def _is_legacy_format(self, state_dict: Dict[str, Any]) -> bool:
+        """Snapshots from the leaf-list era read {"leaves": [...]}; only
+        treat that as legacy when the wrapped tree itself doesn't look
+        like such a dict (in which case both formats coincide anyway)."""
+        if set(state_dict.keys()) != {"leaves"}:
+            return False
+        if not isinstance(state_dict["leaves"], (list, tuple)):
+            return False
+        pairs, _ = _tree_path_keys(self.tree)
+        return not all(keys[0] == "leaves" for keys, _ in pairs)
 
 
 class RNGState:

@@ -2,11 +2,18 @@
 
 Reference: torchsnapshot/storage_plugins/gcs.py:49-277.  Reimplemented on
 ``google-cloud-storage`` (sync client driven from a thread pool, since the
-scheduler caps in-flight storage ops anyway) with the reference's two key
-behaviors:
+scheduler caps in-flight storage ops anyway) with the reference's key
+behaviors, redesigned where the platform allows better:
 
 - ranged reads via ``download_as_bytes(start, end)`` so ``read_object``
   under a memory budget fetches only the requested bytes,
+- **chunked parallel transfer for large blobs** (reference gcs.py:88-219
+  streams 100MB chunks sequentially through one resumable session): here
+  downloads over ~100MB fan out as parallel ranged GETs, and uploads fan
+  out as parallel part uploads stitched with GCS ``compose`` (the
+  parallel-composite pattern) — each part/range individually under the
+  retry strategy, so one flaky connection re-sends 100MB, not 512MB, and
+  a multi-stream transfer rides DCN far better than one HTTP stream,
 - a **collective-progress retry strategy** (reference gcs.py:221-277):
   rather than a fixed per-op deadline, all concurrent ops share a deadline
   that is refreshed whenever *any* op completes — an op only gives up when
@@ -30,6 +37,29 @@ logger = logging.getLogger(__name__)
 
 _PROGRESS_WINDOW_S = 120.0
 _MAX_ATTEMPTS = 6
+_DEFAULT_CHUNK_BYTES = 100 * 1024 * 1024
+_MAX_COMPOSE_COMPONENTS = 32  # GCS compose limit per call
+
+
+def _is_not_found(e: BaseException) -> bool:
+    try:
+        from google.api_core import exceptions as gexc
+
+        if isinstance(e, gexc.NotFound):
+            return True
+    except ImportError:  # pragma: no cover
+        pass
+    # fallback for environments/fakes without google.api_core
+    return type(e).__name__ == "NotFound" or getattr(e, "code", None) == 404
+
+
+def _is_range_unsatisfiable(e: BaseException) -> bool:
+    # 416: ranged GET starting at/after EOF — only a zero-byte object
+    # can produce it for our chunk-aligned ranges
+    return (
+        type(e).__name__ == "RequestedRangeNotSatisfiable"
+        or getattr(e, "code", None) == 416
+    )
 
 
 class _CollectiveProgressRetry:
@@ -57,7 +87,12 @@ class _CollectiveProgressRetry:
 
 
 class GCSStoragePlugin(StoragePlugin):
-    def __init__(self, path: str, num_threads: int = 16) -> None:
+    def __init__(
+        self,
+        path: str,
+        num_threads: int = 16,
+        chunk_bytes: int = _DEFAULT_CHUNK_BYTES,
+    ) -> None:
         try:
             from google.cloud import storage as gcs
         except ImportError as e:  # pragma: no cover
@@ -71,6 +106,7 @@ class GCSStoragePlugin(StoragePlugin):
             max_workers=num_threads, thread_name_prefix="tsnp-gcs"
         )
         self._retry = _CollectiveProgressRetry()
+        self._chunk_bytes = chunk_bytes
 
     def _blob_name(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
@@ -86,17 +122,22 @@ class GCSStoragePlugin(StoragePlugin):
             except FileNotFoundError:
                 raise
             except Exception as e:  # noqa: BLE001
-                # A 404 on a read/delete means the object is missing — map
-                # to the same FileNotFoundError contract as the fs/memory
-                # plugins instead of burning the retry deadline.  WRITES
-                # keep retrying: a resumable-upload session GCS invalidated
+                # A 404 means the object is missing.  Reads map to the
+                # same FileNotFoundError contract as the fs/memory
+                # plugins instead of burning the retry deadline; deletes
+                # treat it as SUCCESS (idempotent cleanup — fs-style
+                # callers expect re-deleting to be a no-op).  WRITES keep
+                # retrying: a resumable-upload session GCS invalidated
                 # mid-upload also surfaces as 404, and a fresh attempt
                 # starts a new session and succeeds.
-                if not op_name.startswith("write ") and (
-                    type(e).__name__ == "NotFound"
-                    or getattr(e, "code", None) == 404
-                ):
-                    raise FileNotFoundError(f"{op_name}: {e}") from e
+                if _is_not_found(e):
+                    if op_name.startswith("delete "):
+                        self._retry.record_progress()
+                        return None
+                    if not op_name.startswith("write "):
+                        raise FileNotFoundError(f"{op_name}: {e}") from e
+                if _is_range_unsatisfiable(e) and op_name.startswith("read "):
+                    raise  # deterministic (zero-byte object); don't retry
                 attempt += 1
                 if not self._retry.should_retry(attempt):
                     raise
@@ -106,16 +147,20 @@ class GCSStoragePlugin(StoragePlugin):
                 )
                 await self._retry.backoff(attempt)
 
+    # ------------------------------------------------------------- write
+
     async def write(self, write_io: WriteIO) -> None:
         from ..utils.memoryview_stream import MemoryviewStream
 
-        blob = self._bucket.blob(self._blob_name(write_io.path))
         view = memoryview(write_io.buf).cast("B")
+        if view.nbytes > self._chunk_bytes:
+            await self._chunked_write(write_io.path, view)
+            return
+        blob = self._bucket.blob(self._blob_name(write_io.path))
 
         def upload() -> None:
-            # zero-copy: stream straight from the staged buffer; resumable
-            # upload kicks in automatically above the chunk-size threshold
-            # and crc32c is verified server-side
+            # zero-copy: stream straight from the staged buffer; crc32c
+            # is verified server-side
             blob.upload_from_file(
                 MemoryviewStream(view),
                 size=view.nbytes,
@@ -125,20 +170,178 @@ class GCSStoragePlugin(StoragePlugin):
 
         await self._with_retry(upload, f"write {write_io.path}")
 
+    async def _chunked_write(self, path: str, view: memoryview) -> None:
+        """Parallel composite upload: N ≤100MB parts uploaded concurrently
+        (each under its own retry), stitched with ``compose`` (hierarchical
+        above 32 components), parts deleted after.  Retry granularity is
+        one part — a flaky connection re-sends 100MB, not the whole blob
+        (reference streams chunks sequentially, gcs.py:88-219)."""
+        from ..utils.memoryview_stream import MemoryviewStream
+
+        name = self._blob_name(path)
+        chunk = self._chunk_bytes
+        n = (view.nbytes + chunk - 1) // chunk
+        part_names = [f"{name}.part-{i:05d}" for i in range(n)]
+
+        async def put(i: int) -> None:
+            lo, hi = i * chunk, min((i + 1) * chunk, view.nbytes)
+            blob = self._bucket.blob(part_names[i])
+
+            def upload() -> None:
+                blob.upload_from_file(
+                    MemoryviewStream(view[lo:hi]),
+                    size=hi - lo,
+                    rewind=True,
+                    checksum="crc32c",
+                )
+
+            await self._with_retry(upload, f"write {path} [part {i}/{n}]")
+
+        temps: list = []
+        try:
+            # settle ALL parts before raising (plain gather would cancel
+            # the awaiting coroutines while their executor threads keep
+            # uploading — racing the cleanup sweep below)
+            results = await asyncio.gather(
+                *(put(i) for i in range(n)), return_exceptions=True
+            )
+            errs = [r for r in results if isinstance(r, BaseException)]
+            if errs:
+                raise errs[0]
+
+            sources, level = part_names, 0
+            while len(sources) > 1:
+                groups = [
+                    sources[j : j + _MAX_COMPOSE_COMPONENTS]
+                    for j in range(0, len(sources), _MAX_COMPOSE_COMPONENTS)
+                ]
+                nxt = []
+                for gi, grp in enumerate(groups):
+                    out = (
+                        name
+                        if len(groups) == 1
+                        else f"{name}.compose-{level}-{gi:05d}"
+                    )
+                    dest = self._bucket.blob(out)
+                    srcs = [self._bucket.blob(s) for s in grp]
+                    await self._with_retry(
+                        functools.partial(dest.compose, srcs),
+                        f"write {path} [compose L{level}.{gi}]",
+                    )
+                    nxt.append(out)
+                    if out != name:
+                        temps.append(out)
+                sources, level = nxt, level + 1
+        finally:
+            # ALWAYS sweep intermediates: an exhausted part retry must
+            # not leak manifest-invisible ~100MB orphans that bill
+            # storage forever (delete is idempotent; sweep errors are
+            # secondary to the write's own outcome)
+            for tmp in part_names + temps:
+                try:
+                    await self._delete_blob(tmp)
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "failed to sweep upload intermediate %s", tmp,
+                        exc_info=True,
+                    )
+
+    # -------------------------------------------------------------- read
+
     async def read(self, read_io: ReadIO) -> None:
-        blob = self._bucket.blob(self._blob_name(read_io.path))
+        name = self._blob_name(read_io.path)
+        blob = self._bucket.blob(name)
+        chunk = self._chunk_bytes
         if read_io.byte_range is None:
-            fn = functools.partial(blob.download_as_bytes)
+            # Optimistic single ranged GET of the first chunk: small
+            # blobs (the common restore case) finish in ONE request —
+            # no stat round-trip — and only a full-length response
+            # means there may be more.
+            try:
+                first = await self._with_retry(
+                    functools.partial(
+                        blob.download_as_bytes, start=0, end=chunk - 1
+                    ),
+                    f"read {read_io.path}",
+                )
+            except Exception as e:  # noqa: BLE001
+                if _is_range_unsatisfiable(e):
+                    read_io.buf = b""  # zero-byte object
+                    return
+                raise
+            if len(first) < chunk:
+                read_io.buf = first
+                return
+            await self._with_retry(
+                blob.reload, f"read {read_io.path} [stat]"
+            )
+            start, end = 0, int(blob.size or 0)
+            if end <= chunk:
+                # exactly one chunk: `first` was the whole blob from a
+                # single (atomic) request
+                read_io.buf = first
+                return
+            # `first` predates the stat, so a concurrent overwrite could
+            # make it a different generation than the ranges below —
+            # discard it and fetch everything pinned to one generation.
+            generation = getattr(blob, "generation", None)
         else:
             start, end = read_io.byte_range
-            fn = functools.partial(
-                blob.download_as_bytes, start=start, end=end - 1
+            if end - start <= chunk:
+                fn = functools.partial(
+                    blob.download_as_bytes, start=start, end=end - 1
+                )
+                read_io.buf = await self._with_retry(
+                    fn, f"read {read_io.path}"
+                )
+                return
+            await self._with_retry(
+                blob.reload, f"read {read_io.path} [stat]"
             )
-        read_io.buf = await self._with_retry(fn, f"read {read_io.path}")
+            generation = getattr(blob, "generation", None)
+
+        # Parallel ranged download, one retry domain per ~100MB range
+        # (reference downloads 100MB chunks sequentially, gcs.py:183-219).
+        # Every range is pinned to the stat's generation: without it, a
+        # concurrent overwrite of the blob could splice two generations
+        # into one buffer undetected (ranged GETs skip crc validation).
+        # A generation mismatch fails the read loudly instead.
+        length = end - start
+        out = bytearray(length)
+
+        async def get(lo: int, hi: int) -> None:
+            kwargs = {"start": lo, "end": hi - 1}
+            if generation is not None:
+                kwargs["if_generation_match"] = generation
+            fn = functools.partial(
+                self._bucket.blob(name).download_as_bytes, **kwargs
+            )
+            data = await self._with_retry(
+                fn, f"read {read_io.path} [{lo}:{hi}]"
+            )
+            if len(data) != hi - lo:
+                raise IOError(
+                    f"ranged read {read_io.path} [{lo}:{hi}] returned "
+                    f"{len(data)} bytes"
+                )
+            out[lo - start : hi - start] = data
+
+        await asyncio.gather(
+            *(
+                get(lo, min(lo + chunk, end))
+                for lo in range(start, end, chunk)
+            )
+        )
+        read_io.buf = out
+
+    # ------------------------------------------------------------ delete
+
+    async def _delete_blob(self, blob_name: str) -> None:
+        blob = self._bucket.blob(blob_name)
+        await self._with_retry(blob.delete, f"delete {blob_name}")
 
     async def delete(self, path: str) -> None:
-        blob = self._bucket.blob(self._blob_name(path))
-        await self._with_retry(blob.delete, f"delete {path}")
+        await self._delete_blob(self._blob_name(path))
 
     async def close(self) -> None:
         self._executor.shutdown(wait=False)

@@ -40,8 +40,13 @@ def migrate_orbax_to_snapshot(
     from ..stateful import PyTreeState, StateDict
 
     tree = import_from_orbax(orbax_path)
-    # keep the named structure in the manifest when the root is a dict
-    # (so snapshot paths read "state/params/w", not "state/leaves/3")
+    # Dict-rooted trees (the orbax norm) go through StateDict so the raw
+    # containers reach flatten untouched: lists stay ListEntries and
+    # None leaves survive, keeping migrate_snapshot_to_orbax's inflate a
+    # faithful inverse.  (PyTreeState's named rendering would rewrite
+    # lists as string-keyed dicts and drop None — jax treats None as an
+    # empty subtree.)  Non-dict roots fall back to PyTreeState, whose
+    # named paths match what a direct snapshot of that tree would use.
     stateful = StateDict(tree) if isinstance(tree, dict) else PyTreeState(tree)
     Snapshot.take(snapshot_path, {key: stateful})
 

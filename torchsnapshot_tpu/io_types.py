@@ -92,6 +92,17 @@ class ReadReq:
 class WriteIO:
     path: str
     buf: Any  # bytes | memoryview
+    # Durable writes are fdatasync'd, with every directory up the chain
+    # fsync'd too.  Set for the COMMIT-point write (.snapshot_metadata)
+    # only; bulk data defaults to page-cache mode, so by default the
+    # guarantee is "a crash never leaves a HALF-written metadata file" —
+    # NOT "a committed local-fs snapshot survives any crash" (data files
+    # behind the marker may still be in page cache; a crash window of
+    # seconds remains).  For full local-fs crash durability set
+    # TORCHSNAPSHOT_TPU_FS_SYNC_DATA=1, which fdatasyncs every data
+    # write (costs write throughput).  Object stores (the production
+    # target) are durable-on-success by nature and ignore all of this.
+    durable: bool = False
 
 
 @dataclass

@@ -24,6 +24,7 @@ _ALLOW_PICKLE_OBJECTS = "ALLOW_PICKLE_OBJECTS"
 _STAGING_THREADS = "STAGING_THREADS"
 _ENABLE_NATIVE_EXT = "ENABLE_NATIVE_EXT"
 _FS_VERIFY_WRITES = "FS_VERIFY_WRITES"
+_FS_SYNC_DATA = "FS_SYNC_DATA"
 _DISABLE_EAGER_HOST_STAGING = "DISABLE_EAGER_HOST_STAGING"
 _PALLAS_ATTENTION = "PALLAS_ATTENTION"
 _REPLICATION_VERIFY = "REPLICATION_VERIFY"
@@ -52,6 +53,9 @@ _DEFAULTS = {
     # Verify every fs write by re-reading and crc32c-comparing (native
     # backend only; catches torn/corrupted local writes at save time).
     _FS_VERIFY_WRITES: 0,
+    # fdatasync every fs DATA write (not just the metadata commit
+    # point): full local-fs crash durability at a write-throughput cost.
+    _FS_SYNC_DATA: 0,
     # async_take unblocks after one batched device→pinned_host transfer
     # instead of after full staging (see host_offload.eager_offload_write_reqs).
     _DISABLE_EAGER_HOST_STAGING: 0,
@@ -130,6 +134,10 @@ def is_native_ext_enabled() -> bool:
 
 def is_fs_verify_writes() -> bool:
     return bool(_get_int(_FS_VERIFY_WRITES))
+
+
+def is_fs_sync_data() -> bool:
+    return bool(_get_int(_FS_SYNC_DATA))
 
 
 def is_eager_host_staging_disabled() -> bool:
@@ -217,6 +225,10 @@ def override_enable_native_ext(value: bool):
 
 def override_fs_verify_writes(value: bool):
     return _override(_FS_VERIFY_WRITES, int(value))
+
+
+def override_fs_sync_data(value: bool):
+    return _override(_FS_SYNC_DATA, int(value))
 
 
 def override_disable_eager_host_staging(value: bool):

@@ -36,6 +36,7 @@ from .flatten import flatten, inflate
 from .io_types import Future, ReadReq, WriteIO, WriteReq
 from .manifest import (
     MANIFEST_VERSION,
+    ChunkedArrayEntry,
     Entry,
     Manifest,
     PrimitiveEntry,
@@ -243,10 +244,13 @@ class Snapshot:
             # (reference snapshot.py:202-209)
             coordinator.barrier()
             if coordinator.rank == 0:
+                # durable: the commit point must survive a host crash —
+                # a synced metadata file is the definition of "committed"
                 storage.sync_write(
                     WriteIO(
                         path=SNAPSHOT_METADATA_FNAME,
                         buf=metadata.to_yaml().encode(),
+                        durable=True,
                     )
                 )
             coordinator.barrier()
@@ -419,6 +423,11 @@ class Snapshot:
         write_reqs: List[WriteReq] = []
         repl_reqs: Dict[str, List[WriteReq]] = {}
         repl_items: List[Tuple[str, int]] = []
+        # chunk-granular items for replicated CHUNKED entries: a multi-GB
+        # replicated host array is split across writer ranks per chunk
+        # instead of riding one rank (reference partitioner.py:40-47)
+        repl_chunk_reqs: Dict[str, WriteReq] = {}
+        chunk_parent: Dict[str, str] = {}
         local_bytes = 0
         verified_repl = _verify_replicated_paths(
             flattened, replicated_globs, coordinator, verify_mode
@@ -438,14 +447,24 @@ class Snapshot:
             entries[lpath] = entry
             cost = sum(r.buffer_stager.get_staging_cost_bytes() for r in reqs)
             if repl and not isinstance(entry, ShardedArrayEntry):
-                repl_reqs[lpath] = reqs
-                repl_items.append((lpath, cost))
+                if isinstance(entry, ChunkedArrayEntry) and len(reqs) > 1:
+                    for ci, r in enumerate(reqs):
+                        k = f"{lpath}\x00{ci}"  # \x00 can't occur in paths
+                        repl_chunk_reqs[k] = r
+                        chunk_parent[k] = lpath
+                        repl_items.append(
+                            (k, r.buffer_stager.get_staging_cost_bytes())
+                        )
+                else:
+                    repl_reqs[lpath] = reqs
+                    repl_items.append((lpath, cost))
             else:
                 write_reqs.extend(reqs)
                 local_bytes += cost
 
         # balance replicated host-state writes across ranks
         # (reference partition_write_reqs, partitioner.py:216-310)
+        split_repl_paths: set = set()
         if repl_items:
             preloads = (
                 coordinator.all_gather_object(local_bytes)
@@ -462,10 +481,32 @@ class Snapshot:
                     # manifest must carry exactly the written copy
                     # (consolidation dedups replicated entries to one rank).
                     del entries[lpath]
+            writes_chunk_of: Dict[str, bool] = {}
+            for k, req in repl_chunk_reqs.items():
+                lp = chunk_parent[k]
+                mine = assignment[k] == rank
+                writes_chunk_of[lp] = writes_chunk_of.get(lp, False) or mine
+                if mine:
+                    write_reqs.append(req)
+            for lp, any_mine in writes_chunk_of.items():
+                if any_mine:
+                    # every chunk-writing rank carries an IDENTICAL copy
+                    # of the whole entry (chunk locations are rank-
+                    # independent under replicated/); restore dedups
+                    split_repl_paths.add(lp)
+                else:
+                    del entries[lp]
 
         # coalesce small writes into slabs (reference batcher.py:204-355)
         if not knobs.is_batching_disabled():
+            # shield split replicated entries: slab-packing a chunk would
+            # re-point it to a rank-LOCAL location, silently diverging the
+            # per-rank copies of the shared entry
+            shielded = {
+                lp: entries.pop(lp) for lp in split_repl_paths if lp in entries
+            }
             entries, write_reqs = batch_write_requests(entries, write_reqs, rank)
+            entries.update(shielded)
 
         # gather per-rank manifests; every rank can build the global view
         # deterministically (reference _gather_manifest, snapshot.py:948-961)
@@ -766,6 +807,7 @@ class PendingSnapshot:
                             WriteIO(
                                 path=SNAPSHOT_METADATA_FNAME,
                                 buf=self._metadata.to_yaml().encode(),
+                                durable=True,
                             )
                         )
                         depart = "ok"

@@ -26,6 +26,29 @@ from .. import knobs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir_chain(leaf_dir: str, stop_below: str) -> None:
+    """fsync ``leaf_dir`` and every ancestor down to (and including) the
+    parent of ``stop_below``: POSIX durability of a NEW file requires
+    syncing each newly-created directory's dirent in ITS parent, and the
+    snapshot root itself is usually freshly created by take()."""
+    leaf_dir = os.path.abspath(leaf_dir)
+    stop = os.path.dirname(os.path.abspath(stop_below))
+    cur = leaf_dir
+    while True:
+        _fsync_dir(cur)
+        if cur == stop or os.path.dirname(cur) == cur:
+            break
+        cur = os.path.dirname(cur)
+
+
 class FSStoragePlugin(StoragePlugin):
     def __init__(self, root: str) -> None:
         self.root = root
@@ -58,7 +81,24 @@ class FSStoragePlugin(StoragePlugin):
         self._ensure_dir(full)
         if self._lib is not None:
             await asyncio.get_running_loop().run_in_executor(
-                self._executor, self._native_write, full, write_io.buf
+                self._executor,
+                self._native_write,
+                full,
+                write_io.buf,
+                write_io.durable,
+            )
+            return
+        if write_io.durable or knobs.is_fs_sync_data():
+            # aiofiles can't fsync; a synced write is one synchronous
+            # write+fdatasync in a thread.  Only the commit-point write
+            # syncs the directory chain (data files' dirents become
+            # durable with the metadata's chain sync that follows them).
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                self._durable_fallback_write,
+                full,
+                write_io.buf,
+                write_io.durable,
             )
             return
         import aiofiles
@@ -66,14 +106,30 @@ class FSStoragePlugin(StoragePlugin):
         async with aiofiles.open(full, "wb") as f:
             await f.write(write_io.buf)
 
-    def _native_write(self, full: str, buf) -> None:
+    def _durable_fallback_write(self, full: str, buf, chain: bool = True) -> None:
+        with open(full, "wb") as f:
+            f.write(buf)
+            f.flush()
+            os.fdatasync(f.fileno())
+        if chain:
+            _fsync_dir_chain(os.path.dirname(full), self.root)
+
+    def _native_write(self, full: str, buf, durable: bool = False) -> None:
         from .._csrc import _buffer_address
 
+        sync_file = durable or knobs.is_fs_sync_data()
         view = memoryview(buf).cast("B")
         addr = _buffer_address(view) if view.nbytes else None
-        rc = self._lib.tsnp_write_file(full.encode(), addr, view.nbytes, 0)
+        rc = self._lib.tsnp_write_file(
+            full.encode(), addr, view.nbytes, 1 if sync_file else 0
+        )
         if rc != 0:
             raise OSError(-rc, os.strerror(-rc), full)
+        if durable:
+            # fdatasync covers the file CONTENT; the file's existence
+            # needs every (possibly just-created) directory up the chain
+            # synced too
+            _fsync_dir_chain(os.path.dirname(full), self.root)
         if knobs.is_fs_verify_writes() and view.nbytes:
             # re-read + crc32c compare: catches torn/corrupted local writes
             # at save time (GCS gets this from server-side crc32c;

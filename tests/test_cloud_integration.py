@@ -50,7 +50,7 @@ def _health_check(plugin, token: str) -> None:
 
 def _plugin_contract(plugin, loop) -> None:
     payload = bytes(range(256)) * 8
-    loop.run_until_complete(WriteIO and plugin.write(WriteIO(path="obj", buf=payload)))
+    loop.run_until_complete(plugin.write(WriteIO(path="obj", buf=payload)))
     whole = ReadIO(path="obj")
     loop.run_until_complete(plugin.read(whole))
     assert bytes(whole.buf) == payload

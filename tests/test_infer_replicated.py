@@ -1,0 +1,165 @@
+"""Replication auto-inference (reference snapshot.py:896-918).
+
+jax.Arrays carry replication in their sharding; HOST state doesn't.  Two
+inference channels cover it: the ``Replicated`` marker wrapper (TPU-native,
+type-level) and torch DDP detection (parity with the reference's only
+inference rule), both expanding to ``key/**`` globs before the cross-rank
+glob intersection.
+"""
+
+import numpy as np
+import pytest
+
+from test_distributed import run_workers
+from torchsnapshot_tpu import Replicated, Snapshot, StateDict
+from torchsnapshot_tpu.snapshot import _infer_replicated
+
+
+def test_replicated_marker_infers_glob():
+    app = {"app": Replicated(StateDict(w=np.zeros(4)))}
+    assert _infer_replicated([], app) == ["app/**"]
+    # explicit globs are kept, "**" short-circuits
+    assert _infer_replicated(["other/*"], app) == ["other/*", "app/**"]
+    assert _infer_replicated(["**"], app) == ["**"]
+
+
+def test_replicated_wraps_plain_dict():
+    r = Replicated({"w": np.arange(3)})
+    assert r.state_dict()["w"].shape == (3,)
+    r.load_state_dict({"w": np.zeros(3)})
+    assert np.array_equal(r.state_dict()["w"], np.zeros(3))
+
+
+def test_plain_stateful_not_inferred():
+    assert _infer_replicated([], {"app": StateDict(w=np.zeros(4))}) == []
+
+
+def test_replicated_shares_callers_mapping():
+    """Restoring through Replicated(plain_dict) must be visible in the
+    caller's dict, not a hidden internal copy."""
+    d = {"w": np.zeros(3)}
+    r = Replicated(d)
+    r.load_state_dict({"w": np.ones(3)})
+    assert np.array_equal(d["w"], np.ones(3))
+
+
+def test_replicated_rejects_non_mapping():
+    with pytest.raises(TypeError, match="mutable mapping"):
+        Replicated(np.arange(4))
+
+
+def test_unwrap_sees_through_wrapper():
+    from torchsnapshot_tpu.stateful import PyTreeState, unwrap
+
+    inner = PyTreeState({"w": np.zeros(2)})
+    assert unwrap(Replicated(inner)) is inner
+    assert unwrap(inner) is inner
+
+
+def test_instance_attr_named_replicated_is_ignored():
+    """Only the class-level marker counts: an instance attribute named
+    'replicated' (e.g. an nn.Module buffer via __getattr__) must neither
+    crash truthiness nor claim the state replicated."""
+    torch = pytest.importorskip("torch")
+
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("replicated", torch.zeros(4))
+
+    assert _infer_replicated([], {"m": M()}) == []
+
+
+def test_replicated_rejects_rng_state():
+    from torchsnapshot_tpu import RNGState
+
+    with pytest.raises(ValueError, match="RNGState"):
+        Replicated(RNGState())
+
+
+def test_replicated_forwards_strict():
+    """restore's signature probe must see ``strict`` on the wrapper, and
+    the wrapper must forward it only to inner statefuls that accept it."""
+    import inspect
+
+    calls = {}
+
+    class WithStrict:
+        def state_dict(self):
+            return {}
+
+        def load_state_dict(self, sd, strict=True):
+            calls["strict"] = strict
+
+    r = Replicated(WithStrict())
+    assert "strict" in inspect.signature(r.load_state_dict).parameters
+    r.load_state_dict({}, strict=False)
+    assert calls["strict"] is False
+
+    # inner without strict: forwarded call must not explode
+    r2 = Replicated(StateDict(a=1))
+    r2.load_state_dict({"a": 2}, strict=False)
+    assert r2.state_dict()["a"] == 2
+
+
+def test_ddp_module_infers_glob(tmp_path):
+    torch = pytest.importorskip("torch")
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel as DDP
+
+    from torchsnapshot_tpu.tricks.torch_module import TorchModuleAdapter
+
+    dist.init_process_group(
+        "gloo",
+        init_method=f"file://{tmp_path}/pg",
+        rank=0,
+        world_size=1,
+    )
+    try:
+        ddp = DDP(torch.nn.Linear(2, 2))
+        # raw DDP stateful and adapter-wrapped both infer key/**
+        assert _infer_replicated([], {"m": ddp}) == ["m/**"]
+        assert _infer_replicated([], {"m": TorchModuleAdapter(ddp)}) == [
+            "m/**"
+        ]
+
+        # parameters_to_ignore -> per-name globs, ignored names excluded
+        lin = torch.nn.Linear(2, 2)
+        DDP._set_params_and_buffers_to_ignore_for_model(lin, ["bias"])
+        ddp_ign = DDP(lin)
+        globs = _infer_replicated([], {"m": TorchModuleAdapter(ddp_ign)})
+        assert globs == ["m/weight"]
+
+        # raw DDP stateful: state-dict names keep the "module." prefix
+        # while parameters_to_ignore holds unprefixed names — the ignored
+        # param must STILL be excluded (divergent per-rank state saved
+        # replicated would drop every other rank's copy)
+        globs_raw = _infer_replicated([], {"m": ddp_ign})
+        assert globs_raw == ["m/module.weight"], globs_raw
+    finally:
+        dist.destroy_process_group()
+
+
+def test_replicated_marker_end_to_end(tmp_path):
+    """Two ranks save a Replicated host dict with NO explicit globs; the
+    manifest must carry exactly one logical copy."""
+    run_workers(
+        tmp_path,
+        2,
+        """
+        from torchsnapshot_tpu import Replicated
+        state = Replicated(StateDict(shared=np.arange(64, dtype=np.float64)))
+        Snapshot.take(snap_dir, {"app": state}, coordinator=coord)
+        """,
+    )
+    manifest = Snapshot(str(tmp_path / "snap")).get_manifest()
+    shared = [k for k in manifest if k.endswith("app/shared")]
+    assert len(shared) == 1, shared
+    assert getattr(manifest[shared[0]], "replicated", False), shared
+
+    # restore round-trips through the marker wrapper
+    dest = Replicated(StateDict(shared=np.zeros(64)))
+    Snapshot(str(tmp_path / "snap")).restore({"app": dest})
+    assert np.array_equal(
+        dest.state_dict()["shared"], np.arange(64, dtype=np.float64)
+    )

@@ -26,7 +26,13 @@ from .coordination import (  # noqa: F401
 from .event import Event  # noqa: F401
 from .event_handlers import register_event_handler, unregister_event_handler  # noqa: F401
 from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
-from .stateful import PyTreeState, RNGState, StateDict, Stateful  # noqa: F401
+from .stateful import (  # noqa: F401
+    PyTreeState,
+    Replicated,
+    RNGState,
+    StateDict,
+    Stateful,
+)
 
 __version__ = "0.1.0"
 
@@ -36,6 +42,7 @@ __all__ = [
     "Stateful",
     "StateDict",
     "PyTreeState",
+    "Replicated",
     "RNGState",
     "Coordinator",
     "LocalCoordinator",

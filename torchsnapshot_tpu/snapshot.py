@@ -55,7 +55,13 @@ from .scheduler import (
     sync_execute_read_reqs,
     sync_execute_write_reqs,
 )
-from .stateful import RNGState, Stateful
+from .stateful import (
+    Replicated,
+    RNGState,
+    Stateful,
+    load_with_strict,
+    unwrap,
+)
 from .storage import url_to_storage_plugin
 
 logger = logging.getLogger(__name__)
@@ -199,6 +205,71 @@ def _verify_replicated_paths(
             sorted(demoted)[:10],
         )
     return verified
+
+
+def _ddp_module(stateful: Any) -> Optional[Any]:
+    """The torch DDP instance behind ``stateful``, if there is one
+    (directly, or wrapped in a ``TorchModuleAdapter``-style adapter
+    exposing ``.module``)."""
+    try:
+        from torch.nn.parallel import DistributedDataParallel as DDP
+    except Exception:  # torch absent/broken: nothing to infer
+        return None
+    for cand in (stateful, getattr(stateful, "module", None)):
+        if isinstance(cand, DDP):
+            return cand
+    return None
+
+
+def _infer_replicated(
+    replicated: Sequence[str], app_state: Dict[str, Any]
+) -> List[str]:
+    """Auto-infer replication globs from the app state (reference
+    _infer_replicated, snapshot.py:896-918).
+
+    jax.Arrays need no help — replication is explicit in their sharding
+    and handled by the sharded preparer.  This covers HOST state:
+
+    - statefuls marked ``Replicated(...)`` (or any object with a truthy
+      ``replicated`` attribute) contribute ``key/**``;
+    - torch DDP-wrapped modules (directly or behind an adapter with a
+      ``.module``) contribute ``key/**``, honoring
+      ``parameters_to_ignore`` by enumerating per-name globs instead
+      when any parameter is excluded from replication.
+
+    Inference runs per-rank BEFORE the glob intersection gather, so a
+    rank that didn't wrap its module gets the glob dropped by the
+    intersection; content verification then guards the rest.
+    """
+    globs = list(replicated)
+    if "**" in globs:
+        return globs
+    for key, val in app_state.items():
+        # class-level marker only: an INSTANCE attribute named
+        # "replicated" (e.g. an nn.Module buffer surfaced via
+        # __getattr__) must neither crash the truthiness test nor
+        # silently claim the state replicated
+        if isinstance(val, Replicated) or (
+            getattr(type(val), "replicated", None) is True
+        ):
+            globs.append(f"{key}/**")
+            continue
+        ddp = _ddp_module(val)
+        if ddp is None:
+            continue
+        ignored = set(getattr(ddp, "parameters_to_ignore", ()) or ())
+        if not ignored:
+            globs.append(f"{key}/**")
+            continue
+        # adapters strip DDP's "module." prefix from state-dict keys while
+        # ``parameters_to_ignore`` holds UNPREFIXED names; the stateful's
+        # own state_dict is authoritative for the names that will appear
+        # as logical paths, so strip the prefix before the membership test
+        for name in val.state_dict().keys():
+            bare = name[7:] if name.startswith("module.") else name
+            if bare not in ignored and name not in ignored:
+                globs.append(f"{key}/{name}")
+    return globs
 
 
 def _validate_app_state(app_state: Dict[str, Any]) -> None:
@@ -345,6 +416,7 @@ class Snapshot:
 
         # path + replicated coalescing across ranks
         # (reference _coalesce_path_and_replicated, snapshot.py:858-894)
+        replicated = _infer_replicated(replicated, app_state)
         path0 = coordinator.broadcast_object(path, src=0)
         if path0 != path:
             logger.warning(
@@ -669,18 +741,7 @@ class Snapshot:
         )
         # propagate strict to load_state_dict when the stateful accepts it
         # (reference snapshot.py:775-778 for nn.Module)
-        import inspect
-
-        try:
-            accepts_strict = "strict" in inspect.signature(
-                stateful.load_state_dict
-            ).parameters
-        except (TypeError, ValueError):
-            accepts_strict = False
-        if accepts_strict:
-            stateful.load_state_dict(state_dict, strict=strict)
-        else:
-            stateful.load_state_dict(state_dict)
+        load_with_strict(stateful, state_dict, strict)
 
     @staticmethod
     def _map_legacy_leaf_targets(
@@ -696,6 +757,7 @@ class Snapshot:
 
         from .stateful import PyTreeState, _tree_path_keys
 
+        stateful = unwrap(stateful)
         if not isinstance(stateful, PyTreeState):
             return
         pat = re.compile(re.escape(key) + r"/leaves/(\d+)$")

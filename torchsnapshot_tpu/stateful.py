@@ -189,6 +189,87 @@ class PyTreeState:
         return not all(keys[0] == "leaves" for keys, _ in pairs)
 
 
+class Replicated:
+    """Marker wrapper declaring a stateful's entire state replicated
+    across ranks.
+
+    The reference auto-infers replication only for DDP-wrapped torch
+    modules (snapshot.py:896-918); everything else needs explicit globs.
+    On TPU, jax.Array replication is implicit in the sharding, but host
+    state (numpy arrays, torch CPU tensors, plain objects) carries no
+    sharding metadata — this wrapper is the explicit, type-level way to
+    say "every rank holds the same copy; balance the write across ranks
+    and persist it once".  ``Snapshot.take`` expands it to a ``key/**``
+    replication glob automatically; content verification still applies,
+    so a wrong claim demotes to per-rank instead of corrupting the save.
+    """
+
+    replicated = True
+
+    def __init__(self, stateful: Any) -> None:
+        if isinstance(stateful, RNGState):
+            # RNGState gets entry-capture/restore special-casing in
+            # Snapshot.take keyed on isinstance; hiding it behind a
+            # wrapper would silently break the "take never perturbs RNG"
+            # invariant — and replicating RNG streams across ranks is
+            # almost never what dp training wants anyway.
+            raise ValueError(
+                "Replicated(RNGState()) is not supported: pass the "
+                "RNGState directly (RNG streams are per-rank state)"
+            )
+        if not isinstance(stateful, Stateful):
+            import collections.abc
+
+            if not isinstance(stateful, collections.abc.MutableMapping):
+                raise TypeError(
+                    "Replicated(...) takes a Stateful or a mutable mapping; "
+                    f"got {type(stateful).__name__}. Wrap leaves in a dict: "
+                    "Replicated({'emb': arr})"
+                )
+            # share the caller's mapping instead of copying it, so a
+            # restore through the wrapper is visible in the original dict
+            wrapped = StateDict()
+            wrapped.data = stateful
+            stateful = wrapped
+        self.stateful = stateful
+
+    def state_dict(self) -> Dict[str, Any]:
+        return self.stateful.state_dict()
+
+    def load_state_dict(
+        self, state_dict: Dict[str, Any], strict: bool = True
+    ) -> None:
+        # ``strict`` declared by name so restore's signature probe sees it
+        load_with_strict(self.stateful, state_dict, strict)
+
+
+def unwrap(stateful: Any) -> Any:
+    """The innermost stateful behind any chain of marker wrappers —
+    isinstance-keyed special cases (e.g. PyTreeState restore templates)
+    must see through ``Replicated``."""
+    while isinstance(stateful, Replicated):
+        stateful = stateful.stateful
+    return stateful
+
+
+def load_with_strict(stateful: Any, state_dict: Dict[str, Any], strict: bool) -> None:
+    """Call ``load_state_dict``, forwarding ``strict`` only when the
+    stateful's signature accepts it (reference snapshot.py:775-778 probes
+    nn.Module the same way)."""
+    import inspect
+
+    try:
+        accepts = "strict" in inspect.signature(
+            stateful.load_state_dict
+        ).parameters
+    except (TypeError, ValueError):
+        accepts = False
+    if accepts:
+        stateful.load_state_dict(state_dict, strict=strict)
+    else:
+        stateful.load_state_dict(state_dict)
+
+
 class RNGState:
     """Captures/restores host RNG state (python ``random`` + global numpy).
 

@@ -190,6 +190,42 @@ def test_pallas_auto_is_off_on_cpu():
         assert knobs.use_pallas_attention() is True
 
 
+def test_serialize_transfers_knob():
+    """auto = off on CPU, on for accelerators; 1/0 force.  The gate must
+    be a real lock only when the knob resolves on (restore consumers run
+    on an executor — see preparers/array.py:materialize_into_template)."""
+    import jax
+
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.preparers import array as array_prep
+    from torchsnapshot_tpu.preparers.array import transfer_gate
+
+    assert jax.default_backend() == "cpu"
+    with knobs.override_serialize_transfers("auto"):
+        assert knobs.serialize_transfers() is False
+    with knobs.override_serialize_transfers("1"):
+        assert knobs.serialize_transfers() is True
+        # gate holds the lock while the caller's transfers are pending
+        with transfer_gate() as pending:
+            assert array_prep._TRANSFER_LOCK.locked()
+            pending.append(jax.numpy.ones(4))
+        assert not array_prep._TRANSFER_LOCK.locked()
+        # restore still correct with the gate forced on
+        import numpy as np
+
+        from torchsnapshot_tpu.preparers.array import (
+            materialize_into_template,
+        )
+
+        tmpl = jax.numpy.zeros((8,), jax.numpy.float32)
+        out = materialize_into_template(
+            np.arange(8, dtype=np.float32), tmpl
+        )
+        assert np.array_equal(np.asarray(out), np.arange(8))
+    with knobs.override_serialize_transfers("0"):
+        assert knobs.serialize_transfers() is False
+
+
 def test_pallas_probe_caches_verdict(monkeypatch):
     from torchsnapshot_tpu.ops import flash_attention as fa
 

@@ -28,6 +28,7 @@ _FS_SYNC_DATA = "FS_SYNC_DATA"
 _DISABLE_EAGER_HOST_STAGING = "DISABLE_EAGER_HOST_STAGING"
 _PALLAS_ATTENTION = "PALLAS_ATTENTION"
 _REPLICATION_VERIFY = "REPLICATION_VERIFY"
+_SERIALIZE_TRANSFERS = "SERIALIZE_TRANSFERS"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -76,6 +77,14 @@ _DEFAULTS = {
     #             intersected across ranks (the partitioner requires an
     #             identical replicated item list on every rank).
     _REPLICATION_VERIFY: "full",
+    # Serialize host↔device transfers through one in-process lock on the
+    # restore path.  "auto" = on for accelerator backends, off on CPU:
+    # a chip has one DMA engine per direction, so concurrent device_put
+    # calls from consumer threads can't add bandwidth — and transport
+    # layers that multiplex one link (tunneled/virtualized PJRT
+    # attachments) can interleave concurrent transfers pathologically.
+    # "1"/"0" force on/off.
+    _SERIALIZE_TRANSFERS: "auto",
 }
 
 _OVERRIDES: dict = {}
@@ -154,6 +163,17 @@ def get_replication_verify() -> str:
     return v
 
 
+def serialize_transfers() -> bool:
+    v = str(_get_raw(_SERIALIZE_TRANSFERS)).lower()
+    if v in ("1", "true", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 def use_pallas_attention() -> bool:
     v = str(_get_raw(_PALLAS_ATTENTION)).lower()
     if v in ("1", "true", "on"):
@@ -213,6 +233,10 @@ def override_per_rank_memory_budget_bytes(value: int):
 
 def override_allow_pickle_objects(value: bool):
     return _override(_ALLOW_PICKLE_OBJECTS, int(value))
+
+
+def override_serialize_transfers(value):
+    return _override(_SERIALIZE_TRANSFERS, value)
 
 
 def override_staging_threads(value: int):

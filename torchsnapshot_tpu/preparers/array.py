@@ -20,6 +20,8 @@ io_preparers/chunked_tensor.py:36-128.  TPU-native differences:
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import threading
 from concurrent.futures import Executor
 from typing import Any, List, Optional, Tuple
 
@@ -42,6 +44,30 @@ from ..serialization import (
 )
 
 logger = logging.getLogger(__name__)
+
+# gates restore-path H2D transfers when knobs.serialize_transfers() is on
+_TRANSFER_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def transfer_gate():
+    """Serialize H2D transfers across consumer threads when
+    ``knobs.serialize_transfers()`` resolves on (see knobs.py).
+
+    Yields a list the caller appends in-flight arrays to; when gating is
+    active the gate blocks on them BEFORE releasing the lock —
+    ``device_put`` returns before the DMA completes, so releasing at
+    dispatch would let other threads' transfers overlap anyway."""
+    pending: List[Any] = []
+    if not knobs.serialize_transfers():
+        yield pending
+        return
+    import jax
+
+    with _TRANSFER_LOCK:
+        yield pending
+        if pending:
+            jax.block_until_ready(pending)
 
 
 def _is_torch_tensor(obj: Any) -> bool:
@@ -225,9 +251,20 @@ def materialize_into_template(np_arr: np.ndarray, obj_out: Any) -> Any:
     if _is_jax_array(obj_out):
         import jax
 
+        from .. import knobs
+
         if np.dtype(np_arr.dtype) != np.dtype(obj_out.dtype):
             np_arr = np_arr.astype(obj_out.dtype)
-        return jax.device_put(np_arr.reshape(obj_out.shape), obj_out.sharding)
+        shaped = np_arr.reshape(obj_out.shape)
+        # consumers run on an executor: gate concurrent H2D puts behind
+        # one lock — a chip has one DMA engine per direction, and
+        # multiplexed transports can interleave concurrent transfers
+        # pathologically (observed as a multi-minute wedge on a tunneled
+        # PJRT attachment)
+        with transfer_gate() as pending:
+            out = jax.device_put(shaped, obj_out.sharding)
+            pending.append(out)
+        return out
     # Template is some other leaf (e.g. a Python scalar where the saved
     # state had a traced jax scalar, like TrainState.step before/after the
     # first jitted step). Behave like "no template": return fresh host data.

@@ -253,6 +253,8 @@ class ShardedArrayIOPreparer:
             if obj_out is not None and is_multi_device_jax_array(obj_out):
                 import jax
 
+                from .array import transfer_gate
+
                 if target_dtype != dtype:
                     for box in list(buffers):
                         buffers[box] = buffers[box].astype(target_dtype)
@@ -261,12 +263,17 @@ class ShardedArrayIOPreparer:
                 )
                 if set(local_boxes) == {full_box}:
                     # fully-replicated template: one broadcasting device_put
-                    fut.set(jax.device_put(buffers[full_box], sharding))
+                    with transfer_gate() as pending:
+                        out = jax.device_put(buffers[full_box], sharding)
+                        pending.append(out)
+                    fut.set(out)
                     return
                 arrays = []
-                for box, devs in local_boxes.items():
-                    for dev in devs:
-                        arrays.append(jax.device_put(buffers[box], dev))
+                with transfer_gate() as pending:
+                    for box, devs in local_boxes.items():
+                        for dev in devs:
+                            arrays.append(jax.device_put(buffers[box], dev))
+                    pending.extend(arrays)
                 fut.set(
                     jax.make_array_from_single_device_arrays(
                         tuple(obj_out.shape), sharding, arrays

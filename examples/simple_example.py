@@ -22,7 +22,12 @@ import optax
 from flax import linen as nn
 from flax.training import train_state
 
-from torchsnapshot_tpu import PyTreeState, RNGState, Snapshot, StateDict
+from torchsnapshot_tpu import (
+    PyTreeState,
+    RNGState,
+    SnapshotManager,
+    StateDict,
+)
 
 NUM_EPOCHS = 4
 STEPS_PER_EPOCH = 8
@@ -58,9 +63,10 @@ def main(ckpt_path: str) -> None:
         "rng": RNGState(),
     }
 
-    # resume if a committed snapshot exists
-    if os.path.exists(os.path.join(ckpt_path, ".snapshot_metadata")):
-        Snapshot(ckpt_path).restore(app_state)
+    # one committed snapshot per epoch, newest two retained; cold start
+    # returns None and training begins at epoch 0
+    mgr = SnapshotManager(ckpt_path, keep_last_n=2)
+    if mgr.restore_latest(app_state) is not None:
         print(f"resumed at epoch {app_state['progress']['epochs']}")
 
     while app_state["progress"]["epochs"] < NUM_EPOCHS:
@@ -72,9 +78,13 @@ def main(ckpt_path: str) -> None:
         app_state["model"].tree = ts
         app_state["progress"]["epochs"] += 1
         # async: training resumes as soon as staging completes
-        pending = Snapshot.async_take(ckpt_path, app_state)
+        pending = mgr.save(
+            app_state, step=app_state["progress"]["epochs"], async_=True
+        )
         print(f"epoch {app_state['progress']['epochs']}: loss={float(loss):.5f}")
         pending.wait()
+    mgr.gc()  # retention for the async saves
+    print(f"committed steps: {mgr.steps()}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,182 @@
+"""SnapshotManager: step-indexed saves, discovery, retention, GC.
+
+Beyond-parity subsystem (manager.py); the GC ordering contract under
+test — metadata deleted FIRST — extends the commit protocol's
+"no metadata == aborted" invariant (snapshot.py:645) to deletion.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import (
+    Snapshot,
+    SnapshotManager,
+    StateDict,
+    delete_snapshot,
+)
+from torchsnapshot_tpu.manager import INDEX_FNAME, entry_locations
+
+
+def _state(v: float) -> StateDict:
+    return StateDict(w=np.full(64, v), step=int(v))
+
+
+def test_cold_start_returns_none(tmp_path):
+    mgr = SnapshotManager(str(tmp_path / "run"))
+    assert mgr.latest_step() is None
+    assert mgr.restore_latest({"app": _state(0)}) is None
+
+
+def test_save_restore_latest_roundtrip(tmp_path):
+    mgr = SnapshotManager(str(tmp_path))
+    for step in (3, 7, 11):
+        mgr.save({"app": _state(step)}, step=step)
+    assert mgr.steps() == [3, 7, 11]
+
+    dest = {"app": _state(0)}
+    assert mgr.restore_latest(dest) == 11
+    assert np.array_equal(dest["app"]["w"], np.full(64, 11.0))
+
+    # a second manager instance discovers the same steps (index + scan)
+    mgr2 = SnapshotManager(str(tmp_path))
+    assert mgr2.latest_step() == 11
+
+
+def test_retention_evicts_oldest(tmp_path):
+    mgr = SnapshotManager(str(tmp_path), keep_last_n=2)
+    for step in range(4):
+        mgr.save({"app": _state(step)}, step=step)
+    assert mgr.steps() == [2, 3]
+    # evicted snapshots are fully gone (metadata AND data)
+    for step in (0, 1):
+        p = mgr.path_for_step(step)
+        assert not os.path.exists(p), os.listdir(p)
+    # survivors restore fine
+    dest = {"app": _state(0)}
+    assert mgr.restore_latest(dest) == 3
+
+
+def test_aborted_snapshot_is_invisible(tmp_path):
+    mgr = SnapshotManager(str(tmp_path))
+    mgr.save({"app": _state(1)}, step=1)
+    # simulate an aborted take: directory without .snapshot_metadata
+    aborted = mgr.path_for_step(2)
+    os.makedirs(aborted)
+    with open(os.path.join(aborted, "0_app_w"), "wb") as f:
+        f.write(b"junk")
+    # and a committed-then-uncommitted one via the index
+    idx = json.loads((tmp_path / INDEX_FNAME).read_text())
+    idx["steps"].append(5)
+    (tmp_path / INDEX_FNAME).write_text(json.dumps(idx))
+    assert mgr.steps() == [1]
+    assert mgr.latest_step() == 1
+
+
+def test_scan_finds_unmanaged_snapshots(tmp_path):
+    # snapshot taken directly (no manager, no index)
+    Snapshot.take(str(tmp_path / "step_0000000042"), {"app": _state(42)})
+    mgr = SnapshotManager(str(tmp_path))
+    assert mgr.steps() == [42]
+    dest = {"app": _state(0)}
+    assert mgr.restore_latest(dest) == 42
+
+
+def test_delete_snapshot_metadata_first(tmp_path):
+    snap = Snapshot.take(str(tmp_path / "s"), {"app": _state(9)})
+    manifest = snap.get_manifest()
+    locs = entry_locations(manifest)
+    assert locs, "expected physical locations in the manifest"
+    for loc in locs:
+        assert os.path.exists(tmp_path / "s" / loc), loc
+    delete_snapshot(str(tmp_path / "s"))
+    assert not os.path.exists(tmp_path / "s")
+    # idempotent on a second call / aborted leftovers
+    delete_snapshot(str(tmp_path / "s"))
+
+
+def test_entry_locations_cover_sharded_and_chunked(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu import PyTreeState, knobs
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    x = jax.device_put(
+        jnp.arange(1024, dtype=jnp.float32), NamedSharding(mesh, P("dp"))
+    )
+    big = np.arange(4096, dtype=np.float64)
+    # batching off so each shard/chunk lands at its own location (the
+    # batched case — one slab, entries sharing it via byte ranges — is
+    # covered by test_delete_snapshot_metadata_first)
+    with knobs.override_max_chunk_size_bytes(8192), \
+            knobs.override_disable_batching(True):
+        snap = Snapshot.take(
+            str(tmp_path / "s"),
+            {"m": PyTreeState({"x": x}), "h": StateDict(big=big)},
+        )
+    locs = entry_locations(snap.get_manifest())
+    for loc in locs:
+        assert os.path.exists(tmp_path / "s" / loc), loc
+    # chunked entry contributes multiple locations
+    assert len(locs) >= 4, locs
+    delete_snapshot(str(tmp_path / "s"))
+    assert not os.path.exists(tmp_path / "s")
+
+
+def test_async_save_with_manager(tmp_path):
+    mgr = SnapshotManager(str(tmp_path), keep_last_n=1)
+    pending = mgr.save({"app": _state(1)}, step=1, async_=True)
+    pending.wait()
+    # async path defers index/GC to the next sync point
+    mgr.save({"app": _state(2)}, step=2)
+    assert mgr.steps() == [2]
+    assert not os.path.exists(mgr.path_for_step(1))
+
+
+def test_async_wait_updates_index_without_scan(tmp_path, monkeypatch):
+    """On stores with no directory listing (cloud), the index is the only
+    discovery channel: wait() on an async save must record the step."""
+    mgr = SnapshotManager(str(tmp_path))
+    monkeypatch.setattr(mgr, "_scan_fs", lambda: [])
+    pending = mgr.save({"app": _state(1)}, step=1, async_=True)
+    snap = pending.wait()
+    assert snap.get_manifest()
+    assert mgr.steps() == [1]
+
+    # a fresh manager (fresh process) discovers it through the index alone
+    mgr2 = SnapshotManager(str(tmp_path))
+    monkeypatch.setattr(mgr2, "_scan_fs", lambda: [])
+    assert mgr2.latest_step() == 1
+
+    # never-waited async saves are swept at the next sync save
+    mgr3 = SnapshotManager(str(tmp_path))
+    monkeypatch.setattr(mgr3, "_scan_fs", lambda: [])
+    p = mgr3.save({"app": _state(2)}, step=2, async_=True)
+    p._pending.wait()  # commit lands, but the manager hook never runs
+    mgr3.save({"app": _state(3)}, step=3)
+    assert mgr3.steps() == [1, 2, 3]
+
+
+def test_corrupt_metadata_is_skipped_not_fatal(tmp_path):
+    mgr = SnapshotManager(str(tmp_path))
+    mgr.save({"app": _state(1)}, step=1)
+    mgr.save({"app": _state(2)}, step=2)
+    # poison the NEWEST snapshot's metadata
+    md = tmp_path / "step_0000000002" / ".snapshot_metadata"
+    md.write_bytes(b"{not yaml: [truncated")
+    dest = {"app": _state(0)}
+    # resume falls back to the previous good step instead of crashing
+    assert mgr.restore_latest(dest) == 1
+    assert np.array_equal(dest["app"]["w"], np.full(64, 1.0))
+    # delete_snapshot can still evict the poisoned snapshot
+    delete_snapshot(str(tmp_path / "step_0000000002"))
+    assert not os.path.exists(tmp_path / "step_0000000002")
+
+
+def test_keep_last_n_validation(tmp_path):
+    with pytest.raises(ValueError, match="keep_last_n"):
+        SnapshotManager(str(tmp_path), keep_last_n=0)

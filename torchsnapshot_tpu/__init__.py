@@ -25,6 +25,7 @@ from .coordination import (  # noqa: F401
 )
 from .event import Event  # noqa: F401
 from .event_handlers import register_event_handler, unregister_event_handler  # noqa: F401
+from .manager import SnapshotManager, delete_snapshot  # noqa: F401
 from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
 from .stateful import (  # noqa: F401
     PyTreeState,
@@ -39,6 +40,8 @@ __version__ = "0.1.0"
 __all__ = [
     "Snapshot",
     "PendingSnapshot",
+    "SnapshotManager",
+    "delete_snapshot",
     "Stateful",
     "StateDict",
     "PyTreeState",

@@ -138,6 +138,11 @@ class StoragePlugin(abc.ABC):
 
         run_in_fresh_loop(self.read(read_io))
 
+    def sync_delete(self, path: str) -> None:
+        from .utils.asyncio_utils import run_in_fresh_loop
+
+        run_in_fresh_loop(self.delete(path))
+
     def sync_close(self) -> None:
         from .utils.asyncio_utils import run_in_fresh_loop
 

@@ -1,0 +1,353 @@
+"""Snapshot lifecycle management: step-indexed saves, retention, GC.
+
+Beyond-parity subsystem.  The reference leaves path bookkeeping to the
+user (examples/simple_example.py hand-rolls "which epoch am I on");
+every production training loop then reinvents the same four things:
+step-numbered snapshot paths, "resume from the newest COMMITTED
+snapshot", bounded retention, and garbage collection of evicted
+snapshots.  ``SnapshotManager`` packages them on top of the existing
+commit protocol (metadata-last, snapshot.py:817-896) — the TPU-ecosystem
+analogue is orbax's CheckpointManager, re-designed around this library's
+URL-based storage plugins and multi-controller coordination:
+
+- **Discovery is index-first, scan-fallback.**  Cloud stores (the
+  primary TPU target) have no cheap directory listing behind the
+  ``StoragePlugin`` API, so the manager maintains ``manager_index.json``
+  at the root via plain plugin read/write; local ``fs`` roots also get a
+  directory scan so snapshots taken without the manager (or an index
+  lost to a crash) are still found.
+- **GC is metadata-first.**  Deleting ``.snapshot_metadata`` FIRST
+  un-commits the snapshot atomically (restore-side contract: no
+  metadata == aborted, snapshot.py:645); object deletes that crash
+  midway leave an aborted snapshot, never a committed-but-corrupt one.
+  Physical objects are enumerated from the manifest's entry locations —
+  plugin-agnostic, no listing needed.
+- **Multi-controller discipline matches take():** every rank calls
+  ``save``/``restore_latest``; only rank 0 mutates the index and runs
+  GC, after the commit barrier inside take.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from .coordination import Coordinator, get_default_coordinator
+from .io_types import ReadIO, WriteIO
+from .manifest import Entry, SnapshotMetadata
+from .snapshot import (
+    SNAPSHOT_METADATA_FNAME,
+    PendingSnapshot,
+    Snapshot,
+)
+from .storage import url_to_storage_plugin
+
+logger = logging.getLogger(__name__)
+
+INDEX_FNAME = "manager_index.json"
+
+
+def entry_locations(manifest: Dict[str, Entry]) -> List[str]:
+    """Every physical storage path a manifest references (relative to the
+    snapshot root).  Used by GC to delete a snapshot through the plugin
+    API without any directory-listing capability."""
+    locs: set = set()
+    for entry in manifest.values():
+        loc = getattr(entry, "location", None)
+        if isinstance(loc, str):
+            locs.add(loc)
+        for attr in ("shards", "chunks"):
+            for shard in getattr(entry, attr, None) or ():
+                sloc = getattr(shard, "location", None)
+                if isinstance(sloc, str):
+                    locs.add(sloc)
+    return sorted(locs)
+
+
+def delete_snapshot(
+    path: str, manifest: Optional[Dict[str, Entry]] = None
+) -> None:
+    """Delete one snapshot, committed or aborted, metadata-first.
+
+    Order matters: removing ``.snapshot_metadata`` first flips the
+    snapshot to "aborted" for every reader (snapshot.py:645), so a crash
+    between here and the last object delete can never be observed as a
+    committed snapshot with missing data.
+
+    ``manifest``, when the caller already verified/parsed it, skips the
+    metadata re-read (one fewer cloud round-trip per eviction)."""
+    storage = url_to_storage_plugin(path)
+    try:
+        locations: List[str] = []
+        if manifest is not None:
+            locations = entry_locations(manifest)
+        else:
+            try:
+                read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+                storage.sync_read(read_io)
+                md = SnapshotMetadata.from_yaml(bytes(read_io.buf).decode())
+                locations = entry_locations(md.manifest)
+            except FileNotFoundError:
+                pass  # aborted snapshot: no manifest to enumerate
+            except Exception as e:  # noqa: BLE001 — corrupt metadata
+                # still delete the metadata below (un-commit the poisoned
+                # snapshot); its objects can't be enumerated and leak on
+                # stores without listing — say so instead of crashing GC
+                logger.warning(
+                    "corrupt %s under %r (%r): deleting metadata only; "
+                    "data objects may be left behind",
+                    SNAPSHOT_METADATA_FNAME, path, e,
+                )
+        try:
+            storage.sync_delete(SNAPSHOT_METADATA_FNAME)
+        except FileNotFoundError:
+            pass
+        for loc in locations:
+            try:
+                storage.sync_delete(loc)
+            except FileNotFoundError:
+                pass  # idempotent: partial previous GC
+    finally:
+        storage.sync_close()
+    # local fs roots: clear leftover (now-empty) directory skeleton
+    if "://" not in path or path.startswith("file://"):
+        import shutil
+
+        shutil.rmtree(path.split("://", 1)[-1], ignore_errors=True)
+
+
+class SnapshotManager:
+    """Step-indexed snapshots under one root with bounded retention.
+
+    >>> mgr = SnapshotManager("/ckpt/run7", keep_last_n=3)
+    >>> step = mgr.restore_latest(app_state)   # None on cold start
+    >>> for step in range(step or 0, total):
+    ...     ...
+    ...     if step % 100 == 0:
+    ...         mgr.save(app_state, step=step, async_=True)
+
+    ``keep_last_n`` counts COMMITTED snapshots; the newest N survive.
+    Retention runs on rank 0 after each committed save (for async saves:
+    when the pending snapshot is waited on, or at the next save).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        keep_last_n: Optional[int] = None,
+        prefix: str = "step_",
+        coordinator: Optional[Coordinator] = None,
+    ) -> None:
+        if keep_last_n is not None and keep_last_n < 1:
+            raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+        self.root = root.rstrip("/")
+        self.keep_last_n = keep_last_n
+        self.prefix = prefix
+        self._coordinator = coordinator
+        # async-saved steps not yet recorded in the index
+        self._pending_async: List[int] = []
+
+    # ------------------------------------------------------------ paths
+
+    def path_for_step(self, step: int) -> str:
+        # fixed-width so lexicographic listing == numeric ordering
+        return f"{self.root}/{self.prefix}{step:010d}"
+
+    @property
+    def _coord(self) -> Coordinator:
+        return self._coordinator or get_default_coordinator()
+
+    # -------------------------------------------------------- discovery
+
+    def _read_index(self) -> List[int]:
+        storage = url_to_storage_plugin(self.root)
+        try:
+            read_io = ReadIO(path=INDEX_FNAME)
+            storage.sync_read(read_io)
+            data = json.loads(bytes(read_io.buf).decode())
+            return sorted(int(s) for s in data.get("steps", []))
+        except FileNotFoundError:
+            return []
+        except Exception as e:  # corrupt index: rebuild from scan
+            logger.warning("unreadable %s (%r); falling back to scan",
+                           INDEX_FNAME, e)
+            return []
+        finally:
+            storage.sync_close()
+
+    def _write_index(self, steps: Sequence[int]) -> None:
+        payload = json.dumps({"steps": sorted(set(steps))}).encode()
+        storage = url_to_storage_plugin(self.root)
+        try:
+            storage.sync_write(WriteIO(path=INDEX_FNAME, buf=payload))
+        finally:
+            storage.sync_close()
+
+    def _scan_fs(self) -> List[int]:
+        """Local-fs fallback: find committed snapshots by directory scan
+        (also catches snapshots taken without the manager)."""
+        import os
+        import re
+
+        if "://" in self.root and not self.root.startswith("file://"):
+            return []
+        base = self.root.split("://", 1)[-1]
+        pat = re.compile(re.escape(self.prefix) + r"(\d+)$")
+        steps = []
+        try:
+            names = os.listdir(base)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = pat.fullmatch(name)
+            if m and os.path.exists(
+                os.path.join(base, name, SNAPSHOT_METADATA_FNAME)
+            ):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def _committed(self) -> Dict[int, Snapshot]:
+        """step → Snapshot (metadata verified and cached) for every
+        committed step, ascending.  The index is advisory; only the
+        commit protocol is trusted — unreadable/corrupt metadata means
+        "not committed" here (GC can still evict it), never a crash that
+        bricks resume for the snapshots that ARE fine."""
+        merged = set(self._read_index()) | set(self._scan_fs())
+        committed: Dict[int, Snapshot] = {}
+        for step in sorted(merged):
+            snap = Snapshot(self.path_for_step(step))
+            try:
+                snap.metadata
+            except FileNotFoundError:
+                continue
+            except Exception as e:  # noqa: BLE001 — corrupt metadata
+                logger.warning(
+                    "step %d has unreadable metadata (%r); treating as "
+                    "uncommitted", step, e,
+                )
+                continue
+            committed[step] = snap
+        return committed
+
+    def steps(self) -> List[int]:
+        """Committed steps, ascending (index ∪ local scan)."""
+        return list(self._committed())
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def snapshot(self, step: int) -> Snapshot:
+        return Snapshot(
+            self.path_for_step(step), coordinator=self._coordinator
+        )
+
+    # ------------------------------------------------------- save/load
+
+    def save(
+        self,
+        app_state: Dict[str, Any],
+        step: int,
+        replicated: Sequence[str] = (),
+        async_: bool = False,
+    ) -> Union[Snapshot, "_ManagedPendingSnapshot"]:
+        path = self.path_for_step(step)
+        if async_:
+            pending = Snapshot.async_take(
+                path, app_state, replicated=replicated,
+                coordinator=self._coordinator,
+            )
+            # index/retention must not run from the commit thread (it
+            # would race a training-loop save() on the index): they run
+            # when the caller joins the pending snapshot, plus at the
+            # next sync save as a safety net for never-waited pendings
+            self._pending_async.append(step)
+            return _ManagedPendingSnapshot(pending, self, step)
+        snap = Snapshot.take(
+            path, app_state, replicated=replicated,
+            coordinator=self._coordinator,
+        )
+        self._after_commit(step)
+        return snap
+
+    def restore_latest(
+        self, app_state: Dict[str, Any], strict: bool = True
+    ) -> Optional[int]:
+        """Restore from the newest committed snapshot.  Returns its step,
+        or ``None`` on cold start (nothing committed yet).  All ranks
+        agree on the choice: rank 0 resolves, everyone else follows."""
+        step = self._coord.broadcast_object(
+            self.latest_step() if self._coord.rank == 0 else None, src=0
+        )
+        if step is None:
+            return None
+        self.snapshot(step).restore(app_state, strict=strict)
+        return step
+
+    # ------------------------------------------------------- retention
+
+    def _after_commit(self, step: Optional[int]) -> None:
+        if self._coord.rank != 0:
+            return
+        # sweep async saves whose commit has landed by now (index-first
+        # stores — cloud — would otherwise never learn about them)
+        steps = set(self._read_index()) | set(self._scan_fs())
+        if step is not None:
+            steps.add(step)
+        flushed = []
+        for s in self._pending_async:
+            try:
+                Snapshot(self.path_for_step(s)).metadata
+            except Exception:  # noqa: BLE001 — not committed yet
+                continue
+            steps.add(s)
+            flushed.append(s)
+        self._pending_async = [
+            s for s in self._pending_async if s not in flushed
+        ]
+        self._write_index(sorted(steps))
+        self.gc()
+
+    def gc(self) -> None:
+        """Apply retention: delete all but the newest ``keep_last_n``
+        committed snapshots.  Rank-0 only; safe to call any time."""
+        if self._coord.rank != 0 or self.keep_last_n is None:
+            return
+        committed = self._committed()
+        evict = list(committed)[: -self.keep_last_n]
+        for step in evict:
+            logger.info("retention: deleting snapshot step %d", step)
+            # reuse the just-verified manifest: no metadata re-read
+            delete_snapshot(
+                self.path_for_step(step),
+                manifest=committed[step].get_manifest(),
+            )
+        if evict:
+            self._write_index(
+                [s for s in committed if s not in set(evict)]
+            )
+
+
+class _ManagedPendingSnapshot:
+    """PendingSnapshot plus the manager's post-commit bookkeeping:
+    ``wait()`` joins the background commit, then (rank 0) records the
+    step in the index and applies retention — the point at which an
+    async save becomes discoverable on stores with no directory
+    listing."""
+
+    def __init__(
+        self, pending: PendingSnapshot, manager: "SnapshotManager",
+        step: int,
+    ) -> None:
+        self._pending = pending
+        self._manager = manager
+        self._step = step
+
+    def wait(self) -> Snapshot:
+        snap = self._pending.wait()
+        self._manager._after_commit(self._step)
+        return snap
+
+    def done(self) -> bool:
+        return self._pending.done()

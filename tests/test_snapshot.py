@@ -209,3 +209,81 @@ def test_dtype_cast_on_restore(tmp_path):
     Snapshot(str(tmp_path / "s")).restore({"app": dest})
     assert dest["x"].dtype == np.float64
     np.testing.assert_array_equal(dest["x"], arr.astype(np.float64))
+
+
+def test_partial_restore_by_glob(tmp_path):
+    """paths= restores only matching leaves; everything else keeps its
+    current value (warm-start params without touching optimizer state)."""
+    from torchsnapshot_tpu import PyTreeState
+
+    tree = {
+        "params": {"w1": np.full(16, 1.0), "w2": np.full(16, 2.0)},
+        "opt": {"mu": np.full(16, 3.0)},
+        "step": 7,
+    }
+    Snapshot.take(str(tmp_path / "s"), {"m": PyTreeState(tree)})
+
+    fresh = {
+        "params": {"w1": np.zeros(16), "w2": np.zeros(16)},
+        "opt": {"mu": np.full(16, -1.0)},
+        "step": 0,
+    }
+    dest = PyTreeState(fresh)
+    Snapshot(str(tmp_path / "s")).restore(
+        {"m": dest}, paths=["m/params/**"]
+    )
+    assert np.array_equal(dest.tree["params"]["w1"], np.full(16, 1.0))
+    assert np.array_equal(dest.tree["params"]["w2"], np.full(16, 2.0))
+    # unmatched leaves untouched
+    assert np.array_equal(dest.tree["opt"]["mu"], np.full(16, -1.0))
+    assert dest.tree["step"] == 0
+
+    # single-leaf glob
+    dest2 = PyTreeState({
+        "params": {"w1": np.zeros(16), "w2": np.zeros(16)},
+        "opt": {"mu": np.zeros(16)},
+        "step": 0,
+    })
+    Snapshot(str(tmp_path / "s")).restore(
+        {"m": dest2}, paths=["m/params/w2"]
+    )
+    assert np.array_equal(dest2.tree["params"]["w2"], np.full(16, 2.0))
+    assert np.array_equal(dest2.tree["params"]["w1"], np.zeros(16))
+
+
+def test_partial_restore_no_match_is_noop(tmp_path):
+    Snapshot.take(str(tmp_path / "s"), {"app": StateDict(x=np.ones(8))})
+    dest = StateDict(x=np.zeros(8))
+    Snapshot(str(tmp_path / "s")).restore(
+        {"app": dest}, paths=["nothing/**"]
+    )
+    assert np.array_equal(dest["x"], np.zeros(8))
+
+
+def test_partial_restore_statedict_merge(tmp_path):
+    Snapshot.take(
+        str(tmp_path / "s"),
+        {"app": StateDict(a=np.ones(4), b=np.full(4, 2.0), c=5)},
+    )
+    dest = StateDict(a=np.zeros(4), b=np.zeros(4), c=0)
+    Snapshot(str(tmp_path / "s")).restore({"app": dest}, paths=["app/b"])
+    assert np.array_equal(dest["b"], np.full(4, 2.0))
+    assert np.array_equal(dest["a"], np.zeros(4))
+    assert dest["c"] == 0
+
+
+def test_partial_restore_preserves_list_structure(tmp_path):
+    """Regression: filtering out a ListEntry child must not compact the
+    list (dropped children would shift survivors onto wrong indices) —
+    unmatched elements keep their current values."""
+    Snapshot.take(
+        str(tmp_path / "s"),
+        {"app": StateDict(layers=[np.full(4, 10.0), np.full(4, 20.0)])},
+    )
+    dest = StateDict(layers=[np.full(4, -1.0), np.full(4, -2.0)])
+    Snapshot(str(tmp_path / "s")).restore(
+        {"app": dest}, paths=["app/layers/1"]
+    )
+    assert len(dest["layers"]) == 2, dest["layers"]
+    assert np.array_equal(dest["layers"][0], np.full(4, -1.0))
+    assert np.array_equal(dest["layers"][1], np.full(4, 20.0))

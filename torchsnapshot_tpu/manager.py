@@ -47,6 +47,10 @@ logger = logging.getLogger(__name__)
 
 INDEX_FNAME = "manager_index.json"
 
+# sync-save sweeps a never-waited async step this many times before
+# concluding its commit failed and dropping it
+_PENDING_SWEEP_PROBES = 3
+
 
 def entry_locations(manifest: Dict[str, Entry]) -> List[str]:
     """Every physical storage path a manifest references (relative to the
@@ -145,8 +149,12 @@ class SnapshotManager:
         self.keep_last_n = keep_last_n
         self.prefix = prefix
         self._coordinator = coordinator
-        # async-saved steps not yet recorded in the index
-        self._pending_async: List[int] = []
+        # rank 0 only: async-saved steps not yet recorded in the index,
+        # step -> remaining sweep probes before giving up on its commit
+        self._pending_async: Dict[int, int] = {}
+        # steps whose commit has been verified (commits are immutable,
+        # so re-verification per sweep would be wasted cloud reads)
+        self._verified: Dict[int, Snapshot] = {}
 
     # ------------------------------------------------------------ paths
 
@@ -207,28 +215,47 @@ class SnapshotManager:
                 steps.append(int(m.group(1)))
         return sorted(steps)
 
-    def _committed(self) -> Dict[int, Snapshot]:
-        """step → Snapshot (metadata verified and cached) for every
-        committed step, ascending.  The index is advisory; only the
-        commit protocol is trusted — unreadable/corrupt metadata means
-        "not committed" here (GC can still evict it), never a crash that
-        bricks resume for the snapshots that ARE fine."""
-        merged = set(self._read_index()) | set(self._scan_fs())
+    def _verify(
+        self, candidates: set, use_cache: bool = False
+    ) -> Dict[int, Snapshot]:
+        """step → Snapshot (metadata verified) for committed candidates,
+        ascending.  The index is advisory; only the commit protocol is
+        trusted — unreadable/corrupt metadata means "not committed" here
+        (GC can still evict it), never a crash that bricks resume for
+        the snapshots that ARE fine.
+
+        ``use_cache`` (retention sweeps only): commits are immutable, so
+        re-verifying every committed step on every save would be wasted
+        cloud reads.  Public discovery (steps / restore_latest) always
+        verifies fresh — external damage to a snapshot must not hide
+        behind the cache when choosing what to restore."""
         committed: Dict[int, Snapshot] = {}
-        for step in sorted(merged):
+        for step in sorted(candidates):
+            if use_cache and step in self._verified:
+                committed[step] = self._verified[step]
+                continue
             snap = Snapshot(self.path_for_step(step))
             try:
                 snap.metadata
             except FileNotFoundError:
+                self._verified.pop(step, None)
                 continue
             except Exception as e:  # noqa: BLE001 — corrupt metadata
                 logger.warning(
                     "step %d has unreadable metadata (%r); treating as "
                     "uncommitted", step, e,
                 )
+                self._verified.pop(step, None)
                 continue
+            self._verified[step] = snap
             committed[step] = snap
         return committed
+
+    def _committed(self, use_cache: bool = False) -> Dict[int, Snapshot]:
+        return self._verify(
+            set(self._read_index()) | set(self._scan_fs()),
+            use_cache=use_cache,
+        )
 
     def steps(self) -> List[int]:
         """Committed steps, ascending (index ∪ local scan)."""
@@ -262,7 +289,8 @@ class SnapshotManager:
             # would race a training-loop save() on the index): they run
             # when the caller joins the pending snapshot, plus at the
             # next sync save as a safety net for never-waited pendings
-            self._pending_async.append(step)
+            if self._coord.rank == 0:
+                self._pending_async[step] = _PENDING_SWEEP_PROBES
             return _ManagedPendingSnapshot(pending, self, step)
         snap = Snapshot.take(
             path, app_state, replicated=replicated,
@@ -272,17 +300,21 @@ class SnapshotManager:
         return snap
 
     def restore_latest(
-        self, app_state: Dict[str, Any], strict: bool = True
+        self,
+        app_state: Dict[str, Any],
+        strict: bool = True,
+        paths: Optional[Sequence[str]] = None,
     ) -> Optional[int]:
         """Restore from the newest committed snapshot.  Returns its step,
         or ``None`` on cold start (nothing committed yet).  All ranks
-        agree on the choice: rank 0 resolves, everyone else follows."""
+        agree on the choice: rank 0 resolves, everyone else follows.
+        ``paths`` filters to matching leaves (Snapshot.restore)."""
         step = self._coord.broadcast_object(
             self.latest_step() if self._coord.rank == 0 else None, src=0
         )
         if step is None:
             return None
-        self.snapshot(step).restore(app_state, strict=strict)
+        self.snapshot(step).restore(app_state, strict=strict, paths=paths)
         return step
 
     # ------------------------------------------------------- retention
@@ -291,30 +323,39 @@ class SnapshotManager:
         if self._coord.rank != 0:
             return
         # sweep async saves whose commit has landed by now (index-first
-        # stores — cloud — would otherwise never learn about them)
-        steps = set(self._read_index()) | set(self._scan_fs())
+        # stores — cloud — would otherwise never learn about them); a
+        # step that stays uncommitted across _PENDING_SWEEP_PROBES
+        # sweeps is dropped (its commit failed) instead of being
+        # re-probed on every save forever
+        candidates = set(self._read_index()) | set(self._scan_fs())
         if step is not None:
-            steps.add(step)
-        flushed = []
-        for s in self._pending_async:
-            try:
-                Snapshot(self.path_for_step(s)).metadata
-            except Exception:  # noqa: BLE001 — not committed yet
-                continue
-            steps.add(s)
-            flushed.append(s)
-        self._pending_async = [
-            s for s in self._pending_async if s not in flushed
-        ]
-        self._write_index(sorted(steps))
-        self.gc()
+            candidates.add(step)
+        candidates.update(self._pending_async)
+        committed = self._verify(candidates, use_cache=True)
+        for s in list(self._pending_async):
+            if s in committed:
+                del self._pending_async[s]
+            else:
+                self._pending_async[s] -= 1
+                if self._pending_async[s] <= 0:
+                    logger.warning(
+                        "async save for step %d never committed; "
+                        "dropping it from the sweep list", s,
+                    )
+                    del self._pending_async[s]
+        self._write_index(sorted(committed))
+        self._apply_retention(committed)
 
     def gc(self) -> None:
         """Apply retention: delete all but the newest ``keep_last_n``
         committed snapshots.  Rank-0 only; safe to call any time."""
         if self._coord.rank != 0 or self.keep_last_n is None:
             return
-        committed = self._committed()
+        self._apply_retention(self._committed())
+
+    def _apply_retention(self, committed: Dict[int, Snapshot]) -> None:
+        if self.keep_last_n is None:
+            return
         evict = list(committed)[: -self.keep_last_n]
         for step in evict:
             logger.info("retention: deleting snapshot step %d", step)
@@ -323,6 +364,7 @@ class SnapshotManager:
                 self.path_for_step(step),
                 manifest=committed[step].get_manifest(),
             )
+            self._verified.pop(step, None)
         if evict:
             self._write_index(
                 [s for s in committed if s not in set(evict)]

@@ -662,9 +662,24 @@ class Snapshot:
     def get_manifest(self) -> Dict[str, Entry]:
         return dict(self.metadata.manifest)
 
-    def restore(self, app_state: AppState, strict: bool = True) -> None:
+    def restore(
+        self,
+        app_state: AppState,
+        strict: bool = True,
+        paths: Optional[Sequence[str]] = None,
+    ) -> None:
         """Distributed load/reshard into the given app state (reference
-        Snapshot.restore, snapshot.py:319-396)."""
+        Snapshot.restore, snapshot.py:319-396).
+
+        ``paths`` (beyond-parity): restore only leaves whose logical path
+        matches one of the fnmatch globs — e.g. ``["model/params/**"]``
+        to warm-start parameters from a pretrained snapshot while the
+        optimizer state keeps its fresh values.  Unmatched leaves are
+        left untouched (the reference's only alternatives are
+        all-or-nothing restore or per-leaf ``read_object``).  Filtering
+        implies non-strict inflation for the skipped leaves; ``strict``
+        still governs whether app_state keys absent from the snapshot
+        raise."""
         coordinator = self._coordinator
         rank, world = coordinator.rank, coordinator.world_size
         _validate_app_state(app_state)
@@ -687,7 +702,7 @@ class Snapshot:
                     if key in app_state:
                         self._load_stateful(
                             key, app_state[key], manifest_for_rank, storage,
-                            strict, rank,
+                            strict, rank, paths=paths,
                         )
                     if world > 1:
                         coordinator.barrier()
@@ -702,6 +717,7 @@ class Snapshot:
         storage: Any,
         strict: bool,
         rank: int,
+        paths: Optional[Sequence[str]] = None,
     ) -> None:
         # reference _load_stateful, snapshot.py:727-782
         key_manifest = {
@@ -716,6 +732,11 @@ class Snapshot:
                 )
             logger.warning("skipping %r: not in snapshot", key)
             return
+        if paths is not None and not any(
+            not is_container_entry(e) and path_is_replicated(p, paths)
+            for p, e in key_manifest.items()
+        ):
+            return  # nothing under this key matches the filter
         # current state provides in-place/sharding templates
         # (reference snapshot.py:754-762)
         _, targets = flatten(stateful.state_dict(), prefix=key)
@@ -728,6 +749,18 @@ class Snapshot:
             if is_container_entry(entry):
                 container_entries[lpath] = entry
                 continue
+            if paths is not None and not path_is_replicated(lpath, paths):
+                # partial restore: no read for unmatched leaves — but
+                # list/tuple structure must survive inflation, so seed
+                # the slot with the CURRENT value instead of dropping it
+                # (a dropped ListEntry child would compact the list and
+                # shift later elements onto wrong indices)
+                target = targets.get(lpath)
+                if target is not None:
+                    fut: Future = Future(target)
+                    fut.set(target)
+                    futures[lpath] = fut
+                continue
             reqs, fut = prepare_read(entry, obj_out=targets.get(lpath))
             read_reqs.extend(reqs)
             futures[lpath] = fut
@@ -737,11 +770,17 @@ class Snapshot:
         sync_execute_read_reqs(read_reqs, storage, budget, rank)
         restored = {lpath: fut.obj for lpath, fut in futures.items()}
         state_dict = inflate(
-            container_entries, restored, prefix=key, allow_missing=not strict
+            container_entries,
+            restored,
+            prefix=key,
+            allow_missing=(not strict) or paths is not None,
         )
         # propagate strict to load_state_dict when the stateful accepts it
-        # (reference snapshot.py:775-778 for nn.Module)
-        load_with_strict(stateful, state_dict, strict)
+        # (reference snapshot.py:775-778 for nn.Module); a paths filter
+        # implies non-strict (unmatched leaves keep current values)
+        load_with_strict(
+            stateful, state_dict, strict and paths is None
+        )
 
     @staticmethod
     def _map_legacy_leaf_targets(

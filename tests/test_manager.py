@@ -180,3 +180,79 @@ def test_corrupt_metadata_is_skipped_not_fatal(tmp_path):
 def test_keep_last_n_validation(tmp_path):
     with pytest.raises(ValueError, match="keep_last_n"):
         SnapshotManager(str(tmp_path), keep_last_n=0)
+
+
+def test_transient_metadata_failure_keeps_index_entry(tmp_path, monkeypatch):
+    """A step whose metadata read fails (outage / corruption) must stay
+    in the index — dropping it would orphan the snapshot forever on
+    stores with no listing."""
+    mgr = SnapshotManager(str(tmp_path))
+    monkeypatch.setattr(mgr, "_scan_fs", lambda: [])
+    mgr.save({"app": _state(1)}, step=1)
+    mgr.save({"app": _state(2)}, step=2)
+    # poison step 1's metadata (stands in for a transient read failure)
+    (tmp_path / "step_0000000001" / ".snapshot_metadata").write_bytes(
+        b"\x00garbage"
+    )
+    mgr2 = SnapshotManager(str(tmp_path))
+    monkeypatch.setattr(mgr2, "_scan_fs", lambda: [])
+    mgr2.save({"app": _state(3)}, step=3)
+    idx = json.loads((tmp_path / INDEX_FNAME).read_text())
+    assert 1 in idx["steps"], idx  # kept in the index
+    assert mgr2.steps() == [2, 3]  # but not served as committed
+
+
+def test_slow_async_commit_not_dropped(tmp_path, monkeypatch):
+    """An async commit still in flight (done()=False) must survive any
+    number of sync-save sweeps and be indexed once it lands."""
+    mgr = SnapshotManager(str(tmp_path))
+    monkeypatch.setattr(mgr, "_scan_fs", lambda: [])
+    p = mgr.save({"app": _state(1)}, step=1, async_=True)
+    p._pending.wait()  # commit actually lands...
+    # ...but pretend the manager still sees it as in flight
+    monkeypatch.setattr(p._pending, "done", lambda: False)
+    for s in (2, 3, 4, 5):
+        mgr.save({"app": _state(s)}, step=s)
+    assert 1 in mgr._pending_async  # never dropped while "in flight"
+    monkeypatch.undo()
+    mgr.save({"app": _state(6)}, step=6)
+    assert mgr.steps() == [1, 2, 3, 4, 5, 6]
+    assert 1 not in mgr._pending_async
+
+
+def test_retention_index_keeps_unverifiable_steps(tmp_path, monkeypatch):
+    """Retention's index rewrite must preserve transiently-unverifiable
+    steps exactly like _after_commit's union-preserving write."""
+    mgr = SnapshotManager(str(tmp_path), keep_last_n=2)
+    for s in (1, 2, 3):
+        mgr.save({"app": _state(s)}, step=s)
+    # poison step 3's metadata: becomes "unverifiable", not evictable
+    (tmp_path / "step_0000000003" / ".snapshot_metadata").write_bytes(
+        b"\x00garbage"
+    )
+    mgr2 = SnapshotManager(str(tmp_path), keep_last_n=2)
+    monkeypatch.setattr(mgr2, "_scan_fs", lambda: [])
+    mgr2.save({"app": _state(4)}, step=4)  # committed now: {1,2,4}
+    idx = json.loads((tmp_path / INDEX_FNAME).read_text())
+    assert 3 in idx["steps"], idx  # survived the retention rewrite
+    assert mgr2.steps() == [2, 4]  # 1 evicted, 3 unverifiable
+
+
+def test_dropped_async_handle_is_swept_without_pinning(tmp_path):
+    """Dropping the async handle without wait() must not pin staged
+    buffers: the weakref dies once the commit thread finishes, and the
+    next sync save indexes the step."""
+    import gc as pygc
+    import time
+
+    mgr = SnapshotManager(str(tmp_path))
+    p = mgr.save({"app": _state(1)}, step=1, async_=True)
+    p._pending._thread.join()
+    # commit thread done: staged-work reference must already be dropped
+    assert p._pending._pending_io_work is None
+    del p
+    pygc.collect()
+    assert mgr._pending_async[1]() is None  # weakref dead: nothing pinned
+    mgr.save({"app": _state(2)}, step=2)
+    assert mgr.steps() == [1, 2]
+    assert 1 not in mgr._pending_async

@@ -287,3 +287,19 @@ def test_partial_restore_preserves_list_structure(tmp_path):
     assert len(dest["layers"]) == 2, dest["layers"]
     assert np.array_equal(dest["layers"][0], np.full(4, -1.0))
     assert np.array_equal(dest["layers"][1], np.full(4, 20.0))
+
+
+def test_partial_restore_list_with_none_slot(tmp_path):
+    """Regression: an unmatched list element whose CURRENT value is None
+    must still hold its slot (membership seeding, not is-None)."""
+    Snapshot.take(
+        str(tmp_path / "s"),
+        {"app": StateDict(layers=[np.full(4, 10.0), np.full(4, 20.0)])},
+    )
+    dest = StateDict(layers=[None, np.zeros(4)])
+    Snapshot(str(tmp_path / "s")).restore(
+        {"app": dest}, paths=["app/layers/1"]
+    )
+    assert len(dest["layers"]) == 2, dest["layers"]
+    assert dest["layers"][0] is None
+    assert np.array_equal(dest["layers"][1], np.full(4, 20.0))

@@ -26,6 +26,7 @@ from .coordination import (  # noqa: F401
 from .event import Event  # noqa: F401
 from .event_handlers import register_event_handler, unregister_event_handler  # noqa: F401
 from .manager import SnapshotManager, delete_snapshot  # noqa: F401
+from .verify import VerifyResult, verify_snapshot  # noqa: F401
 from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
 from .stateful import (  # noqa: F401
     PyTreeState,
@@ -42,6 +43,8 @@ __all__ = [
     "PendingSnapshot",
     "SnapshotManager",
     "delete_snapshot",
+    "VerifyResult",
+    "verify_snapshot",
     "Stateful",
     "StateDict",
     "PyTreeState",

@@ -124,6 +124,14 @@ class StoragePlugin(abc.ABC):
     @abc.abstractmethod
     async def delete(self, path: str) -> None: ...
 
+    async def stat(self, path: str) -> int:
+        """Object size in bytes; FileNotFoundError if absent.  The
+        default reads the whole object (correct on any plugin);
+        subclasses override with a cheap metadata call."""
+        read_io = ReadIO(path=path)
+        await self.read(read_io)
+        return len(read_io.buf)
+
     async def close(self) -> None:
         pass
 
@@ -142,6 +150,11 @@ class StoragePlugin(abc.ABC):
         from .utils.asyncio_utils import run_in_fresh_loop
 
         run_in_fresh_loop(self.delete(path))
+
+    def sync_stat(self, path: str) -> int:
+        from .utils.asyncio_utils import run_in_fresh_loop
+
+        return run_in_fresh_loop(self.stat(path))
 
     def sync_close(self) -> None:
         from .utils.asyncio_utils import run_in_fresh_loop

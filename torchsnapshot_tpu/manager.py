@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import logging
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from .coordination import Coordinator, get_default_coordinator
@@ -46,10 +47,6 @@ from .storage import url_to_storage_plugin
 logger = logging.getLogger(__name__)
 
 INDEX_FNAME = "manager_index.json"
-
-# sync-save sweeps a never-waited async step this many times before
-# concluding its commit failed and dropping it
-_PENDING_SWEEP_PROBES = 3
 
 
 def entry_locations(manifest: Dict[str, Entry]) -> List[str]:
@@ -149,12 +146,19 @@ class SnapshotManager:
         self.keep_last_n = keep_last_n
         self.prefix = prefix
         self._coordinator = coordinator
-        # rank 0 only: async-saved steps not yet recorded in the index,
-        # step -> remaining sweep probes before giving up on its commit
-        self._pending_async: Dict[int, int] = {}
+        # rank 0 only: async saves not yet recorded in the index,
+        # step -> weakref to its PendingSnapshot.  done() distinguishes
+        # "commit still in flight" from "commit thread finished"; a
+        # weakref (the commit thread itself keeps the object alive while
+        # running) so the sweep list never pins staged buffers after
+        # the caller drops its handle
+        self._pending_async: Dict[int, "weakref.ref[PendingSnapshot]"] = {}
         # steps whose commit has been verified (commits are immutable,
         # so re-verification per sweep would be wasted cloud reads)
         self._verified: Dict[int, Snapshot] = {}
+        # steps the last _verify call could not read metadata for
+        # (possible transient outage — kept in the index, not committed)
+        self._last_unverifiable: set = set()
 
     # ------------------------------------------------------------ paths
 
@@ -230,6 +234,7 @@ class SnapshotManager:
         verifies fresh — external damage to a snapshot must not hide
         behind the cache when choosing what to restore."""
         committed: Dict[int, Snapshot] = {}
+        self._last_unverifiable: set = set()
         for step in sorted(candidates):
             if use_cache and step in self._verified:
                 committed[step] = self._verified[step]
@@ -238,13 +243,17 @@ class SnapshotManager:
             try:
                 snap.metadata
             except FileNotFoundError:
+                # definitively uncommitted (the metadata object is absent)
                 self._verified.pop(step, None)
                 continue
-            except Exception as e:  # noqa: BLE001 — corrupt metadata
+            except Exception as e:  # noqa: BLE001 — corrupt OR transient
                 logger.warning(
                     "step %d has unreadable metadata (%r); treating as "
-                    "uncommitted", step, e,
+                    "uncommitted for this call", step, e,
                 )
+                # could be a storage outage: the step must NOT be
+                # dropped from the index over this (see _after_commit)
+                self._last_unverifiable.add(step)
                 self._verified.pop(step, None)
                 continue
             self._verified[step] = snap
@@ -290,7 +299,7 @@ class SnapshotManager:
             # when the caller joins the pending snapshot, plus at the
             # next sync save as a safety net for never-waited pendings
             if self._coord.rank == 0:
-                self._pending_async[step] = _PENDING_SWEEP_PROBES
+                self._pending_async[step] = weakref.ref(pending)
             return _ManagedPendingSnapshot(pending, self, step)
         snap = Snapshot.take(
             path, app_state, replicated=replicated,
@@ -323,27 +332,37 @@ class SnapshotManager:
         if self._coord.rank != 0:
             return
         # sweep async saves whose commit has landed by now (index-first
-        # stores — cloud — would otherwise never learn about them); a
-        # step that stays uncommitted across _PENDING_SWEEP_PROBES
-        # sweeps is dropped (its commit failed) instead of being
-        # re-probed on every save forever
+        # stores — cloud — would otherwise never learn about them).
+        # done() distinguishes in-flight from finished: an in-flight
+        # commit stays queued without a wasted metadata probe; a
+        # finished one either committed (index it) or definitively
+        # failed (its metadata is absent — drop it).
         candidates = set(self._read_index()) | set(self._scan_fs())
         if step is not None:
             candidates.add(step)
-        candidates.update(self._pending_async)
+        settled = set()
+        for s, ref in self._pending_async.items():
+            pending = ref()
+            # a dead ref means the commit thread (which holds the object
+            # while running) finished and the caller dropped the handle
+            if pending is None or pending.done():
+                settled.add(s)
+        candidates.update(settled)
         committed = self._verify(candidates, use_cache=True)
-        for s in list(self._pending_async):
+        for s in settled:
             if s in committed:
                 del self._pending_async[s]
-            else:
-                self._pending_async[s] -= 1
-                if self._pending_async[s] <= 0:
-                    logger.warning(
-                        "async save for step %d never committed; "
-                        "dropping it from the sweep list", s,
-                    )
-                    del self._pending_async[s]
-        self._write_index(sorted(committed))
+            elif s not in self._last_unverifiable:
+                logger.warning(
+                    "async save for step %d finished without committing; "
+                    "dropping it from the sweep list", s,
+                )
+                del self._pending_async[s]
+        # union-preserving index write: a step whose metadata read
+        # failed TRANSIENTLY (outage) keeps its index entry — dropping
+        # it would orphan a good snapshot forever on stores with no
+        # listing; only definitively-absent metadata un-indexes a step
+        self._write_index(sorted(set(committed) | self._last_unverifiable))
         self._apply_retention(committed)
 
     def gc(self) -> None:
@@ -366,8 +385,13 @@ class SnapshotManager:
             )
             self._verified.pop(step, None)
         if evict:
+            # keep transiently-unverifiable steps in the index here too
+            # (same invariant as _after_commit's union-preserving write)
             self._write_index(
-                [s for s in committed if s not in set(evict)]
+                sorted(
+                    (set(committed) - set(evict))
+                    | self._last_unverifiable
+                )
             )
 
 

@@ -754,11 +754,12 @@ class Snapshot:
                 # list/tuple structure must survive inflation, so seed
                 # the slot with the CURRENT value instead of dropping it
                 # (a dropped ListEntry child would compact the list and
-                # shift later elements onto wrong indices)
-                target = targets.get(lpath)
-                if target is not None:
-                    fut: Future = Future(target)
-                    fut.set(target)
+                # shift later elements onto wrong indices).  Membership,
+                # not is-None: a present-but-None leaf still holds its
+                # list slot.
+                if lpath in targets:
+                    fut: Future = Future(targets[lpath])
+                    fut.set(targets[lpath])
                     futures[lpath] = fut
                 continue
             reqs, fut = prepare_read(entry, obj_out=targets.get(lpath))
@@ -813,6 +814,15 @@ class Snapshot:
                 targets[legacy[i]] = leaf
 
     # ----------------------------------------------------------- read_object
+
+    def verify(self, deep: bool = False) -> "Any":
+        """Integrity audit of this rank's view (beyond-parity; see
+        verify.py): every referenced object must exist with at least the
+        byte extent the manifest claims; ``deep=True`` additionally
+        dry-run-restores every entry.  Returns a ``VerifyResult``."""
+        from .verify import verify_snapshot
+
+        return verify_snapshot(self, deep=deep)
 
     def read_object(
         self,
@@ -928,6 +938,11 @@ class PendingSnapshot:
             if self._exc is None:
                 self._exc = e
         finally:
+            # the drained work pins the staged host buffers through its
+            # starter/future closures; a PendingSnapshot handle may
+            # outlive the commit arbitrarily (e.g. held by a manager's
+            # sweep list), so drop them the moment they're consumed
+            self._pending_io_work = None
             try:
                 self._storage.sync_close()
             except Exception:

@@ -194,6 +194,18 @@ class FSStoragePlugin(StoragePlugin):
 
             await aiofiles.os.remove(full)
 
+    async def stat(self, path: str) -> int:
+        full = self._full(path)
+        if self._executor is not None:
+            st = await asyncio.get_running_loop().run_in_executor(
+                self._executor, os.stat, full
+            )
+        else:
+            import aiofiles.os
+
+            st = await aiofiles.os.stat(full)
+        return st.st_size
+
     async def close(self) -> None:
         if self._executor is not None:
             self._executor.shutdown(wait=False)

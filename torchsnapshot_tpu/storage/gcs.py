@@ -334,6 +334,16 @@ class GCSStoragePlugin(StoragePlugin):
         )
         read_io.buf = out
 
+    async def stat(self, path: str) -> int:
+        blob_name = self._blob_name(path)
+
+        def head() -> int:
+            blob = self._bucket.blob(blob_name)
+            blob.reload()  # metadata GET; NotFound -> retry layer maps it
+            return int(blob.size)
+
+        return await self._with_retry(head, f"read {blob_name} (stat)")
+
     # ------------------------------------------------------------ delete
 
     async def _delete_blob(self, blob_name: str) -> None:

@@ -43,5 +43,13 @@ class MemoryStoragePlugin(StoragePlugin):
             start, end = read_io.byte_range
             read_io.buf = data[start:end]
 
+    async def stat(self, path: str) -> int:
+        try:
+            return len(self._store[path])
+        except KeyError:
+            raise FileNotFoundError(
+                f"memory://{self.namespace}/{path}"
+            ) from None
+
     async def delete(self, path: str) -> None:
         del self._store[path]

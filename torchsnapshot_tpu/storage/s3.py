@@ -104,6 +104,34 @@ class S3StoragePlugin(StoragePlugin):
                 raise
             read_io.buf = await self._run(resp["Body"].read)
 
+    async def stat(self, path: str) -> int:
+        key = self._key(path)
+        try:
+            if self._is_fs:
+                info = await self._run(
+                    functools.partial(
+                        self._backend.info, f"{self.bucket}/{key}"
+                    )
+                )
+                return int(info["size"])
+            resp = await self._run(
+                functools.partial(
+                    self._backend.head_object, Bucket=self.bucket, Key=key
+                )
+            )
+            return int(resp["ContentLength"])
+        except FileNotFoundError:
+            raise
+        except Exception as e:
+            code = str(
+                getattr(e, "response", {}).get("Error", {}).get("Code", "")
+            )
+            if code in ("NoSuchKey", "404") or type(e).__name__ in (
+                "NoSuchKey",
+            ):
+                raise FileNotFoundError(f"s3://{self.bucket}/{key}") from e
+            raise
+
     async def delete(self, path: str) -> None:
         key = self._key(path)
         if self._is_fs:

@@ -162,16 +162,24 @@ def batch_write_requests(
         slab_location = f"{rank}/batched.{i}"
         offset = 0
         stagers: List[Tuple[BufferStager, int]] = []
+        sinks = []
         for wr, cost in slab:
             record = targets[wr.path]
             record.location = slab_location
             record.byte_range = [offset, offset + cost]
             stagers.append((wr.buffer_stager, cost))
+            # re-range the member's checksum sinks into slab coordinates
+            # so each entry's crc still covers exactly its own payload
+            for sink, rng in wr.checksum_sinks or ():
+                lo = offset + (rng[0] if rng else 0)
+                hi = offset + (rng[1] if rng else cost)
+                sinks.append((sink, (lo, hi)))
             offset += cost
         new_reqs.append(
             WriteReq(
                 path=slab_location,
                 buffer_stager=BatchedBufferStager(stagers, offset),
+                checksum_sinks=sinks or None,
             )
         )
     return entries, new_reqs

@@ -24,7 +24,7 @@ import abc
 import asyncio
 from concurrent.futures import Executor
 from dataclasses import dataclass, field
-from typing import Any, Generic, List, Optional, TypeVar
+from typing import Any, Callable, Generic, List, Optional, Tuple, TypeVar
 
 T = TypeVar("T")
 
@@ -79,6 +79,14 @@ class BufferConsumer(abc.ABC):
 class WriteReq:
     path: str
     buffer_stager: BufferStager
+    # (sink, byte_range | None): after staging, each sink receives the
+    # crc32 of its slice of the staged buffer (None = whole buffer) —
+    # preparers point these at manifest entry/shard ``crc32`` fields so
+    # committed metadata carries end-to-end content checksums.  The
+    # batcher re-ranges sinks when it folds requests into a slab.
+    checksum_sinks: Optional[
+        List[Tuple[Callable[[int], None], Optional[Tuple[int, int]]]]
+    ] = None
 
 
 @dataclass

@@ -59,6 +59,7 @@ class ArrayEntry(Entry):
         shape: List[int],
         replicated: bool,
         byte_range: Optional[List[int]] = None,
+        crc32: Optional[int] = None,
     ) -> None:
         super().__init__(type="Array")
         self.location = location
@@ -67,11 +68,16 @@ class ArrayEntry(Entry):
         self.shape = shape
         self.replicated = replicated
         self.byte_range = byte_range
+        # zlib.crc32 of the serialized payload, recorded at staging time
+        # (knobs WRITE_CHECKSUMS); checked by verify(deep=True)
+        self.crc32 = crc32
 
     def to_dict(self) -> Dict[str, Any]:
         d = super().to_dict()
         if d.get("byte_range") is None:
             del d["byte_range"]
+        if d.get("crc32") is None:
+            del d["crc32"]
         return d
 
 
@@ -84,6 +90,7 @@ class Shard:
     sizes: List[int]
     location: str
     byte_range: Optional[List[int]] = None
+    crc32: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -93,6 +100,8 @@ class Shard:
         }
         if self.byte_range is not None:
             d["byte_range"] = self.byte_range
+        if self.crc32 is not None:
+            d["crc32"] = self.crc32
         return d
 
     @classmethod
@@ -102,6 +111,7 @@ class Shard:
             sizes=list(d["sizes"]),
             location=d["location"],
             byte_range=list(d["byte_range"]) if d.get("byte_range") else None,
+            crc32=d.get("crc32"),
         )
 
 
@@ -193,12 +203,26 @@ class ObjectEntry(Entry):
     location: str
     serializer: str
     replicated: bool
+    crc32: Optional[int]
 
-    def __init__(self, location: str, serializer: str, replicated: bool) -> None:
+    def __init__(
+        self,
+        location: str,
+        serializer: str,
+        replicated: bool,
+        crc32: Optional[int] = None,
+    ) -> None:
         super().__init__(type="object")
         self.location = location
         self.serializer = serializer
         self.replicated = replicated
+        self.crc32 = crc32
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = super().to_dict()
+        if d.get("crc32") is None:
+            del d["crc32"]
+        return d
 
 
 _PRIMITIVE_TYPES = ("int", "float", "str", "bool", "bytes", "NoneType")
@@ -309,6 +333,7 @@ def entry_from_dict(d: Dict[str, Any]) -> Entry:
             shape=list(d["shape"]),
             replicated=bool(d["replicated"]),
             byte_range=list(d["byte_range"]) if d.get("byte_range") else None,
+            crc32=d.get("crc32"),
         )
     if t == "ShardedArray":
         return ShardedArrayEntry(
@@ -331,6 +356,7 @@ def entry_from_dict(d: Dict[str, Any]) -> Entry:
             location=d["location"],
             serializer=d["serializer"],
             replicated=bool(d["replicated"]),
+            crc32=d.get("crc32"),
         )
     if t in _PRIMITIVE_TYPES:
         return PrimitiveEntry(

@@ -360,7 +360,15 @@ class ArrayIOPreparer:
             stager = HostArrayBufferStager(
                 _to_host_view(obj), defensive_copy=is_async_snapshot
             )
-        return entry, [WriteReq(path=location, buffer_stager=stager)]
+        return entry, [
+            WriteReq(
+                path=location,
+                buffer_stager=stager,
+                checksum_sinks=[
+                    (lambda c, e=entry: setattr(e, "crc32", c), None)
+                ],
+            )
+        ]
 
     @staticmethod
     def prepare_read(
@@ -471,7 +479,18 @@ class ChunkedArrayIOPreparer:
                 stager = HostArrayBufferStager(
                     _to_host_view(obj)[r0:r1], defensive_copy=is_async_snapshot
                 )
-            write_reqs.append(WriteReq(path=chunk_location, buffer_stager=stager))
+            write_reqs.append(
+                WriteReq(
+                    path=chunk_location,
+                    buffer_stager=stager,
+                    checksum_sinks=[
+                        (
+                            lambda c, s=chunks[-1]: setattr(s, "crc32", c),
+                            None,
+                        )
+                    ],
+                )
+            )
         entry = ChunkedArrayEntry(
             dtype=array_dtype_str(obj),
             shape=shape,

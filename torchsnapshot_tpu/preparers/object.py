@@ -62,7 +62,13 @@ class ObjectIOPreparer:
             location=location, serializer=serializer, replicated=replicated
         )
         return entry, [
-            WriteReq(path=location, buffer_stager=ObjectBufferStager(payload))
+            WriteReq(
+                path=location,
+                buffer_stager=ObjectBufferStager(payload),
+                checksum_sinks=[
+                    (lambda c, e=entry: setattr(e, "crc32", c), None)
+                ],
+            )
         ]
 
     @staticmethod

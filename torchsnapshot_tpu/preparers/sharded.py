@@ -194,6 +194,14 @@ class ShardedArrayIOPreparer:
                             index=index if sub != box else None,
                             nbytes=box_nelems(sub) * itemsize,
                         ),
+                        checksum_sinks=[
+                            (
+                                lambda c, s=shards[-1]: setattr(
+                                    s, "crc32", c
+                                ),
+                                None,
+                            )
+                        ],
                     )
                 )
         entry = ShardedArrayEntry(

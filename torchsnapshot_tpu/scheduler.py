@@ -50,6 +50,17 @@ _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
 
 
+def _apply_checksum_sinks(buf, sinks) -> None:
+    """Feed each sink the crc32 of its byte range of the staged buffer
+    (WriteReq.checksum_sinks contract, io_types.py)."""
+    import zlib
+
+    view = memoryview(buf).cast("B")
+    for sink, rng in sinks:
+        piece = view if rng is None else view[rng[0] : rng[1]]
+        sink(zlib.crc32(piece) & 0xFFFFFFFF)
+
+
 def get_process_memory_budget_bytes(local_process_count: int = 1) -> int:
     """Host-memory budget for staging (reference scheduler.py:47-67)."""
     override = knobs.get_per_rank_memory_budget_bytes()
@@ -211,6 +222,14 @@ async def _execute_write_pipelines(
     async def stage_one(p: _WritePipeline) -> _WritePipeline:
         p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
         p.buf_size = len(memoryview(p.buf).cast("B")) if p.buf is not None else 0
+        sinks = p.write_req.checksum_sinks
+        if sinks and knobs.write_checksums_enabled():
+            # content checksums into the manifest (entries are serialized
+            # at commit, strictly after staging completes) — off-loop,
+            # the staged buffer is immutable from here on
+            await asyncio.get_running_loop().run_in_executor(
+                executor, _apply_checksum_sinks, p.buf, sinks
+            )
         return p
 
     async def write_one(p: _WritePipeline) -> _WritePipeline:

@@ -272,6 +272,52 @@ def _infer_replicated(
     return globs
 
 
+def _crc_key(location: str, byte_range: Any) -> str:
+    br = f"{byte_range[0]}-{byte_range[1]}" if byte_range else ""
+    return f"{location}|{br}"
+
+
+def _collect_local_crcs(local_entries: Dict[str, Entry]) -> Dict[str, int]:
+    """(location|byte_range) → crc32 for every locally-written payload
+    whose checksum sink fired during staging.  Keyed by physical extent
+    (rank-agnostic and unique), so merging needs no knowledge of how
+    consolidation re-keyed the logical paths."""
+    out: Dict[str, int] = {}
+    for e in local_entries.values():
+        crc = getattr(e, "crc32", None)
+        loc = getattr(e, "location", None)
+        if crc is not None and isinstance(loc, str):
+            out[_crc_key(loc, getattr(e, "byte_range", None))] = crc
+        for attr in ("shards", "chunks"):
+            for s in getattr(e, attr, None) or ():
+                if s.crc32 is not None:
+                    out[_crc_key(s.location, s.byte_range)] = s.crc32
+    return out
+
+
+def _merge_crcs(
+    manifest: Dict[str, Entry], crc_maps: Sequence[Dict[str, int]]
+) -> None:
+    """Stamp gathered content checksums onto the manifest in place (the
+    manifest was serialized across ranks BEFORE staging computed them)."""
+    merged: Dict[str, int] = {}
+    for m in crc_maps:
+        merged.update(m or {})
+    if not merged:
+        return
+    for e in manifest.values():
+        loc = getattr(e, "location", None)
+        if isinstance(loc, str) and hasattr(e, "crc32"):
+            crc = merged.get(_crc_key(loc, getattr(e, "byte_range", None)))
+            if crc is not None:
+                e.crc32 = crc
+        for attr in ("shards", "chunks"):
+            for s in getattr(e, attr, None) or ():
+                crc = merged.get(_crc_key(s.location, s.byte_range))
+                if crc is not None:
+                    s.crc32 = crc
+
+
 def _validate_app_state(app_state: Dict[str, Any]) -> None:
     # reference snapshot.py:672-690
     for key, value in app_state.items():
@@ -307,10 +353,21 @@ class Snapshot:
         with log_event(
             Event("take", {"path": path, "rank": coordinator.rank})
         ):
-            metadata, pending_io, storage, commit_uid = cls._take_impl(
-                path, app_state, replicated, coordinator, is_async=False
+            metadata, pending_io, storage, commit_uid, local_entries = (
+                cls._take_impl(
+                    path, app_state, replicated, coordinator, is_async=False
+                )
             )
             pending_io.sync_complete()
+            # content checksums became final when staging finished above;
+            # gather them (foreground path: collectives are fine) and
+            # merge into every rank's metadata copy
+            local_crcs = _collect_local_crcs(local_entries)
+            if coordinator.world_size > 1:
+                crc_maps = coordinator.all_gather_object(local_crcs)
+            else:
+                crc_maps = [local_crcs]
+            _merge_crcs(metadata.manifest, crc_maps)
             # commit: all ranks done writing → rank 0 writes metadata
             # (reference snapshot.py:202-209)
             coordinator.barrier()
@@ -350,8 +407,10 @@ class Snapshot:
         with log_event(
             Event("async_take", {"path": path, "rank": coordinator.rank})
         ):
-            metadata, pending_io, storage, commit_uid = cls._take_impl(
-                path, app_state, replicated, coordinator, is_async=True
+            metadata, pending_io, storage, commit_uid, local_entries = (
+                cls._take_impl(
+                    path, app_state, replicated, coordinator, is_async=True
+                )
             )
         return PendingSnapshot(
             path=path,
@@ -360,6 +419,7 @@ class Snapshot:
             storage=storage,
             coordinator=coordinator,
             commit_uid=commit_uid,
+            local_entries=local_entries,
         )
 
     @classmethod
@@ -370,7 +430,7 @@ class Snapshot:
         replicated: Sequence[str],
         coordinator: Coordinator,
         is_async: bool,
-    ) -> Tuple[SnapshotMetadata, PendingIOWork, Any, str]:
+    ) -> Tuple[SnapshotMetadata, PendingIOWork, Any, str, Dict[str, Entry]]:
         # reference _take_impl, snapshot.py:517-635
         rank, world = coordinator.rank, coordinator.world_size
         _validate_app_state(app_state)
@@ -582,8 +642,13 @@ class Snapshot:
 
         # gather per-rank manifests; every rank can build the global view
         # deterministically (reference _gather_manifest, snapshot.py:948-961)
+        # NOTE: this serializes entry objects BEFORE staging runs, so
+        # checksum sinks (which fire during staging) mutate only the
+        # LOCAL objects below — the commit paths re-gather crc maps
+        # post-staging and merge them into the metadata (_merge_crcs).
+        local_entry_objs = {**manifest, **entries}
         local_manifest_d = {
-            lpath: e.to_dict() for lpath, e in {**manifest, **entries}.items()
+            lpath: e.to_dict() for lpath, e in local_entry_objs.items()
         }
         if world > 1:
             gathered_manifests = coordinator.all_gather_object(local_manifest_d)
@@ -623,7 +688,7 @@ class Snapshot:
             write_reqs, storage, budget, rank,
             wait_for_staging=not unblock_early,
         )
-        return metadata, pending_io, storage, commit_uid
+        return metadata, pending_io, storage, commit_uid, local_entry_objs
 
     # --------------------------------------------------------------- restore
 
@@ -876,6 +941,7 @@ class PendingSnapshot:
         storage: Any,
         coordinator: Coordinator,
         commit_uid: str,
+        local_entries: Optional[Dict[str, Entry]] = None,
     ) -> None:
         self.path = path
         self._metadata = metadata
@@ -883,6 +949,7 @@ class PendingSnapshot:
         self._storage = storage
         self._coordinator = coordinator
         self._commit_uid = commit_uid
+        self._local_entries = local_entries or {}
         self._exc: Optional[BaseException] = None
         self._snapshot: Optional[Snapshot] = None
         self._thread = threading.Thread(
@@ -903,6 +970,23 @@ class PendingSnapshot:
             self._exc = e
             status = f"err:{e!r}"
         try:
+            # content checksums finalized during background staging ride
+            # the KV channel (collectives are forbidden here); set BEFORE
+            # arrive so rank 0's post-arrival read always finds them
+            import json as _json
+
+            if status == "ok":
+                try:
+                    coord.kv_set(
+                        f"{uid}/crcs/{rank}",
+                        _json.dumps(
+                            _collect_local_crcs(self._local_entries)
+                        ),
+                    )
+                except Exception:  # noqa: BLE001 — checksums best-effort
+                    coord.kv_set(f"{uid}/crcs/{rank}", "{}")
+            else:
+                coord.kv_set(f"{uid}/crcs/{rank}", "{}")
             coord.kv_set(f"{uid}/arrive/{rank}", status)
             if rank == 0:
                 # ALWAYS set the depart key, even if the metadata write
@@ -914,6 +998,21 @@ class PendingSnapshot:
                     ]
                     failed = [s for s in statuses if s != "ok"]
                     if not failed:
+                        try:
+                            _merge_crcs(
+                                self._metadata.manifest,
+                                [
+                                    _json.loads(
+                                        coord.kv_get(f"{uid}/crcs/{r}")
+                                    )
+                                    for r in range(world)
+                                ],
+                            )
+                        except Exception:  # noqa: BLE001 — best-effort
+                            logger.warning(
+                                "crc merge failed; committing without "
+                                "checksums", exc_info=True,
+                            )
                         self._storage.sync_write(
                             WriteIO(
                                 path=SNAPSHOT_METADATA_FNAME,
@@ -956,7 +1055,14 @@ class PendingSnapshot:
             raise self._exc
         if self._snapshot is None:
             self._snapshot = Snapshot(self.path, self._coordinator)
-            self._snapshot._metadata_cache = self._metadata
+            if self._coordinator.rank == 0:
+                # rank 0's commit thread merged the gathered checksums
+                # into this manifest before writing it
+                self._snapshot._metadata_cache = self._metadata
+            # other ranks lazy-load the COMMITTED metadata: their local
+            # copy never saw the crc merge, and a handle whose manifest
+            # silently lacks checksums would make verify(deep=True) skip
+            # every content check
         return self._snapshot
 
     def done(self) -> bool:

@@ -48,10 +48,15 @@ class VerifyResult:
     unreadable: List[Tuple[str, str]] = field(
         default_factory=list
     )  # (logical_path, error)
+    corrupt: List[Tuple[str, int, int]] = field(
+        default_factory=list
+    )  # (location, recorded_crc32, actual_crc32) — deep mode only
 
     @property
     def ok(self) -> bool:
-        return not (self.missing or self.truncated or self.unreadable)
+        return not (
+            self.missing or self.truncated or self.unreadable or self.corrupt
+        )
 
     def raise_if_failed(self) -> None:
         if not self.ok:
@@ -70,6 +75,8 @@ class VerifyResult:
             parts.append(f"truncated={self.truncated[:5]}")
         if self.unreadable:
             parts.append(f"unreadable={self.unreadable[:5]}")
+        if self.corrupt:
+            parts.append(f"corrupt={self.corrupt[:5]}")
         return "FAILED " + ", ".join(parts)
 
 
@@ -150,6 +157,140 @@ def _stat_all(storage: Any, locations: List[str]):
     return run_in_fresh_loop(gather())
 
 
+def _crc_targets(
+    manifest: Dict[str, Entry]
+) -> List[Tuple[str, Optional[List[int]], int]]:
+    """(location, byte_range, recorded_crc32) for every payload the
+    manifest carries a content checksum for (knobs WRITE_CHECKSUMS)."""
+    targets = []
+    seen = set()
+    for entry in manifest.values():
+        loc = getattr(entry, "location", None)
+        crc = getattr(entry, "crc32", None)
+        if isinstance(loc, str) and crc is not None:
+            key = (loc, tuple(getattr(entry, "byte_range", None) or ()))
+            if key not in seen:
+                seen.add(key)
+                targets.append(
+                    (loc, getattr(entry, "byte_range", None), crc)
+                )
+        for attr in ("shards", "chunks"):
+            for s in getattr(entry, attr, None) or ():
+                if s.crc32 is None:
+                    continue
+                key = (s.location, tuple(s.byte_range or ()))
+                if key not in seen:
+                    seen.add(key)
+                    targets.append((s.location, s.byte_range, s.crc32))
+    return targets
+
+
+def _check_crcs(
+    storage: Any,
+    manifest: Dict[str, Entry],
+    result: VerifyResult,
+    extents: Dict[str, int],
+) -> set:
+    """Deep mode: re-read every checksummed payload and compare crc32
+    (catches bit rot / torn or overwritten content that sizes and parse
+    checks can miss).  Returns the set of ``(location, byte_range)``
+    keys that VERIFIED — entries fully covered by verified checksums
+    skip the parse pass (their bytes are exactly what the serializer
+    wrote, so re-reading them to parse would double the audit's I/O).
+
+    Reads are admitted under the process staging budget (each task
+    buffers its whole payload; 16 concurrent 128MB slabs would otherwise
+    spike multi-GB on a small audit VM)."""
+    import asyncio
+    import zlib
+
+    from .io_types import ReadIO
+    from .utils.asyncio_utils import run_in_fresh_loop
+
+    targets = _crc_targets(manifest)
+    if not targets:
+        return set()
+    budget_cap = get_process_memory_budget_bytes()
+
+    def size_of(loc, byte_range):
+        if byte_range:
+            return int(byte_range[1]) - int(byte_range[0])
+        return extents.get(loc, 0)
+
+    async def gather():
+        sem = asyncio.Semaphore(_STAT_CONCURRENCY)
+        in_use = 0
+        budget_free = asyncio.Condition()
+
+        async def one(loc, byte_range, crc):
+            nonlocal in_use
+            nbytes = size_of(loc, byte_range)
+            async with budget_free:
+                # admit under budget; an oversized payload is admitted
+                # alone (same progress rule as the write scheduler)
+                await budget_free.wait_for(
+                    lambda: in_use == 0 or in_use + nbytes <= budget_cap
+                )
+                in_use += nbytes
+            try:
+                async with sem:
+                    read_io = ReadIO(
+                        path=loc,
+                        byte_range=(
+                            list(byte_range) if byte_range else None
+                        ),
+                    )
+                    await storage.read(read_io)
+                    actual = (
+                        zlib.crc32(memoryview(read_io.buf).cast("B"))
+                        & 0xFFFFFFFF
+                    )
+                    return loc, byte_range, crc, actual, None
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                return loc, byte_range, crc, None, e
+            finally:
+                async with budget_free:
+                    in_use -= nbytes
+                    budget_free.notify_all()
+
+        return await asyncio.gather(
+            *(one(*target) for target in targets)
+        )
+
+    verified = set()
+    for loc, byte_range, crc, actual, err in run_in_fresh_loop(gather()):
+        if err is not None:
+            # existence/size problems are already reported by the stat
+            # pass; don't double-report missing objects here
+            if not isinstance(err, FileNotFoundError):
+                result.unreadable.append((loc, f"crc read: {err!r}"))
+        elif actual != crc:
+            result.corrupt.append((loc, crc, actual))
+        else:
+            verified.add((loc, tuple(byte_range or ())))
+    return verified
+
+
+def _fully_crc_verified(entry: Entry, verified: set) -> bool:
+    """True iff the entry has ≥1 payload and EVERY payload's
+    (location, byte_range) verified against a recorded checksum."""
+    n = 0
+    loc = getattr(entry, "location", None)
+    if isinstance(loc, str):
+        n += 1
+        key = (loc, tuple(getattr(entry, "byte_range", None) or ()))
+        if key not in verified:
+            return False
+    for attr in ("shards", "chunks"):
+        for s in getattr(entry, attr, None) or ():
+            n += 1
+            if (s.location, tuple(s.byte_range or ())) not in verified:
+                return False
+    return n > 0
+
+
 def verify_snapshot(
     snapshot: Any, deep: bool = False, rank: Optional[int] = None
 ) -> VerifyResult:
@@ -175,6 +316,10 @@ def verify_snapshot(
                 if outcome < expected:
                     result.truncated.append((location, expected, outcome))
 
+        crc_verified: set = set()
+        if deep:
+            crc_verified = _check_crcs(storage, manifest, result, extents)
+
         for lpath, entry in sorted(manifest.items()):
             if is_container_entry(entry):
                 continue
@@ -186,6 +331,11 @@ def verify_snapshot(
                     result.unreadable.append((lpath, repr(e)))
                 continue
             if not deep:
+                continue
+            if _fully_crc_verified(entry, crc_verified):
+                # every payload byte matched the checksum recorded when
+                # the serializer produced it — a parse re-read would
+                # double the I/O to re-learn the same thing
                 continue
             try:
                 read_reqs, fut = prepare_read(entry, obj_out=None)

@@ -196,11 +196,26 @@ class _MergedRangeConsumer(BufferConsumer):
     async def consume_buffer(
         self, buf: Any, executor: Optional[Executor] = None
     ) -> None:
+        import asyncio
+
+        from .io_types import check_read_crc
+
         view = memoryview(buf).cast("B")
+        verify = knobs.verify_on_restore()
         for req, start, end in self.subs:
-            await req.buffer_consumer.consume_buffer(
-                view[start - self.base : end - self.base], executor
-            )
+            piece = view[start - self.base : end - self.base]
+            if req.expected_crc32 is not None and verify:
+                # the merged spanning read bypassed the scheduler's
+                # whole-request check; each member still verifies its
+                # own slice (off-loop: tens of MB per member would
+                # stall every concurrent read pipeline)
+                if executor is not None:
+                    await asyncio.get_running_loop().run_in_executor(
+                        executor, check_read_crc, req, piece
+                    )
+                else:
+                    check_read_crc(req, piece)
+            await req.buffer_consumer.consume_buffer(piece, executor)
 
     def get_consuming_cost_bytes(self) -> int:
         # the spanning buffer is what actually occupies host memory

@@ -89,11 +89,31 @@ class WriteReq:
     ] = None
 
 
+def check_read_crc(read_req: "ReadReq", buf: Any) -> None:
+    """VERIFY_ON_RESTORE: fail loudly when a whole-payload read doesn't
+    match its manifest-recorded checksum (shared by the scheduler's
+    request-level check and the batcher's per-member slice check)."""
+    import zlib
+
+    expected = read_req.expected_crc32
+    actual = zlib.crc32(memoryview(buf).cast("B")) & 0xFFFFFFFF
+    if actual != expected:
+        raise RuntimeError(
+            f"checksum mismatch reading {read_req.path!r} "
+            f"(range {read_req.byte_range}): recorded crc32={expected}, "
+            f"read crc32={actual} — the payload changed after commit"
+        )
+
+
 @dataclass
 class ReadReq:
     path: str
     buffer_consumer: BufferConsumer
     byte_range: Optional[List[int]] = None  # [start, end)
+    # manifest-recorded crc32 when this read covers a payload exactly
+    # (whole entry/shard/chunk — never a tile); checked before consume
+    # when knobs VERIFY_ON_RESTORE is on
+    expected_crc32: Optional[int] = None
 
 
 @dataclass

@@ -30,6 +30,7 @@ _PALLAS_ATTENTION = "PALLAS_ATTENTION"
 _REPLICATION_VERIFY = "REPLICATION_VERIFY"
 _SERIALIZE_TRANSFERS = "SERIALIZE_TRANSFERS"
 _WRITE_CHECKSUMS = "WRITE_CHECKSUMS"
+_VERIFY_ON_RESTORE = "VERIFY_ON_RESTORE"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -91,6 +92,11 @@ _DEFAULTS = {
     # torn writes that byte sizes can't).  Runs in the staging thread
     # pool off the blocked path; ~2-3 GB/s per thread.
     _WRITE_CHECKSUMS: 1,
+    # Check recorded checksums during restore reads (whole-payload reads
+    # only; tiled reads are skipped).  Off by default: restore is the
+    # latency-critical path, and Snapshot.verify(deep=True) exists for
+    # audits — flip on for untrusted/long-archived snapshots.
+    _VERIFY_ON_RESTORE: 0,
 }
 
 _OVERRIDES: dict = {}
@@ -173,6 +179,10 @@ def write_checksums_enabled() -> bool:
     return bool(int(_get_raw(_WRITE_CHECKSUMS)))
 
 
+def verify_on_restore() -> bool:
+    return bool(int(_get_raw(_VERIFY_ON_RESTORE)))
+
+
 def serialize_transfers() -> bool:
     v = str(_get_raw(_SERIALIZE_TRANSFERS)).lower()
     if v in ("1", "true", "on"):
@@ -251,6 +261,10 @@ def override_serialize_transfers(value):
 
 def override_write_checksums(value: bool):
     return _override(_WRITE_CHECKSUMS, int(value))
+
+
+def override_verify_on_restore(value: bool):
+    return _override(_VERIFY_ON_RESTORE, int(value))
 
 
 def override_staging_threads(value: int):

@@ -429,6 +429,7 @@ class ArrayIOPreparer:
                     path=entry.location,
                     byte_range=list(entry.byte_range) if entry.byte_range else None,
                     buffer_consumer=ArrayBufferConsumer(entry, obj_out, fut),
+                    expected_crc32=getattr(entry, "crc32", None),
                 )
             ],
             fut,
@@ -535,6 +536,7 @@ class ChunkedArrayIOPreparer:
                         dtype=entry.dtype,
                         countdown=countdown,
                     ),
+                    expected_crc32=chunk.crc32,
                 )
             )
         return read_reqs, fut

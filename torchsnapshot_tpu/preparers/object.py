@@ -79,6 +79,7 @@ class ObjectIOPreparer:
                 ReadReq(
                     path=entry.location,
                     buffer_consumer=ObjectBufferConsumer(entry, fut),
+                    expected_crc32=getattr(entry, "crc32", None),
                 )
             ],
             fut,

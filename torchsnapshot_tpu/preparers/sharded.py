@@ -298,6 +298,7 @@ class ShardedArrayIOPreparer:
         countdown = _Countdown(n=len(plans), on_zero=assemble)
         read_reqs: List[ReadReq] = []
         for shard, sbox, overlaps in plans:
+            expected_crc: Optional[int] = None
             # Minimal fetch: if every overlap is a dim-0 slab of the saved
             # blob, fetch just the covering row range.
             if all(is_dim0_slab(ov, sbox) for ov, _ in overlaps) and sbox[1]:
@@ -314,9 +315,16 @@ class ShardedArrayIOPreparer:
                 read_sizes = list(sbox[1])
                 read_sizes[0] = r1 - r0
                 read_box = make_box(read_offsets, read_sizes)
+                if r0 == 0 and r1 == sbox[1][0]:
+                    # the covering row range IS the whole shard payload:
+                    # its recorded checksum applies
+                    expected_crc = shard.crc32
             else:
                 byte_range = list(shard.byte_range) if shard.byte_range else None
                 read_box = sbox
+                # this branch reads the WHOLE shard payload: its recorded
+                # checksum applies (partial row-range reads above don't)
+                expected_crc = shard.crc32
             read_reqs.append(
                 ReadReq(
                     path=shard.location,
@@ -328,6 +336,7 @@ class ShardedArrayIOPreparer:
                         buffers=buffers,
                         countdown=countdown,
                     ),
+                    expected_crc32=expected_crc,
                 )
             )
         return read_reqs, fut

@@ -42,7 +42,14 @@ from typing import Awaitable, Callable, List, Optional
 import psutil
 
 from . import knobs
-from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+from .io_types import (
+    ReadIO,
+    ReadReq,
+    StoragePlugin,
+    WriteIO,
+    WriteReq,
+    check_read_crc,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -383,6 +390,13 @@ async def _execute_read_pipelines(
         return p
 
     async def consume_one(p: _ReadPipeline) -> _ReadPipeline:
+        if (
+            p.read_req.expected_crc32 is not None
+            and knobs.verify_on_restore()
+        ):
+            await asyncio.get_running_loop().run_in_executor(
+                executor, check_read_crc, p.read_req, p.buf
+            )
         await p.read_req.buffer_consumer.consume_buffer(p.buf, executor)
         p.buf = None
         return p

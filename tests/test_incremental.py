@@ -1,0 +1,257 @@
+"""Incremental takes: content-addressed dedup against a base snapshot.
+
+Staged objects whose whole-object crc32 matches the base snapshot's
+object at the same location are hardlinked (fs) / copied server-side
+(cloud) instead of rewritten; each snapshot owns its objects, so
+deleting either never corrupts the other.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import (
+    Snapshot,
+    SnapshotManager,
+    StateDict,
+    delete_snapshot,
+    knobs,
+)
+
+
+def _inode(p):
+    return os.stat(p).st_ino
+
+
+def test_incremental_take_hardlinks_unchanged_objects(tmp_path):
+    frozen = np.arange(4096, dtype=np.float64)
+    hot = np.zeros(4096, dtype=np.float32)
+    with knobs.override_disable_batching(True):
+        s1 = Snapshot.take(
+            str(tmp_path / "s1"),
+            {"app": StateDict(frozen=frozen, hot=hot)},
+        )
+        s2 = Snapshot.take(
+            str(tmp_path / "s2"),
+            {"app": StateDict(frozen=frozen, hot=hot + 1.0)},
+            base=str(tmp_path / "s1"),
+        )
+    man1, man2 = s1.get_manifest(), s2.get_manifest()
+    loc_frozen = man2["0/app/frozen"].location
+    loc_hot = man2["0/app/hot"].location
+    # unchanged object is the SAME inode (hardlink), changed one is new
+    assert _inode(tmp_path / "s2" / loc_frozen) == _inode(
+        tmp_path / "s1" / man1["0/app/frozen"].location
+    )
+    assert _inode(tmp_path / "s2" / loc_hot) != _inode(
+        tmp_path / "s1" / man1["0/app/hot"].location
+    )
+    # both snapshots restore correctly and pass a deep audit
+    for snap, hot_want in ((s1, hot), (s2, hot + 1.0)):
+        dest = StateDict(
+            frozen=np.zeros_like(frozen), hot=np.zeros_like(hot)
+        )
+        snap.restore({"app": dest})
+        assert np.array_equal(dest["frozen"], frozen)
+        assert np.array_equal(dest["hot"], hot_want)
+        assert snap.verify(deep=True).ok
+
+
+def test_incremental_survives_base_deletion(tmp_path):
+    arr = np.arange(8192, dtype=np.float32)
+    with knobs.override_disable_batching(True):
+        Snapshot.take(str(tmp_path / "s1"), {"app": StateDict(w=arr)})
+        s2 = Snapshot.take(
+            str(tmp_path / "s2"),
+            {"app": StateDict(w=arr)},
+            base=str(tmp_path / "s1"),
+        )
+    delete_snapshot(str(tmp_path / "s1"))
+    assert not os.path.exists(tmp_path / "s1")
+    dest = StateDict(w=np.zeros_like(arr))
+    Snapshot(str(tmp_path / "s2")).restore({"app": dest})
+    assert np.array_equal(dest["w"], arr)
+    assert s2.verify(deep=True).ok
+
+
+def test_incremental_batched_slab_dedup(tmp_path):
+    """Identical member sets produce identical slabs — the whole slab
+    dedups in one link."""
+    state = {
+        "app": StateDict(
+            a=np.arange(512, dtype=np.float32),
+            b=np.ones(256, dtype=np.float64),
+        )
+    }
+    Snapshot.take(str(tmp_path / "s1"), state)
+    s2 = Snapshot.take(
+        str(tmp_path / "s2"), state, base=str(tmp_path / "s1")
+    )
+    slab = next(
+        e.location
+        for e in s2.get_manifest().values()
+        if getattr(e, "location", "").endswith("batched.0")
+    )
+    assert _inode(tmp_path / "s2" / slab) == _inode(tmp_path / "s1" / slab)
+    assert s2.verify(deep=True).ok
+
+
+def test_incremental_objects_table_in_metadata(tmp_path):
+    s1 = Snapshot.take(
+        str(tmp_path / "s1"), {"app": StateDict(w=np.ones(64))}
+    )
+    # objects table present in COMMITTED metadata (fresh handle)
+    md = Snapshot(str(tmp_path / "s1")).metadata
+    assert md.objects, md.objects
+    # chained increments: s3 links against s2 which linked against s1
+    s2 = Snapshot.take(
+        str(tmp_path / "s2"), {"app": StateDict(w=np.ones(64))},
+        base=str(tmp_path / "s1"),
+    )
+    assert Snapshot(str(tmp_path / "s2")).metadata.objects
+    s3 = Snapshot.take(
+        str(tmp_path / "s3"), {"app": StateDict(w=np.ones(64))},
+        base=str(tmp_path / "s2"),
+    )
+    loc = next(iter(s3.metadata.objects))
+    assert _inode(tmp_path / "s3" / loc) == _inode(tmp_path / "s1" / loc)
+
+
+def test_incremental_without_checksums_degrades(tmp_path):
+    arr = np.ones(128)
+    with knobs.override_write_checksums(False):
+        Snapshot.take(str(tmp_path / "s1"), {"app": StateDict(w=arr)})
+        s2 = Snapshot.take(
+            str(tmp_path / "s2"), {"app": StateDict(w=arr)},
+            base=str(tmp_path / "s1"),
+        )
+    dest = StateDict(w=np.zeros_like(arr))
+    s2.restore({"app": dest})
+    assert np.array_equal(dest["w"], arr)
+
+
+def test_incremental_bogus_base_degrades(tmp_path):
+    arr = np.ones(128)
+    snap = Snapshot.take(
+        str(tmp_path / "s"), {"app": StateDict(w=arr)},
+        base=str(tmp_path / "no_such_snapshot"),
+    )
+    dest = StateDict(w=np.zeros_like(arr))
+    snap.restore({"app": dest})
+    assert np.array_equal(dest["w"], arr)
+
+
+def test_manager_incremental_save(tmp_path):
+    mgr = SnapshotManager(str(tmp_path))
+    frozen = np.arange(2048, dtype=np.float64)
+    with knobs.override_disable_batching(True):
+        mgr.save({"app": StateDict(emb=frozen, step=1)}, step=1)
+        mgr.save(
+            {"app": StateDict(emb=frozen, step=2)},
+            step=2,
+            incremental=True,
+        )
+    man2 = mgr.snapshot(2).get_manifest()
+    loc = man2["0/app/emb"].location
+    assert _inode(mgr.path_for_step(2) + "/" + loc) == _inode(
+        mgr.path_for_step(1) + "/" + loc
+    )
+    dest = StateDict(emb=np.zeros_like(frozen), step=0)
+    assert mgr.restore_latest({"app": dest}) == 2
+    assert dest["step"] == 2
+    assert np.array_equal(dest["emb"], frozen)
+
+
+def test_memory_plugin_link_from():
+    from torchsnapshot_tpu.io_types import WriteIO
+    from torchsnapshot_tpu.storage import url_to_storage_plugin
+
+    src = url_to_storage_plugin("memory://lnk_src")
+    dst = url_to_storage_plugin("memory://lnk_dst")
+    src.sync_write(WriteIO(path="x", buf=b"hello"))
+    import asyncio
+
+    from torchsnapshot_tpu.utils.asyncio_utils import run_in_fresh_loop
+
+    run_in_fresh_loop(dst.link_from("memory://lnk_src", "x"))
+    assert dst.sync_stat("x") == 5
+    with pytest.raises(FileNotFoundError):
+        run_in_fresh_loop(dst.link_from("memory://lnk_src", "nope"))
+
+
+def test_fs_write_breaks_hardlink(tmp_path):
+    """Regression: re-writing a snapshot path must break dedup hardlinks
+    — an in-place truncate would rewrite the inode another snapshot's
+    metadata still describes."""
+    arr = np.arange(1024, dtype=np.float32)
+    with knobs.override_disable_batching(True):
+        s1 = Snapshot.take(str(tmp_path / "s1"), {"app": StateDict(w=arr)})
+        Snapshot.take(
+            str(tmp_path / "s2"), {"app": StateDict(w=arr)},
+            base=str(tmp_path / "s1"),
+        )
+        # re-take s1 IN PLACE with different content
+        Snapshot.take(
+            str(tmp_path / "s1"), {"app": StateDict(w=arr * 2.0)}
+        )
+    # s2 still holds the ORIGINAL bytes and verifies
+    dest = StateDict(w=np.zeros_like(arr))
+    Snapshot(str(tmp_path / "s2")).restore({"app": dest})
+    assert np.array_equal(dest["w"], arr)
+    assert Snapshot(str(tmp_path / "s2")).verify(deep=True).ok
+    # and the re-taken s1 holds the new bytes
+    dest1 = StateDict(w=np.zeros_like(arr))
+    Snapshot(str(tmp_path / "s1")).restore({"app": dest1})
+    assert np.array_equal(dest1["w"], arr * 2.0)
+
+
+def test_incremental_self_base_is_safe(tmp_path):
+    """base == target path must not self-link (the fs fallback's
+    unlink-before-link would destroy the only copy)."""
+    arr = np.ones(256)
+    Snapshot.take(str(tmp_path / "s"), {"app": StateDict(w=arr)})
+    snap = Snapshot.take(
+        str(tmp_path / "s"), {"app": StateDict(w=arr)},
+        base=str(tmp_path / "s"),
+    )
+    dest = StateDict(w=np.zeros_like(arr))
+    snap.restore({"app": dest})
+    assert np.array_equal(dest["w"], arr)
+
+
+def test_manager_incremental_resave_latest_step(tmp_path):
+    mgr = SnapshotManager(str(tmp_path))
+    mgr.save({"app": StateDict(w=np.ones(64))}, step=1)
+    # re-save the SAME latest step incrementally: must not self-corrupt
+    mgr.save({"app": StateDict(w=np.full(64, 2.0))}, step=1, incremental=True)
+    dest = StateDict(w=np.zeros(64))
+    assert mgr.restore_latest({"app": dest}) == 1
+    assert np.array_equal(dest["w"], np.full(64, 2.0))
+
+
+def test_memory_incremental_nested_namespace():
+    from torchsnapshot_tpu.storage.memory import reset_namespace
+
+    for ns in ("inc_root/step_1", "inc_root/step_2"):
+        reset_namespace(ns)
+    arr = np.arange(512, dtype=np.float64)
+    with knobs.override_disable_batching(True):
+        Snapshot.take("memory://inc_root/step_1", {"app": StateDict(w=arr)})
+        s2 = Snapshot.take(
+            "memory://inc_root/step_2", {"app": StateDict(w=arr)},
+            base="memory://inc_root/step_1",
+        )
+    dest = StateDict(w=np.zeros_like(arr))
+    s2.restore({"app": dest})
+    assert np.array_equal(dest["w"], arr)
+
+
+def test_objects_table_digest_shape(tmp_path):
+    snap = Snapshot.take(
+        str(tmp_path / "s"), {"app": StateDict(w=np.ones(64))}
+    )
+    md = Snapshot(str(tmp_path / "s")).metadata
+    for loc, rec in md.objects.items():
+        assert len(rec) == 3, (loc, rec)  # [crc32, adler32, size]
+        assert rec[2] == os.path.getsize(tmp_path / "s" / loc)

@@ -87,6 +87,19 @@ class WriteReq:
     checksum_sinks: Optional[
         List[Tuple[Callable[[int], None], Optional[Tuple[int, int]]]]
     ] = None
+    # incremental takes: (base snapshot url, that base's object digest
+    # for this same location).  When the staged object's digest matches,
+    # the write is replaced by StoragePlugin.link_from (hardlink /
+    # server-side copy) — content-addressed dedup against the previous
+    # checkpoint.  The digest is (crc32, adler32, size): two independent
+    # checksums + exact length, so one 32-bit collision can't silently
+    # link stale content.
+    dedup: Optional[Tuple[str, Tuple[int, int, int]]] = None
+    # receives the staged object's (crc32, adler32, size) at staging
+    # time when WRITE_CHECKSUMS is on
+    digest_sink: Optional[Callable[[List[int]], None]] = None
+    # filled via digest_sink; consumed by the dedup check
+    object_digest: Optional[Tuple[int, int, int]] = None
 
 
 def check_read_crc(read_req: "ReadReq", buf: Any) -> None:
@@ -159,6 +172,16 @@ class StoragePlugin(abc.ABC):
         read_io = ReadIO(path=path)
         await self.read(read_io)
         return len(read_io.buf)
+
+    async def link_from(self, base_url: str, path: str) -> None:
+        """Make ``path`` under this plugin's root hold the same content
+        as ``path`` under ``base_url``, WITHOUT moving the bytes through
+        this host when the backend can avoid it (fs: hardlink; object
+        stores: server-side copy).  Each snapshot must own the resulting
+        object — deleting the base must not affect it.  Raising
+        NotImplementedError makes the caller fall back to a normal
+        write."""
+        raise NotImplementedError
 
     async def close(self) -> None:
         pass

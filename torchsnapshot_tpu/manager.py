@@ -287,12 +287,25 @@ class SnapshotManager:
         step: int,
         replicated: Sequence[str] = (),
         async_: bool = False,
+        incremental: bool = False,
     ) -> Union[Snapshot, "_ManagedPendingSnapshot"]:
+        """``incremental=True`` dedups against the newest committed step:
+        objects whose content checksum is unchanged are hardlinked /
+        server-side-copied instead of rewritten (Snapshot.take(base=)).
+        Cold start (no committed step) degrades to a full save."""
         path = self.path_for_step(step)
+        base: Optional[str] = None
+        if incremental:
+            prev = self._coord.broadcast_object(
+                self.latest_step() if self._coord.rank == 0 else None,
+                src=0,
+            )
+            if prev is not None:
+                base = self.path_for_step(prev)
         if async_:
             pending = Snapshot.async_take(
                 path, app_state, replicated=replicated,
-                coordinator=self._coordinator,
+                coordinator=self._coordinator, base=base,
             )
             # index/retention must not run from the commit thread (it
             # would race a training-loop save() on the index): they run
@@ -303,7 +316,7 @@ class SnapshotManager:
             return _ManagedPendingSnapshot(pending, self, step)
         snap = Snapshot.take(
             path, app_state, replicated=replicated,
-            coordinator=self._coordinator,
+            coordinator=self._coordinator, base=base,
         )
         self._after_commit(step)
         return snap

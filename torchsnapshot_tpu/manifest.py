@@ -381,16 +381,23 @@ class SnapshotMetadata:
     version: str
     world_size: int
     manifest: Manifest = field(default_factory=dict)
+    # location → [crc32, adler32, size] of the whole stored object
+    # (slabs included); written when WRITE_CHECKSUMS is on.  This is
+    # what incremental takes compare against: a staged object whose
+    # digest matches the base snapshot's object at the same location is
+    # linked, not rewritten.  Two independent checksums + exact length
+    # so one 32-bit collision can't silently dedup changed content.
+    objects: Dict[str, List[int]] = field(default_factory=dict)
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "version": self.version,
-                "world_size": self.world_size,
-                "manifest": {k: v.to_dict() for k, v in self.manifest.items()},
-            },
-            sort_keys=True,
-        )
+        d = {
+            "version": self.version,
+            "world_size": self.world_size,
+            "manifest": {k: v.to_dict() for k, v in self.manifest.items()},
+        }
+        if self.objects:
+            d["objects"] = self.objects
+        return json.dumps(d, sort_keys=True)
 
     # JSON is a YAML subset; emit JSON for speed, accept YAML on read
     # (reference manifest.py:442-475).
@@ -410,7 +417,13 @@ class SnapshotMetadata:
             d = yaml.load(s, Loader=loader)
         manifest = {k: entry_from_dict(v) for k, v in d["manifest"].items()}
         return cls(
-            version=d["version"], world_size=int(d["world_size"]), manifest=manifest
+            version=d["version"],
+            world_size=int(d["world_size"]),
+            manifest=manifest,
+            objects={
+                k: ([int(x) for x in v] if isinstance(v, list) else [int(v)])
+                for k, v in (d.get("objects") or {}).items()
+            },
         )
 
     from_json = from_yaml

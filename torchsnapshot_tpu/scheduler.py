@@ -57,15 +57,24 @@ _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
 
 
-def _apply_checksum_sinks(buf, sinks) -> None:
+def _apply_checksum_sinks(buf, sinks, digest_sink=None) -> None:
     """Feed each sink the crc32 of its byte range of the staged buffer
-    (WriteReq.checksum_sinks contract, io_types.py)."""
+    (WriteReq.checksum_sinks contract, io_types.py); ``digest_sink``
+    additionally receives the whole object's (crc32, adler32, size)."""
     import zlib
 
     view = memoryview(buf).cast("B")
-    for sink, rng in sinks:
+    for sink, rng in sinks or ():
         piece = view if rng is None else view[rng[0] : rng[1]]
         sink(zlib.crc32(piece) & 0xFFFFFFFF)
+    if digest_sink is not None:
+        digest_sink(
+            [
+                zlib.crc32(view) & 0xFFFFFFFF,
+                zlib.adler32(view) & 0xFFFFFFFF,
+                view.nbytes,
+            ]
+        )
 
 
 def get_process_memory_budget_bytes(local_process_count: int = 1) -> int:
@@ -120,13 +129,14 @@ class _WritePipeline:
     """One write request's journey through the pipeline (reference
     scheduler.py:70-97)."""
 
-    __slots__ = ("write_req", "staging_cost", "buf", "buf_size")
+    __slots__ = ("write_req", "staging_cost", "buf", "buf_size", "deduped")
 
     def __init__(self, write_req: WriteReq) -> None:
         self.write_req = write_req
         self.staging_cost = write_req.buffer_stager.get_staging_cost_bytes()
         self.buf = None
         self.buf_size = 0
+        self.deduped = False
 
 
 class PendingIOWork:
@@ -229,18 +239,43 @@ async def _execute_write_pipelines(
     async def stage_one(p: _WritePipeline) -> _WritePipeline:
         p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
         p.buf_size = len(memoryview(p.buf).cast("B")) if p.buf is not None else 0
-        sinks = p.write_req.checksum_sinks
-        if sinks and knobs.write_checksums_enabled():
+        wr = p.write_req
+        if (wr.checksum_sinks or wr.digest_sink) and (
+            knobs.write_checksums_enabled()
+        ):
             # content checksums into the manifest (entries are serialized
             # at commit, strictly after staging completes) — off-loop,
             # the staged buffer is immutable from here on
             await asyncio.get_running_loop().run_in_executor(
-                executor, _apply_checksum_sinks, p.buf, sinks
+                executor,
+                _apply_checksum_sinks,
+                p.buf,
+                wr.checksum_sinks,
+                wr.digest_sink,
             )
         return p
 
     async def write_one(p: _WritePipeline) -> _WritePipeline:
-        await storage.write(WriteIO(path=p.write_req.path, buf=p.buf))
+        wr = p.write_req
+        if wr.dedup is not None and wr.object_digest == wr.dedup[1]:
+            # content unchanged vs the base snapshot: link/server-side
+            # copy instead of moving the bytes again.  Any failure
+            # (plugin without link_from, base object gone, S3's 5GiB
+            # CopyObject cap) degrades to the normal write — dedup is an
+            # optimization, never a correctness dependency.
+            try:
+                await storage.link_from(wr.dedup[0], wr.path)
+                stats["deduped_bytes"] = (
+                    stats.get("deduped_bytes", 0) + p.buf_size
+                )
+                p.deduped = True
+                return p
+            except Exception as e:  # noqa: BLE001
+                logger.info(
+                    "dedup link for %r failed (%r); writing normally",
+                    wr.path, e,
+                )
+        await storage.write(WriteIO(path=wr.path, buf=p.buf))
         return p
 
     def dispatch_staging() -> None:
@@ -292,7 +327,8 @@ async def _execute_write_pipelines(
                 else:
                     io_tasks.discard(task)
                     p = task.result()
-                    stats["bytes_written"] += p.buf_size
+                    if not p.deduped:  # linked objects moved no bytes
+                        stats["bytes_written"] += p.buf_size
                     budget.credit(p.buf_size)
                     p.buf = None
             if not ready_for_staging and not staging_tasks:

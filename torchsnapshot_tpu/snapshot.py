@@ -318,6 +318,27 @@ def _merge_crcs(
                     s.crc32 = crc
 
 
+def _crc_payload(
+    local_entries: Dict[str, Entry], object_crcs: Dict[str, int]
+) -> Dict[str, Any]:
+    """One rank's post-staging checksum contribution: per-payload entry
+    crcs + whole-object crcs (the incremental-dedup table)."""
+    return {
+        "entries": _collect_local_crcs(local_entries),
+        "objects": dict(object_crcs),
+    }
+
+
+def _merge_crc_payloads(
+    metadata: SnapshotMetadata, payloads: Sequence[Dict[str, Any]]
+) -> None:
+    _merge_crcs(
+        metadata.manifest, [p.get("entries") or {} for p in payloads]
+    )
+    for p in payloads:
+        metadata.objects.update(p.get("objects") or {})
+
+
 def _validate_app_state(app_state: Dict[str, Any]) -> None:
     # reference snapshot.py:672-690
     for key, value in app_state.items():
@@ -346,28 +367,40 @@ class Snapshot:
         app_state: AppState,
         replicated: Sequence[str] = (),
         coordinator: Optional[Coordinator] = None,
+        base: Optional[str] = None,
     ) -> "Snapshot":
         """Synchronous distributed save (reference Snapshot.take,
-        snapshot.py:112-228)."""
+        snapshot.py:112-228).
+
+        ``base`` (beyond-parity, incremental takes): path of a previous
+        committed snapshot.  Staged objects whose content checksum
+        matches the base's object at the same location are hardlinked /
+        server-side-copied instead of rewritten — near-free checkpoints
+        of mostly-unchanged state (frozen layers, embeddings, dataloader
+        state).  Requires WRITE_CHECKSUMS on both takes; each snapshot
+        owns its objects, so deleting the base never corrupts this one.
+        """
         coordinator = coordinator or get_default_coordinator()
         with log_event(
             Event("take", {"path": path, "rank": coordinator.rank})
         ):
-            metadata, pending_io, storage, commit_uid, local_entries = (
-                cls._take_impl(
-                    path, app_state, replicated, coordinator, is_async=False
-                )
+            (
+                metadata, pending_io, storage, commit_uid,
+                local_entries, object_crcs,
+            ) = cls._take_impl(
+                path, app_state, replicated, coordinator,
+                is_async=False, base=base,
             )
             pending_io.sync_complete()
             # content checksums became final when staging finished above;
             # gather them (foreground path: collectives are fine) and
             # merge into every rank's metadata copy
-            local_crcs = _collect_local_crcs(local_entries)
+            local_crcs = _crc_payload(local_entries, object_crcs)
             if coordinator.world_size > 1:
                 crc_maps = coordinator.all_gather_object(local_crcs)
             else:
                 crc_maps = [local_crcs]
-            _merge_crcs(metadata.manifest, crc_maps)
+            _merge_crc_payloads(metadata, crc_maps)
             # commit: all ranks done writing → rank 0 writes metadata
             # (reference snapshot.py:202-209)
             coordinator.barrier()
@@ -394,6 +427,7 @@ class Snapshot:
         app_state: AppState,
         replicated: Sequence[str] = (),
         coordinator: Optional[Coordinator] = None,
+        base: Optional[str] = None,
     ) -> "PendingSnapshot":
         """Unblock-early save (reference Snapshot.async_take,
         snapshot.py:229-318).  Returns once the snapshot content is
@@ -407,10 +441,12 @@ class Snapshot:
         with log_event(
             Event("async_take", {"path": path, "rank": coordinator.rank})
         ):
-            metadata, pending_io, storage, commit_uid, local_entries = (
-                cls._take_impl(
-                    path, app_state, replicated, coordinator, is_async=True
-                )
+            (
+                metadata, pending_io, storage, commit_uid,
+                local_entries, object_crcs,
+            ) = cls._take_impl(
+                path, app_state, replicated, coordinator,
+                is_async=True, base=base,
             )
         return PendingSnapshot(
             path=path,
@@ -420,6 +456,7 @@ class Snapshot:
             coordinator=coordinator,
             commit_uid=commit_uid,
             local_entries=local_entries,
+            object_crcs=object_crcs,
         )
 
     @classmethod
@@ -430,7 +467,11 @@ class Snapshot:
         replicated: Sequence[str],
         coordinator: Coordinator,
         is_async: bool,
-    ) -> Tuple[SnapshotMetadata, PendingIOWork, Any, str, Dict[str, Entry]]:
+        base: Optional[str] = None,
+    ) -> Tuple[
+        SnapshotMetadata, PendingIOWork, Any, str,
+        Dict[str, Entry], Dict[str, int],
+    ]:
         # reference _take_impl, snapshot.py:517-635
         rank, world = coordinator.rank, coordinator.world_size
         _validate_app_state(app_state)
@@ -453,7 +494,7 @@ class Snapshot:
         try:
             return cls._take_impl_inner(
                 path, app_state, replicated, coordinator, is_async,
-                rank, world, rng_states_at_entry,
+                rank, world, rng_states_at_entry, base,
             )
         finally:
             for k, v in app_state.items():
@@ -472,7 +513,11 @@ class Snapshot:
         rank: int,
         world: int,
         rng_states_at_entry: Dict[str, Dict[str, Any]],
-    ) -> Tuple[SnapshotMetadata, PendingIOWork, Any, str]:
+        base: Optional[str] = None,
+    ) -> Tuple[
+        SnapshotMetadata, PendingIOWork, Any, str,
+        Dict[str, Entry], Dict[str, int],
+    ]:
 
         # path + replicated coalescing across ranks
         # (reference _coalesce_path_and_replicated, snapshot.py:858-894)
@@ -489,12 +534,26 @@ class Snapshot:
         # it must be rank-agreed (strictest wins) without paying an extra
         # KV round
         local_mode = _safe_replication_verify_mode()
+        local_cksum = knobs.write_checksums_enabled()
         if world > 1:
             gathered = coordinator.all_gather_object(
-                (sorted(set(replicated)), local_mode)
+                (sorted(set(replicated)), local_mode, base, local_cksum)
             )
-            gathered_globs = [g for g, _ in gathered]
-            modes = [m for _, m in gathered]
+            gathered_globs = [g for g, _, _, _ in gathered]
+            modes = [m for _, m, _, _ in gathered]
+            # incremental base + checksum participation must be
+            # rank-agreed: they gate a later broadcast of the base's
+            # object table, and divergent branches would deadlock it.
+            # Rank 0's base wins (like the path); dedup needs checksums
+            # on EVERY rank (each rank stages its own objects).
+            base = gathered[0][2]
+            checksums_all = all(c for _, _, _, c in gathered)
+            if not checksums_all and base is not None:
+                logger.warning(
+                    "rank %d: WRITE_CHECKSUMS off on some rank; "
+                    "incremental dedup disabled for this take", rank,
+                )
+                base = None
             replicated_globs = sorted(
                 set(gathered_globs[0]).intersection(*map(set, gathered_globs[1:]))
             )
@@ -640,6 +699,60 @@ class Snapshot:
             entries, write_reqs = batch_write_requests(entries, write_reqs, rank)
             entries.update(shielded)
 
+        # whole-object digests feed the metadata objects table and the
+        # incremental-dedup decision; attached AFTER batching so slab
+        # objects are covered at their final paths
+        object_crcs: Dict[str, List[int]] = {}
+        if base is not None and base.rstrip("/") == path.rstrip("/"):
+            # self-dedup would link an object onto itself (and the fs
+            # fallback's unlink-before-link would destroy the only copy)
+            logger.warning(
+                "rank %d: incremental base equals the target path %r; "
+                "performing a full save", rank, path,
+            )
+            base = None
+        if knobs.write_checksums_enabled():
+            base_objects: Dict[str, Any] = {}
+            if base is not None:
+                # rank 0 reads the base's object table once and shares it
+                # (every rank GETting a multi-MB metadata object from
+                # cloud storage at the start of each take is a
+                # thundering herd); branch participation is rank-agreed
+                # by the gather above
+                if rank == 0:
+                    try:
+                        base_objects = Snapshot(base).metadata.objects or {}
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning(
+                            "rank 0: incremental base %r unusable (%r); "
+                            "performing a full save", base, e,
+                        )
+                if world > 1:
+                    base_objects = coordinator.broadcast_object(
+                        base_objects, src=0
+                    )
+            for wr in write_reqs:
+                def _object_sink(digest: List[int], wr=wr) -> None:
+                    wr.object_digest = tuple(digest)
+                    object_crcs[wr.path] = list(digest)
+
+                wr.digest_sink = _object_sink
+                base_digest = base_objects.get(wr.path)
+                # dedup compares (crc32, adler32, size) — two independent
+                # checksums + exact length, so a lone crc32 collision
+                # can't silently link stale content
+                if (
+                    base is not None
+                    and isinstance(base_digest, (list, tuple))
+                    and len(base_digest) == 3
+                ):
+                    wr.dedup = (base, tuple(int(x) for x in base_digest))
+        elif base is not None:
+            logger.warning(
+                "rank %d: take(base=...) needs WRITE_CHECKSUMS=1; "
+                "performing a full save", rank,
+            )
+
         # gather per-rank manifests; every rank can build the global view
         # deterministically (reference _gather_manifest, snapshot.py:948-961)
         # NOTE: this serializes entry objects BEFORE staging runs, so
@@ -688,7 +801,10 @@ class Snapshot:
             write_reqs, storage, budget, rank,
             wait_for_staging=not unblock_early,
         )
-        return metadata, pending_io, storage, commit_uid, local_entry_objs
+        return (
+            metadata, pending_io, storage, commit_uid,
+            local_entry_objs, object_crcs,
+        )
 
     # --------------------------------------------------------------- restore
 
@@ -942,6 +1058,7 @@ class PendingSnapshot:
         coordinator: Coordinator,
         commit_uid: str,
         local_entries: Optional[Dict[str, Entry]] = None,
+        object_crcs: Optional[Dict[str, int]] = None,
     ) -> None:
         self.path = path
         self._metadata = metadata
@@ -950,6 +1067,7 @@ class PendingSnapshot:
         self._coordinator = coordinator
         self._commit_uid = commit_uid
         self._local_entries = local_entries or {}
+        self._object_crcs = object_crcs if object_crcs is not None else {}
         self._exc: Optional[BaseException] = None
         self._snapshot: Optional[Snapshot] = None
         self._thread = threading.Thread(
@@ -980,7 +1098,9 @@ class PendingSnapshot:
                     coord.kv_set(
                         f"{uid}/crcs/{rank}",
                         _json.dumps(
-                            _collect_local_crcs(self._local_entries)
+                            _crc_payload(
+                                self._local_entries, self._object_crcs
+                            )
                         ),
                     )
                 except Exception:  # noqa: BLE001 — checksums best-effort
@@ -999,8 +1119,8 @@ class PendingSnapshot:
                     failed = [s for s in statuses if s != "ok"]
                     if not failed:
                         try:
-                            _merge_crcs(
-                                self._metadata.manifest,
+                            _merge_crc_payloads(
+                                self._metadata,
                                 [
                                     _json.loads(
                                         coord.kv_get(f"{uid}/crcs/{r}")

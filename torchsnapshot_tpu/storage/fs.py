@@ -79,6 +79,14 @@ class FSStoragePlugin(StoragePlugin):
     async def write(self, write_io: WriteIO) -> None:
         full = self._full(write_io.path)
         self._ensure_dir(full)
+        # break hardlinks before writing: incremental dedup shares inodes
+        # across snapshots, so truncating in place would rewrite an
+        # object some OTHER snapshot's metadata still describes
+        try:
+            if os.stat(full).st_nlink > 1:
+                os.remove(full)
+        except OSError:
+            pass
         if self._lib is not None:
             await asyncio.get_running_loop().run_in_executor(
                 self._executor,
@@ -193,6 +201,34 @@ class FSStoragePlugin(StoragePlugin):
             import aiofiles.os
 
             await aiofiles.os.remove(full)
+
+    async def link_from(self, base_url: str, path: str) -> None:
+        """Hardlink the base snapshot's object (content-addressed dedup
+        for incremental takes).  Hardlinks give each snapshot its own
+        directory entry to the shared inode: deleting either snapshot
+        leaves the other intact.  Cross-device links fall back to a
+        copy (still no read through Python: shutil.copyfile)."""
+        base_root = base_url.split("://", 1)[-1]
+        src = os.path.join(base_root, path)
+        dst = self._full(path)
+
+        def _link() -> None:
+            self._ensure_dir(dst)
+            try:
+                if os.path.exists(dst):
+                    os.remove(dst)
+                os.link(src, dst)
+            except OSError:
+                import shutil
+
+                shutil.copyfile(src, dst)
+
+        if self._executor is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                self._executor, _link
+            )
+        else:
+            _link()
 
     async def stat(self, path: str) -> int:
         full = self._full(path)

@@ -334,6 +334,27 @@ class GCSStoragePlugin(StoragePlugin):
         )
         read_io.buf = out
 
+    async def link_from(self, base_url: str, path: str) -> None:
+        """Server-side copy from the base snapshot (incremental takes):
+        the bytes never leave GCS, so deduped objects cost one metadata
+        op instead of a full upload over DCN."""
+        base = base_url.split("://", 1)[-1]
+        src_bucket_name, _, src_prefix = base.partition("/")
+        src_name = f"{src_prefix}/{path}" if src_prefix else path
+        dst_name = self._blob_name(path)
+
+        def copy() -> None:
+            src_bucket = (
+                self._bucket
+                if src_bucket_name == self._bucket.name
+                else self._client.bucket(src_bucket_name)
+            )
+            src_bucket.copy_blob(
+                src_bucket.blob(src_name), self._bucket, dst_name
+            )
+
+        await self._with_retry(copy, f"read {src_name} (copy)")
+
     async def stat(self, path: str) -> int:
         blob_name = self._blob_name(path)
 

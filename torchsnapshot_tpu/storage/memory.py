@@ -43,6 +43,17 @@ class MemoryStoragePlugin(StoragePlugin):
             start, end = read_io.byte_range
             read_io.buf = data[start:end]
 
+    async def link_from(self, base_url: str, path: str) -> None:
+        # the namespace is the WHOLE path after the scheme (nested
+        # memory:// URLs like memory://root/step_1 are one namespace)
+        base_ns = base_url.split("://", 1)[-1]
+        with _LOCK:
+            src_store = _NAMESPACES.setdefault(base_ns, {})
+        try:
+            self._store[path] = src_store[path]  # bytes are immutable
+        except KeyError:
+            raise FileNotFoundError(f"{base_url}/{path}") from None
+
     async def stat(self, path: str) -> int:
         try:
             return len(self._store[path])

@@ -104,6 +104,28 @@ class S3StoragePlugin(StoragePlugin):
                 raise
             read_io.buf = await self._run(resp["Body"].read)
 
+    async def link_from(self, base_url: str, path: str) -> None:
+        base = base_url.split("://", 1)[-1]
+        src_bucket, _, src_prefix = base.partition("/")
+        src_key = f"{src_prefix}/{path}" if src_prefix else path
+        if self._is_fs:
+            await self._run(
+                functools.partial(
+                    self._backend.copy,
+                    f"{src_bucket}/{src_key}",
+                    f"{self.bucket}/{self._key(path)}",
+                )
+            )
+        else:
+            await self._run(
+                functools.partial(
+                    self._backend.copy_object,
+                    Bucket=self.bucket,
+                    Key=self._key(path),
+                    CopySource={"Bucket": src_bucket, "Key": src_key},
+                )
+            )
+
     async def stat(self, path: str) -> int:
         key = self._key(path)
         try:

@@ -305,6 +305,13 @@ def verify_snapshot(
     storage = url_to_storage_plugin(snapshot.path)
     try:
         extents = _expected_extents(manifest)
+        # the objects table (WRITE_CHECKSUMS takes) records exact sizes —
+        # a stricter bound than the entry-derived minimum extents
+        exact_sizes = {
+            loc: rec[2]
+            for loc, rec in (snapshot.metadata.objects or {}).items()
+            if isinstance(rec, (list, tuple)) and len(rec) == 3
+        }
         for location, outcome in _stat_all(storage, sorted(extents)):
             expected = extents[location]
             if isinstance(outcome, FileNotFoundError):
@@ -313,7 +320,10 @@ def verify_snapshot(
                 result.unreadable.append((location, f"stat: {outcome!r}"))
             else:
                 result.objects_checked += 1
-                if outcome < expected:
+                exact = exact_sizes.get(location)
+                if exact is not None and outcome != exact:
+                    result.truncated.append((location, exact, outcome))
+                elif outcome < expected:
                     result.truncated.append((location, expected, outcome))
 
         crc_verified: set = set()

@@ -35,6 +35,8 @@ import weakref
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from .coordination import Coordinator, get_default_coordinator
+from .event import Event
+from .event_handlers import log_event
 from .io_types import ReadIO, WriteIO
 from .manifest import Entry, SnapshotMetadata
 from .snapshot import (
@@ -383,7 +385,8 @@ class SnapshotManager:
         committed snapshots.  Rank-0 only; safe to call any time."""
         if self._coord.rank != 0 or self.keep_last_n is None:
             return
-        self._apply_retention(self._committed())
+        with log_event(Event("manager_gc", {"root": self.root})):
+            self._apply_retention(self._committed())
 
     def _apply_retention(self, committed: Dict[int, Snapshot]) -> None:
         if self.keep_last_n is None:

@@ -296,11 +296,21 @@ def verify_snapshot(
 ) -> VerifyResult:
     """Audit one rank's view of a snapshot (default: this process's
     rank).  See module docstring for the shallow/deep contract."""
+    from .event import Event
+    from .event_handlers import log_event
+
+    if rank is None:
+        rank = snapshot._coordinator.rank
+    with log_event(
+        Event("verify", {"path": snapshot.path, "deep": deep, "rank": rank})
+    ):
+        return _verify_impl(snapshot, deep, rank)
+
+
+def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
     from .storage import url_to_storage_plugin
 
     result = VerifyResult()
-    if rank is None:
-        rank = snapshot._coordinator.rank
     manifest = dict(get_manifest_for_rank(snapshot.metadata, rank))
     storage = url_to_storage_plugin(snapshot.path)
     try:

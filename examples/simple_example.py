@@ -77,9 +77,14 @@ def main(ckpt_path: str) -> None:
             ts, loss = train_step(ts, x, y)
         app_state["model"].tree = ts
         app_state["progress"]["epochs"] += 1
-        # async: training resumes as soon as staging completes
+        # async: training resumes as soon as staging completes;
+        # incremental: unchanged objects hardlink against the previous
+        # committed epoch instead of being rewritten
         pending = mgr.save(
-            app_state, step=app_state["progress"]["epochs"], async_=True
+            app_state,
+            step=app_state["progress"]["epochs"],
+            async_=True,
+            incremental=True,
         )
         print(f"epoch {app_state['progress']['epochs']}: loss={float(loss):.5f}")
         pending.wait()

@@ -485,11 +485,21 @@ def sync_execute_read_reqs(
     pipelines = [_ReadPipeline(rr) for rr in read_reqs]
     budget = _Budget(memory_budget_bytes)
     loop_thread = _LoopThread(name="tsnp-read-loop")
+    t0 = time.monotonic()
     fut = loop_thread.submit(
         _execute_read_pipelines(pipelines, storage, budget, executor)
     )
     try:
         fut.result()
+        # read throughput breadcrumb (reference logs the symmetric
+        # number on its read path, scheduler.py:443-444)
+        total = sum(p.consuming_cost for p in pipelines)
+        dt = max(time.monotonic() - t0, 1e-9)
+        if total:
+            logger.info(
+                "rank %d: read %.2fGB in %.2fs (%.2f GB/s)",
+                rank, total / 1e9, dt, total / 1e9 / dt,
+            )
     finally:
         executor.shutdown(wait=False)
         loop_thread.shutdown()

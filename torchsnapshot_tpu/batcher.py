@@ -25,6 +25,8 @@ import logging
 from concurrent.futures import Executor
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from . import knobs
 from .io_types import BufferConsumer, BufferStager, ReadReq, WriteReq
 from .manifest import ArrayEntry, ChunkedArrayEntry, Entry, ShardedArrayEntry
@@ -202,9 +204,11 @@ class _MergedRangeConsumer(BufferConsumer):
 
         view = memoryview(buf).cast("B")
         verify = knobs.verify_on_restore()
-        for req, start, end in self.subs:
-            piece = view[start - self.base : end - self.base]
-            if req.expected_crc32 is not None and verify:
+        if verify:
+            for req, start, end in self.subs:
+                piece = view[start - self.base : end - self.base]
+                if req.expected_crc32 is None:
+                    continue
                 # the merged spanning read bypassed the scheduler's
                 # whole-request check; each member still verifies its
                 # own slice (off-loop: tens of MB per member would
@@ -215,7 +219,95 @@ class _MergedRangeConsumer(BufferConsumer):
                     )
                 else:
                     check_read_crc(req, piece)
+        # eligibility first (pure isinstance checks, no jax import), THEN
+        # the knob (whose "auto" may import jax); the unpack itself runs
+        # on the executor — first-restore XLA compilation would stall
+        # every concurrent read pipeline if it ran on the loop thread
+        if self._device_unpack_eligible() and knobs.device_unpack_enabled():
+            if executor is not None:
+                done = await asyncio.get_running_loop().run_in_executor(
+                    executor, self._try_device_unpack, view
+                )
+            else:
+                done = self._try_device_unpack(view)
+            if done:
+                return
+        for req, start, end in self.subs:
+            piece = view[start - self.base : end - self.base]
             await req.buffer_consumer.consume_buffer(piece, executor)
+
+    def _device_unpack_eligible(self) -> bool:
+        from .preparers.array import ArrayBufferConsumer
+
+        return bool(self.subs) and all(
+            isinstance(req.buffer_consumer, ArrayBufferConsumer)
+            and req.buffer_consumer.obj_out is not None
+            for req, _, _ in self.subs
+        )
+
+    def _try_device_unpack(self, view: memoryview) -> bool:
+        """Restore every member with ONE H2D transfer + one compiled
+        slice/bitcast program when all members are plain array reads
+        into single-device jax templates on the same device (the
+        read-side mirror of the device slab pack).  Any ineligibility
+        or failure returns False and the host path runs instead."""
+        from .preparers.array import ArrayBufferConsumer, _is_jax_array
+        from .serialization import BUFFER_PROTOCOL, string_to_dtype
+
+        members = []
+        out_dtypes = []
+        consumers = []
+        device = None
+        try:
+            for req, start, end in self.subs:
+                c = req.buffer_consumer
+                if not isinstance(c, ArrayBufferConsumer):
+                    return False
+                if c.entry.serializer != BUFFER_PROTOCOL:
+                    return False
+                out = c.obj_out
+                if out is None or not _is_jax_array(out):
+                    return False
+                devs = list(out.sharding.device_set)
+                if len(devs) != 1:
+                    return False
+                # pinned_host templates must stay in host memory: the
+                # unpack commits to default device memory, which would
+                # silently defeat an offload (the host path preserves
+                # the template's full sharding incl. memory kind)
+                if getattr(out.sharding, "memory_kind", None) not in (
+                    None, "device",
+                ):
+                    return False
+                if device is None:
+                    device = devs[0]
+                elif devs[0] != device:
+                    return False
+                if tuple(out.shape) != tuple(c.entry.shape):
+                    return False
+                members.append(
+                    (
+                        start - self.base,
+                        str(np.dtype(string_to_dtype(c.entry.dtype))),
+                        tuple(c.entry.shape),
+                    )
+                )
+                out_dtypes.append(np.dtype(out.dtype))
+                consumers.append(c)
+            if not consumers:
+                return False
+            from .ops.device_pack import unpack_slab_to_device
+
+            arrays = unpack_slab_to_device(
+                view, tuple(members), tuple(out_dtypes), device
+            )
+        except Exception:  # noqa: BLE001 — host path is always correct
+            logger.debug("device slab unpack failed; host fallback",
+                         exc_info=True)
+            return False
+        for c, arr in zip(consumers, arrays):
+            c.fut.set(arr)
+        return True
 
     def get_consuming_cost_bytes(self) -> int:
         # the spanning buffer is what actually occupies host memory

@@ -31,6 +31,7 @@ _REPLICATION_VERIFY = "REPLICATION_VERIFY"
 _SERIALIZE_TRANSFERS = "SERIALIZE_TRANSFERS"
 _WRITE_CHECKSUMS = "WRITE_CHECKSUMS"
 _VERIFY_ON_RESTORE = "VERIFY_ON_RESTORE"
+_DEVICE_UNPACK = "DEVICE_UNPACK"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -97,6 +98,12 @@ _DEFAULTS = {
     # latency-critical path, and Snapshot.verify(deep=True) exists for
     # audits — flip on for untrusted/long-archived snapshots.
     _VERIFY_ON_RESTORE: 0,
+    # Restore batched slabs with ONE H2D transfer + one compiled
+    # slice/bitcast program (the read-side mirror of the device slab
+    # pack) instead of one device_put per member.  "auto" = on for
+    # accelerator backends, off on CPU (host-side copies are already
+    # cheap there); "1"/"0" force.
+    _DEVICE_UNPACK: "auto",
 }
 
 _OVERRIDES: dict = {}
@@ -183,6 +190,20 @@ def verify_on_restore() -> bool:
     return bool(int(_get_raw(_VERIFY_ON_RESTORE)))
 
 
+def device_unpack_enabled() -> bool:
+    v = str(_get_raw(_DEVICE_UNPACK)).lower()
+    if v in ("1", "true", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:  # no jax: the host path needs none
+        return False
+
+
 def serialize_transfers() -> bool:
     v = str(_get_raw(_SERIALIZE_TRANSFERS)).lower()
     if v in ("1", "true", "on"):
@@ -265,6 +286,10 @@ def override_write_checksums(value: bool):
 
 def override_verify_on_restore(value: bool):
     return _override(_VERIFY_ON_RESTORE, int(value))
+
+
+def override_device_unpack(value):
+    return _override(_DEVICE_UNPACK, value)
 
 
 def override_staging_threads(value: int):

@@ -52,3 +52,82 @@ def pack_arrays_to_host(arrays: List[Any]) -> np.ndarray:
     except Exception:
         pass
     return np.asarray(packed)
+
+
+# ------------------------------------------------------------- unpack
+
+def _unpack_builder(members, out_dtypes):
+    """Build the jitted slab-unpack: slab u8 -> per-member arrays.  One
+    compiled program per slab LAYOUT (shape/dtype/offset tuple); XLA
+    caches it, so steady-state restores of the same model compile once."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    try:
+        import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
+    except Exception:
+        pass
+
+    def unpack(slab):
+        outs = []
+        for (off, dtype_str, shape), out_dt in zip(members, out_dtypes):
+            dt = np.dtype(dtype_str) if isinstance(dtype_str, str) else dtype_str
+            n = int(np.prod(shape)) if shape else 1
+            if dt == np.bool_:
+                nbytes = n
+                piece = slab[off : off + nbytes]
+                arr = piece.astype(jnp.bool_)
+            elif np.issubdtype(dt, np.complexfloating):
+                half = np.dtype(
+                    np.float32 if dt == np.complex64 else np.float64
+                )
+                nbytes = n * dt.itemsize
+                piece = slab[off : off + nbytes]
+                comps = lax.bitcast_convert_type(
+                    piece.reshape(n * 2, half.itemsize), jnp.dtype(half)
+                ).reshape(n, 2)
+                arr = lax.complex(comps[:, 0], comps[:, 1])
+            else:
+                nbytes = n * dt.itemsize
+                piece = slab[off : off + nbytes]
+                arr = lax.bitcast_convert_type(
+                    piece.reshape(n, dt.itemsize), jnp.dtype(dt)
+                ).reshape(-1)
+            arr = arr.reshape(shape)
+            if out_dt is not None and np.dtype(out_dt) != np.dtype(dt):
+                arr = arr.astype(jnp.dtype(np.dtype(out_dt)))
+            outs.append(arr)
+        return tuple(outs)
+
+    return unpack
+
+
+_UNPACK_CACHE: dict = {}
+
+
+def unpack_slab_to_device(buf, members, out_dtypes, device) -> List[Any]:
+    """ONE H2D transfer + ONE compiled program turn a host slab into all
+    of its member device arrays — the restore-side mirror of
+    ``pack_arrays_to_host`` (amortizes per-transfer latency exactly the
+    way the write side amortizes DtoH launches).
+
+    ``members``: ((byte_offset, dtype_str, shape), ...) within ``buf``;
+    ``out_dtypes``: per-member template dtype (cast on device) or None.
+    """
+    import jax
+
+    from ..preparers.array import transfer_gate
+
+    key = (tuple(members), tuple(str(d) for d in out_dtypes))
+    fn = _UNPACK_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(_unpack_builder(members, out_dtypes))
+        _UNPACK_CACHE[key] = fn
+    u8 = np.frombuffer(buf, np.uint8)
+    # the slab H2D rides the same gate as every other restore transfer
+    # (concurrent puts interleave pathologically on multiplexed
+    # transports — see knobs.serialize_transfers)
+    with transfer_gate() as pending:
+        slab = jax.device_put(u8, device)
+        pending.append(slab)
+    return list(fn(slab))

@@ -158,7 +158,7 @@ def test_device_unpack_restore_roundtrip(tmp_path):
 
     from torchsnapshot_tpu import PyTreeState, Snapshot, knobs
 
-    from torchsnapshot_tpu.ops.device_pack import _UNPACK_CACHE
+    from torchsnapshot_tpu.ops.device_pack import _jitted_unpack
 
     tree = {
         "w_f32": jnp.arange(512, dtype=jnp.float32),
@@ -181,10 +181,12 @@ def test_device_unpack_restore_roundtrip(tmp_path):
     # all-jax template: the device path must actually run (observable
     # as a new compiled layout in the unpack cache)
     dest = fresh()
-    cache_before = len(_UNPACK_CACHE)
+    misses_before = _jitted_unpack.cache_info().misses
     with knobs.override_device_unpack("1"):
         Snapshot(str(tmp_path / "s")).restore({"m": dest})
-    assert len(_UNPACK_CACHE) > cache_before, "device unpack did not run"
+    assert (
+        _jitted_unpack.cache_info().misses > misses_before
+    ), "device unpack did not run"
     for k in tree:
         got = np.asarray(dest.tree[k])
         want = np.asarray(tree[k])

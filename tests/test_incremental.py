@@ -302,3 +302,30 @@ def test_incremental_two_rank_save(tmp_path):
     for r in (0, 1):
         res = verify_snapshot(s2, deep=True, rank=r)
         assert res.ok, (r, str(res))
+
+
+def test_link_failure_falls_back_to_write(tmp_path, monkeypatch):
+    """A plugin whose link_from raises (base object gone, backend cap)
+    degrades to a normal write — dedup is never a correctness
+    dependency."""
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+    arr = np.arange(1024, dtype=np.float32)
+    with knobs.override_disable_batching(True):
+        Snapshot.take(str(tmp_path / "s1"), {"app": StateDict(w=arr)})
+
+        async def boom(self, base_url, path):
+            raise RuntimeError("backend refused the copy")
+
+        monkeypatch.setattr(FSStoragePlugin, "link_from", boom)
+        s2 = Snapshot.take(
+            str(tmp_path / "s2"), {"app": StateDict(w=arr)},
+            base=str(tmp_path / "s1"),
+        )
+    loc = s2.get_manifest()["0/app/w"].location
+    # written normally: distinct inode, content intact
+    assert _inode(tmp_path / "s2" / loc) != _inode(tmp_path / "s1" / loc)
+    dest = StateDict(w=np.zeros_like(arr))
+    s2.restore({"app": dest})
+    assert np.array_equal(dest["w"], arr)
+    assert s2.verify(deep=True).ok

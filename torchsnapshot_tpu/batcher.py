@@ -237,11 +237,14 @@ class _MergedRangeConsumer(BufferConsumer):
             await req.buffer_consumer.consume_buffer(piece, executor)
 
     def _device_unpack_eligible(self) -> bool:
-        from .preparers.array import ArrayBufferConsumer
+        from .preparers.array import ArrayBufferConsumer, _is_jax_array
 
         return bool(self.subs) and all(
             isinstance(req.buffer_consumer, ArrayBufferConsumer)
             and req.buffer_consumer.obj_out is not None
+            # module-name check, no jax import: numpy/torch templates
+            # skip the executor dispatch entirely
+            and _is_jax_array(req.buffer_consumer.obj_out)
             for req, _, _ in self.subs
         )
 

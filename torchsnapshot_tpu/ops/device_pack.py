@@ -11,6 +11,7 @@ XLA caches the compiled pack per shape-tuple, so steady-state checkpoints
 
 from __future__ import annotations
 
+import functools
 from typing import Any, List
 
 import numpy as np
@@ -102,7 +103,11 @@ def _unpack_builder(members, out_dtypes):
     return unpack
 
 
-_UNPACK_CACHE: dict = {}
+@functools.lru_cache(maxsize=32)
+def _jitted_unpack(members, out_dtypes):
+    import jax
+
+    return jax.jit(_unpack_builder(members, out_dtypes))
 
 
 def unpack_slab_to_device(buf, members, out_dtypes, device) -> List[Any]:
@@ -118,11 +123,12 @@ def unpack_slab_to_device(buf, members, out_dtypes, device) -> List[Any]:
 
     from ..preparers.array import transfer_gate
 
-    key = (tuple(members), tuple(str(d) for d in out_dtypes))
-    fn = _UNPACK_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(_unpack_builder(members, out_dtypes))
-        _UNPACK_CACHE[key] = fn
+    # LRU, not a bare dict: evolving slab layouts (the key includes
+    # byte offsets) would otherwise pin a compiled executable per
+    # layout forever in a long-lived process
+    fn = _jitted_unpack(
+        tuple(members), tuple(str(d) for d in out_dtypes)
+    )
     u8 = np.frombuffer(buf, np.uint8)
     # the slab H2D rides the same gate as every other restore transfer
     # (concurrent puts interleave pathologically on multiplexed

@@ -89,8 +89,32 @@ def pallas_probe_ok() -> bool:
     return _PROBE_VERDICT
 
 
+def _block_scores(
+    q_scaled, k_blk, jq, kb, q_offset, k_offset, sk_real, sq_real, causal
+):
+    """Masked scores for one (q-block, kv-block) pair — the ONE place
+    the masking semantics live; forward and both backward kernels share
+    it so the backward can never drift from the forward's convention.
+    Returns (scores [BQ,BK] with -inf outside, mask, global k_idx)."""
+    scores = jax.lax.dot_general(
+        q_scaled, k_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    row = jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
+    q_pos = q_offset + jq * _BQ + row
+    k_idx = kb * _BK + col
+    mask = jnp.logical_and(
+        k_idx < sk_real, (jq * _BQ + row) < sq_real
+    )
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_offset + k_idx)
+    return jnp.where(mask, scores, _NEG_INF), mask, k_idx
+
+
 def _attend_kernel(
-    offs_ref,  # SMEM scalar prefetch: [q_offset, k_offset, sk_real]
+    offs_ref,  # SMEM scalar prefetch: [q_offset, k_offset, sk_real, sq_real]
     q_ref,  # [1, BQ, D]      (revisited across the kv grid dim)
     k_ref,  # [1, BK, D]      (one kv block resident at a time)
     v_ref,  # [1, BK, D]
@@ -114,6 +138,7 @@ def _attend_kernel(
     q_offset = offs_ref[0]
     k_offset = offs_ref[1]
     sk_real = offs_ref[2]
+    sq_real = offs_ref[3]
     jq = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -127,20 +152,9 @@ def _attend_kernel(
     k_blk = k_ref[0].astype(jnp.float32)  # [BK, D]
     v_blk = v_ref[0].astype(jnp.float32)
 
-    scores = jax.lax.dot_general(
-        q,
-        k_blk,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [BQ, BK]
-    q_pos = q_offset + jq * _BQ + jax.lax.broadcasted_iota(
-        jnp.int32, (_BQ, _BK), 0
+    scores, mask, _ = _block_scores(
+        q, k_blk, jq, kb, q_offset, k_offset, sk_real, sq_real, causal
     )
-    k_idx = kb * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
-    mask = k_idx < sk_real  # padded keys contribute nothing
-    if causal:
-        mask = jnp.logical_and(mask, q_pos >= k_offset + k_idx)
-    scores = jnp.where(mask, scores, _NEG_INF)
 
     m_run, l_run = m_sc[:], l_sc[:]
     m_blk = jnp.max(scores, axis=-1)  # [BQ]
@@ -193,7 +207,7 @@ def _flash_partials_jit(
     sq_pad, d = qp.shape[1], qp.shape[2]
     sk_pad = kp.shape[1]
     offs = jnp.concatenate(
-        [offs.astype(jnp.int32), jnp.array([sk], jnp.int32)]
+        [offs.astype(jnp.int32), jnp.array([sk, sq], jnp.int32)]
     )
 
     grid = (bh, sq_pad // _BQ, sk_pad // _BK)
@@ -250,35 +264,333 @@ def _partials_impl(q, k, v, qo, ko, causal: bool, scale: float, vma: tuple):
     return pv, m_safe, l
 
 
+# --------------------------------------------------- pallas backward
+
+def _bwd_dq_kernel(
+    offs_ref,  # SMEM: [q_offset, k_offset, sk_real, sq_real]
+    q_ref,  # [1, BQ, D]
+    k_ref,  # [1, BK, D]
+    v_ref,  # [1, BK, D]
+    m_ref,  # [1, BQ]   final row max (m_safe) from the forward
+    gpv_ref,  # [1, BQ, D]  cotangent of pv (f32)
+    gl_ref,  # [1, BQ]     cotangent of l
+    gmt_ref,  # [1, BQ]    g_m - T  (T = gpv·pv + l*g_l, precomputed)
+    dq_ref,  # [1, BQ, D]  out (f32)
+    amax_ref,  # [1, BQ]   out (i32): global col of the row max
+    dq_sc,  # VMEM [BQ, D] f32
+    amax_sc,  # VMEM [BQ] i32
+    found_sc,  # VMEM [BQ] i32
+    *,
+    causal: bool,
+    scale: float,
+):
+    """dq for one (q-block, kv-block) step, kv innermost.
+
+    With the forward's final (m, l, pv) saved, the backward needs no
+    online softmax: p_ij = exp(s_ij - m_i) directly, and the row term
+    T_i collapses to gpv_i·pv_i + l_i·g_l_i (computed outside).  The
+    g_m cotangent lands on the FIRST column attaining the row max — a
+    valid subgradient of max; located here (the kv walk is sequential)
+    and exported for the dk/dv kernel."""
+    q_offset, k_offset = offs_ref[0], offs_ref[1]
+    sk_real, sq_real = offs_ref[2], offs_ref[3]
+    jq = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+        amax_sc[:] = jnp.full_like(amax_sc, -1)
+        found_sc[:] = jnp.zeros_like(found_sc)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    m = m_ref[0]
+    gpv = gpv_ref[0].astype(jnp.float32)
+    gl = gl_ref[0]
+    gmt = gmt_ref[0]
+
+    scores, mask, k_idx = _block_scores(
+        q, k_blk, jq, kb, q_offset, k_offset, sk_real, sq_real, causal
+    )
+    p = jnp.where(mask, jnp.exp(scores - m[:, None]), 0.0)
+    gv = jax.lax.dot_general(  # gpv_i · v_j  -> [BQ, BK]
+        gpv, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (gv + gl[:, None])
+
+    # first column attaining the row max (within valid positions).
+    # Tolerance, not bit equality: m comes from the SEPARATELY COMPILED
+    # forward kernel, and Mosaic may schedule the two dot accumulations
+    # differently on hardware — a 1-ulp drift must not silently drop
+    # the whole g_m cotangent for the row.
+    tol = 1e-6 * jnp.maximum(jnp.abs(m), 1.0)
+    eq = jnp.logical_and(mask, scores >= (m - tol)[:, None])
+    big = jnp.int32(2**30)
+    first_local = jnp.min(jnp.where(eq, k_idx, big), axis=-1)  # [BQ]
+    blk_has = first_local < big
+    newly = jnp.logical_and(found_sc[:] == 0, blk_has)
+    amax_sc[:] = jnp.where(newly, first_local, amax_sc[:])
+    ds = ds + jnp.where(
+        jnp.logical_and(newly[:, None], k_idx == first_local[:, None]),
+        gmt[:, None],
+        0.0,
+    )
+    found_sc[:] = jnp.where(blk_has, 1, found_sc[:])
+
+    dq_sc[:] = dq_sc[:] + scale * jax.lax.dot_general(
+        ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _emit():
+        dq_ref[0] = dq_sc[:]
+        amax_ref[0] = amax_sc[:]
+
+
+def _bwd_dkv_kernel(
+    offs_ref,
+    q_ref,  # [1, BQ, D]
+    k_ref,  # [1, BK, D]
+    v_ref,  # [1, BK, D]
+    m_ref,  # [1, BQ]
+    gpv_ref,  # [1, BQ, D]
+    gl_ref,  # [1, BQ]
+    gmt_ref,  # [1, BQ]
+    amax_ref,  # [1, BQ] i32 from the dq kernel
+    dk_ref,  # [1, BK, D] out (f32)
+    dv_ref,  # [1, BK, D] out (f32)
+    dk_sc,  # VMEM [BK, D] f32
+    dv_sc,  # VMEM [BK, D] f32
+    *,
+    causal: bool,
+    scale: float,
+):
+    """dk/dv for one (kv-block, q-block) step, q innermost (the
+    accumulation axis for dk/dv is q, so the grid transposes)."""
+    q_offset, k_offset = offs_ref[0], offs_ref[1]
+    sk_real, sq_real = offs_ref[2], offs_ref[3]
+    kb = pl.program_id(1)
+    jq = pl.program_id(2)
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    m = m_ref[0]
+    gpv = gpv_ref[0].astype(jnp.float32)
+    gl = gl_ref[0]
+    gmt = gmt_ref[0]
+    amax = amax_ref[0]
+
+    scores, mask, k_idx = _block_scores(
+        q, k_blk, jq, kb, q_offset, k_offset, sk_real, sq_real, causal
+    )
+    p = jnp.where(mask, jnp.exp(scores - m[:, None]), 0.0)
+
+    dv_sc[:] = dv_sc[:] + jax.lax.dot_general(  # p^T · gpv
+        p, gpv, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    gv = jax.lax.dot_general(
+        gpv, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (gv + gl[:, None])
+    ds = ds + jnp.where(k_idx == amax[:, None], gmt[:, None], 0.0)
+    # q is already pre-scaled above, so dk_j = Σ_i ds_ij (scale·q_i)
+    # needs no extra factor (dq does: k is unscaled there)
+    dk_sc[:] = dk_sc[:] + jax.lax.dot_general(  # ds^T · (scale·q)
+        ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(jq == pl.num_programs(2) - 1)
+    def _emit():
+        dk_ref[0] = dk_sc[:]
+        dv_ref[0] = dv_sc[:]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "vma")
+)
+def _flash_bwd_jit(
+    q, k, v, m, gpv, gl, gmt, offs, *, causal: bool, scale: float,
+    vma: tuple = (),
+):
+    """q/k/v/gpv: [bh, s, d]; m/gl/gmt: [bh, sq].  Returns f32
+    (dq [bh,sq,d], dk [bh,sk,d], dv [bh,sk,d]) — flash-tiled backward,
+    per-step memory O(BQ·BK) like the forward."""
+    bh, sq, d0 = q.shape
+    sk = k.shape[1]
+    qp = _pad_to(_pad_to(q, 1, _BQ), 2, _LANE)
+    kp = _pad_to(_pad_to(k, 1, _BK), 2, _LANE)
+    vp = _pad_to(_pad_to(v, 1, _BK), 2, _LANE)
+    gpvp = _pad_to(_pad_to(gpv.astype(jnp.float32), 1, _BQ), 2, _LANE)
+    mp = _pad_to(m, 1, _BQ)
+    glp = _pad_to(gl, 1, _BQ)
+    gmtp = _pad_to(gmt, 1, _BQ)
+    sq_pad, d = qp.shape[1], qp.shape[2]
+    sk_pad = kp.shape[1]
+    offs = jnp.concatenate(
+        [offs.astype(jnp.int32), jnp.array([sk, sq], jnp.int32)]
+    )
+    vma = frozenset(vma)
+
+    grid_a = (bh, sq_pad // _BQ, sk_pad // _BK)
+    kern_a = functools.partial(_bwd_dq_kernel, causal=causal, scale=scale)
+    dq, amax = pl.pallas_call(
+        kern_a,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid_a,
+            in_specs=[
+                pl.BlockSpec((1, _BQ, d), lambda i, j, kb, o: (i, j, 0)),
+                pl.BlockSpec((1, _BK, d), lambda i, j, kb, o: (i, kb, 0)),
+                pl.BlockSpec((1, _BK, d), lambda i, j, kb, o: (i, kb, 0)),
+                pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
+                pl.BlockSpec((1, _BQ, d), lambda i, j, kb, o: (i, j, 0)),
+                pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
+                pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, _BQ, d), lambda i, j, kb, o: (i, j, 0)),
+                pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((_BQ, d), jnp.float32),
+                pltpu.VMEM((_BQ,), jnp.int32),
+                pltpu.VMEM((_BQ,), jnp.int32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_pad, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, sq_pad), jnp.int32, vma=vma),
+        ],
+        interpret=_use_interpret(),
+    )(offs, qp, kp, vp, mp, gpvp, glp, gmtp)
+
+    grid_b = (bh, sk_pad // _BK, sq_pad // _BQ)
+    kern_b = functools.partial(
+        _bwd_dkv_kernel, causal=causal, scale=scale
+    )
+    dk, dv = pl.pallas_call(
+        kern_b,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid_b,
+            in_specs=[
+                pl.BlockSpec((1, _BQ, d), lambda i, kb, j, o: (i, j, 0)),
+                pl.BlockSpec((1, _BK, d), lambda i, kb, j, o: (i, kb, 0)),
+                pl.BlockSpec((1, _BK, d), lambda i, kb, j, o: (i, kb, 0)),
+                pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
+                pl.BlockSpec((1, _BQ, d), lambda i, kb, j, o: (i, j, 0)),
+                pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
+                pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
+                pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, _BK, d), lambda i, kb, j, o: (i, kb, 0)),
+                pl.BlockSpec((1, _BK, d), lambda i, kb, j, o: (i, kb, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((_BK, d), jnp.float32),
+                pltpu.VMEM((_BK, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_pad, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, sk_pad, d), jnp.float32, vma=vma),
+        ],
+        interpret=_use_interpret(),
+    )(offs, qp, kp, vp, mp, gpvp, glp, gmtp, amax)
+    return (
+        dq[:, :sq, :d0],
+        dk[:, :sk, :d0],
+        dv[:, :sk, :d0],
+    )
+
+
+def _flash_bwd(q, k, v, qo, ko, outs, cts, causal, scale, vma):
+    """Pallas flash backward for the partials contract (pv, m, l)."""
+    pv, m_safe, l = outs
+    g_pv, g_m, g_l = cts
+    b, sq, h, d = q.shape
+    # T_i = gpv_i·pv_i + l_i·g_l_i collapses the row sum the standard
+    # flash backward would recompute
+    T = (
+        jnp.einsum(
+            "bshd,bshd->bhs",
+            g_pv.astype(jnp.float32),
+            pv.astype(jnp.float32),
+        )
+        + l * g_l
+    )
+    gmt = g_m.astype(jnp.float32) - T
+
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * h, x.shape[1], x.shape[3]
+    )
+    flat = lambda x: x.reshape(b * h, x.shape[2])  # [b,h,s] -> [bh,s]
+    offs = jnp.stack([qo, ko]).astype(jnp.int32)
+    dq, dk, dv = _flash_bwd_jit(
+        to_bh(q), to_bh(k), to_bh(v),
+        flat(m_safe), to_bh(g_pv), flat(g_l.astype(jnp.float32)),
+        flat(gmt), offs,
+        causal=causal, scale=scale, vma=tuple(vma),
+    )
+    back = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return (
+        back(dq, sq).astype(q.dtype),
+        back(dk, k.shape[1]).astype(k.dtype),
+        back(dv, k.shape[1]).astype(v.dtype),
+    )
+
+
 @functools.lru_cache(maxsize=64)
 def _make_diff_partials(causal: bool, scale: float, vma: tuple):
-    """pallas_call has no autodiff rule; wrap the kernel in a custom_vjp
-    whose backward recomputes the block pair with XLA ops (same per-step
-    memory/compute as the non-pallas path — forward keeps the flash
-    tiling, training pays the old recompute cost on backward only)."""
+    """pallas_call has no autodiff rule; wrap the kernel in a custom_vjp.
+
+    The backward is flash-tiled pallas too (_flash_bwd: O(BQ·BK)
+    per-step memory, saved (m, l, pv) instead of an online pass) when
+    the pallas knob resolves on; otherwise it recomputes the block pair
+    with XLA ops (correct everywhere, O(sq·sk) score materialization)."""
 
     @jax.custom_vjp
     def f(q, k, v, qo, ko):
         return _partials_impl(q, k, v, qo, ko, causal, scale, vma)
 
     def fwd(q, k, v, qo, ko):
-        return _partials_impl(q, k, v, qo, ko, causal, scale, vma), (
-            q, k, v, qo, ko,
-        )
+        out = _partials_impl(q, k, v, qo, ko, causal, scale, vma)
+        return out, (q, k, v, qo, ko, out)
 
     def bwd(res, cts):
-        q, k, v, qo, ko = res
-        from ..parallel.ring_attention import _block_attend
+        q, k, v, qo, ko, outs = res
+        from .. import knobs
 
-        def xla_fn(q, k, v):
-            pv, m_safe, l, _ = _block_attend(
-                q, k, v,
-                q_offset=qo, k_offset=ko, causal=causal, scale=scale,
+        if knobs.use_pallas_attention():
+            dq, dk, dv = _flash_bwd(
+                q, k, v, qo, ko, outs, cts, causal, scale, vma
             )
-            return pv, m_safe, l
+        else:
+            from ..parallel.ring_attention import _block_attend
 
-        _, vjp = jax.vjp(xla_fn, q, k, v)
-        dq, dk, dv = vjp(cts)
+            def xla_fn(q, k, v):
+                pv, m_safe, l, _ = _block_attend(
+                    q, k, v,
+                    q_offset=qo, k_offset=ko, causal=causal, scale=scale,
+                )
+                return pv, m_safe, l
+
+            _, vjp = jax.vjp(xla_fn, q, k, v)
+            dq, dk, dv = vjp(cts)
         # integer offsets: cotangent type is float0
         zero0 = lambda x: np.zeros(x.shape, dtype=jax.dtypes.float0)
         return dq, dk, dv, zero0(qo), zero0(ko)

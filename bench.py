@@ -100,13 +100,39 @@ def _attention_bench() -> dict:
     flash_s = _time_op(lambda: flash_attention(q, k, v, causal=True))
     xla = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
     xla_s = _time_op(lambda: xla(q, k, v))
-    return {
+    result = {
         "pallas_compiled": True,
         "shape": [b, s, h, d],
         "flash_ms": round(flash_s * 1e3, 3),
         "xla_dense_ms": round(xla_s * 1e3, 3),
         "flash_speedup": round(xla_s / flash_s, 3),
     }
+    # fwd+bwd: exercises the flash-tiled pallas backward kernels
+    try:
+        from torchsnapshot_tpu import knobs
+
+        def loss(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True).astype(jnp.float32)
+                ** 2
+            )
+
+        with knobs.override_pallas_attention("1"):
+            g_flash = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            grad_flash_s = _time_op(lambda: g_flash(q, k, v))
+        with knobs.override_pallas_attention("0"):
+            g_xla = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            grad_xla_s = _time_op(lambda: g_xla(q, k, v))
+        result.update(
+            {
+                "grad_flash_ms": round(grad_flash_s * 1e3, 3),
+                "grad_xla_bwd_ms": round(grad_xla_s * 1e3, 3),
+                "grad_speedup": round(grad_xla_s / grad_flash_s, 3),
+            }
+        )
+    except Exception as e:
+        result["grad_bench_error"] = f"{e!r}"[:200]
+    return result
 
 
 def run_child() -> None:

@@ -68,13 +68,33 @@ def pallas_probe_ok() -> bool:
     virtualized TPU runtimes don't).  A failed probe logs and falls back
     to the XLA attention path; it never raises."""
     global _PROBE_VERDICT
+    if _PROBE_VERDICT == "probing":
+        # re-entered from the custom_vjp bwd of the probe's own grad:
+        # answer yes so the probe exercises the PALLAS backward (what
+        # it exists to validate); a compile failure still fails the
+        # outer probe
+        return True
     if _PROBE_VERDICT is None:
         if not PALLAS_AVAILABLE:
             _PROBE_VERDICT = False
         else:
+            _PROBE_VERDICT = "probing"
             try:
                 x = jnp.zeros((1, _BQ, 1, _LANE), jnp.bfloat16)
                 jax.block_until_ready(flash_attention(x, x, x, causal=True))
+                # the backward kernels are separate Mosaic programs
+                # (i32 scratch, transposed grid): a runtime where only
+                # the forward compiles must fall back as a unit, or the
+                # first jax.grad step would crash uncatchably
+                g = jax.grad(
+                    lambda q: jnp.sum(
+                        flash_attention(q, x, x, causal=True).astype(
+                            jnp.float32
+                        )
+                        ** 2
+                    )
+                )(x)
+                jax.block_until_ready(g)
                 _PROBE_VERDICT = True
             except Exception:
                 import logging
@@ -274,12 +294,11 @@ def _bwd_dq_kernel(
     m_ref,  # [1, BQ]   final row max (m_safe) from the forward
     gpv_ref,  # [1, BQ, D]  cotangent of pv (f32)
     gl_ref,  # [1, BQ]     cotangent of l
-    gmt_ref,  # [1, BQ]    g_m - T  (T = gpv·pv + l*g_l, precomputed)
     dq_ref,  # [1, BQ, D]  out (f32)
     amax_ref,  # [1, BQ]   out (i32): global col of the row max
     dq_sc,  # VMEM [BQ, D] f32
-    amax_sc,  # VMEM [BQ] i32
-    found_sc,  # VMEM [BQ] i32
+    amax_sc,  # VMEM [BQ] i32 (-1 = none valid yet)
+    runm_sc,  # VMEM [BQ] f32: running max of recomputed scores
     *,
     causal: bool,
     scale: float,
@@ -301,7 +320,7 @@ def _bwd_dq_kernel(
     def _init():
         dq_sc[:] = jnp.zeros_like(dq_sc)
         amax_sc[:] = jnp.full_like(amax_sc, -1)
-        found_sc[:] = jnp.zeros_like(found_sc)
+        runm_sc[:] = jnp.full_like(runm_sc, _NEG_INF)
 
     q = q_ref[0].astype(jnp.float32) * scale
     k_blk = k_ref[0].astype(jnp.float32)
@@ -309,7 +328,6 @@ def _bwd_dq_kernel(
     m = m_ref[0]
     gpv = gpv_ref[0].astype(jnp.float32)
     gl = gl_ref[0]
-    gmt = gmt_ref[0]
 
     scores, mask, k_idx = _block_scores(
         q, k_blk, jq, kb, q_offset, k_offset, sk_real, sq_real, causal
@@ -321,24 +339,23 @@ def _bwd_dq_kernel(
     )
     ds = p * (gv + gl[:, None])
 
-    # first column attaining the row max (within valid positions).
-    # Tolerance, not bit equality: m comes from the SEPARATELY COMPILED
-    # forward kernel, and Mosaic may schedule the two dot accumulations
-    # differently on hardware — a 1-ulp drift must not silently drop
-    # the whole g_m cotangent for the row.
-    tol = 1e-6 * jnp.maximum(jnp.abs(m), 1.0)
-    eq = jnp.logical_and(mask, scores >= (m - tol)[:, None])
+    # Row-argmax of the RECOMPUTED scores, tracked as a running
+    # (max, first-col) pair across kv blocks.  Never compared against
+    # the saved m from the separately compiled forward — cross-kernel
+    # float drift therefore cannot drop or misplace the g_m cotangent;
+    # the δ contribution itself is applied OUTSIDE the kernels as an
+    # XLA gather/scatter on this argmax (a valid subgradient of max).
+    blk_max = jnp.max(scores, axis=-1)  # -inf when nothing valid
     big = jnp.int32(2**30)
-    first_local = jnp.min(jnp.where(eq, k_idx, big), axis=-1)  # [BQ]
-    blk_has = first_local < big
-    newly = jnp.logical_and(found_sc[:] == 0, blk_has)
-    amax_sc[:] = jnp.where(newly, first_local, amax_sc[:])
-    ds = ds + jnp.where(
-        jnp.logical_and(newly[:, None], k_idx == first_local[:, None]),
-        gmt[:, None],
-        0.0,
+    blk_first = jnp.min(
+        jnp.where(
+            jnp.logical_and(mask, scores == blk_max[:, None]), k_idx, big
+        ),
+        axis=-1,
     )
-    found_sc[:] = jnp.where(blk_has, 1, found_sc[:])
+    better = jnp.logical_and(blk_first < big, blk_max > runm_sc[:])
+    amax_sc[:] = jnp.where(better, blk_first, amax_sc[:])
+    runm_sc[:] = jnp.maximum(runm_sc[:], blk_max)
 
     dq_sc[:] = dq_sc[:] + scale * jax.lax.dot_general(
         ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
@@ -359,8 +376,6 @@ def _bwd_dkv_kernel(
     m_ref,  # [1, BQ]
     gpv_ref,  # [1, BQ, D]
     gl_ref,  # [1, BQ]
-    gmt_ref,  # [1, BQ]
-    amax_ref,  # [1, BQ] i32 from the dq kernel
     dk_ref,  # [1, BK, D] out (f32)
     dv_ref,  # [1, BK, D] out (f32)
     dk_sc,  # VMEM [BK, D] f32
@@ -387,10 +402,8 @@ def _bwd_dkv_kernel(
     m = m_ref[0]
     gpv = gpv_ref[0].astype(jnp.float32)
     gl = gl_ref[0]
-    gmt = gmt_ref[0]
-    amax = amax_ref[0]
 
-    scores, mask, k_idx = _block_scores(
+    scores, mask, _ = _block_scores(
         q, k_blk, jq, kb, q_offset, k_offset, sk_real, sq_real, causal
     )
     p = jnp.where(mask, jnp.exp(scores - m[:, None]), 0.0)
@@ -403,8 +416,9 @@ def _bwd_dkv_kernel(
         gpv, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
+    # the g_m δ term is applied outside the kernels (gather/scatter on
+    # the dq kernel's exported argmax)
     ds = p * (gv + gl[:, None])
-    ds = ds + jnp.where(k_idx == amax[:, None], gmt[:, None], 0.0)
     # q is already pre-scaled above, so dk_j = Σ_i ds_ij (scale·q_i)
     # needs no extra factor (dq does: k is unscaled there)
     dk_sc[:] = dk_sc[:] + jax.lax.dot_general(  # ds^T · (scale·q)
@@ -422,12 +436,13 @@ def _bwd_dkv_kernel(
     jax.jit, static_argnames=("causal", "scale", "vma")
 )
 def _flash_bwd_jit(
-    q, k, v, m, gpv, gl, gmt, offs, *, causal: bool, scale: float,
+    q, k, v, m, gpv, gl, offs, *, causal: bool, scale: float,
     vma: tuple = (),
 ):
-    """q/k/v/gpv: [bh, s, d]; m/gl/gmt: [bh, sq].  Returns f32
-    (dq [bh,sq,d], dk [bh,sk,d], dv [bh,sk,d]) — flash-tiled backward,
-    per-step memory O(BQ·BK) like the forward."""
+    """q/k/v/gpv: [bh, s, d]; m/gl: [bh, sq].  Returns f32
+    (dq [bh,sq,d], dk [bh,sk,d], dv [bh,sk,d], amax [bh,sq] i32) —
+    flash-tiled backward (without the g_m δ term, which the caller
+    applies from amax), per-step memory O(BQ·BK) like the forward."""
     bh, sq, d0 = q.shape
     sk = k.shape[1]
     qp = _pad_to(_pad_to(q, 1, _BQ), 2, _LANE)
@@ -436,7 +451,6 @@ def _flash_bwd_jit(
     gpvp = _pad_to(_pad_to(gpv.astype(jnp.float32), 1, _BQ), 2, _LANE)
     mp = _pad_to(m, 1, _BQ)
     glp = _pad_to(gl, 1, _BQ)
-    gmtp = _pad_to(gmt, 1, _BQ)
     sq_pad, d = qp.shape[1], qp.shape[2]
     sk_pad = kp.shape[1]
     offs = jnp.concatenate(
@@ -458,7 +472,6 @@ def _flash_bwd_jit(
                 pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
                 pl.BlockSpec((1, _BQ, d), lambda i, j, kb, o: (i, j, 0)),
                 pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
-                pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, _BQ, d), lambda i, j, kb, o: (i, j, 0)),
@@ -467,7 +480,7 @@ def _flash_bwd_jit(
             scratch_shapes=[
                 pltpu.VMEM((_BQ, d), jnp.float32),
                 pltpu.VMEM((_BQ,), jnp.int32),
-                pltpu.VMEM((_BQ,), jnp.int32),
+                pltpu.VMEM((_BQ,), jnp.float32),
             ],
         ),
         out_shape=[
@@ -475,7 +488,7 @@ def _flash_bwd_jit(
             jax.ShapeDtypeStruct((bh, sq_pad), jnp.int32, vma=vma),
         ],
         interpret=_use_interpret(),
-    )(offs, qp, kp, vp, mp, gpvp, glp, gmtp)
+    )(offs, qp, kp, vp, mp, gpvp, glp)
 
     grid_b = (bh, sk_pad // _BK, sq_pad // _BQ)
     kern_b = functools.partial(
@@ -493,8 +506,6 @@ def _flash_bwd_jit(
                 pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
                 pl.BlockSpec((1, _BQ, d), lambda i, kb, j, o: (i, j, 0)),
                 pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
-                pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
-                pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, _BK, d), lambda i, kb, j, o: (i, kb, 0)),
@@ -510,11 +521,12 @@ def _flash_bwd_jit(
             jax.ShapeDtypeStruct((bh, sk_pad, d), jnp.float32, vma=vma),
         ],
         interpret=_use_interpret(),
-    )(offs, qp, kp, vp, mp, gpvp, glp, gmtp, amax)
+    )(offs, qp, kp, vp, mp, gpvp, glp)
     return (
         dq[:, :sq, :d0],
         dk[:, :sk, :d0],
         dv[:, :sk, :d0],
+        amax[:, :sq],
     )
 
 
@@ -540,12 +552,30 @@ def _flash_bwd(q, k, v, qo, ko, outs, cts, causal, scale, vma):
     )
     flat = lambda x: x.reshape(b * h, x.shape[2])  # [b,h,s] -> [bh,s]
     offs = jnp.stack([qo, ko]).astype(jnp.int32)
-    dq, dk, dv = _flash_bwd_jit(
-        to_bh(q), to_bh(k), to_bh(v),
+    q_bh, k_bh, v_bh = to_bh(q), to_bh(k), to_bh(v)
+    dq, dk, dv, amax = _flash_bwd_jit(
+        q_bh, k_bh, v_bh,
         flat(m_safe), to_bh(g_pv), flat(g_l.astype(jnp.float32)),
-        flat(gmt), offs,
+        offs,
         causal=causal, scale=scale, vma=tuple(vma),
     )
+    # g_m δ term, applied OUTSIDE the kernels on the dq kernel's
+    # exported argmax (gather for dq, scatter-add for dk): a valid
+    # subgradient of max with no cross-kernel float comparison to
+    # drift on hardware.  Rows with no valid position keep zero.
+    sk = k.shape[1]
+    gmt_flat = flat(gmt)
+    valid = amax >= 0
+    gmt_eff = jnp.where(valid, gmt_flat, 0.0)  # [bh, sq]
+    idx = jnp.clip(amax, 0, sk - 1)  # [bh, sq]
+    k_at = jnp.take_along_axis(
+        k_bh.astype(jnp.float32), idx[:, :, None], axis=1
+    )  # [bh, sq, d]
+    dq = dq + scale * gmt_eff[:, :, None] * k_at
+    contrib = scale * gmt_eff[:, :, None] * q_bh.astype(jnp.float32)
+    bh_idx = jnp.arange(b * h)[:, None]
+    dk = dk.at[bh_idx, idx, :].add(contrib)
+
     back = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return (
         back(dq, sq).astype(q.dtype),

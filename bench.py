@@ -344,6 +344,30 @@ def _run_child_gracefully(budget: float):
     return out, (err or "") + f"\n{note}SIGKILL was required", -9
 
 
+def _tunnel_diagnosis() -> str:
+    """Fast check of the axon TPU attachment's transport so a dead
+    tunnel yields a precise error instead of N slow init timeouts
+    (backend init blocks forever retrying connect when the relay is
+    gone — round 1's failure mode had no diagnostics at all)."""
+    # only when the env EXPLICITLY targets the tunneled axon backend —
+    # defaulting to the probe on unset env would mislabel ordinary CPU
+    # runs (no 808x listener there either) as tunnel failures
+    if "axon" not in os.environ.get("JAX_PLATFORMS", ""):
+        return ""
+    import socket
+
+    for port in (8082, 8083, 8087):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2):
+                return ""  # something listens: transport looks alive
+        except OSError:
+            continue
+    return (
+        "TPU tunnel transport down: no relay listener on 127.0.0.1:808x "
+        "(backend init would block indefinitely)"
+    )
+
+
 def main() -> None:
     if "--child" in sys.argv:
         run_child()
@@ -352,9 +376,17 @@ def main() -> None:
     deadline = time.time() + _SUPERVISOR_DEADLINE_S
     last_err = ""
     attempt = 0
+    diagnoses: list = []
     while attempt < _MAX_ATTEMPTS and time.time() < deadline - 30:
         attempt += 1
         budget = min(_CHILD_TIMEOUT_S, max(60, deadline - time.time()))
+        diagnosis = _tunnel_diagnosis()
+        if diagnosis:
+            # the transport is down: a full-length attempt would just
+            # hang in backend init — probe briefly in case the relay
+            # comes back, then fail fast with the diagnosis attached
+            budget = min(budget, 90)
+            diagnoses.append(f"attempt {attempt}: {diagnosis}")
         out, err, rc = _run_child_gracefully(budget)
         # forward the child's JSON line even if it later crashed — but
         # only a line that actually parses (a child killed mid-print
@@ -378,18 +410,23 @@ def main() -> None:
             time.sleep(min(20 * attempt, max(1, deadline - time.time() - 60)))
 
     # exhausted: still emit a parseable record for the driver
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": 0.0,
-                "unit": "GB/s/chip",
-                "vs_baseline": 0.0,
-                "error": last_err,
-                "attempts": attempt,
-            }
-        )
-    )
+    record = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "GB/s/chip",
+        "vs_baseline": 0.0,
+        "error": last_err,
+        "attempts": attempt,
+    }
+    # per-attempt diagnoses captured when each attempt was clamped —
+    # a relay recovering just before exhaustion must not erase why the
+    # attempts themselves failed
+    final = _tunnel_diagnosis()
+    if final:
+        diagnoses.append(f"at exit: {final}")
+    if diagnoses:
+        record["diagnosis"] = "; ".join(diagnoses[-5:])
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

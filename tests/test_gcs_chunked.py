@@ -79,6 +79,8 @@ class FakeBlob:
 
 
 class FakeBucket:
+    name = "bkt"
+
     def __init__(self):
         self.data = {}
         self.gens = {}
@@ -87,6 +89,14 @@ class FakeBucket:
 
     def blob(self, name):
         return FakeBlob(self, name)
+
+    def copy_blob(self, src_blob, dst_bucket, new_name):
+        self.fail_hook("copy", new_name)
+        if src_blob.name not in self.data:
+            raise NotFound(src_blob.name)
+        dst_bucket.data[new_name] = self.data[src_blob.name]
+        # real GCS rewrites always mint a generation
+        dst_bucket.gens[new_name] = dst_bucket.gens.get(new_name, 0) + 1
 
 
 def make_plugin(chunk_bytes):
@@ -268,3 +278,23 @@ def test_delete_is_idempotent():
     run(p.delete("obj"))
     assert "run/obj" not in p._bucket.data
     run(p.delete("obj"))  # second delete: 404 -> success, no raise
+
+
+def test_stat_via_metadata_reload():
+    p = make_plugin(chunk_bytes=10**9)
+    run(p.write(WriteIO(path="obj", buf=b"x" * 77)))
+    assert run(p.stat("obj")) == 77
+    with pytest.raises(FileNotFoundError):
+        run(p.stat("missing"))
+
+
+def test_link_from_server_side_copy():
+    p = make_plugin(chunk_bytes=10**9)
+    # base snapshot under another prefix of the same bucket
+    p._bucket.data["base/obj"] = b"payload"
+    p._bucket.gens["base/obj"] = 1
+    run(p.link_from("gs://bkt/base", "obj"))
+    io_ = ReadIO(path="obj")
+    run(p.read(io_))
+    assert bytes(io_.buf) == b"payload"
+    assert run(p.stat("obj")) == 7  # copied blob has metadata too

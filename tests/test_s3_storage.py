@@ -40,6 +40,19 @@ class FakeBoto3Client:
             data = data[int(lo) : int(hi) + 1]  # S3 Range end is inclusive
         return {"Body": io.BytesIO(data)}
 
+    def head_object(self, Bucket, Key):
+        self.calls.append(("head", Bucket, Key))
+        if (Bucket, Key) not in self.objects:
+            raise NoSuchKey(Key)
+        return {"ContentLength": len(self.objects[(Bucket, Key)])}
+
+    def copy_object(self, Bucket, Key, CopySource):
+        self.calls.append(("copy", Bucket, Key, tuple(CopySource.items())))
+        src = (CopySource["Bucket"], CopySource["Key"])
+        if src not in self.objects:
+            raise NoSuchKey(CopySource["Key"])
+        self.objects[(Bucket, Key)] = self.objects[src]
+
     def delete_object(self, Bucket, Key):
         self.calls.append(("delete", Bucket, Key))
         # S3 delete is idempotent: deleting a missing key succeeds
@@ -143,3 +156,26 @@ def test_snapshot_level_round_trip_via_stub(tmp_path, monkeypatch):
     dest = StateDict(w=np.zeros(8, np.int32))
     Snapshot("s3://bkt/ck").restore({"app": dest})
     np.testing.assert_array_equal(dest["w"], np.arange(8, dtype=np.int32))
+
+
+def test_stat_via_head_object():
+    p = make_plugin()
+    run(p.write(WriteIO(path="obj", buf=b"123456")))
+    assert run(p.stat("obj")) == 6
+    assert ("head", "bkt", "run/1/obj") in p._backend.calls
+    with pytest.raises(FileNotFoundError):
+        run(p.stat("missing"))
+
+
+def test_link_from_server_side_copy():
+    p = make_plugin()
+    # the "base snapshot" lives under another prefix of the same bucket
+    p._backend.objects[("bkt", "base/7/obj")] = b"payload"
+    run(p.link_from("s3://bkt/base/7", "obj"))
+    # copied server-side: no get/put of the payload
+    assert ("copy", "bkt", "run/1/obj",
+            (("Bucket", "bkt"), ("Key", "base/7/obj"))) in p._backend.calls
+    assert not any(c[0] in ("get", "put") for c in p._backend.calls)
+    assert run(p.stat("obj")) == 7
+    with pytest.raises(NoSuchKey):
+        run(p.link_from("s3://bkt/base/7", "nope"))

@@ -1,0 +1,174 @@
+"""Randomized cross-feature stress: random state trees through take →
+deep verify → incremental take → elastic (resharded) restore → partial
+restore, over many seeds.
+
+Each feature has targeted tests; this hunts the INTERACTIONS — e.g. a
+chunked bf16 array inside a slab, deduped against a base, restored onto
+a different mesh spec while a glob filter is active.
+"""
+
+import fnmatch
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict, knobs
+
+_NP_DTYPES = [np.float32, np.float64, np.int32, np.uint8]
+_JAX_DTYPES = [jnp.float32, jnp.bfloat16, jnp.int32]
+
+
+def _random_state(rng, mesh):
+    """(app_state dict, flat {path: numpy oracle}) with a random mix of
+    host arrays, device arrays (some sharded), scalars and containers."""
+    tree = {}
+    oracle = {}
+
+    def put(container, key, value, path):
+        container[key] = value
+        oracle[path] = np.asarray(value).copy() if hasattr(
+            value, "shape"
+        ) else value
+
+    n_leaves = rng.integers(3, 9)
+    for i in range(n_leaves):
+        kind = rng.integers(0, 5)
+        key = f"leaf{i}"
+        if kind == 0:  # host numpy
+            dt = _NP_DTYPES[rng.integers(len(_NP_DTYPES))]
+            shape = tuple(rng.integers(1, 33, size=rng.integers(1, 3)))
+            arr = (rng.standard_normal(shape) * 10).astype(dt)
+            put(tree, key, arr, key)
+        elif kind == 1:  # single-device jax
+            dt = _JAX_DTYPES[rng.integers(len(_JAX_DTYPES))]
+            n = int(rng.integers(8, 700))
+            arr = jnp.asarray(
+                (rng.standard_normal(n) * 4).astype(np.float32)
+            ).astype(dt)
+            put(tree, key, arr, key)
+        elif kind == 2:  # sharded jax over a random 1/2-axis spec
+            rows = int(rng.integers(1, 5)) * 8
+            cols = int(rng.integers(1, 5)) * 8
+            arr_np = (rng.standard_normal((rows, cols)) * 3).astype(
+                np.float32
+            )
+            spec = [P("dp", None), P(None, "tp"), P("dp", "tp"), P()][
+                rng.integers(4)
+            ]
+            arr = jax.device_put(
+                jnp.asarray(arr_np), NamedSharding(mesh, spec)
+            )
+            put(tree, key, arr, key)
+        elif kind == 3:  # scalar / string
+            if rng.integers(2):
+                put(tree, key, int(rng.integers(0, 1000)), key)
+            else:
+                put(tree, key, f"tag-{rng.integers(0, 1000)}", key)
+        else:  # nested container with a couple of leaves
+            sub = {}
+            for j in range(int(rng.integers(1, 3))):
+                arr = (rng.standard_normal(16) * 2).astype(np.float32)
+                put(sub, f"s{j}", arr, f"{key}/s{j}")
+            tree[key] = sub
+    return tree, oracle
+
+
+def _templates_like(oracle, mesh2, rng):
+    """Fresh zeroed templates; jax leaves land on a DIFFERENT mesh spec
+    (elastic restore)."""
+    out = {}
+    for path, val in oracle.items():
+        parts = path.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        if isinstance(val, np.ndarray):
+            if val.ndim == 2 and val.shape[0] % 8 == 0:
+                spec = [P("r", None), P()][rng.integers(2)]
+                node[parts[-1]] = jax.device_put(
+                    jnp.zeros(val.shape, jnp.float32),
+                    NamedSharding(mesh2, spec),
+                )
+            else:
+                node[parts[-1]] = np.zeros_like(val)
+        else:
+            node[parts[-1]] = type(val)()  # 0 for ints, "" for strings
+    return out
+
+
+def _check(tree, oracle, paths=None, prev=None):
+    for path, want in oracle.items():
+        parts = path.split("/")
+        node = tree
+        for p in parts:
+            node = node[p]
+        if paths is not None and not any(
+            fnmatch.fnmatch(f"m/{path}", g) for g in paths
+        ):
+            want = prev[path]  # unmatched: previous value preserved
+        if isinstance(want, np.ndarray):
+            lossy = np.asarray(node).dtype.itemsize < 8
+            got = np.asarray(node, dtype=np.float64)
+            np.testing.assert_allclose(
+                got,
+                np.asarray(want, dtype=np.float64),
+                rtol=2e-2 if lossy else 1e-9,
+                atol=1e-2 if lossy else 1e-9,
+                err_msg=path,
+            )
+        else:
+            assert node == want, (path, node, want)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_roundtrip(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(2, 4), ("dp", "tp"))
+    mesh2 = Mesh(devs.reshape(8), ("r",))
+
+    tree, oracle = _random_state(rng, mesh)
+
+    batching = bool(rng.integers(2))
+    chunk = int(rng.choice([256, 4096, 512 * 1024 * 1024]))
+    with knobs.override_disable_batching(not batching), \
+            knobs.override_max_chunk_size_bytes(chunk):
+        s1 = Snapshot.take(str(tmp_path / "s1"), {"m": PyTreeState(tree)})
+        assert s1.verify(deep=True).ok
+
+        # mutate a random subset of HOST leaves; device leaves stay
+        mutated = dict(oracle)
+        t2 = dict(tree)
+        for path in list(oracle):
+            if "/" not in path and isinstance(
+                tree.get(path), np.ndarray
+            ) and rng.integers(2):
+                t2[path] = tree[path] + 1
+                mutated[path] = np.asarray(t2[path]).copy()
+
+        s2 = Snapshot.take(
+            str(tmp_path / "s2"),
+            {"m": PyTreeState(t2)},
+            base=str(tmp_path / "s1"),
+        )
+        assert s2.verify(deep=True).ok
+
+        # elastic restore of the incremental snapshot onto mesh2
+        dest = PyTreeState(_templates_like(mutated, mesh2, rng))
+        with knobs.override_verify_on_restore(bool(rng.integers(2))):
+            s2.restore({"m": dest})
+        _check(dest.tree, mutated)
+
+        # partial restore of snapshot 1 over the restored state: matched
+        # leaves roll BACK to s1 values, unmatched keep s2 values
+        glob = ["m/leaf0*", "m/leaf1*"]
+        prev = {
+            p: np.asarray(v).copy() if isinstance(v, np.ndarray) else v
+            for p, v in mutated.items()
+        }
+        s1.restore({"m": dest}, paths=glob)
+        _check(dest.tree, oracle, paths=glob, prev=prev)

@@ -177,5 +177,6 @@ def test_link_from_server_side_copy():
             (("Bucket", "bkt"), ("Key", "base/7/obj"))) in p._backend.calls
     assert not any(c[0] in ("get", "put") for c in p._backend.calls)
     assert run(p.stat("obj")) == 7
-    with pytest.raises(NoSuchKey):
+    # missing copy source maps to the cross-plugin contract
+    with pytest.raises(FileNotFoundError):
         run(p.link_from("s3://bkt/base/7", "nope"))

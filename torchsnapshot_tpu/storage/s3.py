@@ -108,23 +108,38 @@ class S3StoragePlugin(StoragePlugin):
         base = base_url.split("://", 1)[-1]
         src_bucket, _, src_prefix = base.partition("/")
         src_key = f"{src_prefix}/{path}" if src_prefix else path
-        if self._is_fs:
-            await self._run(
-                functools.partial(
-                    self._backend.copy,
-                    f"{src_bucket}/{src_key}",
-                    f"{self.bucket}/{self._key(path)}",
+        try:
+            if self._is_fs:
+                await self._run(
+                    functools.partial(
+                        self._backend.copy,
+                        f"{src_bucket}/{src_key}",
+                        f"{self.bucket}/{self._key(path)}",
+                    )
                 )
-            )
-        else:
-            await self._run(
-                functools.partial(
-                    self._backend.copy_object,
-                    Bucket=self.bucket,
-                    Key=self._key(path),
-                    CopySource={"Bucket": src_bucket, "Key": src_key},
+            else:
+                await self._run(
+                    functools.partial(
+                        self._backend.copy_object,
+                        Bucket=self.bucket,
+                        Key=self._key(path),
+                        CopySource={"Bucket": src_bucket, "Key": src_key},
+                    )
                 )
+        except FileNotFoundError:
+            raise
+        except Exception as e:
+            # same missing-key contract as read/stat (and gs:// link_from)
+            code = str(
+                getattr(e, "response", {}).get("Error", {}).get("Code", "")
             )
+            if code in ("NoSuchKey", "404") or type(e).__name__ in (
+                "NoSuchKey",
+            ):
+                raise FileNotFoundError(
+                    f"s3://{src_bucket}/{src_key}"
+                ) from e
+            raise
 
     async def stat(self, path: str) -> int:
         key = self._key(path)

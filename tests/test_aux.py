@@ -255,3 +255,54 @@ def test_pallas_probe_failure_falls_back(monkeypatch):
     monkeypatch.setattr(fa, "flash_attention", boom)
     assert fa.pallas_probe_ok() is False
     assert fa.pallas_probe_ok() is False
+
+
+def test_cli_ls_verify_steps_delete(tmp_path, capsys):
+    """Operator CLI: ls/manifest/verify/steps/delete round-trip."""
+    import numpy as np
+
+    from torchsnapshot_tpu import SnapshotManager, StateDict
+    from torchsnapshot_tpu.__main__ import main as cli
+
+    mgr = SnapshotManager(str(tmp_path))
+    mgr.save(
+        {"app": StateDict(w=np.arange(256, dtype=np.float32), step=3)},
+        step=1,
+    )
+    snap_path = mgr.path_for_step(1)
+
+    assert cli(["ls", snap_path]) == 0
+    out = capsys.readouterr().out
+    assert "app/w" in out and "float32[256]" in out
+
+    assert cli(["manifest", snap_path]) == 0
+    md = capsys.readouterr().out
+    assert '"manifest"' in md and '"objects"' in md
+
+    assert cli(["verify", "--deep", snap_path]) == 0
+    assert capsys.readouterr().out.startswith("OK")
+
+    assert cli(["steps", str(tmp_path)]) == 0
+    assert capsys.readouterr().out.splitlines()[0].startswith("1\t")
+
+    # corrupt -> verify fails with exit 1
+    import os
+
+    # damage one payload byte
+    man_entry = next(
+        e for e in mgr.snapshot(1).get_manifest().values()
+        if getattr(e, "crc32", None) is not None
+    )
+    p = os.path.join(snap_path, man_entry.location)
+    data = bytearray(open(p, "rb").read())
+    data[(man_entry.byte_range or [0])[0]] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    assert cli(["verify", "--deep", snap_path]) == 1
+    assert "FAILED" in capsys.readouterr().out
+
+    assert cli(["delete", snap_path]) == 2  # refused without --yes
+    capsys.readouterr()
+    assert cli(["delete", snap_path, "--yes"]) == 0
+    assert not os.path.exists(snap_path)
+
+    assert cli(["ls", snap_path]) == 1  # gone -> clean error, not traceback

@@ -1,0 +1,140 @@
+"""Operator CLI: inspect, audit and manage snapshots from a shell.
+
+    python -m torchsnapshot_tpu ls        <snapshot-path>
+    python -m torchsnapshot_tpu manifest  <snapshot-path>
+    python -m torchsnapshot_tpu verify    <snapshot-path> [--deep] [--rank N]
+    python -m torchsnapshot_tpu steps     <manager-root>
+    python -m torchsnapshot_tpu delete    <snapshot-path> --yes
+
+Paths take any storage URL the library accepts (plain/fs, gs://, s3://).
+Exit code is non-zero when a verify fails or a delete is refused —
+usable directly from CI and babysitter jobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _human(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def _cmd_ls(args) -> int:
+    from .manifest import is_container_entry
+    from .serialization import serialized_size_bytes, string_to_dtype
+    from .snapshot import Snapshot
+
+    man = Snapshot(args.path).get_manifest()
+    rows = []
+    for lpath, e in sorted(man.items()):
+        if is_container_entry(e):
+            continue
+        kind = e.type
+        detail = ""
+        nbytes = 0
+        shape = getattr(e, "shape", None)
+        dtype = getattr(e, "dtype", None)
+        if shape is not None and dtype is not None:
+            detail = f"{dtype}{list(shape)}"
+            nbytes = serialized_size_bytes(shape, string_to_dtype(dtype))
+        rows.append((lpath, kind, detail, nbytes))
+    width = max((len(r[0]) for r in rows), default=10)
+    for lpath, kind, detail, nbytes in rows:
+        size = _human(nbytes) if nbytes else ""
+        print(f"{lpath:<{width}}  {kind:<12} {detail:<24} {size}")
+    print(f"{len(rows)} entries")
+    return 0
+
+
+def _cmd_manifest(args) -> int:
+    from .snapshot import Snapshot
+
+    print(
+        json.dumps(
+            json.loads(Snapshot(args.path).metadata.to_json()), indent=2
+        )
+    )
+    return 0
+
+
+def _cmd_verify(args) -> int:
+    from .snapshot import Snapshot
+    from .verify import verify_snapshot
+
+    res = verify_snapshot(
+        Snapshot(args.path), deep=args.deep, rank=args.rank
+    )
+    print(str(res))
+    return 0 if res.ok else 1
+
+
+def _cmd_steps(args) -> int:
+    from .manager import SnapshotManager
+
+    mgr = SnapshotManager(args.root)
+    steps = mgr.steps()
+    for step in steps:
+        print(f"{step}\t{mgr.path_for_step(step)}")
+    if not steps:
+        print("(no committed snapshots)", file=sys.stderr)
+    return 0
+
+
+def _cmd_delete(args) -> int:
+    from .manager import delete_snapshot
+
+    if not args.yes:
+        print("refusing to delete without --yes", file=sys.stderr)
+        return 2
+    delete_snapshot(args.path)
+    print(f"deleted {args.path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("ls", help="list a snapshot's logical entries")
+    p.add_argument("path")
+    p.set_defaults(fn=_cmd_ls)
+
+    p = sub.add_parser("manifest", help="dump snapshot metadata as JSON")
+    p.add_argument("path")
+    p.set_defaults(fn=_cmd_manifest)
+
+    p = sub.add_parser("verify", help="integrity audit (exit 1 on failure)")
+    p.add_argument("path")
+    p.add_argument("--deep", action="store_true",
+                   help="re-read payloads against recorded checksums")
+    p.add_argument("--rank", type=int, default=0)
+    p.set_defaults(fn=_cmd_verify)
+
+    p = sub.add_parser("steps", help="list a manager root's committed steps")
+    p.add_argument("root")
+    p.set_defaults(fn=_cmd_steps)
+
+    p = sub.add_parser("delete", help="delete one snapshot (metadata-first)")
+    p.add_argument("path")
+    p.add_argument("--yes", action="store_true")
+    p.set_defaults(fn=_cmd_delete)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (FileNotFoundError, RuntimeError) as e:
+        # missing OR corrupt/aborted snapshots print one clean line —
+        # diagnosing exactly these is what the operator ran the tool for
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,19 @@ from concurrent.futures import ThreadPoolExecutor
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 
+def _raise_missing_as_fnf(e: Exception, uri: str) -> None:
+    """Map botocore NoSuchKey/404 to the cross-plugin FileNotFoundError
+    contract (fs/memory/gcs behave the same); re-raise anything else."""
+    if isinstance(e, FileNotFoundError):
+        raise e
+    code = str(
+        getattr(e, "response", {}).get("Error", {}).get("Code", "")
+    )
+    if code in ("NoSuchKey", "404") or type(e).__name__ in ("NoSuchKey",):
+        raise FileNotFoundError(uri) from e
+    raise e
+
+
 class S3StoragePlugin(StoragePlugin):
     def __init__(self, path: str, num_threads: int = 16) -> None:
         self.bucket, _, self.prefix = path.partition("/")
@@ -88,20 +101,9 @@ class S3StoragePlugin(StoragePlugin):
                 )
             except Exception as e:
                 # Map missing keys to the same cold-start contract as the
-                # fs/memory/gcs plugins (botocore ClientError NoSuchKey /
-                # 404) so `except FileNotFoundError` works for s3:// too.
-                code = str(
-                    getattr(e, "response", {})
-                    .get("Error", {})
-                    .get("Code", "")
-                )
-                if code in ("NoSuchKey", "404") or type(e).__name__ in (
-                    "NoSuchKey",
-                ):
-                    raise FileNotFoundError(
-                        f"s3://{self.bucket}/{key}"
-                    ) from e
-                raise
+                # fs/memory/gcs plugins so `except FileNotFoundError`
+                # works for s3:// too.
+                _raise_missing_as_fnf(e, f"s3://{self.bucket}/{key}")
             read_io.buf = await self._run(resp["Body"].read)
 
     async def link_from(self, base_url: str, path: str) -> None:
@@ -126,20 +128,9 @@ class S3StoragePlugin(StoragePlugin):
                         CopySource={"Bucket": src_bucket, "Key": src_key},
                     )
                 )
-        except FileNotFoundError:
-            raise
         except Exception as e:
             # same missing-key contract as read/stat (and gs:// link_from)
-            code = str(
-                getattr(e, "response", {}).get("Error", {}).get("Code", "")
-            )
-            if code in ("NoSuchKey", "404") or type(e).__name__ in (
-                "NoSuchKey",
-            ):
-                raise FileNotFoundError(
-                    f"s3://{src_bucket}/{src_key}"
-                ) from e
-            raise
+            _raise_missing_as_fnf(e, f"s3://{src_bucket}/{src_key}")
 
     async def stat(self, path: str) -> int:
         key = self._key(path)
@@ -157,17 +148,8 @@ class S3StoragePlugin(StoragePlugin):
                 )
             )
             return int(resp["ContentLength"])
-        except FileNotFoundError:
-            raise
         except Exception as e:
-            code = str(
-                getattr(e, "response", {}).get("Error", {}).get("Code", "")
-            )
-            if code in ("NoSuchKey", "404") or type(e).__name__ in (
-                "NoSuchKey",
-            ):
-                raise FileNotFoundError(f"s3://{self.bucket}/{key}") from e
-            raise
+            _raise_missing_as_fnf(e, f"s3://{self.bucket}/{key}")
 
     async def delete(self, path: str) -> None:
         key = self._key(path)

@@ -1,0 +1,127 @@
+"""Pipeline parallelism (parallel/pipeline.py): GPipe schedule over a
+mesh axis — forward parity with the sequential oracle, gradient parity,
+and checkpoint round-trip of pp-sharded stage weights incl. elastic
+restore onto a different topology."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import PyTreeState, Snapshot
+from torchsnapshot_tpu.parallel.pipeline import (
+    init_pipeline_params,
+    pipeline_forward,
+    pipeline_train_step,
+    sequential_forward,
+    shard_pipeline_params,
+)
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("pp",))
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 2), (4, 4), (8, 2)])
+def test_pipeline_forward_matches_sequential(n_stages, n_micro):
+    mesh = _mesh(n_stages)
+    params = shard_pipeline_params(
+        init_pipeline_params(jax.random.PRNGKey(0), n_stages, 16), mesh
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    out = pipeline_forward(params, x, mesh, n_microbatches=n_micro)
+    ref = sequential_forward(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_pipeline_grads_match_sequential():
+    mesh = _mesh(4)
+    params = shard_pipeline_params(
+        init_pipeline_params(jax.random.PRNGKey(2), 4, 8), mesh
+    )
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 8))
+    y = jax.random.normal(jax.random.PRNGKey(4), (4, 8))
+
+    g_pipe = jax.grad(
+        lambda p: jnp.mean(
+            (pipeline_forward(p, x, mesh, n_microbatches=2) - y) ** 2
+        )
+    )(params)
+    g_ref = jax.grad(
+        lambda p: jnp.mean((sequential_forward(p, x) - y) ** 2)
+    )(params)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(g_pipe[k]), np.asarray(g_ref[k]),
+            rtol=1e-5, atol=1e-5, err_msg=k,
+        )
+
+
+def test_pipeline_training_reduces_loss():
+    mesh = _mesh(4)
+    params = shard_pipeline_params(
+        init_pipeline_params(jax.random.PRNGKey(5), 4, 16), mesh
+    )
+    x = jax.random.normal(jax.random.PRNGKey(6), (8, 16))
+    y = jnp.zeros((8, 16))
+    losses = []
+    for _ in range(3):
+        params, loss = pipeline_train_step(params, x, y, mesh)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_checkpoint_elastic_restore(tmp_path):
+    """pp-sharded stage weights checkpoint like any sharded array: save
+    from a 4-stage pipeline, restore onto a 2-stage-deeper-mesh AND onto
+    a replicated eval topology — values exact in both."""
+    mesh4 = _mesh(4)
+    params = shard_pipeline_params(
+        init_pipeline_params(jax.random.PRNGKey(7), 4, 16), mesh4
+    )
+    params, _ = pipeline_train_step(
+        params, jax.random.normal(jax.random.PRNGKey(8), (8, 16)),
+        jnp.zeros((8, 16)), mesh4,
+    )
+    snap = Snapshot.take(str(tmp_path / "s"), {"pp": PyTreeState(params)})
+
+    # different pipeline-axis size (2 devices)
+    mesh2 = _mesh(2)
+    dest2 = PyTreeState(
+        {
+            "w": jax.device_put(
+                jnp.zeros((4, 16, 16)), NamedSharding(mesh2, P("pp"))
+            ),
+            "b": jax.device_put(
+                jnp.zeros((4, 16)), NamedSharding(mesh2, P("pp"))
+            ),
+        }
+    )
+    snap.restore({"pp": dest2})
+    np.testing.assert_array_equal(
+        np.asarray(dest2.tree["w"]), np.asarray(params["w"])
+    )
+
+    # replicated eval topology; pipeline on mesh2 must agree with the
+    # sequential oracle on the restored weights
+    dest_eval = PyTreeState(
+        {"w": jnp.zeros((4, 16, 16)), "b": jnp.zeros((4, 16))}
+    )
+    snap.restore({"pp": dest_eval})
+    x = jax.random.normal(jax.random.PRNGKey(9), (4, 16))
+    ref = sequential_forward(dest_eval.tree, x)
+    # note: 4 stages over a 2-device mesh is not supported by this
+    # schedule (stage dim must equal the axis size) — fails loudly,
+    # including under `python -O` (ValueError, not assert)
+    with pytest.raises(ValueError, match="pp axis size"):
+        pipeline_forward(dest2.tree, x, mesh2, n_microbatches=2)
+    np.testing.assert_allclose(
+        np.asarray(sequential_forward(dest2.tree, x)),
+        np.asarray(ref),
+        rtol=1e-6,
+        atol=1e-6,
+    )

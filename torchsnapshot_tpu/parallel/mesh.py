@@ -15,6 +15,21 @@ from typing import Optional, Tuple
 import numpy as np
 
 
+def get_shard_map():
+    """(shard_map callable, new_style) — the jax>=0.8 top-level API vs
+    the experimental module.  One shim for every parallel op (the
+    new/old split also decides which replication-check kwarg exists:
+    ``check_vma`` new-style, ``check_rep`` old-style)."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+
+        return shard_map, True
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map, False
+
+
 def ensure_cpu_devices(min_devices: int = 1) -> None:
     """Force the CPU platform (dropping any experimental TPU plugin whose
     init would block without hardware) — used by tests and the driver's

@@ -144,14 +144,9 @@ def ring_attention(
     ``batch_axis``)."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map  # jax >= 0.8
+    from .mesh import get_shard_map
 
-        new_style = True
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
-
-        new_style = False
+    shard_map, new_style = get_shard_map()
 
     spec = P(batch_axis, axis_name, None, None)
     kwargs = {}

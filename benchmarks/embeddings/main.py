@@ -34,7 +34,7 @@ def main() -> None:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     import numpy as np
 
-    from torchsnapshot_tpu import PyTreeState, Snapshot
+    from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict
     from torchsnapshot_tpu.rss_profiler import measure_rss_deltas
 
     devices = np.array(jax.devices())
@@ -57,6 +57,12 @@ def main() -> None:
     }
     jax.block_until_ready(tables)
     total_gb = args.tables * rows_per_table * args.dim * 4 / 1e9
+
+    # absorb one-time costs (thread pools, event loop, plugin imports)
+    # so the timed numbers reflect steady state, like bench.py's warmup
+    _warm = tempfile.mkdtemp(prefix="tsnp_warm_")
+    Snapshot.take(_warm, {"w": StateDict(x=np.zeros(1024, np.float32))})
+    shutil.rmtree(_warm, ignore_errors=True)
 
     work = args.work_dir or tempfile.mkdtemp(prefix="tsnp_emb_")
     try:

@@ -17,6 +17,8 @@ import sys
 import tempfile
 import time
 
+import numpy as np
+
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 )
@@ -32,7 +34,7 @@ def main() -> None:
 
     import jax
 
-    from torchsnapshot_tpu import PyTreeState, Snapshot
+    from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict
     from torchsnapshot_tpu.models.transformer import (
         TransformerConfig,
         make_train_state,
@@ -52,6 +54,12 @@ def main() -> None:
         x.nbytes for x in jax.tree_util.tree_leaves(ts) if hasattr(x, "nbytes")
     )
     total_gb = n_bytes / 1e9
+
+    # absorb one-time costs (thread pools, event loop, plugin imports)
+    # so the timed numbers reflect steady state, like bench.py's warmup
+    _warm = tempfile.mkdtemp(prefix="tsnp_warm_")
+    Snapshot.take(_warm, {"w": StateDict(x=np.zeros(1024, np.float32))})
+    shutil.rmtree(_warm, ignore_errors=True)
 
     work = args.work_dir or tempfile.mkdtemp(prefix="tsnp_fsdp_")
     try:

@@ -33,7 +33,7 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from torchsnapshot_tpu import PyTreeState, Snapshot
+    from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict
     from torchsnapshot_tpu.rss_profiler import measure_rss_deltas
 
     n_arrays = 32
@@ -46,6 +46,12 @@ def main() -> None:
     params = {f"layer{i}/w": make(i) for i in range(n_arrays)}
     jax.block_until_ready(params)
     total_gb = n_arrays * elems * 2 / 1e9
+
+    # absorb one-time costs (thread pools, event loop, plugin imports)
+    # so the timed numbers reflect steady state, like bench.py's warmup
+    _warm = tempfile.mkdtemp(prefix="tsnp_warm_")
+    Snapshot.take(_warm, {"w": StateDict(x=np.zeros(1024, np.float32))})
+    shutil.rmtree(_warm, ignore_errors=True)
 
     work = args.work_dir or tempfile.mkdtemp(prefix="tsnp_repl_")
     try:

@@ -34,7 +34,7 @@ def main() -> None:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     import numpy as np
 
-    from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict
+    from torchsnapshot_tpu import PyTreeState, Snapshot
     from torchsnapshot_tpu.rss_profiler import measure_rss_deltas
 
     devices = np.array(jax.devices())
@@ -58,17 +58,20 @@ def main() -> None:
     jax.block_until_ready(tables)
     total_gb = args.tables * rows_per_table * args.dim * 4 / 1e9
 
-    # absorb one-time costs (thread pools, event loop, plugin imports)
-    # so the timed numbers reflect steady state, like bench.py's warmup
-    _warm = tempfile.mkdtemp(prefix="tsnp_warm_")
-    Snapshot.take(_warm, {"w": StateDict(x=np.zeros(1024, np.float32))})
-    shutil.rmtree(_warm, ignore_errors=True)
+    from torchsnapshot_tpu.utils.benchio import settle_dir, warm_up_snapshot_runtime
+
+    warm_up_snapshot_runtime()
 
     work = args.work_dir or tempfile.mkdtemp(prefix="tsnp_emb_")
     try:
         t0 = time.perf_counter()
         Snapshot.take(os.path.join(work, "sync"), {"emb": PyTreeState(tables)})
         t_sync = time.perf_counter() - t0
+
+        # settle the sync phase's dirty pages so writeback doesn't
+        # throttle the async phase on slow disks (would inflate blocked
+        # time with kernel flusher stalls unrelated to the library)
+        settle_dir(work)
 
         rss = []
         with measure_rss_deltas(rss):

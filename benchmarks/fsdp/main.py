@@ -34,7 +34,7 @@ def main() -> None:
 
     import jax
 
-    from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict
+    from torchsnapshot_tpu import PyTreeState, Snapshot
     from torchsnapshot_tpu.models.transformer import (
         TransformerConfig,
         make_train_state,
@@ -55,17 +55,18 @@ def main() -> None:
     )
     total_gb = n_bytes / 1e9
 
-    # absorb one-time costs (thread pools, event loop, plugin imports)
-    # so the timed numbers reflect steady state, like bench.py's warmup
-    _warm = tempfile.mkdtemp(prefix="tsnp_warm_")
-    Snapshot.take(_warm, {"w": StateDict(x=np.zeros(1024, np.float32))})
-    shutil.rmtree(_warm, ignore_errors=True)
+    from torchsnapshot_tpu.utils.benchio import settle_dir, warm_up_snapshot_runtime
+
+    warm_up_snapshot_runtime()
 
     work = args.work_dir or tempfile.mkdtemp(prefix="tsnp_fsdp_")
     try:
         t0 = time.perf_counter()
         Snapshot.take(os.path.join(work, "snap"), {"ts": PyTreeState(ts)})
         t_save = time.perf_counter() - t0
+
+        # settle save's dirty pages before timing the load phase
+        settle_dir(work)
 
         ts2 = make_train_state(cfg, seed=1, mesh=mesh)
         t0 = time.perf_counter()

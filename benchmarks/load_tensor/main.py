@@ -36,11 +36,9 @@ def main() -> None:
     elems = int(args.gb * 1e9 / 4)
     arr = np.arange(elems, dtype=np.float32)
 
-    # absorb one-time costs (thread pools, event loop, plugin imports)
-    # so the timed numbers reflect steady state, like bench.py's warmup
-    _warm = tempfile.mkdtemp(prefix="tsnp_warm_")
-    Snapshot.take(_warm, {"w": StateDict(x=np.zeros(1024, np.float32))})
-    shutil.rmtree(_warm, ignore_errors=True)
+    from torchsnapshot_tpu.utils.benchio import warm_up_snapshot_runtime
+
+    warm_up_snapshot_runtime()
 
     work = args.work_dir or tempfile.mkdtemp(prefix="tsnp_load_")
     try:

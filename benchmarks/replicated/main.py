@@ -33,7 +33,7 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict
+    from torchsnapshot_tpu import PyTreeState, Snapshot
     from torchsnapshot_tpu.rss_profiler import measure_rss_deltas
 
     n_arrays = 32
@@ -47,11 +47,9 @@ def main() -> None:
     jax.block_until_ready(params)
     total_gb = n_arrays * elems * 2 / 1e9
 
-    # absorb one-time costs (thread pools, event loop, plugin imports)
-    # so the timed numbers reflect steady state, like bench.py's warmup
-    _warm = tempfile.mkdtemp(prefix="tsnp_warm_")
-    Snapshot.take(_warm, {"w": StateDict(x=np.zeros(1024, np.float32))})
-    shutil.rmtree(_warm, ignore_errors=True)
+    from torchsnapshot_tpu.utils.benchio import settle_dir, warm_up_snapshot_runtime
+
+    warm_up_snapshot_runtime()
 
     work = args.work_dir or tempfile.mkdtemp(prefix="tsnp_repl_")
     try:
@@ -61,6 +59,11 @@ def main() -> None:
         np.savez(os.path.join(work, "baseline.npz"), **host)
         t_naive = time.perf_counter() - t0
         del host
+
+        # settle the baseline's dirty pages: on a slow disk, writeback of
+        # the naive file otherwise throttles the snapshot phase's writes
+        # and the comparison measures the kernel's flusher, not the library
+        settle_dir(work)
 
         rss = []
         with measure_rss_deltas(rss):

@@ -34,7 +34,7 @@ def main() -> None:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     import numpy as np
 
-    from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict
+    from torchsnapshot_tpu import PyTreeState, Snapshot
 
     devices = np.array(jax.devices())
     mesh = Mesh(devices, ("shard",))
@@ -55,11 +55,9 @@ def main() -> None:
     jax.block_until_ready((params, opt_state))
     total_gb = (n_params * 2 + 2 * n_params * 4) / 1e9
 
-    # absorb one-time costs (thread pools, event loop, plugin imports)
-    # so the timed numbers reflect steady state, like bench.py's warmup
-    _warm = tempfile.mkdtemp(prefix="tsnp_warm_")
-    Snapshot.take(_warm, {"w": StateDict(x=np.zeros(1024, np.float32))})
-    shutil.rmtree(_warm, ignore_errors=True)
+    from torchsnapshot_tpu.utils.benchio import settle_dir, warm_up_snapshot_runtime
+
+    warm_up_snapshot_runtime()
 
     work = args.work_dir or tempfile.mkdtemp(prefix="tsnp_zero_")
     try:
@@ -69,6 +67,9 @@ def main() -> None:
             {"params": PyTreeState(params), "opt": PyTreeState(opt_state)},
         )
         t_save = time.perf_counter() - t0
+
+        # settle save's dirty pages before timing the load phase
+        settle_dir(work)
 
         opt2 = jax.jit(tx.init)(
             jax.device_put(jnp.zeros(n_params, dtype=jnp.float32), sharding)

@@ -1,0 +1,46 @@
+"""Benchmark I/O hygiene helpers shared by benchmarks/*/main.py.
+
+``warm_up_snapshot_runtime``: absorb the runtime's one-time costs (thread
+pools, the private event loop, storage-plugin imports) with one tiny
+async_take so timed phases reflect steady state.
+
+``settle_dir``: fsync every file under a directory.  Benchmarks with two
+timed phases (naive-vs-snapshot, sync-vs-async, save-then-load) need the
+first phase's dirty pages flushed before timing the second, or the
+kernel's writeback throttling charges phase 1's bytes to phase 2's clock.
+Scoped to the benchmark's own files — a machine-wide ``os.sync()`` would
+block on unrelated writers on shared hosts.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+
+def warm_up_snapshot_runtime() -> None:
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    root = tempfile.mkdtemp(prefix="tsnp_warm_")
+    try:
+        Snapshot.async_take(
+            root, {"w": StateDict(x=np.zeros(1024, np.float32))}
+        ).wait()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def settle_dir(path: str) -> None:
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for name in filenames:
+            try:
+                fd = os.open(os.path.join(dirpath, name), os.O_RDONLY)
+            except OSError:
+                continue
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)

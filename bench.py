@@ -55,6 +55,7 @@ import time
 
 BASELINE_GBPS = 20.0 / 13.91  # reference: 1 node x 1 GPU, local FS
 METRIC = "async_save_blocked_throughput"
+_RELAY_PORTS = (8082, 8083, 8087)  # the axon tunnel relay's listeners
 
 # Fewer, longer attempts: killing a child that is merely *slow* poisons
 # the TPU lease (the next backend init then blocks for minutes), so one
@@ -468,6 +469,45 @@ def _run_child_streaming(deadline: float):
     return (results[-1] if results else None), "".join(err_buf), proc.returncode
 
 
+def _tunnel_holders() -> list:
+    """PIDs (other than ours) holding TCP connections to the relay's
+    808x ports — a sibling TPU client whose claim the chip is stuck on.
+    The claim is exclusive: a benchmark queued behind a forgotten
+    process looks exactly like a dead tunnel (round 1 had no way to
+    tell).  /proc-based; returns [] where /proc is unavailable."""
+    import glob
+
+    ports = set(_RELAY_PORTS)
+    inodes = set()
+    for table in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(table) as f:
+                lines = f.readlines()[1:]
+        except OSError:
+            continue
+        for ln in lines:
+            parts = ln.split()
+            try:
+                rport = int(parts[2].split(":")[1], 16)
+                if rport in ports and parts[3] == "01":  # ESTABLISHED
+                    inodes.add(parts[9])
+            except (IndexError, ValueError):
+                continue
+    if not inodes:
+        return []
+    me = {os.getpid(), os.getppid()}
+    holders = set()
+    for fd in glob.glob("/proc/[0-9]*/fd/*"):
+        try:
+            if os.readlink(fd).strip("socket:[]") in inodes:
+                pid = int(fd.split("/")[2])
+                if pid not in me:
+                    holders.add(pid)
+        except OSError:
+            continue
+    return sorted(holders)
+
+
 def _tunnel_diagnosis() -> str:
     """Fast check of the axon TPU attachment's transport so a dead
     tunnel yields a precise error instead of N slow init timeouts
@@ -480,7 +520,7 @@ def _tunnel_diagnosis() -> str:
         return ""
     import socket
 
-    for port in (8082, 8083, 8087):
+    for port in _RELAY_PORTS:
         try:
             with socket.create_connection(("127.0.0.1", port), timeout=2):
                 return ""  # something listens: transport looks alive
@@ -511,6 +551,20 @@ def main() -> None:
             # comes back, then fail fast with the diagnosis attached
             attempt_deadline = min(attempt_deadline, time.time() + 90)
             diagnoses.append(f"attempt {attempt}: {diagnosis}")
+        holders = (
+            _tunnel_holders()
+            if "axon" in os.environ.get("JAX_PLATFORMS", "")
+            else []
+        )
+        if holders:
+            # not fatal (their claim may release; the init window gives
+            # them time) but the most likely reason an otherwise-healthy
+            # init sits silent: the chip claim is exclusive and this
+            # bench is queued behind the sibling process(es)
+            diagnoses.append(
+                f"attempt {attempt}: sibling process(es) {holders} hold "
+                f"live TPU relay connections"
+            )
         line, err, rc = _run_child_streaming(attempt_deadline)
         if line is not None:
             # re-print so the final stdout line is certainly the most

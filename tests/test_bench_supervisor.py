@@ -1,0 +1,156 @@
+"""Contract tests for bench.py's streaming supervisor.
+
+The supervisor is the round's benchmark-delivery mechanism: it must
+stream the child's incremental metric lines, kill only on
+lack-of-progress, retry a child that crashed before producing a result,
+and always leave a full metric record as the LAST stdout line.  These
+tests drive ``_run_child_streaming``/``main`` against a scripted fake
+child (no jax, no TPU) by monkeypatching the spawn target.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+
+def _load_bench():
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def bench():
+    return _load_bench()
+
+
+def _fake_child(tmp_path, body: str) -> str:
+    """Write a fake child script; the supervisor spawns ``sys.executable
+    <bench.py> --child``, so tests point it at this file instead."""
+    p = tmp_path / "fake_child.py"
+    p.write_text(
+        "import json, sys, time\n" + textwrap.dedent(body)
+    )
+    return str(p)
+
+
+def _run(bench, monkeypatch, tmp_path, body, deadline_s=30.0):
+    script = _fake_child(tmp_path, body)
+    monkeypatch.setattr(bench, "__file__", script)
+    import time as _time
+
+    return bench._run_child_streaming(_time.time() + deadline_s)
+
+
+def test_streams_and_returns_last_full_line(bench, monkeypatch, tmp_path, capsys):
+    line1 = {"metric": bench.METRIC, "value": 1.0}
+    line2 = {"metric": bench.METRIC, "value": 2.0, "restore_gbps": 3.0}
+    body = f"""
+    print(json.dumps({line1!r}), flush=True)
+    print(json.dumps({line2!r}), flush=True)
+    """
+    last, err, rc = _run(bench, monkeypatch, tmp_path, body)
+    assert rc == 0
+    assert json.loads(last)["value"] == 2.0
+    out = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert [o["value"] for o in out] == [1.0, 2.0]
+
+
+def test_phase_lines_reset_clock_but_are_not_results(
+    bench, monkeypatch, tmp_path, capsys
+):
+    body = f"""
+    print(json.dumps({{"metric": "{bench.METRIC}", "phase": "init", "value": 0.0}}), flush=True)
+    print(json.dumps({{"metric": "{bench.METRIC}", "phase": "attention:x"}}), flush=True)
+    """
+    last, err, rc = _run(bench, monkeypatch, tmp_path, body)
+    # crumbs alone are not a result: the attempt must read as failed
+    assert last is None
+    # and crumbs are never forwarded to the supervisor's stdout
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_stall_kill_preserves_streamed_results(
+    bench, monkeypatch, tmp_path, capsys
+):
+    monkeypatch.setattr(bench, "_INIT_WINDOW_S", 2)
+    monkeypatch.setattr(bench, "_PHASE_WINDOW_S", 2)
+    body = f"""
+    print(json.dumps({{"metric": "{bench.METRIC}", "value": 7.5}}), flush=True)
+    time.sleep(600)
+    """
+    last, err, rc = _run(bench, monkeypatch, tmp_path, body)
+    assert json.loads(last)["value"] == 7.5
+    assert "stalled" in err
+    assert rc != 0
+
+
+def test_malformed_lines_ignored(bench, monkeypatch, tmp_path):
+    body = f"""
+    print('{{"metric": truncated', flush=True)
+    print("not json at all", flush=True)
+    print(json.dumps({{"metric": "{bench.METRIC}", "value": 4.0}}), flush=True)
+    """
+    last, err, rc = _run(bench, monkeypatch, tmp_path, body)
+    assert json.loads(last)["value"] == 4.0
+
+
+def test_crashing_child_returns_no_result_with_stderr(
+    bench, monkeypatch, tmp_path
+):
+    body = """
+    sys.stderr.write("boom diagnostics\\n")
+    raise SystemExit(3)
+    """
+    last, err, rc = _run(bench, monkeypatch, tmp_path, body)
+    assert last is None
+    assert rc == 3
+    assert "boom diagnostics" in err
+
+
+def test_main_exhaustion_prints_parseable_failure_record(
+    bench, monkeypatch, tmp_path, capsys
+):
+    script = _fake_child(tmp_path, "raise SystemExit(2)\n")
+    monkeypatch.setattr(bench, "__file__", script)
+    monkeypatch.setattr(bench, "_SUPERVISOR_DEADLINE_S", 120)
+    monkeypatch.setattr(bench.time, "sleep", lambda _s: None)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    lines = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(lines[-1])
+    assert rec["value"] == 0.0
+    assert 1 <= rec["attempts"] <= bench._MAX_ATTEMPTS
+    assert "rc=2" in rec["error"]
+
+
+def test_main_success_last_line_is_full_record(
+    bench, monkeypatch, tmp_path, capsys
+):
+    good = {"metric": bench.METRIC, "value": 9.9, "vs_baseline": 6.9}
+    body = f"""
+    print(json.dumps({{"metric": "{bench.METRIC}", "phase": "init", "value": 0.0}}), flush=True)
+    print(json.dumps({good!r}), flush=True)
+    print(json.dumps({{"metric": "{bench.METRIC}", "phase": "attention:y"}}), flush=True)
+    """
+    script = _fake_child(tmp_path, body)
+    monkeypatch.setattr(bench, "__file__", script)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    lines = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(lines[-1])
+    assert "phase" not in rec and rec["value"] == 9.9
+
+
+def test_tunnel_holders_returns_list(bench):
+    holders = bench._tunnel_holders()
+    assert isinstance(holders, list)
+    assert os.getpid() not in holders

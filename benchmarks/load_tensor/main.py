@@ -44,6 +44,11 @@ def main() -> None:
     try:
         snap = Snapshot.take(os.path.join(work, "snap"), {"t": StateDict(x=arr)})
         out = np.zeros_like(arr)
+        # make every output page resident BEFORE measuring: np.zeros is
+        # calloc-backed, so otherwise the read faulting pages in counts
+        # the 1x output buffer itself as "RSS delta" and masks whether
+        # the library's transient buffers respect the budget
+        out.fill(0)
         rss = []
         with measure_rss_deltas(rss):
             t0 = time.perf_counter()

@@ -1,0 +1,84 @@
+"""utils/checksums.py: crc32/adler32 combination against zlib ground truth,
+and the scheduler's fold-vs-recompute digest equivalence."""
+
+import os
+import random
+import zlib
+
+from torchsnapshot_tpu.utils.checksums import (
+    adler32_combine,
+    combine_piece_digests,
+    crc32_combine,
+)
+
+
+def test_combine_matches_zlib_randomized():
+    rng = random.Random(7)
+    for _ in range(100):
+        a = os.urandom(rng.randint(0, 4096))
+        b = os.urandom(rng.randint(0, 4096))
+        assert crc32_combine(zlib.crc32(a), zlib.crc32(b), len(b)) == zlib.crc32(a + b)
+        assert adler32_combine(
+            zlib.adler32(a), zlib.adler32(b), len(b)
+        ) == zlib.adler32(a + b)
+
+
+def test_combine_empty_segments():
+    c = zlib.crc32(b"hello")
+    assert crc32_combine(c, zlib.crc32(b""), 0) == c
+    a = zlib.adler32(b"hello")
+    assert adler32_combine(a, zlib.adler32(b""), 0) == a
+
+
+def test_piece_folding_tiles():
+    rng = random.Random(1)
+    data = os.urandom(65536)
+    cuts = sorted(rng.sample(range(65536), 9))
+    pieces, prev = [], 0
+    for c in cuts + [65536]:
+        seg = data[prev:c]
+        pieces.append((zlib.crc32(seg), zlib.adler32(seg), len(seg)))
+        prev = c
+    assert combine_piece_digests(pieces) == (
+        zlib.crc32(data),
+        zlib.adler32(data),
+        len(data),
+    )
+
+
+def test_apply_checksum_sinks_fold_equals_recompute():
+    from torchsnapshot_tpu.scheduler import _apply_checksum_sinks
+
+    data = os.urandom(10000)
+    got_fold, got_whole, piece_crcs = [], [], []
+    # tiling ranges -> folded digest
+    sinks = [
+        (piece_crcs.append, (0, 3000)),
+        (piece_crcs.append, (3000, 10000)),
+    ]
+    _apply_checksum_sinks(data, sinks, got_fold.append)
+    # non-tiling ranges (gap) -> whole-buffer recompute path
+    _apply_checksum_sinks(
+        data, [(lambda c: None, (0, 2000))], got_whole.append
+    )
+    expect = [zlib.crc32(data) & 0xFFFFFFFF, zlib.adler32(data) & 0xFFFFFFFF, 10000]
+    assert got_fold[0] == expect
+    assert got_whole[0] == expect
+    assert piece_crcs == [
+        zlib.crc32(data[:3000]) & 0xFFFFFFFF,
+        zlib.crc32(data[3000:]) & 0xFFFFFFFF,
+    ]
+
+
+def test_apply_checksum_sinks_whole_buffer_single_sink():
+    from torchsnapshot_tpu.scheduler import _apply_checksum_sinks
+
+    data = os.urandom(5000)
+    crcs, digests = [], []
+    _apply_checksum_sinks(data, [(crcs.append, None)], digests.append)
+    assert crcs == [zlib.crc32(data) & 0xFFFFFFFF]
+    assert digests[0] == [
+        zlib.crc32(data) & 0xFFFFFFFF,
+        zlib.adler32(data) & 0xFFFFFFFF,
+        5000,
+    ]

@@ -169,3 +169,27 @@ def test_chunked_tiled_read_verifies_assembled_crc(tmp_path):
     # default: checksumming on restore is opt-in)
     out = s.read_object("0/app/w", memory_budget_bytes=1 << 14)
     assert not np.array_equal(out, big)
+
+
+def test_tiled_read_into_casting_template_verifies_raw_bytes(tmp_path):
+    # budgeted read into a WIDER-dtype template: the crc must be checked
+    # against the stored float32 payload bytes (per-tile, pre-cast), not
+    # the float64 target bytes — this used to raise a spurious mismatch
+    from torchsnapshot_tpu import knobs
+
+    big = np.arange(1 << 18, dtype=np.float32)
+    with knobs.override_max_chunk_size_bytes(1 << 18):
+        Snapshot.take(str(tmp_path / "t"), {"app": StateDict(w=big)})
+    s = Snapshot(str(tmp_path / "t"))
+    with knobs.override_verify_on_restore(True):
+        out = s.read_object("0/app/w", memory_budget_bytes=1 << 14)
+        np.testing.assert_array_equal(out, big)
+        # plain (unchunked) array, float64 template, budget + verify on
+        small = np.arange(1 << 16, dtype=np.float32)
+        Snapshot.take(str(tmp_path / "t2"), {"app": StateDict(w=small)})
+        tmpl = np.zeros(1 << 16, dtype=np.float64)
+        out2 = Snapshot(str(tmp_path / "t2")).read_object(
+            "0/app/w", obj_out=tmpl, memory_budget_bytes=1 << 12
+        )
+        assert out2 is tmpl
+        np.testing.assert_array_equal(tmpl, small.astype(np.float64))

@@ -307,17 +307,21 @@ class _TiledConsumer(BufferConsumer):
         countdown: "_Countdown",
         tile_bytes: int,
         dtype: str,
+        crc_fold: Optional["_TileCrcFold"] = None,
     ):
         self.target_flat = target_flat
         self.elem_range = elem_range
         self.countdown = countdown
         self.tile_bytes = tile_bytes
         self.dtype = dtype
+        self.crc_fold = crc_fold
 
     async def consume_buffer(
         self, buf: Any, executor: Optional[Executor] = None
     ) -> None:
         start, end = self.elem_range
+        if self.crc_fold is not None:
+            self.crc_fold.record(start, buf)
         np_arr = array_from_buffer(buf, self.dtype, (end - start,))
         fast_copyto(self.target_flat[start:end], np_arr)
         self.countdown.step()
@@ -366,38 +370,56 @@ def _plan_flat_tiles(
     return tiles
 
 
-def _verify_region_then(
-    host_flat: np.ndarray,
-    c0: int,
-    c1: int,
-    expected_crc32,
-    what: str,
-    then,
-):
-    """on_zero hook for a tiled region: byte-range reads cannot be
-    checked individually against the recorded whole-object crc32, but the
-    tiles fully cover [c0, c1), so the ASSEMBLED region verifies exactly
-    like a whole read would (same VERIFY_ON_RESTORE gate as
-    io_types.check_read_crc) — tiling must not silently weaken integrity
-    checking."""
+class _TileCrcFold:
+    """Integrity checking for a tiled region: byte-range reads cannot be
+    checked individually against the recorded whole-object crc32, so each
+    tile contributes the crc32 of its RAW payload bytes (hashed before
+    any dtype cast into the target — a float32 payload restored into a
+    float64 template must still verify against the stored bytes), and on
+    completion the per-tile values fold via crc32_combine in offset order
+    (tiles complete out of order).  Work on the scheduler's loop thread
+    stays O(tile), never O(region); the final fold is O(tiles·log n)
+    integer math.  Same VERIFY_ON_RESTORE gate as io_types.check_read_crc;
+    tiling must not silently weaken integrity checking.
 
-    def run() -> None:
-        if expected_crc32 is not None and knobs.verify_on_restore():
-            import zlib
+    CONTRACT under budgets: tiles are written into the target BEFORE the
+    fold can detect corruption (pre-verifying would need an O(region)
+    scratch buffer, which the memory budget exists to forbid), so on a
+    detected mismatch the read raises but the output buffer's contents
+    are unspecified.  The unbudgeted path verifies before any copy and
+    leaves templates pristine on failure."""
 
-            actual = (
-                zlib.crc32(memoryview(host_flat[c0:c1]).cast("B"))
-                & 0xFFFFFFFF
-            )
-            if actual != expected_crc32:
+    def __init__(self, expected_crc32, what: str, then) -> None:
+        self.expected = expected_crc32
+        self.what = what
+        self.then = then
+        self.want = expected_crc32 is not None and knobs.verify_on_restore()
+        self.pieces: dict = {}  # tile start offset -> (crc32, nbytes)
+
+    def record(self, start: int, buf) -> None:
+        if not self.want:
+            return
+        import zlib
+
+        view = memoryview(buf).cast("B")
+        self.pieces[start] = (zlib.crc32(view) & 0xFFFFFFFF, view.nbytes)
+
+    def finish(self) -> None:
+        if self.want:
+            from ..utils.checksums import crc32_combine
+
+            actual, _total = 0, 0
+            for start in sorted(self.pieces):
+                crc, nbytes = self.pieces[start]
+                actual = crc32_combine(actual, crc, nbytes)
+            if actual != self.expected:
                 raise RuntimeError(
-                    f"crc32 mismatch for {what}: recorded "
-                    f"crc32={expected_crc32}, assembled-from-tiles "
-                    f"crc32={actual} — the payload changed after commit"
+                    f"crc32 mismatch for {self.what}: recorded "
+                    f"crc32={self.expected}, assembled-from-tiles "
+                    f"crc32={actual} — the payload changed after commit "
+                    f"(output buffer contents are unspecified)"
                 )
-        then()
-
-    return run
+        self.then()
 
 
 class ArrayIOPreparer:
@@ -461,21 +483,16 @@ class ArrayIOPreparer:
             tiles = _plan_flat_tiles(
                 0, n_elems, itemsize, buffer_size_limit_bytes
             )
-            countdown = _Countdown(
-                n=len(tiles),
-                on_zero=_verify_region_then(
-                    target_flat,
-                    0,
-                    n_elems,
-                    getattr(entry, "crc32", None),
-                    f"{entry.location} (tiled)",
-                    lambda: fut.set(
-                        target
-                        if obj_out is None or isinstance(obj_out, np.ndarray)
-                        else obj_out
-                    ),
+            fold = _TileCrcFold(
+                getattr(entry, "crc32", None),
+                f"{entry.location} (tiled)",
+                lambda: fut.set(
+                    target
+                    if obj_out is None or isinstance(obj_out, np.ndarray)
+                    else obj_out
                 ),
             )
+            countdown = _Countdown(n=len(tiles), on_zero=fold.finish)
             read_reqs: List[ReadReq] = []
             for start, end, byte_range in tiles:
                 read_reqs.append(
@@ -488,6 +505,7 @@ class ArrayIOPreparer:
                             countdown=countdown,
                             tile_bytes=(end - start) * itemsize,
                             dtype=entry.dtype,
+                            crc_fold=fold,
                         ),
                     )
                 )
@@ -623,17 +641,10 @@ class ChunkedArrayIOPreparer:
                     buffer_size_limit_bytes,
                     base_byte=chunk.byte_range[0] if chunk.byte_range else 0,
                 )
-                inner = _Countdown(
-                    n=len(tiles),
-                    on_zero=_verify_region_then(
-                        host_flat,
-                        c0,
-                        c1,
-                        chunk.crc32,
-                        f"{chunk.location} (tiled)",
-                        outer.step,
-                    ),
+                fold = _TileCrcFold(
+                    chunk.crc32, f"{chunk.location} (tiled)", outer.step
                 )
+                inner = _Countdown(n=len(tiles), on_zero=fold.finish)
                 for t0, t1, byte_range in tiles:
                     read_reqs.append(
                         ReadReq(
@@ -645,6 +656,7 @@ class ChunkedArrayIOPreparer:
                                 countdown=inner,
                                 tile_bytes=(t1 - t0) * itemsize,
                                 dtype=entry.dtype,
+                                crc_fold=fold,
                             ),
                         )
                     )

@@ -60,14 +60,49 @@ _AVAILABLE_MEMORY_MULTIPLIER = 0.6
 def _apply_checksum_sinks(buf, sinks, digest_sink=None) -> None:
     """Feed each sink the crc32 of its byte range of the staged buffer
     (WriteReq.checksum_sinks contract, io_types.py); ``digest_sink``
-    additionally receives the whole object's (crc32, adler32, size)."""
+    additionally receives the whole object's (crc32, adler32, size).
+
+    When the sink ranges exactly tile the buffer (a slab: members packed
+    back-to-back; or one whole-buffer sink), the object digest is FOLDED
+    from the per-piece values (utils/checksums.py) instead of re-reading
+    every byte — two passes over the staged data instead of three."""
     import zlib
 
+    from .utils.checksums import combine_piece_digests
+
     view = memoryview(buf).cast("B")
-    for sink, rng in sinks or ():
+    spans = [
+        (0, view.nbytes) if rng is None else (rng[0], rng[1])
+        for _, rng in sinks or ()
+    ]
+    ordered = sorted(set(spans))
+    can_fold = (
+        digest_sink is not None
+        and spans
+        and len(ordered) == len(spans)
+        and ordered[0][0] == 0
+        and ordered[-1][1] == view.nbytes
+        and all(a[1] == b[0] for a, b in zip(ordered, ordered[1:]))
+    )
+    piece_digests = {}
+    for (sink, rng), span in zip(sinks or (), spans):
         piece = view if rng is None else view[rng[0] : rng[1]]
-        sink(zlib.crc32(piece) & 0xFFFFFFFF)
-    if digest_sink is not None:
+        crc = zlib.crc32(piece) & 0xFFFFFFFF
+        sink(crc)
+        if can_fold:
+            piece_digests[span] = (
+                crc,
+                zlib.adler32(piece) & 0xFFFFFFFF,
+                piece.nbytes,
+            )
+    if digest_sink is None:
+        return
+    if can_fold:
+        crc, adler, total = combine_piece_digests(
+            [piece_digests[s] for s in ordered]
+        )
+        digest_sink([crc, adler, total])
+    else:
         digest_sink(
             [
                 zlib.crc32(view) & 0xFFFFFFFF,

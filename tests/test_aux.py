@@ -306,3 +306,20 @@ def test_cli_ls_verify_steps_delete(tmp_path, capsys):
     assert not os.path.exists(snap_path)
 
     assert cli(["ls", snap_path]) == 1  # gone -> clean error, not traceback
+
+
+def test_serialize_transfers_auto_gates_on_tunneled_backend(monkeypatch):
+    # auto = on ONLY for tunneled (axon) attachments; a real TPU VM has
+    # independent DMA engines and must keep H2D overlap (off)
+    from torchsnapshot_tpu import knobs
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert knobs.serialize_transfers() is False
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+    assert knobs.serialize_transfers() is True
+    with knobs.override_serialize_transfers("0"):
+        assert knobs.serialize_transfers() is False
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert knobs.serialize_transfers() is False
+    with knobs.override_serialize_transfers("1"):
+        assert knobs.serialize_transfers() is True

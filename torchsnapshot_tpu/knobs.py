@@ -210,9 +210,21 @@ def serialize_transfers() -> bool:
         return True
     if v in ("0", "false", "off"):
         return False
-    import jax
+    # auto: the pathological interleaving this guards against (concurrent
+    # H2D puts thrashing a single multiplexed stream) is a property of
+    # TUNNELED/proxied attachments, not of TPUs — a real TPU VM has
+    # independent DMA engines and wants overlap.  Gate only when the
+    # process targets a tunneled PJRT plugin (via env var or the
+    # programmatic jax.config path); direct-attached backends (cpu, tpu,
+    # gpu) resolve off.
+    selected = os.environ.get("JAX_PLATFORMS", "") or ""
+    try:
+        import jax
 
-    return jax.default_backend() != "cpu"
+        selected += "," + (jax.config.jax_platforms or "")
+    except Exception:
+        pass
+    return "axon" in selected.lower()
 
 
 def use_pallas_attention() -> bool:

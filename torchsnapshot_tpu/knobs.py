@@ -222,6 +222,13 @@ def serialize_transfers() -> bool:
         import jax
 
         selected += "," + (jax.config.jax_platforms or "")
+        # an auto-registered tunnel plugin may be selected with neither
+        # the env var nor the config set; consult backends that are
+        # ALREADY initialized (never trigger an init here — a tunneled
+        # backend's init can block for minutes)
+        from jax._src import xla_bridge
+
+        selected += "," + ",".join(getattr(xla_bridge, "_backends", {}))
     except Exception:
         pass
     return "axon" in selected.lower()

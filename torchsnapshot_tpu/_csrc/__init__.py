@@ -32,22 +32,72 @@ def _build() -> bool:
     # multi-process tests spawn several) can never observe a half-written
     # .so — worst case they each build once and the last rename wins.
     tmp = f"{_SO}.tmp.{os.getpid()}"
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        os.replace(tmp, _SO)
-        return True
-    except Exception as e:  # noqa: BLE001
-        logger.debug("fastio build failed (falling back to Python): %r", e)
+    # -march=native is a ~25% win for the fused digest loops (the adler
+    # closed-form reductions vectorize), but an ISA-specific binary must
+    # never outlive its host CPU: the build records the CPU fingerprint
+    # next to the .so, and load() discards a cached binary whose
+    # fingerprint no longer matches (a copied venv / NFS tree / docker
+    # image moved to an older CPU would otherwise SIGILL mid-checkpoint).
+    # Hosts where the fingerprint cannot be read get portable flags only.
+    fp = _cpu_fingerprint()
+    variants = ([(["-march=native"], fp)] if fp else []) + [([], "")]
+    for extra, build_fp in variants:
         try:
-            os.remove(tmp)
-        except OSError:
-            pass
+            subprocess.run(
+                ["g++", "-O3", *extra, "-shared", "-fPIC", "-o", tmp, _SRC],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, _SO)
+            try:
+                with open(_SO + ".cpu", "w") as f:
+                    f.write(build_fp)
+            except OSError:
+                pass
+            return True
+        except Exception as e:  # noqa: BLE001
+            logger.debug(
+                "fastio build failed with %s (%r)", extra or "base flags", e
+            )
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return False
+
+
+def _cpu_fingerprint() -> str:
+    """Hash of this host's CPU feature flags ('' when undeterminable —
+    callers then avoid ISA-specific codegen entirely)."""
+    try:
+        import hashlib
+
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha256(
+                        " ".join(sorted(line.split(":", 1)[1].split())).encode()
+                    ).hexdigest()[:16]
+    except OSError:
+        pass
+    return ""
+
+
+def _cached_so_usable() -> bool:
+    """The on-disk .so is current AND was built for this CPU (or with
+    portable flags, recorded as an empty fingerprint)."""
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+        _SRC
+    ):
         return False
+    try:
+        with open(_SO + ".cpu") as f:
+            built_for = f.read().strip()
+    except OSError:
+        # no record: legacy portable build — loadable anywhere
+        return True
+    return built_for == "" or built_for == _cpu_fingerprint()
 
 
 def _try_load() -> Optional[ctypes.CDLL]:
@@ -66,9 +116,7 @@ def load() -> Optional[ctypes.CDLL]:
             return _lib
         _load_attempted = True
         lib = None
-        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(
-            _SRC
-        ):
+        if _cached_so_usable():
             lib = _try_load()
         if lib is None:
             # stale, absent, or unloadable (e.g. foreign-platform binary):
@@ -100,6 +148,13 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_uint32,
         ]
+        lib.tsnp_copy_digest.restype = None
+        lib.tsnp_copy_digest.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
         _lib = lib
         return _lib
 
@@ -120,3 +175,24 @@ def crc32c(data, seed: int = 0) -> Optional[int]:
     if view.nbytes == 0:
         return int(lib.tsnp_crc32c(None, 0, seed))
     return int(lib.tsnp_crc32c(_buffer_address(view), view.nbytes, seed))
+
+
+def copy_digest(dst, src) -> Optional[tuple]:
+    """memcpy ``src`` into ``dst`` (equal-size buffers) while computing
+    the zlib (crc32, adler32) of the bytes in the same cache-blocked
+    native pass; None when the lib is unavailable (caller falls back to
+    a python copy + separate hashing)."""
+    lib = load()
+    if lib is None:
+        return None
+    sview = memoryview(src).cast("B")
+    dview = memoryview(dst).cast("B")
+    if dview.nbytes != sview.nbytes or dview.readonly:
+        return None
+    if sview.nbytes == 0:
+        return (0, 1)
+    out = (ctypes.c_uint32 * 2)()
+    lib.tsnp_copy_digest(
+        _buffer_address(dview), _buffer_address(sview), sview.nbytes, out
+    )
+    return (int(out[0]), int(out[1]))

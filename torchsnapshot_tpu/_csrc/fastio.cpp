@@ -90,25 +90,30 @@ int64_t tsnp_file_size(const char *path) {
   return static_cast<int64_t>(st.st_size);
 }
 
+// slice-by-8 table construction, shared by the crc32c (Castagnoli) and
+// zlib-crc32 variants below.
+static void init_slice8_tables(uint32_t poly, uint32_t table[8][256]) {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++)
+      crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    table[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = table[0][i];
+    for (int s = 1; s < 8; s++) {
+      crc = table[0][crc & 0xff] ^ (crc >> 8);
+      table[s][i] = crc;
+    }
+  }
+}
+
 // crc32c (Castagnoli), slice-by-8.
 static uint32_t crc32c_table[8][256];
 static bool crc32c_init_done = false;
 
 static void crc32c_init() {
-  const uint32_t poly = 0x82f63b78u;
-  for (uint32_t i = 0; i < 256; i++) {
-    uint32_t crc = i;
-    for (int j = 0; j < 8; j++)
-      crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
-    crc32c_table[0][i] = crc;
-  }
-  for (uint32_t i = 0; i < 256; i++) {
-    uint32_t crc = crc32c_table[0][i];
-    for (int s = 1; s < 8; s++) {
-      crc = crc32c_table[0][crc & 0xff] ^ (crc >> 8);
-      crc32c_table[s][i] = crc;
-    }
-  }
+  init_slice8_tables(0x82f63b78u, crc32c_table);
   crc32c_init_done = true;
 }
 
@@ -135,6 +140,82 @@ uint32_t tsnp_crc32c(const void *buf, int64_t size, uint32_t seed) {
     size--;
   }
   return ~crc;
+}
+
+// zlib-polynomial crc32 (0xEDB88320), slice-by-8 — bit-compatible with
+// python's zlib.crc32 (manifest checksums use that polynomial; crc32c
+// above is only for fs write verification).
+static uint32_t crc32z_table[8][256];
+static bool crc32z_init_done = false;
+
+static void crc32z_init() {
+  init_slice8_tables(0xEDB88320u, crc32z_table);
+  crc32z_init_done = true;
+}
+
+// memcpy src -> dst while computing zlib crc32 AND adler32 of the bytes,
+// processed in 64KB blocks so each block is digested while still hot in
+// cache: memory traffic is one read + one write instead of the three
+// read passes of copy-then-crc-then-adler.  out[0] = crc32 (zlib
+// finalized), out[1] = adler32.  Runs entirely outside the GIL (ctypes).
+void tsnp_copy_digest(void *dst, const void *src, int64_t size,
+                      uint32_t *out) {
+  if (!crc32z_init_done)
+    crc32z_init();
+  const uint8_t *p = static_cast<const uint8_t *>(src);
+  uint8_t *q = static_cast<uint8_t *>(dst);
+  uint32_t crc = 0xFFFFFFFFu;
+  const uint32_t MOD = 65521u;
+  uint32_t a = 1, b = 0;
+  int64_t off = 0;
+  while (off < size) {
+    int64_t blk = size - off;
+    if (blk > 65536)
+      blk = 65536;
+    memcpy(q + off, p + off, static_cast<size_t>(blk));
+    const uint8_t *s = p + off;
+    int64_t n = blk;
+    while (n >= 8) {
+      uint64_t chunk;
+      memcpy(&chunk, s, 8);
+      crc ^= static_cast<uint32_t>(chunk);
+      uint32_t hi = static_cast<uint32_t>(chunk >> 32);
+      crc = crc32z_table[7][crc & 0xff] ^ crc32z_table[6][(crc >> 8) & 0xff] ^
+            crc32z_table[5][(crc >> 16) & 0xff] ^ crc32z_table[4][crc >> 24] ^
+            crc32z_table[3][hi & 0xff] ^ crc32z_table[2][(hi >> 8) & 0xff] ^
+            crc32z_table[1][(hi >> 16) & 0xff] ^ crc32z_table[0][hi >> 24];
+      s += 8;
+      n -= 8;
+    }
+    while (n > 0) {
+      crc = crc32z_table[0][(crc ^ *s) & 0xff] ^ (crc >> 8);
+      s++;
+      n--;
+    }
+    // adler32 per 5552-byte window via the closed form
+    //   a' = a + S1,  b' = b + m*a + m*S1 - S2
+    // with S1 = sum(s[k]), S2 = sum(k*s[k]) — both plain reductions the
+    // compiler can vectorize, unlike the scalar b += a dependency chain
+    s = p + off;
+    n = blk;
+    while (n > 0) {
+      int64_t m = n > 5552 ? 5552 : n;
+      uint64_t s1 = 0, s2 = 0;
+      for (int64_t k = 0; k < m; k++) {
+        s1 += s[k];
+        s2 += static_cast<uint64_t>(k) * s[k];
+      }
+      uint64_t mm = static_cast<uint64_t>(m);
+      uint64_t bb = b + mm * a + mm * s1 - s2;
+      a = static_cast<uint32_t>((a + s1) % MOD);
+      b = static_cast<uint32_t>(bb % MOD);
+      s += m;
+      n -= m;
+    }
+    off += blk;
+  }
+  out[0] = ~crc;
+  out[1] = (b << 16) | a;
 }
 
 }  // extern "C"

@@ -64,16 +64,53 @@ class BatchedBufferStager(BufferStager):
                 logger.debug("device slab pack failed; host fallback", exc_info=True)
         # Host fallback stages members SEQUENTIALLY so peak memory stays at
         # slab + one member — matching get_staging_cost_bytes regardless of
-        # which path ran.
+        # which path ran.  When the native engine is present, each member
+        # is packed with the fused copy+digest pass (one read + one write
+        # of memory traffic, GIL released); the recorded per-member
+        # (crc32, adler32, size) lets the scheduler feed manifest checksum
+        # sinks and fold the slab digest with NO further passes over the
+        # staged bytes (scheduler._apply_checksum_sinks).
+        from ._csrc import copy_digest
+
+        def _pack_one(dst, view):
+            # heavy pass (memcpy + crc32 + adler32, GIL released inside
+            # the ctypes call) — runs in the executor so the loop thread
+            # stays free for other pipelines' staging and I/O completions
+            d = copy_digest(dst, view)
+            if d is None:  # no native lib: plain copy, no digests
+                dst[:] = view
+            return d
+
+        loop = asyncio.get_running_loop()
         slab = bytearray(self.total)
+        slab_view = memoryview(slab)
+        piece_digests: dict = {}
         offset = 0
         for s, cost in self.stagers:
             buf = await s.stage_buffer(executor)
             view = memoryview(buf).cast("B")
             assert view.nbytes == cost, (view.nbytes, cost)
-            slab[offset : offset + cost] = view
+            dst = slab_view[offset : offset + cost]
+            if cost == 0:
+                digest = (0, 1)
+            elif executor is not None:
+                digest = await loop.run_in_executor(
+                    executor, _pack_one, dst, view
+                )
+            else:
+                digest = _pack_one(dst, view)
+            if digest is None:
+                piece_digests = None
+            elif piece_digests is not None:
+                piece_digests[(offset, offset + cost)] = (
+                    digest[0],
+                    digest[1],
+                    cost,
+                )
             offset += cost
-            del buf, view
+            del buf, view, dst
+        if piece_digests:
+            self.piece_digests = piece_digests
         self.stagers = []
         return memoryview(slab)
 

@@ -57,20 +57,25 @@ _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
 
 
-def _apply_checksum_sinks(buf, sinks, digest_sink=None) -> None:
+def _apply_checksum_sinks(buf, sinks, digest_sink=None, precomputed=None) -> None:
     """Feed each sink the crc32 of its byte range of the staged buffer
     (WriteReq.checksum_sinks contract, io_types.py); ``digest_sink``
     additionally receives the whole object's (crc32, adler32, size).
 
-    When the sink ranges exactly tile the buffer (a slab: members packed
-    back-to-back; or one whole-buffer sink), the object digest is FOLDED
-    from the per-piece values (utils/checksums.py) instead of re-reading
-    every byte — two passes over the staged data instead of three."""
+    ``precomputed``: {(start, end): (crc32, adler32, size)} recorded by
+    the stager while it packed the bytes (the native fused copy+digest
+    pass, batcher.BatchedBufferStager) — matching spans skip hashing
+    entirely.  When the sink ranges exactly tile the buffer (a slab:
+    members packed back-to-back; or one whole-buffer sink), the object
+    digest FOLDS from the per-piece values (utils/checksums.py) instead
+    of re-reading every byte; with a full precomputed set the staged
+    data is not touched at all here."""
     import zlib
 
     from .utils.checksums import combine_piece_digests
 
     view = memoryview(buf).cast("B")
+    pre = precomputed or {}
     spans = [
         (0, view.nbytes) if rng is None else (rng[0], rng[1])
         for _, rng in sinks or ()
@@ -86,15 +91,19 @@ def _apply_checksum_sinks(buf, sinks, digest_sink=None) -> None:
     )
     piece_digests = {}
     for (sink, rng), span in zip(sinks or (), spans):
-        piece = view if rng is None else view[rng[0] : rng[1]]
-        crc = zlib.crc32(piece) & 0xFFFFFFFF
+        hit = pre.get(span)
+        if hit is not None and hit[2] == span[1] - span[0]:
+            crc = hit[0]
+            adler = hit[1]
+        else:
+            piece = view[span[0] : span[1]]
+            crc = zlib.crc32(piece) & 0xFFFFFFFF
+            adler = (
+                zlib.adler32(piece) & 0xFFFFFFFF if can_fold else None
+            )
         sink(crc)
         if can_fold:
-            piece_digests[span] = (
-                crc,
-                zlib.adler32(piece) & 0xFFFFFFFF,
-                piece.nbytes,
-            )
+            piece_digests[span] = (crc, adler, span[1] - span[0])
     if digest_sink is None:
         return
     if can_fold:
@@ -287,6 +296,7 @@ async def _execute_write_pipelines(
                 p.buf,
                 wr.checksum_sinks,
                 wr.digest_sink,
+                getattr(wr.buffer_stager, "piece_digests", None),
             )
         return p
 

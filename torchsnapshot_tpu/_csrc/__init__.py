@@ -40,11 +40,35 @@ def _build() -> bool:
     # image moved to an older CPU would otherwise SIGILL mid-checkpoint).
     # Hosts where the fingerprint cannot be read get portable flags only.
     fp = _cpu_fingerprint()
-    variants = ([(["-march=native"], fp)] if fp else []) + [([], "")]
-    for extra, build_fp in variants:
+    # zlib linkage first (its SIMD crc32 beats our slice-by-8 ~2x);
+    # then without, for hosts missing zlib.h/libz
+    zflags = (["-DTSNP_USE_ZLIB"], ["-lz"])
+    native = (
+        [
+            (["-march=native", *zflags[0]], zflags[1], fp),
+            (["-march=native"], [], fp),
+        ]
+        if fp
+        else []
+    )
+    portable = [(zflags[0], zflags[1], ""), ([], [], "")]
+    # ISA-specific variants exist ONLY when a CPU fingerprint can be
+    # recorded; order prefers zlib linkage (its SIMD crc32), then no-zlib
+    variants = native[:1] + portable[:1] + native[1:] + portable[1:]
+    for extra, libs, build_fp in variants:
         try:
             subprocess.run(
-                ["g++", "-O3", *extra, "-shared", "-fPIC", "-o", tmp, _SRC],
+                [
+                    "g++",
+                    "-O3",
+                    *extra,
+                    "-shared",
+                    "-fPIC",
+                    "-o",
+                    tmp,
+                    _SRC,
+                    *libs,
+                ],
                 check=True,
                 capture_output=True,
                 timeout=120,

@@ -163,6 +163,24 @@ def test_fuzz_roundtrip(tmp_path, seed):
             s2.restore({"m": dest})
         _check(dest.tree, mutated)
 
+        # budgeted random access of one host-array leaf (tiles + chunked
+        # tiles + verify interplay); tiny budget forces ranged sub-reads
+        host_paths = [
+            p
+            for p, v in mutated.items()
+            if "/" not in p and isinstance(v, np.ndarray)
+        ]
+        if host_paths:
+            pick = host_paths[rng.integers(len(host_paths))]
+            with knobs.override_verify_on_restore(bool(rng.integers(2))):
+                got = s2.read_object(
+                    f"0/m/{pick}",
+                    memory_budget_bytes=int(rng.choice([64, 1024, 1 << 20])),
+                )
+            np.testing.assert_array_equal(
+                np.asarray(got), mutated[pick], err_msg=pick
+            )
+
         # partial restore of snapshot 1 over the restored state: matched
         # leaves roll BACK to s1 values, unmatched keep s2 values
         glob = ["m/leaf0*", "m/leaf1*"]

@@ -78,7 +78,16 @@ def _build() -> bool:
                 with open(_SO + ".cpu", "w") as f:
                     f.write(build_fp)
             except OSError:
-                pass
+                if build_fp:
+                    # an ISA-specific binary without its fingerprint
+                    # record would later read as "portable" and SIGILL
+                    # on a different CPU — drop it and try the next
+                    # (portable) variant instead
+                    try:
+                        os.remove(_SO)
+                    except OSError:
+                        pass
+                    continue
             return True
         except Exception as e:  # noqa: BLE001
             logger.debug(

@@ -156,3 +156,40 @@ def test_slab_piece_digests_end_to_end(tmp_path):
     for i in range(4):
         e = man[f"0/m/p{i}"]
         assert e.crc32 == zlib.crc32(arrs[f"p{i}"].tobytes()) & 0xFFFFFFFF
+
+
+def test_shift_matrix_cache_concurrent_cold_start():
+    # the pow2-shift cache must stay index-aligned under concurrent cold
+    # extension (a duplicate append would silently corrupt every later
+    # combine)
+    import importlib
+    import threading as th
+
+    from torchsnapshot_tpu.utils import checksums as cs
+
+    importlib.reload(cs)
+    datas = [os.urandom(random.Random(i).randint(1, 1 << 20)) for i in range(8)]
+    errs = []
+
+    def work(d):
+        try:
+            a, b = d[: len(d) // 2], d[len(d) // 2 :]
+            got = cs.crc32_combine(zlib.crc32(a), zlib.crc32(b), len(b))
+            if got != zlib.crc32(d):
+                errs.append((len(d), got))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [th.Thread(target=work, args=(d,)) for d in datas]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    # cache indices hold the right powers afterwards too
+    for i in range(200):
+        d = os.urandom(1 << (i % 21))
+        a, b = d[: len(d) // 3], d[len(d) // 3 :]
+        assert cs.crc32_combine(
+            zlib.crc32(a), zlib.crc32(b), len(b)
+        ) == zlib.crc32(d)

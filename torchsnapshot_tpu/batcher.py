@@ -378,6 +378,10 @@ def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
             continue
         reqs.sort(key=lambda r: r.byte_range[0])
         run: List[ReadReq] = []
+        run_hi = 0  # rolling max end of the current run: the gap test
+        # must be O(1) per request, not a scan of the run (20k ranged
+        # reads to one slab would otherwise cost O(n^2) — measured 50s
+        # of a 54s restore for 20k tiny leaves)
 
         def flush() -> None:
             if not run:
@@ -398,8 +402,11 @@ def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
             run.clear()
 
         for r in reqs:
-            if run and r.byte_range[0] - max(x.byte_range[1] for x in run) > max_gap:
+            if run and r.byte_range[0] - run_hi > max_gap:
                 flush()
+            run_hi = (
+                r.byte_range[1] if not run else max(run_hi, r.byte_range[1])
+            )
             run.append(r)
         flush()
     return out

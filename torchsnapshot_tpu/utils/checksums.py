@@ -18,6 +18,7 @@ arithmetic (mod 65521), matching zlib's adler32_combine_.
 
 from __future__ import annotations
 
+import threading
 from typing import Sequence, Tuple
 
 _CRC_POLY = 0xEDB88320
@@ -40,38 +41,57 @@ def _gf2_matrix_square(square: list, mat: Sequence[int]) -> None:
         square[n] = _gf2_matrix_times(mat, mat[n])
 
 
+# cache of "advance crc by 2^k zero BYTES" operators.  The matrices
+# depend only on k, so they are built once and shared: rebuilding +
+# re-squaring them per combine made folding 20k slab pieces cost ~8s
+# (measured) — cached application is popcount(len2) matrix·vector
+# products of 32 xors each.  Extension is LOCKED: crc32_combine runs on
+# executor worker threads (scheduler digesting), and an unsynchronized
+# check-then-append lets two threads append the same square, after
+# which index k no longer holds the 2^k operator and every later
+# combine is silently wrong.  Reads of already-built entries are
+# lock-free (entries are immutable once published).
+_SHIFT_BY_POW2_BYTES: list = []
+_SHIFT_LOCK = threading.Lock()
+
+
+def _shift_matrix(k: int) -> Sequence[int]:
+    if len(_SHIFT_BY_POW2_BYTES) > k:
+        return _SHIFT_BY_POW2_BYTES[k]
+    with _SHIFT_LOCK:
+        while len(_SHIFT_BY_POW2_BYTES) <= k:
+            if not _SHIFT_BY_POW2_BYTES:
+                odd = [0] * 32  # advance-1-bit operator
+                odd[0] = _CRC_POLY
+                row = 1
+                for n in range(1, 32):
+                    odd[n] = row
+                    row <<= 1
+                m = [0] * 32
+                _gf2_matrix_square(m, odd)  # 2 bits
+                m2 = [0] * 32
+                _gf2_matrix_square(m2, m)  # 4 bits
+                one_byte = [0] * 32
+                _gf2_matrix_square(one_byte, m2)  # 8 bits = 1 byte
+                _SHIFT_BY_POW2_BYTES.append(one_byte)
+            else:
+                nxt = [0] * 32
+                _gf2_matrix_square(nxt, _SHIFT_BY_POW2_BYTES[-1])
+                _SHIFT_BY_POW2_BYTES.append(nxt)
+        return _SHIFT_BY_POW2_BYTES[k]
+
+
 def crc32_combine(crc1: int, crc2: int, len2: int) -> int:
     """crc32 of A+B given crc32(A), crc32(B), len(B)."""
     if len2 <= 0:
         return crc1 & 0xFFFFFFFF
-    even = [0] * 32
-    odd = [0] * 32
-    # odd = the "advance one zero byte... actually one BIT" operator
-    odd[0] = _CRC_POLY
-    row = 1
-    for n in range(1, 32):
-        odd[n] = row
-        row <<= 1
-    # even = advance 2 bits; odd (re-derived) = advance 4 bits; then the
-    # loop squares alternately, applying the operator for each set bit
-    # of len2 (len2 is in BYTES: start by advancing 8 bits per unit)
-    _gf2_matrix_square(even, odd)  # 2 bits
-    _gf2_matrix_square(odd, even)  # 4 bits
     crc1 &= 0xFFFFFFFF
-    crc2 &= 0xFFFFFFFF
-    while True:
-        _gf2_matrix_square(even, odd)  # 8, 32, 128... bits
+    k = 0
+    while len2:
         if len2 & 1:
-            crc1 = _gf2_matrix_times(even, crc1)
+            crc1 = _gf2_matrix_times(_shift_matrix(k), crc1)
         len2 >>= 1
-        if not len2:
-            break
-        _gf2_matrix_square(odd, even)
-        if len2 & 1:
-            crc1 = _gf2_matrix_times(odd, crc1)
-        len2 >>= 1
-        if not len2:
-            break
+        k += 1
     return (crc1 ^ crc2) & 0xFFFFFFFF
 
 

@@ -508,6 +508,14 @@ def _tunnel_holders() -> list:
     return sorted(holders)
 
 
+def _axon_holders() -> list:
+    """_tunnel_holders(), gated to tunneled runs (the only place relay
+    connections mean anything)."""
+    if "axon" not in os.environ.get("JAX_PLATFORMS", ""):
+        return []
+    return _tunnel_holders()
+
+
 def _tunnel_diagnosis() -> str:
     """Fast check of the axon TPU attachment's transport so a dead
     tunnel yields a precise error instead of N slow init timeouts
@@ -551,11 +559,7 @@ def main() -> None:
             # comes back, then fail fast with the diagnosis attached
             attempt_deadline = min(attempt_deadline, time.time() + 90)
             diagnoses.append(f"attempt {attempt}: {diagnosis}")
-        holders = (
-            _tunnel_holders()
-            if "axon" in os.environ.get("JAX_PLATFORMS", "")
-            else []
-        )
+        holders = _axon_holders()
         if holders:
             # not fatal (their claim may release; the init window gives
             # them time) but the most likely reason an otherwise-healthy
@@ -573,7 +577,39 @@ def main() -> None:
             return
         tail = "\n".join((err or "").strip().splitlines()[-8:])
         last_err = f"rc={rc}: {tail}"[-1500:]
-        if attempt < _MAX_ATTEMPTS and time.time() < deadline - 90:
+        retry_possible = (
+            attempt < _MAX_ATTEMPTS and time.time() < deadline - 90
+        )
+        if retry_possible:
+            # a stale bench child orphaned by an earlier session holds
+            # the exclusive chip claim and starves every attempt; SIGINT
+            # lets its runtime release the lease cleanly.  ONLY processes
+            # whose cmdline shows them to be a bench child are touched —
+            # an unrelated (possibly healthy, concurrent) TPU client is
+            # reported by the holder diagnosis above, never killed.  The
+            # existing 20s+ back-off below covers the lease release.
+            import signal as _signal
+
+            stale = []
+            for pid in _axon_holders():
+                try:
+                    with open(f"/proc/{pid}/cmdline", "rb") as f:
+                        cmd = f.read().replace(b"\0", b" ")
+                except OSError:
+                    continue
+                if b"bench.py" in cmd:
+                    stale.append(pid)
+            for pid in stale:
+                try:
+                    os.kill(pid, _signal.SIGINT)
+                except OSError:
+                    pass
+            if stale:
+                diagnoses.append(
+                    f"attempt {attempt}: SIGINTed stale bench child(ren) "
+                    f"{stale} before retrying"
+                )
+        if retry_possible:
             sys.stderr.write(
                 f"bench attempt {attempt} failed ({last_err[:200]}); "
                 f"retrying\n"

@@ -577,17 +577,16 @@ def main() -> None:
             return
         tail = "\n".join((err or "").strip().splitlines()[-8:])
         last_err = f"rc={rc}: {tail}"[-1500:]
-        retry_possible = (
-            attempt < _MAX_ATTEMPTS and time.time() < deadline - 90
-        )
-        if retry_possible:
-            # a stale bench child orphaned by an earlier session holds
-            # the exclusive chip claim and starves every attempt; SIGINT
-            # lets its runtime release the lease cleanly.  ONLY processes
-            # whose cmdline shows them to be a bench child are touched —
-            # an unrelated (possibly healthy, concurrent) TPU client is
-            # reported by the holder diagnosis above, never killed.  The
-            # existing 20s+ back-off below covers the lease release.
+        if attempt < _MAX_ATTEMPTS and time.time() < deadline - 90:
+            # a stale bench child ORPHANED by an earlier session (its
+            # supervisor gone, so it was reparented to init) holds the
+            # exclusive chip claim and starves every attempt; SIGINT
+            # lets its runtime release the lease cleanly.  Only
+            # processes that are both bench children by cmdline AND
+            # orphans (ppid 1) are touched — a concurrent healthy
+            # bench's child still has its supervisor as parent and is
+            # only reported by the holder diagnosis, never killed.  The
+            # 20s+ back-off below covers the lease release.
             import signal as _signal
 
             stale = []
@@ -595,9 +594,11 @@ def main() -> None:
                 try:
                     with open(f"/proc/{pid}/cmdline", "rb") as f:
                         cmd = f.read().replace(b"\0", b" ")
-                except OSError:
+                    with open(f"/proc/{pid}/stat") as f:
+                        ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+                except (OSError, IndexError, ValueError):
                     continue
-                if b"bench.py" in cmd:
+                if b"bench.py" in cmd and ppid == 1:
                     stale.append(pid)
             for pid in stale:
                 try:
@@ -606,10 +607,9 @@ def main() -> None:
                     pass
             if stale:
                 diagnoses.append(
-                    f"attempt {attempt}: SIGINTed stale bench child(ren) "
-                    f"{stale} before retrying"
+                    f"attempt {attempt}: SIGINTed orphaned bench "
+                    f"child(ren) {stale} before retrying"
                 )
-        if retry_possible:
             sys.stderr.write(
                 f"bench attempt {attempt} failed ({last_err[:200]}); "
                 f"retrying\n"

@@ -70,16 +70,27 @@ class BatchedBufferStager(BufferStager):
         # (crc32, adler32, size) lets the scheduler feed manifest checksum
         # sinks and fold the slab digest with NO further passes over the
         # staged bytes (scheduler._apply_checksum_sinks).
+        import zlib
+
         from ._csrc import copy_digest
 
         def _pack_one(dst, view):
             # heavy pass (memcpy + crc32 + adler32, GIL released inside
-            # the ctypes call) — runs in the executor so the loop thread
-            # stays free for other pipelines' staging and I/O completions
+            # the ctypes call) — big members run in the executor so the
+            # loop thread stays free for other pipelines' staging and
+            # I/O completions
             d = copy_digest(dst, view)
             if d is None:  # no native lib: plain copy, no digests
                 dst[:] = view
             return d
+
+        # tiny members: the ctypes/executor round-trips cost more than
+        # the copy itself (a 20k-leaf optimizer state is 20k ~16-byte
+        # members) — python slice copy + zlib digests inline; mid-size
+        # members pack natively inline (sub-ms loop occupancy); only
+        # genuinely big copies pay the executor hop
+        _INLINE_PY_MAX = 4096
+        _EXEC_OFFLOAD_MIN = 256 * 1024
 
         loop = asyncio.get_running_loop()
         slab = bytearray(self.total)
@@ -93,7 +104,13 @@ class BatchedBufferStager(BufferStager):
             dst = slab_view[offset : offset + cost]
             if cost == 0:
                 digest = (0, 1)
-            elif executor is not None:
+            elif cost <= _INLINE_PY_MAX:
+                dst[:] = view
+                digest = (
+                    zlib.crc32(view) & 0xFFFFFFFF,
+                    zlib.adler32(view) & 0xFFFFFFFF,
+                )
+            elif executor is not None and cost >= _EXEC_OFFLOAD_MIN:
                 digest = await loop.run_in_executor(
                     executor, _pack_one, dst, view
                 )

@@ -104,3 +104,22 @@ def test_two_async_takes_sequential(tmp_path):
     s2.wait()
     for p in ("a", "b"):
         assert os.path.exists(str(tmp_path / p / SNAPSHOT_METADATA_FNAME))
+
+
+def test_two_async_takes_overlapping(tmp_path):
+    # both PendingSnapshots in flight at once: background commit threads
+    # and KV barrier uids must not collide across concurrent takes
+    import numpy as np
+
+    a = {"m": StateDict(x=np.arange(50000, dtype=np.float64))}
+    b = {"m": StateDict(y=np.arange(30000, dtype=np.float64) * 2)}
+    p1 = Snapshot.async_take(str(tmp_path / "a"), a)
+    p2 = Snapshot.async_take(str(tmp_path / "b"), b)
+    s2 = p2.wait()  # reversed wait order on purpose
+    s1 = p1.wait()
+    oa = {"m": StateDict(x=np.zeros(50000))}
+    ob = {"m": StateDict(y=np.zeros(30000))}
+    s1.restore(oa)
+    s2.restore(ob)
+    np.testing.assert_array_equal(oa["m"]["x"], np.arange(50000, dtype=np.float64))
+    np.testing.assert_array_equal(ob["m"]["y"], np.arange(30000, dtype=np.float64) * 2)

@@ -123,6 +123,73 @@ def test_oversized_item_progresses():
     assert storage.writes["big"] == b"x" * 1000
 
 
+def test_no_head_of_line_blocking():
+    # A head item bigger than the whole budget must not idle smaller
+    # items that DO fit: admission scans the whole ready set (reference
+    # scheduler.py:266-277).  Staging is largest-first, so "big" heads
+    # the deque; it should stage LAST (only once the pipeline drains to
+    # empty and the oversized-progress rule admits it).
+    order = []
+    lock = threading.Lock()
+
+    class OrderStager(ChunkStager):
+        def __init__(self, name, payload):
+            super().__init__(payload)
+            self.name = name
+
+        async def stage_buffer(self, executor=None):
+            with lock:
+                order.append(self.name)
+            return await super().stage_buffer(executor)
+
+    storage = TrackingStorage(delay=0.005)
+    reqs = [WriteReq(path="big", buffer_stager=OrderStager("big", b"B" * 1000))]
+    reqs += [
+        WriteReq(path=f"s{i}", buffer_stager=OrderStager(f"s{i}", b"s" * 50))
+        for i in range(4)
+    ]
+    pending = sync_execute_write_reqs(reqs, storage, memory_budget_bytes=120, rank=0)
+    pending.sync_complete()
+    assert len(storage.writes) == 5
+    assert storage.writes["big"] == b"B" * 1000
+    assert order[0] != "big", f"oversized head staged first: {order}"
+    assert order[-1] == "big", f"small items idled behind the head: {order}"
+
+
+def test_read_no_head_of_line_blocking():
+    # Same property on the read pipeline: a consuming cost larger than
+    # the budget must not idle smaller reads behind it.  "big" heads the
+    # request list; the fixed admission scans past it (it reaches the
+    # storage layer LAST, via the pipeline-empty oversized rule), while
+    # the old head-first admission read it FIRST and serialized the
+    # smalls behind its budget debit.
+    order = []
+    lock = threading.Lock()
+
+    class OrderStorage(TrackingStorage):
+        async def read(self, read_io):
+            with lock:
+                order.append(read_io.path)
+            await super().read(read_io)
+
+    storage = OrderStorage()
+    payloads = {"big": b"B" * 1000, **{f"s{i}": b"s" * 50 for i in range(4)}}
+    for path, data in payloads.items():
+        storage.writes[path] = data
+    sink = {}
+    read_reqs = [
+        ReadReq(
+            path=p,
+            buffer_consumer=CollectConsumer(sink, p, cost=len(d)),
+        )
+        for p, d in payloads.items()
+    ]
+    sync_execute_read_reqs(read_reqs, storage, memory_budget_bytes=120, rank=0)
+    assert sink == payloads
+    assert order[0] != "big", f"oversized head read first: {order}"
+    assert order[-1] == "big", f"small reads idled behind the head: {order}"
+
+
 def test_io_concurrency_cap():
     storage = TrackingStorage(delay=0.02)
     with knobs.override_max_per_rank_io_concurrency(3):

@@ -76,9 +76,10 @@ def _apply_checksum_sinks(buf, sinks, digest_sink=None, precomputed=None) -> Non
 
     view = memoryview(buf).cast("B")
     pre = precomputed or {}
+    sinks = list(sinks or ())  # a generator would be empty on re-iteration
     spans = [
         (0, view.nbytes) if rng is None else (rng[0], rng[1])
-        for _, rng in sinks or ()
+        for _, rng in sinks
     ]
     ordered = sorted(set(spans))
     can_fold = (
@@ -90,7 +91,7 @@ def _apply_checksum_sinks(buf, sinks, digest_sink=None, precomputed=None) -> Non
         and all(a[1] == b[0] for a, b in zip(ordered, ordered[1:]))
     )
     piece_digests = {}
-    for (sink, rng), span in zip(sinks or (), spans):
+    for (sink, rng), span in zip(sinks, spans):
         hit = pre.get(span)
         if hit is not None and hit[2] == span[1] - span[0]:
             crc = hit[0]
@@ -279,6 +280,10 @@ async def _execute_write_pipelines(
     io_tasks: set = set()
     io_concurrency = knobs.get_max_per_rank_io_concurrency()
     reporter = _WriteReporter(budget, stats)
+    # smallest pending staging cost: lets a wake where nothing can fit
+    # skip the admission scan in O(1) instead of rotating the whole
+    # deque on every task completion (O(n^2) across a large take)
+    min_pending_cost = min((p.staging_cost for p in pipelines), default=0)
 
     async def stage_one(p: _WritePipeline) -> _WritePipeline:
         p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
@@ -324,18 +329,37 @@ async def _execute_write_pipelines(
         return p
 
     def dispatch_staging() -> None:
-        # Admit under budget; if nothing is in flight and nothing staged,
-        # admit one oversized item to guarantee progress
-        # (reference scheduler.py:266-277).
-        while ready_for_staging:
-            cost = ready_for_staging[0].staging_cost
-            pipeline_empty = not staging_tasks and not io_tasks and not ready_for_io
-            if budget.fits(cost) or pipeline_empty:
+        # Scan ALL pending requests, admitting every one that fits the
+        # remaining budget — the deque is largest-first, so breaking at
+        # a non-fitting head would idle smaller items that DO fit
+        # (head-of-line blocking; reference scheduler.py:266-277 iterates
+        # the whole ready set).  If nothing fits and nothing is in
+        # flight, admit one oversized item to guarantee progress.
+        nonlocal min_pending_cost
+        if not ready_for_staging:
+            return
+        if budget.fits(min_pending_cost):
+            new_min = None
+            for _ in range(len(ready_for_staging)):
                 p = ready_for_staging.popleft()
-                budget.debit(p.staging_cost)
-                staging_tasks.add(asyncio.ensure_future(stage_one(p)))
-            else:
-                break
+                if budget.fits(p.staging_cost):
+                    budget.debit(p.staging_cost)
+                    staging_tasks.add(asyncio.ensure_future(stage_one(p)))
+                else:
+                    ready_for_staging.append(p)
+                    if new_min is None or p.staging_cost < new_min:
+                        new_min = p.staging_cost
+            min_pending_cost = new_min or 0
+            if not ready_for_staging:
+                return
+        if not staging_tasks and not io_tasks and not ready_for_io:
+            # rotation preserves the largest-first order, so the head is
+            # the largest pending item; admitting it leaves min unchanged
+            p = ready_for_staging.popleft()
+            budget.debit(p.staging_cost)
+            staging_tasks.add(asyncio.ensure_future(stage_one(p)))
+            if not ready_for_staging:
+                min_pending_cost = 0
 
     def dispatch_io() -> None:
         while ready_for_io and len(io_tasks) < io_concurrency:
@@ -463,6 +487,9 @@ async def _execute_read_pipelines(
     io_tasks: set = set()
     consume_tasks: set = set()
     io_concurrency = knobs.get_max_per_rank_io_concurrency()
+    # smallest pending consuming cost — O(1) skip of the admission scan
+    # on wakes where nothing can fit (see the write loop's twin)
+    min_pending_cost = min((p.consuming_cost for p in pipelines), default=0)
 
     async def read_one(p: _ReadPipeline) -> _ReadPipeline:
         read_io = ReadIO(path=p.read_req.path, byte_range=p.read_req.byte_range)
@@ -484,17 +511,39 @@ async def _execute_read_pipelines(
 
     try:
         while ready_for_io or io_tasks or consume_tasks:
-            # admit reads under the consuming-cost budget
+            # admit reads under the consuming-cost budget, scanning past
+            # non-fitting items so one big read can't idle small ones
             # (reference scheduler.py:386-446)
-            while ready_for_io and len(io_tasks) < io_concurrency:
-                cost = ready_for_io[0].consuming_cost
-                pipeline_empty = not io_tasks and not consume_tasks
-                if budget.fits(cost) or pipeline_empty:
+            if (
+                ready_for_io
+                and len(io_tasks) < io_concurrency
+                and budget.fits(min_pending_cost)
+            ):
+                # complete the full rotation even once the cap is hit so
+                # the deque's relative order is preserved (a mid-rotation
+                # break would leave later items ahead of re-appended
+                # earlier ones); cap-held items count toward new_min,
+                # which keeps the watermark conservatively low
+                new_min = None
+                for _ in range(len(ready_for_io)):
                     p = ready_for_io.popleft()
-                    budget.debit(p.consuming_cost)
-                    io_tasks.add(asyncio.ensure_future(read_one(p)))
-                else:
-                    break
+                    if len(io_tasks) < io_concurrency and budget.fits(
+                        p.consuming_cost
+                    ):
+                        budget.debit(p.consuming_cost)
+                        io_tasks.add(asyncio.ensure_future(read_one(p)))
+                    else:
+                        ready_for_io.append(p)
+                        if new_min is None or p.consuming_cost < new_min:
+                            new_min = p.consuming_cost
+                min_pending_cost = new_min if new_min is not None else 0
+            if ready_for_io and not io_tasks and not consume_tasks:
+                p = ready_for_io.popleft()
+                budget.debit(p.consuming_cost)
+                io_tasks.add(asyncio.ensure_future(read_one(p)))
+                min_pending_cost = min(
+                    (q.consuming_cost for q in ready_for_io), default=0
+                )
             if not io_tasks and not consume_tasks:
                 continue
             done, _ = await asyncio.wait(

@@ -1,0 +1,146 @@
+"""Template donation on restore: the 1x-device-memory property.
+
+The reference restores IN PLACE into pre-allocated tensors
+(snapshot.py:743-753, io_preparers/tensor.py:91-126), so device peak is
+~1x payload.  jax.Arrays are immutable, so the TPU-native equivalent is
+put-then-delete: each template's device buffers are freed as soon as its
+replacement dispatches (preparers/array.py donate_template) — peak is
+~1x payload + one leaf, and a failed restore leaves templates intact.
+On CPU the knob's "auto" resolves off; these tests force it on to
+exercise the mechanism.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import PyTreeState, Snapshot, knobs
+from torchsnapshot_tpu.preparers.array import (
+    donate_template,
+    materialize_into_template,
+)
+
+
+def _params(n=4, m=64):
+    return {
+        f"w{i}": jnp.arange(m, dtype=jnp.float32) * (i + 1) for i in range(n)
+    }
+
+
+def test_donation_deletes_templates_and_restores(tmp_path):
+    params = _params()
+    snap = Snapshot.take(str(tmp_path / "snap"), {"m": PyTreeState(params)})
+    templates = {k: jnp.zeros_like(v) for k, v in params.items()}
+    refs = dict(templates)  # outside refs: donation must still free them
+    dest = PyTreeState(templates)
+    with knobs.override_restore_donate("1"):
+        snap.restore({"m": dest})
+    for k, v in params.items():
+        np.testing.assert_array_equal(np.asarray(dest.tree[k]), np.asarray(v))
+    for k, t in refs.items():
+        assert t.is_deleted(), f"template {k} not donated"
+
+
+def test_donation_auto_is_off_on_cpu(tmp_path):
+    params = _params(n=2)
+    snap = Snapshot.take(str(tmp_path / "snap"), {"m": PyTreeState(params)})
+    templates = {k: jnp.zeros_like(v) for k, v in params.items()}
+    refs = dict(templates)
+    snap.restore({"m": PyTreeState(templates)})  # default: auto
+    for t in refs.values():
+        assert not t.is_deleted()
+
+
+def test_template_survives_until_replacement_dispatched():
+    # the load-bearing ordering: donation happens strictly AFTER the
+    # replacement's device_put, so a failed put leaves the template
+    # intact (failure safety beats the one-leaf extra peak)
+    template = jnp.zeros((32,), jnp.float32)
+    data = np.arange(32, dtype=np.float32)
+    real_put = jax.device_put
+    deleted_at_put = []
+
+    def spy_put(x, sharding=None, **kw):
+        deleted_at_put.append(template.is_deleted())
+        return real_put(x, sharding, **kw)
+
+    with knobs.override_restore_donate("1"):
+        jax.device_put = spy_put
+        try:
+            out = materialize_into_template(data, template)
+        finally:
+            jax.device_put = real_put
+    assert deleted_at_put == [False]
+    assert template.is_deleted()  # donated once the put dispatched
+    np.testing.assert_array_equal(np.asarray(out), data)
+
+
+def test_failed_restore_leaves_template_intact():
+    # mid-restore failure (H2D error, transfer wedge) must not destroy
+    # the caller's live state: donation never precedes the put
+    template = jnp.ones((32,), jnp.float32)
+    data = np.arange(32, dtype=np.float32)
+    real_put = jax.device_put
+
+    def failing_put(x, sharding=None, **kw):
+        raise RuntimeError("injected transfer failure")
+
+    with knobs.override_restore_donate("1"):
+        jax.device_put = failing_put
+        try:
+            with pytest.raises(RuntimeError, match="injected"):
+                materialize_into_template(data, template)
+        finally:
+            jax.device_put = real_put
+    assert not template.is_deleted()
+    np.testing.assert_array_equal(np.asarray(template), np.ones(32))
+
+
+def test_aliased_template_restores_both_leaves(tmp_path):
+    # one array object serving as the template for two paths: the second
+    # donation no-ops on the already-deleted array, and both leaves are
+    # rebuilt from storage bytes
+    params = {"a": jnp.arange(16, dtype=jnp.float32), "b": jnp.ones((16,))}
+    snap = Snapshot.take(str(tmp_path / "snap"), {"m": PyTreeState(params)})
+    shared = jnp.zeros((16,), jnp.float32)
+    dest = PyTreeState({"a": shared, "b": shared})
+    with knobs.override_restore_donate("1"):
+        snap.restore({"m": dest})
+    np.testing.assert_array_equal(np.asarray(dest.tree["a"]), np.arange(16))
+    np.testing.assert_array_equal(np.asarray(dest.tree["b"]), np.ones(16))
+    assert shared.is_deleted()
+
+
+def test_sharded_template_donated(tmp_path):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    arr = jax.device_put(jnp.arange(64, dtype=jnp.float32), sharding)
+    snap = Snapshot.take(str(tmp_path / "snap"), {"m": PyTreeState({"w": arr})})
+    template = jax.device_put(jnp.zeros((64,), jnp.float32), sharding)
+    dest = PyTreeState({"w": template})
+    with knobs.override_restore_donate("1"):
+        snap.restore({"m": dest})
+    np.testing.assert_array_equal(np.asarray(dest.tree["w"]), np.arange(64))
+    assert template.is_deleted()
+    assert dest.tree["w"].sharding.is_equivalent_to(sharding, 1)
+
+
+def test_donate_helper_modes():
+    arr = jnp.ones((4,))
+    with knobs.override_restore_donate("0"):
+        donate_template(arr)
+        assert not arr.is_deleted()
+    with knobs.override_restore_donate("auto"):  # cpu -> off
+        donate_template(arr)
+        assert not arr.is_deleted()
+    with knobs.override_restore_donate("1"):
+        donate_template(arr)
+        assert arr.is_deleted()
+        donate_template(arr)  # idempotent on a deleted array
+    # unrecognized values degrade to auto (a typo'd env var must not
+    # abort a half-applied restore), with a warning
+    with knobs.override_restore_donate("bogus"):
+        assert knobs.restore_donation() == "auto"

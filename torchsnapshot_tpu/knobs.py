@@ -8,8 +8,11 @@ and every knob has a context-manager override for tests.
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 from typing import Iterator, Optional
+
+_logger = logging.getLogger(__name__)
 
 _ENV_PREFIX = "TORCHSNAPSHOT_TPU_"
 
@@ -32,6 +35,7 @@ _SERIALIZE_TRANSFERS = "SERIALIZE_TRANSFERS"
 _WRITE_CHECKSUMS = "WRITE_CHECKSUMS"
 _VERIFY_ON_RESTORE = "VERIFY_ON_RESTORE"
 _DEVICE_UNPACK = "DEVICE_UNPACK"
+_RESTORE_DONATE = "RESTORE_DONATE"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -104,6 +108,17 @@ _DEFAULTS = {
     # accelerator backends, off on CPU (host-side copies are already
     # cheap there); "1"/"0" force.
     _DEVICE_UNPACK: "auto",
+    # Free each restore template's device buffers as soon as its
+    # replacement materializes, holding restore's device peak at ~1x
+    # payload + one leaf — the jax analogue of the reference's in-place
+    # load into pre-allocated tensors (snapshot.py:743-753; jax.Arrays
+    # are immutable, so "in place" becomes put-then-delete, ordered so a
+    # failed restore leaves the templates intact).  The template array
+    # objects become invalid on success (restore replaces them via
+    # load_state_dict anyway).  "auto" = on when the template lives on an
+    # accelerator (HBM is the scarce resource), off for host-resident
+    # templates; "1"/"0" force.
+    _RESTORE_DONATE: "auto",
 }
 
 _OVERRIDES: dict = {}
@@ -217,21 +232,57 @@ def serialize_transfers() -> bool:
     # process targets a tunneled PJRT plugin (via env var or the
     # programmatic jax.config path); direct-attached backends (cpu, tpu,
     # gpu) resolve off.
-    selected = os.environ.get("JAX_PLATFORMS", "") or ""
+    explicit = os.environ.get("JAX_PLATFORMS", "") or ""
     try:
         import jax
 
-        selected += "," + (jax.config.jax_platforms or "")
-        # an auto-registered tunnel plugin may be selected with neither
-        # the env var nor the config set; consult backends that are
-        # ALREADY initialized (never trigger an init here — a tunneled
-        # backend's init can block for minutes)
+        explicit += "," + (jax.config.jax_platforms or "")
+    except Exception as e:
+        _logger.debug("serialize_transfers auto: jax.config read failed: %r", e)
+    if explicit.replace(",", "").strip():
+        # an explicit platform selection is authoritative: only the named
+        # platforms can initialize, so a registered-but-unselected tunnel
+        # factory must NOT gate a cpu/tpu run
+        return "axon" in explicit.lower()
+    try:
+        # selection is auto: an auto-registered tunnel plugin may win
+        # backend resolution; consult REGISTERED plugin factories and
+        # ALREADY-initialized backends (never trigger an init here — a
+        # tunneled backend's init can block for minutes).  Both dicts
+        # are jax-internal; a rename makes this leg fall through (logged
+        # so the silent-off is diagnosable — the env-var override
+        # remains the escape hatch).
         from jax._src import xla_bridge
 
-        selected += "," + ",".join(getattr(xla_bridge, "_backends", {}))
-    except Exception:
-        pass
-    return "axon" in selected.lower()
+        names = ",".join(getattr(xla_bridge, "_backends", {}))
+        names += "," + ",".join(getattr(xla_bridge, "_backend_factories", {}))
+    except Exception as e:
+        _logger.debug(
+            "serialize_transfers auto: xla_bridge introspection failed "
+            "(jax-internal layout changed?): %r", e,
+        )
+        return False
+    return "axon" in names.lower()
+
+
+def restore_donation() -> str:
+    """One of "on" | "off" | "auto" (see _RESTORE_DONATE above).
+
+    Unrecognized values degrade to "auto" with a warning instead of
+    raising: this knob is first read per-leaf in the middle of restore,
+    where a typo'd env var must not abort a half-applied restore
+    (donation is an optimization, never fatal)."""
+    v = str(_get_raw(_RESTORE_DONATE)).lower()
+    if v in ("1", "true", "on"):
+        return "on"
+    if v in ("0", "false", "off"):
+        return "off"
+    if v != "auto":
+        _logger.warning(
+            "TORCHSNAPSHOT_TPU_RESTORE_DONATE=%r is not auto/on/off; "
+            "treating as auto", v,
+        )
+    return "auto"
 
 
 def use_pallas_attention() -> bool:
@@ -337,3 +388,7 @@ def override_pallas_attention(value):
 
 def override_replication_verify(value: str):
     return _override(_REPLICATION_VERIFY, value)
+
+
+def override_restore_donate(value):
+    return _override(_RESTORE_DONATE, value)

@@ -40,6 +40,21 @@ def path_is_replicated(logical_path: str, replicated_globs: Sequence[str]) -> bo
     return any(fnmatch.fnmatch(logical_path, g) for g in replicated_globs)
 
 
+def estimate_write_bytes(obj: Any) -> int:
+    """Cheap, gather-free byte estimate of one leaf's write load, used to
+    pre-load the sharded-box balancer with per-rank host-state weight
+    (reference partitioner.py:266-270).  Exactness doesn't matter —
+    balancing is a heuristic — but the estimate must be computable
+    without staging (no serialization, no D2H)."""
+    if is_primitive_type(obj):
+        return 0
+    if is_array_like(obj):
+        return array_nbytes(obj)
+    if isinstance(obj, (bytes, bytearray)):
+        return len(obj)
+    return 0  # arbitrary object: serialized size unknown until staged
+
+
 def prepare_write(
     obj: Any,
     logical_path: str,
@@ -48,12 +63,16 @@ def prepare_write(
     is_async_snapshot: bool = False,
     process_index: int = 0,
     process_count: int = 1,
+    writer_loads: Optional[List[int]] = None,
 ) -> Tuple[Entry, List[WriteReq]]:
     """Plan the write of one leaf (reference io_preparer.py:82-147).
 
     Storage-path namespace (reference io_preparer.py:52-61):
     ``replicated/`` for replicated entries, ``sharded/`` for sharded arrays,
     ``<rank>/`` for per-rank entries.
+
+    ``writer_loads``: shared per-process load vector for the sharded-box
+    balancer (see assign_box_writers); identical across controllers.
     """
     if is_primitive_type(obj):
         return PrimitiveEntry.from_object(obj, replicated=replicated), []
@@ -64,6 +83,7 @@ def prepare_write(
             logical_path=logical_path,
             process_index=process_index,
             process_count=process_count,
+            writer_loads=writer_loads,
         )
 
     if is_array_like(obj):

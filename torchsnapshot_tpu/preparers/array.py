@@ -83,6 +83,39 @@ def _is_jax_array(obj: Any) -> bool:
     return isinstance(obj, jax.Array)
 
 
+def donate_template(arr: Any) -> None:
+    """Free a jax restore-template's device buffers as soon as its
+    replacement has materialized, so restore's device peak stays at ~1x
+    payload + one leaf instead of 2x (all templates + all restored) —
+    the jax analogue of the reference's in-place load into pre-allocated
+    tensors (snapshot.py:743-753, io_preparers/tensor.py:91-126).
+
+    Called strictly AFTER the replacement's device_put, never before: a
+    restore that fails mid-leaf (transfer wedge, H2D OOM) must leave the
+    caller's live template arrays intact, not destroyed.
+
+    ``delete()`` frees the buffers while keeping shape/dtype/sharding
+    metadata valid, which is all any later step needs.  Aliased leaves
+    (one array as the template for several paths) are safe: the second
+    donation sees ``is_deleted()`` and no-ops, and each path's restored
+    array is built from storage bytes, never from the template."""
+    mode = knobs.restore_donation()
+    if mode == "off":
+        return
+    if mode == "auto":
+        try:
+            on_accel = all(d.platform != "cpu" for d in arr.devices())
+        except Exception:  # noqa: BLE001 — e.g. inside a transform
+            on_accel = False
+        if not on_accel:
+            return
+    try:
+        if not arr.is_deleted():
+            arr.delete()
+    except Exception as e:  # donation is an optimization, never fatal
+        logger.debug("template donation skipped: %r", e)
+
+
 def is_array_like(obj: Any) -> bool:
     if isinstance(obj, np.ndarray):
         return True
@@ -256,14 +289,18 @@ def materialize_into_template(np_arr: np.ndarray, obj_out: Any) -> Any:
         if np.dtype(np_arr.dtype) != np.dtype(obj_out.dtype):
             np_arr = np_arr.astype(obj_out.dtype)
         shaped = np_arr.reshape(obj_out.shape)
+        sharding = obj_out.sharding
         # consumers run on an executor: gate concurrent H2D puts behind
         # one lock — a chip has one DMA engine per direction, and
         # multiplexed transports can interleave concurrent transfers
         # pathologically (observed as a multi-minute wedge on a tunneled
         # PJRT attachment)
         with transfer_gate() as pending:
-            out = jax.device_put(shaped, obj_out.sharding)
+            out = jax.device_put(shaped, sharding)
             pending.append(out)
+        # replacement dispatched: the template's device buffer is no
+        # longer needed — free it so peak stays ~1x payload
+        donate_template(obj_out)
         return out
     # Template is some other leaf (e.g. a Python scalar where the saved
     # state had a traced jax scalar, like TrainState.step before/after the

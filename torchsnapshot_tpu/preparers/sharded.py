@@ -130,13 +130,23 @@ def _subdivide(box: Box, itemsize: int, max_bytes: int) -> List[Box]:
 
 
 def assign_box_writers(
-    boxes: Dict[Box, List[Any]], itemsize: int, process_count: int
+    boxes: Dict[Box, List[Any]],
+    itemsize: int,
+    process_count: int,
+    preloads: Optional[List[int]] = None,
 ) -> Dict[Box, int]:
     """Deterministic greedy balance: every process computes the identical
     assignment from the (global) sharding metadata. Largest box first, to
     the least-loaded candidate process (reference partitioner.py:140-213,
-    minus the gather+broadcast)."""
-    loads = [0] * max(1, process_count)
+    minus the gather+broadcast).
+
+    ``preloads``: per-process byte loads already committed elsewhere —
+    per-rank host-state bytes and earlier sharded leaves' assignments
+    (reference partitioner.py:266-270 counts non-replicated bytes as
+    pre-load).  MUTATED IN PLACE so one vector composes across every
+    sharded leaf of a take; callers must pass an identical vector on
+    every controller (it feeds a collective-free assignment)."""
+    loads = preloads if preloads is not None else [0] * max(1, process_count)
     assignment: Dict[Box, int] = {}
     ordered = sorted(
         boxes.keys(), key=lambda b: (-box_nelems(b), b[0])
@@ -156,11 +166,14 @@ class ShardedArrayIOPreparer:
         logical_path: str,
         process_index: int,
         process_count: int,
+        writer_loads: Optional[List[int]] = None,
     ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
         shape = tuple(int(s) for s in obj.shape)
         itemsize = np.dtype(obj.dtype).itemsize
         boxes = _unique_boxes(obj.sharding, shape)
-        assignment = assign_box_writers(boxes, itemsize, process_count)
+        assignment = assign_box_writers(
+            boxes, itemsize, process_count, preloads=writer_loads
+        )
 
         # device -> local shard data for this process
         local_data: Dict[Any, Any] = {
@@ -261,7 +274,7 @@ class ShardedArrayIOPreparer:
             if obj_out is not None and is_multi_device_jax_array(obj_out):
                 import jax
 
-                from .array import transfer_gate
+                from .array import donate_template, transfer_gate
 
                 if target_dtype != dtype:
                     for box in list(buffers):
@@ -274,6 +287,10 @@ class ShardedArrayIOPreparer:
                     with transfer_gate() as pending:
                         out = jax.device_put(buffers[full_box], sharding)
                         pending.append(out)
+                    # replacement dispatched: free the template's device
+                    # buffers (1x-restore; a failed put above leaves the
+                    # template intact)
+                    donate_template(obj_out)
                     fut.set(out)
                     return
                 arrays = []
@@ -282,11 +299,11 @@ class ShardedArrayIOPreparer:
                         for dev in devs:
                             arrays.append(jax.device_put(buffers[box], dev))
                     pending.extend(arrays)
-                fut.set(
-                    jax.make_array_from_single_device_arrays(
-                        tuple(obj_out.shape), sharding, arrays
-                    )
+                out = jax.make_array_from_single_device_arrays(
+                    tuple(obj_out.shape), sharding, arrays
                 )
+                donate_template(obj_out)
+                fut.set(out)
             else:
                 (buf,) = buffers.values()
                 fut.set(materialize_into_template(buf, obj_out))

@@ -47,7 +47,13 @@ from .manifest import (
 )
 from .manifest_ops import consolidate_manifests, get_manifest_for_rank
 from .partitioner import partition_replicated_writes
-from .preparers import path_is_replicated, prepare_read, prepare_write
+from .preparers import (
+    estimate_write_bytes,
+    path_is_replicated,
+    prepare_read,
+    prepare_write,
+)
+from .preparers.sharded import is_multi_device_jax_array
 from .serialization import serialize_object
 from .scheduler import (
     PendingIOWork,
@@ -623,6 +629,24 @@ class Snapshot:
         verified_repl = _verify_replicated_paths(
             flattened, replicated_globs, coordinator, verify_mode
         )
+        # Per-rank host-state weight feeds the sharded-box balancer as a
+        # pre-load, so a process carrying heavy per-rank host state (e.g.
+        # a data-loader rank's buffers) is assigned fewer sharded boxes —
+        # the two balancers compose (reference partitioner.py:266-270).
+        # The gathered vector is identical on every controller, keeping
+        # box assignment collective-free and deterministic; it is then
+        # MUTATED by each sharded leaf's assignment so sharded leaves
+        # also compose with each other.
+        host_est = sum(
+            estimate_write_bytes(obj)
+            for lp, obj in flattened.items()
+            if lp not in verified_repl and not is_multi_device_jax_array(obj)
+        )
+        writer_loads = list(
+            coordinator.all_gather_object(host_est)
+            if world > 1
+            else [host_est]
+        )
         for lpath in sorted(flattened.keys()):
             obj = flattened[lpath]
             repl = lpath in verified_repl
@@ -634,6 +658,7 @@ class Snapshot:
                 is_async_snapshot=is_async,
                 process_index=rank,
                 process_count=world,
+                writer_loads=writer_loads,
             )
             entries[lpath] = entry
             cost = sum(r.buffer_stager.get_staging_cost_bytes() for r in reqs)

@@ -1,0 +1,127 @@
+"""Opportunistic TPU bench watcher.
+
+The axon relay transport (127.0.0.1:808x) dies and resurrects
+unpredictably across a session; rounds 1 and 2 both lost their ONLY
+hardware measurement because the bench ran exactly once, at end-of-round,
+and found the transport dead.  This watcher inverts the strategy: poll
+the relay cheaply (a TCP connect — never a backend init, which would
+hang for ~24 minutes when the transport is down), and the moment a
+listener appears, run ``bench.py`` (its supervisor persists any
+successful result to ``BENCH_EARLY.json``, which the end-of-round run
+falls back to).
+
+Safety rules (see bench.py's module docstring for why):
+- never attach while another bench.py process exists (chip claim is
+  exclusive; queuing behind a sibling looks like a dead tunnel);
+- never signal a TPU child (bench.py's supervisor owns that, SIGINT
+  first, progress-based);
+- stop well before end-of-round so the driver's own bench never queues
+  behind us.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+LOG = os.path.join(REPO, ".bench_watch.log")
+PIDFILE = os.path.join(REPO, ".bench_watch.pid")
+RELAY_PORTS = (8082, 8083, 8087)
+
+
+def _log(msg: str) -> None:
+    with open(LOG, "a") as f:
+        f.write(f"[{time.strftime('%H:%M:%S')}] {msg}\n")
+
+
+def _relay_alive() -> bool:
+    for port in RELAY_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2):
+                return True
+        except OSError:
+            continue
+    return False
+
+
+def _bench_running() -> bool:
+    try:
+        out = subprocess.run(
+            ["pgrep", "-f", "bench.py"], capture_output=True, text=True
+        ).stdout.split()
+        return any(int(p) != os.getpid() for p in out)
+    except (OSError, ValueError):
+        return False
+
+
+def main() -> None:
+    hours = float(sys.argv[1]) if len(sys.argv) > 1 else 9.0
+    max_successes = 3
+    deadline = time.time() + hours * 3600
+    successes = 0
+    try:  # single instance: a clobbered pidfile orphans the first watcher
+        with open(PIDFILE) as f:
+            other = int(f.read().strip())
+        os.kill(other, 0)
+        _log(f"watcher {other} already running; exiting")
+        return
+    except (OSError, ValueError):
+        pass
+    with open(PIDFILE, "w") as f:
+        f.write(str(os.getpid()))
+    _log(f"watcher started, pid={os.getpid()}, budget={hours}h")
+    try:
+        while time.time() < deadline:
+            if not _relay_alive():
+                time.sleep(60)
+                continue
+            if _bench_running():
+                _log("relay alive but a bench.py already runs; waiting")
+                time.sleep(120)
+                continue
+            _log("relay alive — launching bench.py")
+            try:
+                out = subprocess.run(
+                    [sys.executable, BENCH],
+                    capture_output=True,
+                    text=True,
+                    timeout=1800,
+                    cwd=REPO,
+                ).stdout
+            except subprocess.TimeoutExpired:
+                # bench.py's own supervisor deadline is 1380s; this is a
+                # belt-and-suspenders bound that should never fire
+                _log("bench.py exceeded 1800s (unexpected); moving on")
+                time.sleep(600)
+                continue
+            value = 0.0
+            for line in out.strip().splitlines():
+                try:
+                    value = float(json.loads(line).get("value", 0))
+                except ValueError:
+                    continue
+            _log(f"bench.py finished, last value={value}")
+            if value > 0:
+                successes += 1
+                if successes >= max_successes:
+                    _log("max successes reached; exiting")
+                    return
+                time.sleep(7200)  # re-measure later for a better number
+            else:
+                time.sleep(600)  # listener up but remote side unhealthy
+    finally:
+        try:
+            os.remove(PIDFILE)
+        except OSError:
+            pass
+        _log("watcher exiting")
+
+
+if __name__ == "__main__":
+    main()

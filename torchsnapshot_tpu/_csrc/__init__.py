@@ -19,26 +19,42 @@ logger = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fastio.cpp")
-_SO = os.path.join(_HERE, "fastio.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 
 
-def _build() -> bool:
-    # Compile to a process-unique temp file and os.rename into place:
-    # atomic on posix, so concurrent first-use across processes (the
-    # multi-process tests spawn several) can never observe a half-written
-    # .so — worst case they each build once and the last rename wins.
-    tmp = f"{_SO}.tmp.{os.getpid()}"
+def _so_candidates() -> list:
+    """Loadable cache paths, most-preferred first.
+
+    The CPU fingerprint is embedded in the FILENAME, so a native .so and
+    its provenance are published by ONE atomic rename — there is no
+    companion record that a crash or concurrent builder could leave
+    missing/stale (which would let a -march=native binary masquerade as
+    portable and SIGILL on an older CPU)."""
+    fp = _cpu_fingerprint()
+    cands = []
+    if fp:
+        cands.append(os.path.join(_HERE, f"fastio.{fp}.so"))
+    cands.append(os.path.join(_HERE, "fastio.portable.so"))
+    return cands
+
+
+def _build() -> Optional[str]:
+    # Compile to a process-unique temp file and os.replace into the
+    # fingerprint-named destination: atomic on posix, so concurrent
+    # first-use across processes (the multi-process tests spawn several)
+    # can never observe a half-written .so or a native .so under the
+    # portable name — worst case they each build once, last rename wins.
+    tmp = os.path.join(_HERE, f"fastio.so.tmp.{os.getpid()}")
     # -march=native is a ~25% win for the fused digest loops (the adler
     # closed-form reductions vectorize), but an ISA-specific binary must
-    # never outlive its host CPU: the build records the CPU fingerprint
-    # next to the .so, and load() discards a cached binary whose
-    # fingerprint no longer matches (a copied venv / NFS tree / docker
-    # image moved to an older CPU would otherwise SIGILL mid-checkpoint).
-    # Hosts where the fingerprint cannot be read get portable flags only.
+    # never outlive its host CPU: it is cached under fastio.<fp>.so and
+    # only ever loaded by a host with the same CPU-feature fingerprint
+    # (a copied venv / NFS tree / docker image moved to an older CPU
+    # resolves to a different name and rebuilds).  Hosts where the
+    # fingerprint cannot be read get portable flags only.
     fp = _cpu_fingerprint()
     # zlib linkage first (its SIMD crc32 beats our slice-by-8 ~2x);
     # then without, for hosts missing zlib.h/libz
@@ -73,22 +89,19 @@ def _build() -> bool:
                 capture_output=True,
                 timeout=120,
             )
-            os.replace(tmp, _SO)
-            try:
-                with open(_SO + ".cpu", "w") as f:
-                    f.write(build_fp)
-            except OSError:
-                if build_fp:
-                    # an ISA-specific binary without its fingerprint
-                    # record would later read as "portable" and SIGILL
-                    # on a different CPU — drop it and try the next
-                    # (portable) variant instead
-                    try:
-                        os.remove(_SO)
-                    except OSError:
-                        pass
-                    continue
-            return True
+            dest = os.path.join(
+                _HERE,
+                f"fastio.{build_fp}.so" if build_fp else "fastio.portable.so",
+            )
+            os.replace(tmp, dest)
+            if fp and not build_fp:
+                # every native variant failed on a fingerprintable host
+                # (e.g. a g++ that rejects -march=native): record that,
+                # so later processes accept the cached portable build
+                # instead of re-paying the failed native compiles on
+                # every startup
+                _publish_marker(_no_native_marker(fp))
+            return dest
         except Exception as e:  # noqa: BLE001
             logger.debug(
                 "fastio build failed with %s (%r)", extra or "base flags", e
@@ -97,7 +110,21 @@ def _build() -> bool:
                 os.remove(tmp)
             except OSError:
                 pass
-    return False
+    return None
+
+
+def _no_native_marker(fp: str) -> str:
+    return os.path.join(_HERE, f"fastio.{fp}.nonative")
+
+
+def _publish_marker(path: str) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w"):
+            pass
+        os.replace(tmp, path)
+    except OSError:
+        pass
 
 
 def _cpu_fingerprint() -> str:
@@ -117,27 +144,11 @@ def _cpu_fingerprint() -> str:
     return ""
 
 
-def _cached_so_usable() -> bool:
-    """The on-disk .so is current AND was built for this CPU (or with
-    portable flags, recorded as an empty fingerprint)."""
-    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
-        _SRC
-    ):
-        return False
+def _try_load(path: str) -> Optional[ctypes.CDLL]:
     try:
-        with open(_SO + ".cpu") as f:
-            built_for = f.read().strip()
-    except OSError:
-        # no record: legacy portable build — loadable anywhere
-        return True
-    return built_for == "" or built_for == _cpu_fingerprint()
-
-
-def _try_load() -> Optional[ctypes.CDLL]:
-    try:
-        return ctypes.CDLL(_SO)
+        return ctypes.CDLL(path)
     except OSError as e:
-        logger.debug("fastio load failed: %r", e)
+        logger.debug("fastio load failed for %s: %r", path, e)
         return None
 
 
@@ -148,17 +159,43 @@ def load() -> Optional[ctypes.CDLL]:
         if _load_attempted:
             return _lib
         _load_attempted = True
-        lib = None
-        if _cached_so_usable():
-            lib = _try_load()
+
+        def _fresh(path: str) -> bool:
+            try:
+                return os.path.getmtime(path) >= os.path.getmtime(_SRC)
+            except OSError:
+                return False
+
+        cands = _so_candidates()
+        # Only the PREFERRED (native, when fingerprintable) candidate is
+        # accepted from cache: settling for a fresh portable .so while
+        # the native one is stale/absent would silently forfeit the
+        # -march=native win forever (a successful load skips _build) —
+        # UNLESS a fresh .nonative marker records that native compilation
+        # already failed for this CPU, in which case the cached portable
+        # build is the best achievable and rebuilding every process would
+        # just re-pay the failed native compiles.
+        lib = _try_load(cands[0]) if _fresh(cands[0]) else None
+        if (
+            lib is None
+            and len(cands) > 1
+            and _fresh(_no_native_marker(_cpu_fingerprint()))
+            and _fresh(cands[-1])
+        ):
+            lib = _try_load(cands[-1])
         if lib is None:
-            # stale, absent, or unloadable (e.g. foreign-platform binary):
-            # rebuild once and retry
-            if not _build():
-                return None
-            lib = _try_load()
-            if lib is None:
-                return None
+            dest = _build()
+            lib = _try_load(dest) if dest else None
+        if lib is None:
+            # no toolchain: any fresh lesser candidate beats the pure-
+            # python fallback
+            for cand in cands[1:]:
+                if _fresh(cand):
+                    lib = _try_load(cand)
+                    if lib is not None:
+                        break
+        if lib is None:
+            return None
         lib.tsnp_write_file.restype = ctypes.c_int
         lib.tsnp_write_file.argtypes = [
             ctypes.c_char_p,

@@ -116,20 +116,37 @@ static void init_slice8_tables(uint32_t poly, uint32_t table[8][256]) {
   }
 }
 
+// The word-at-a-time slice-by-8 folds `crc ^= (uint32_t)chunk` on a
+// memcpy'd 8-byte word, which is only correct when the low word holds
+// the FIRST four bytes — i.e. on little-endian hosts.  Big-endian hosts
+// take the (correct, slower) bytewise loops instead of silently
+// recording wrong checksums into manifests.
+#if defined(__BYTE_ORDER__) && (__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__)
+#define TSNP_LITTLE_ENDIAN 1
+#else
+#define TSNP_LITTLE_ENDIAN 0
+#endif
+
 // crc32c (Castagnoli), slice-by-8.
 static uint32_t crc32c_table[8][256];
-static bool crc32c_init_done = false;
+// zlib-polynomial crc32 (0xEDB88320), slice-by-8 — bit-compatible with
+// python's zlib.crc32 (manifest checksums use that polynomial; crc32c
+// above is only for fs write verification).
+static uint32_t crc32z_table[8][256];
 
-static void crc32c_init() {
+// Eager init at library load: tsnp_crc32c / tsnp_copy_digest are called
+// concurrently from executor threads with the GIL released, so a lazy
+// check-then-init would be a data race (a thread could read a
+// partially-built higher slice).
+__attribute__((constructor)) static void tsnp_init_crc_tables() {
   init_slice8_tables(0x82f63b78u, crc32c_table);
-  crc32c_init_done = true;
+  init_slice8_tables(0xEDB88320u, crc32z_table);
 }
 
 uint32_t tsnp_crc32c(const void *buf, int64_t size, uint32_t seed) {
-  if (!crc32c_init_done)
-    crc32c_init();
   uint32_t crc = ~seed;
   const uint8_t *p = static_cast<const uint8_t *>(buf);
+#if TSNP_LITTLE_ENDIAN
   while (size >= 8) {
     uint64_t chunk;
     memcpy(&chunk, p, 8);
@@ -142,23 +159,13 @@ uint32_t tsnp_crc32c(const void *buf, int64_t size, uint32_t seed) {
     p += 8;
     size -= 8;
   }
+#endif
   while (size > 0) {
     crc = crc32c_table[0][(crc ^ *p) & 0xff] ^ (crc >> 8);
     p++;
     size--;
   }
   return ~crc;
-}
-
-// zlib-polynomial crc32 (0xEDB88320), slice-by-8 — bit-compatible with
-// python's zlib.crc32 (manifest checksums use that polynomial; crc32c
-// above is only for fs write verification).
-static uint32_t crc32z_table[8][256];
-static bool crc32z_init_done = false;
-
-static void crc32z_init() {
-  init_slice8_tables(0xEDB88320u, crc32z_table);
-  crc32z_init_done = true;
 }
 
 // memcpy src -> dst while computing zlib crc32 AND adler32 of the bytes,
@@ -187,8 +194,6 @@ void tsnp_copy_digest(void *dst, const void *src, int64_t size,
   out[1] = static_cast<uint32_t>(zadl);
   return;
 #else
-  if (!crc32z_init_done)
-    crc32z_init();
   uint32_t crc = 0xFFFFFFFFu;
   const uint32_t MOD = 65521u;
   uint32_t a = 1, b = 0;
@@ -200,6 +205,7 @@ void tsnp_copy_digest(void *dst, const void *src, int64_t size,
     memcpy(q + off, p + off, static_cast<size_t>(blk));
     const uint8_t *s = p + off;
     int64_t n = blk;
+#if TSNP_LITTLE_ENDIAN
     while (n >= 8) {
       uint64_t chunk;
       memcpy(&chunk, s, 8);
@@ -212,6 +218,7 @@ void tsnp_copy_digest(void *dst, const void *src, int64_t size,
       s += 8;
       n -= 8;
     }
+#endif
     while (n > 0) {
       crc = crc32z_table[0][(crc ^ *s) & 0xff] ^ (crc >> 8);
       s++;

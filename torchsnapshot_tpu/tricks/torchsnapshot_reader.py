@@ -1,0 +1,372 @@
+"""Read checkpoints written by facebookresearch/torchsnapshot.
+
+The one thing a migrating user can't regenerate is their trained
+checkpoints.  This module reads the reference's on-disk format directly
+into host arrays / python values, so a reference-era snapshot restores
+into a JAX training state with no torch run required (torch IS required
+only for ``torch_save``-serialized payloads).
+
+Format contract implemented here (reference, by file:line):
+
+- ``.snapshot_metadata`` is JSON (a YAML subset, written via json.dumps
+  for speed — manifest.py:442-448); entries are tagged unions dispatched
+  on ``type`` (manifest.py:450-475).
+- Manifest keys are ``<rank>/<logical_path>`` per-rank views
+  (io_preparer.py:52-61); ``/`` inside user dict keys is %-escaped
+  (flatten.py:215-226, RFC-3986 subset).
+- Containers: ``dict``/``OrderedDict`` carry ``keys``; ``list`` children
+  sit at integer path components (flatten.py:20-77).
+- Primitives are inlined: int/str/bool as strings, bytes as base64,
+  float as base64-packed little-endian f64 (manifest.py:335-400).
+- ``Tensor`` entries: ``location`` (+ optional ``byte_range``),
+  ``serializer`` ∈ {buffer_protocol, torch_save}, ``dtype`` like
+  ``torch.bfloat16``, ``shape`` (manifest.py:49-95).  buffer_protocol is
+  raw C-order bytes (serialization.py:177-265).
+- ``ChunkedTensor``: ``chunks`` of {offsets, sizes, tensor}
+  (manifest.py:171-210); ``ShardedTensor``: ``shards`` of the same shape
+  (manifest.py:118-168), with each rank's manifest listing only its own
+  shards — the full tensor is the union across rank views;
+  ``DTensor`` adds mesh/dim_map metadata and possibly-duplicated
+  replicated shards (manifest.py:211-261).
+- ``object`` entries are ``torch.save`` pickles (io_preparers/object.py)
+  — decoded only when the pickle knob allows.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import unquote
+
+import numpy as np
+
+from .. import knobs
+from ..io_types import ReadIO
+from ..utils.asyncio_utils import run_in_fresh_loop
+
+_TORCH_DTYPES: Dict[str, Any] = {}
+
+
+def _np_dtype(torch_name: str) -> np.dtype:
+    if not _TORCH_DTYPES:
+        import ml_dtypes
+
+        _TORCH_DTYPES.update(
+            {
+                "torch.float32": np.dtype(np.float32),
+                "torch.float64": np.dtype(np.float64),
+                "torch.float16": np.dtype(np.float16),
+                "torch.bfloat16": np.dtype(ml_dtypes.bfloat16),
+                "torch.int8": np.dtype(np.int8),
+                "torch.int16": np.dtype(np.int16),
+                "torch.int32": np.dtype(np.int32),
+                "torch.int64": np.dtype(np.int64),
+                "torch.uint8": np.dtype(np.uint8),
+                "torch.bool": np.dtype(np.bool_),
+                "torch.complex64": np.dtype(np.complex64),
+                "torch.complex128": np.dtype(np.complex128),
+                "torch.float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+                "torch.float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+            }
+        )
+    try:
+        return _TORCH_DTYPES[torch_name]
+    except KeyError:
+        raise ValueError(
+            f"unsupported reference dtype {torch_name!r} (quantized tensors "
+            f"are not importable — dequantize before saving, or load with "
+            f"the reference library)"
+        ) from None
+
+
+def _read_bytes(storage, location: str, byte_range: Optional[List[int]]) -> bytes:
+    read_io = ReadIO(
+        path=location,
+        byte_range=tuple(byte_range) if byte_range else None,
+    )
+    run_in_fresh_loop(storage.read(read_io))
+    return bytes(memoryview(read_io.buf).cast("B"))
+
+
+class _BlobCache:
+    """Prefetches every blob the manifest references with ONE event loop
+    and bounded concurrency, so a many-entry checkpoint on object
+    storage doesn't pay per-blob loop setup + serial latency."""
+
+    def __init__(self, storage, concurrency: int = 16) -> None:
+        self._storage = storage
+        self._concurrency = concurrency
+        self._blobs: Dict[Tuple[str, Optional[Tuple[int, int]]], bytes] = {}
+
+    @staticmethod
+    def _key(entry: dict) -> Tuple[str, Optional[Tuple[int, int]]]:
+        br = entry.get("byte_range")
+        return entry["location"], (tuple(br) if br else None)
+
+    def prefetch(self, tensorish_entries: List[dict]) -> None:
+        import asyncio
+
+        keys = []
+        for entry in tensorish_entries:
+            for sub in (
+                entry.get("chunks") or entry.get("shards") or [entry]
+            ):
+                tensor = sub.get("tensor", sub)
+                if "location" in tensor:
+                    keys.append(self._key(tensor))
+        keys = [k for k in dict.fromkeys(keys) if k not in self._blobs]
+
+        async def fetch_all() -> None:
+            sem = asyncio.Semaphore(self._concurrency)
+
+            async def one(key):
+                loc, br = key
+                async with sem:
+                    read_io = ReadIO(path=loc, byte_range=br)
+                    await self._storage.read(read_io)
+                self._blobs[key] = bytes(memoryview(read_io.buf).cast("B"))
+
+            await asyncio.gather(*(one(k) for k in keys))
+
+        if keys:
+            run_in_fresh_loop(fetch_all())
+
+    def get(self, entry: dict) -> bytes:
+        key = self._key(entry)
+        if key not in self._blobs:
+            self._blobs[key] = _read_bytes(self._storage, key[0], key[1])
+        return self._blobs[key]
+
+
+def _decode_primitive(entry: dict) -> Any:
+    t, sv = entry["type"], entry["serialized_value"]
+    if t == "int":
+        return int(sv)
+    if t == "str":
+        return sv
+    if t == "bool":
+        if sv not in ("True", "False"):
+            raise ValueError(f"bad bool serialized_value {sv!r}")
+        return sv == "True"
+    if t == "bytes":
+        return base64.b64decode(sv.encode())
+    if t == "float":
+        return struct.unpack("d", base64.b64decode(sv.encode()))[0]
+    raise ValueError(f"unknown primitive type {t!r}")
+
+
+def _decode_tensor(blobs: "_BlobCache", entry: dict) -> np.ndarray:
+    data = blobs.get(entry)
+    if entry.get("serializer") == "torch_save":
+        return _torch_load(data).numpy()
+    dtype = _np_dtype(entry["dtype"])
+    arr = np.frombuffer(data, dtype=dtype)
+    return arr.reshape(entry["shape"]).copy()
+
+
+def _torch_load(data: bytes) -> Any:
+    if not knobs.is_pickle_allowed():
+        raise RuntimeError(
+            "entry uses the reference's torch_save (pickle) serializer; "
+            "decoding requires TORCHSNAPSHOT_TPU_ALLOW_PICKLE_OBJECTS=1 "
+            "and must only be used on trusted snapshots"
+        )
+    import io
+
+    import torch
+
+    return torch.load(io.BytesIO(data), weights_only=False)
+
+
+def _dedup_pieces(pieces: List[dict]) -> List[dict]:
+    """Replicated shards repeat the same box across rank views; keep one
+    per (offsets, sizes) so coverage accounting and reads stay exact."""
+    seen = {}
+    for piece in pieces:
+        seen.setdefault(
+            (tuple(piece["offsets"]), tuple(piece["sizes"])), piece
+        )
+    return list(seen.values())
+
+
+def _assemble_pieces(
+    blobs: "_BlobCache", shape: List[int], dtype: str, pieces: List[dict]
+) -> np.ndarray:
+    """Paste {offsets, sizes, tensor} pieces (chunks or shards) into a
+    dense array; a union that leaves holes raises instead of returning
+    uninitialized memory."""
+    pieces = _dedup_pieces(pieces)
+    covered = sum(int(np.prod(p["sizes"])) for p in pieces)
+    total = int(np.prod(shape))
+    if covered != total:
+        raise ValueError(
+            f"shard/chunk union covers {covered} of {total} elements of "
+            f"shape {tuple(shape)} — incomplete or overlapping pieces "
+            f"(elasticity-trimmed or corrupted manifest?)"
+        )
+    out = np.empty(tuple(shape), dtype=_np_dtype(dtype))
+    for piece in pieces:
+        sub = _decode_tensor(blobs, piece["tensor"])
+        slices = tuple(
+            slice(o, o + s) for o, s in zip(piece["offsets"], piece["sizes"])
+        )
+        out[slices] = sub.reshape(piece["sizes"])
+    return out
+
+
+def _decode_leaf(blobs: "_BlobCache", entry: dict) -> Any:
+    t = entry["type"]
+    if t in ("int", "str", "bool", "bytes", "float"):
+        return _decode_primitive(entry)
+    if t == "Tensor":
+        return _decode_tensor(blobs, entry)
+    if t in ("ChunkedTensor", "ShardedTensor", "DTensor"):
+        pieces = entry.get("chunks") or entry.get("shards") or []
+        return _assemble_pieces(blobs, entry["shape"], entry["dtype"], pieces)
+    if t == "object":
+        return _torch_load(blobs.get(entry))
+    raise ValueError(f"unknown entry type {t!r}")
+
+
+_CONTAINER_TYPES = ("dict", "OrderedDict", "list")
+
+
+def _merge_sharded_across_ranks(manifest: dict) -> dict:
+    """Per-rank manifests carry only that rank's shards of a sharded
+    tensor; the full tensor is the union across every rank's view
+    (reference manifest_ops.py:111-177), deduped by box."""
+    merged: Dict[str, dict] = {}
+    for key, entry in manifest.items():
+        if entry.get("type") not in ("ShardedTensor", "DTensor"):
+            continue
+        _, _, suffix = key.partition("/")
+        if suffix not in merged:
+            merged[suffix] = {**entry, "shards": []}
+        merged[suffix]["shards"].extend(entry.get("shards") or [])
+    for slot in merged.values():
+        slot["shards"] = _dedup_pieces(slot["shards"])
+    return merged
+
+
+def read_torchsnapshot(path: str, rank: int = 0) -> Dict[str, Any]:
+    """Load a reference-format snapshot into a nested state dict of host
+    numpy arrays / python values.
+
+    ``rank``: which rank's view to materialize (rank 0 sees every
+    replicated and sharded entry fully assembled — the right choice when
+    consolidating a distributed reference checkpoint into one JAX
+    process; a multi-host import can pass its own rank).
+
+    The result restores into JAX as-is::
+
+        state = read_torchsnapshot("/ckpts/step100")
+        params = jax.tree.map(jnp.asarray, state["model"])
+    """
+    from ..storage import url_to_storage_plugin
+
+    storage = url_to_storage_plugin(path)
+    try:
+        raw = _read_bytes(storage, ".snapshot_metadata", None)
+        try:
+            metadata = json.loads(raw)
+        except ValueError:  # hand-edited YAML that isn't the JSON subset
+            import yaml
+
+            metadata = yaml.safe_load(raw)
+        manifest: Dict[str, dict] = metadata["manifest"]
+        sharded_full = _merge_sharded_across_ranks(manifest)
+
+        # This rank's view: its own entries, plus rank 0's REPLICATED
+        # entries — the reference consolidates replicated entries into
+        # rank 0's manifest only (partitioner.py:311-355), and overlays
+        # them onto every other rank's view at read time
+        # (manifest_ops.py:35-109).  Containers ride along so an
+        # overlaid leaf always has its ancestors.
+        view: Dict[str, dict] = {}
+        for key, entry in sorted(manifest.items()):
+            if key.startswith(f"{rank}/"):
+                view[key.partition("/")[2]] = entry
+        if rank != 0:
+            rank0 = {
+                key.partition("/")[2]: entry
+                for key, entry in manifest.items()
+                if key.startswith("0/")
+            }
+            overlaid = [
+                s
+                for s, e in rank0.items()
+                if s not in view
+                and e["type"] not in _CONTAINER_TYPES
+                and e.get("replicated")
+            ]
+            for suffix in overlaid:
+                view[suffix] = rank0[suffix]
+                # ancestors ride along so list/dict types reconstruct
+                # correctly (spurious unrelated containers do NOT)
+                parent = suffix.rpartition("/")[0]
+                while parent and parent not in view:
+                    if parent in rank0:
+                        view[parent] = rank0[parent]
+                    parent = parent.rpartition("/")[0]
+
+        flat: Dict[str, Any] = {}
+        containers: Dict[str, dict] = {}
+        leaf_entries: List[dict] = []
+        for suffix, entry in view.items():
+            if entry["type"] in _CONTAINER_TYPES:
+                containers[suffix] = entry
+            else:
+                leaf_entries.append(
+                    sharded_full.get(suffix, entry)
+                )
+        blobs = _BlobCache(storage)
+        blobs.prefetch(leaf_entries)
+        for suffix, entry in view.items():
+            if entry["type"] not in _CONTAINER_TYPES:
+                flat[suffix] = _decode_leaf(
+                    blobs, sharded_full.get(suffix, entry)
+                )
+        return _inflate(containers, flat)
+    finally:
+        storage.sync_close()
+
+
+def _inflate(containers: Dict[str, dict], flat: Dict[str, Any]) -> Dict[str, Any]:
+    """Rebuild the nested structure from container entries + leaves
+    (mirror of reference inflate, flatten.py:79-141)."""
+    root: Dict[str, Any] = {}
+
+    def ensure(path: str) -> Any:
+        """The container object at logical ``path``, creating ancestors."""
+        if path == "":
+            return root
+        parent_path, _, comp = path.rpartition("/")
+        parent = ensure(parent_path)
+        entry = containers.get(path, {"type": "dict"})
+        if isinstance(parent, list):
+            idx = int(comp)
+            while len(parent) <= idx:
+                parent.append(None)
+            if parent[idx] is None:
+                parent[idx] = [] if entry["type"] == "list" else {}
+            return parent[idx]
+        key = unquote(comp)
+        if key not in parent or parent[key] is None:
+            parent[key] = [] if entry["type"] == "list" else {}
+        return parent[key]
+
+    for path, entry in sorted(containers.items()):
+        ensure(path)
+    for path, value in sorted(flat.items()):
+        parent_path, _, comp = path.rpartition("/")
+        parent = ensure(parent_path)
+        if isinstance(parent, list):
+            idx = int(comp)
+            while len(parent) <= idx:
+                parent.append(None)
+            parent[idx] = value
+        else:
+            parent[unquote(comp)] = value
+    return root

@@ -17,3 +17,4 @@ mapping:
 from .flax_adapters import FlaxTrainStateAdapter  # noqa: F401
 from .torch_module import TorchModuleAdapter, TorchOptimizerAdapter  # noqa: F401
 from .torchsnapshot_reader import read_torchsnapshot  # noqa: F401
+from .torchsnapshot_writer import write_torchsnapshot  # noqa: F401

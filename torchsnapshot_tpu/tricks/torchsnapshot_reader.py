@@ -160,7 +160,18 @@ def _decode_primitive(entry: dict) -> Any:
 def _decode_tensor(blobs: "_BlobCache", entry: dict) -> np.ndarray:
     data = blobs.get(entry)
     if entry.get("serializer") == "torch_save":
-        return _torch_load(data).numpy()
+        tensor = _torch_load(data)
+        try:
+            return tensor.numpy()
+        except TypeError:
+            # e.g. quantized tensors: torch_save round-trips them but
+            # numpy has no such dtype — surface the remediation instead
+            # of an obscure ScalarType error
+            raise ValueError(
+                f"torch_save tensor of dtype {tensor.dtype} has no numpy "
+                f"equivalent (quantized?) — dequantize before saving, or "
+                f"load this snapshot once with the reference library"
+            ) from None
     dtype = _np_dtype(entry["dtype"])
     arr = np.frombuffer(data, dtype=dtype)
     return arr.reshape(entry["shape"]).copy()
@@ -338,6 +349,18 @@ def _inflate(containers: Dict[str, dict], flat: Dict[str, Any]) -> Dict[str, Any
     (mirror of reference inflate, flatten.py:79-141)."""
     root: Dict[str, Any] = {}
 
+    def dict_key(parent_path: str, comp: str) -> Any:
+        """Original dict key for a path component: the container's
+        ``keys`` list preserves int keys (List[Union[str, int]],
+        reference manifest.py:320) that the path stringifies."""
+        decoded = unquote(comp)
+        entry = containers.get(parent_path)
+        if entry:
+            for k in entry.get("keys", ()):
+                if str(k) == decoded:
+                    return k
+        return decoded
+
     def ensure(path: str) -> Any:
         """The container object at logical ``path``, creating ancestors."""
         if path == "":
@@ -352,7 +375,7 @@ def _inflate(containers: Dict[str, dict], flat: Dict[str, Any]) -> Dict[str, Any
             if parent[idx] is None:
                 parent[idx] = [] if entry["type"] == "list" else {}
             return parent[idx]
-        key = unquote(comp)
+        key = dict_key(parent_path, comp)
         if key not in parent or parent[key] is None:
             parent[key] = [] if entry["type"] == "list" else {}
         return parent[key]
@@ -368,5 +391,5 @@ def _inflate(containers: Dict[str, dict], flat: Dict[str, Any]) -> Dict[str, Any
                 parent.append(None)
             parent[idx] = value
         else:
-            parent[unquote(comp)] = value
+            parent[dict_key(parent_path, comp)] = value
     return root

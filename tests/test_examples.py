@@ -15,6 +15,7 @@ _EXPECT = {
     "simple_example.py": "committed steps:",
     "spmd_example.py": "OK",
     "embeddings_example.py": "budgeted read_object of a single table: OK",
+    "migration_example.py": "round-trip through the reference format: OK",
 }
 
 

@@ -257,6 +257,78 @@ def test_pallas_probe_failure_falls_back(monkeypatch):
     assert fa.pallas_probe_ok() is False
 
 
+def test_cli_convert_round_trip(tmp_path, capsys):
+    """`convert` migrates native -> reference format -> native, with
+    leaf values surviving both hops."""
+    import numpy as np
+
+    from torchsnapshot_tpu import PyTreeState, Snapshot
+    from torchsnapshot_tpu.__main__ import main as cli
+
+    native = str(tmp_path / "native")
+    Snapshot.take(
+        native,
+        {"m": PyTreeState({"w": np.arange(16, dtype=np.float32), "n": 5})},
+    )
+    ref = str(tmp_path / "ref")
+    assert cli(["convert", "--to-reference", native, ref]) == 0
+    capsys.readouterr()
+    import json as _json
+
+    meta = _json.loads((tmp_path / "ref" / ".snapshot_metadata").read_text())
+    assert meta["manifest"]["0/m/w"]["dtype"] == "torch.float32"
+
+    back = str(tmp_path / "back")
+    assert cli(["convert", ref, back]) == 0
+    got = Snapshot(back).read_object("0/m/w")
+    np.testing.assert_array_equal(got, np.arange(16, dtype=np.float32))
+    assert Snapshot(back).read_object("0/m/n") == 5
+
+
+def test_cli_convert_refuses_multirank_without_rank(tmp_path, capsys):
+    """A multi-rank snapshot converted without --rank would silently
+    drop other ranks' private state; the CLI refuses instead."""
+    import json as _json
+
+    from torchsnapshot_tpu.__main__ import main as cli
+
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (ref / ".snapshot_metadata").write_text(
+        _json.dumps({
+            "version": "0.1.0", "world_size": 4,
+            "manifest": {
+                "0/app": {"type": "dict", "keys": ["n"]},
+                "0/app/n": {
+                    "type": "int", "serialized_value": "1",
+                    "replicated": False, "readable": None,
+                },
+            },
+        })
+    )
+    assert cli(["convert", str(ref), str(tmp_path / "out")]) == 1
+    assert "world_size=4" in capsys.readouterr().err
+    # explicit --rank converts deliberately
+    assert cli(["convert", "--rank", "0", str(ref), str(tmp_path / "out")]) == 0
+
+
+def test_cli_convert_unconvertible_dtype_is_clean_error(tmp_path, capsys):
+    import ml_dtypes
+    import numpy as np
+
+    from torchsnapshot_tpu import PyTreeState, Snapshot
+    from torchsnapshot_tpu.__main__ import main as cli
+
+    native = str(tmp_path / "native")
+    Snapshot.take(
+        native,
+        {"m": PyTreeState({"q": np.zeros(2, dtype=ml_dtypes.float8_e4m3fn)})},
+    )
+    rc = cli(["convert", "--to-reference", native, str(tmp_path / "ref")])
+    assert rc == 1
+    assert "error:" in capsys.readouterr().err  # one line, no traceback
+
+
 def test_cli_ls_verify_steps_delete(tmp_path, capsys):
     """Operator CLI: ls/manifest/verify/steps/delete round-trip."""
     import numpy as np

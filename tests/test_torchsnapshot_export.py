@@ -93,6 +93,72 @@ def test_unsupported_dtype_raises(tmp_path):
         write_torchsnapshot(str(tmp_path / "snap"), state)
 
 
+_FUZZ_DTYPES = [
+    "float32", "float64", "float16", "int8", "int16", "int32", "int64",
+    "uint8", "bool", "complex64",
+]
+
+
+def _random_tree(rng, depth=0):
+    import ml_dtypes
+
+    tree = {}
+    for i in range(int(rng.integers(1, 5))):
+        kind = int(rng.integers(0, 7 if depth < 2 else 5))
+        key = ["k", "a/b", "x%y", "0", "deep"][int(rng.integers(5))] + str(i)
+        if kind == 0:
+            dt = _FUZZ_DTYPES[int(rng.integers(len(_FUZZ_DTYPES)))]
+            shape = tuple(rng.integers(1, 9, size=int(rng.integers(1, 4))))
+            tree[key] = (rng.standard_normal(shape) * 8).astype(dt)
+        elif kind == 1:
+            tree[key] = (rng.standard_normal(6) * 4).astype(ml_dtypes.bfloat16)
+        elif kind == 2:
+            tree[key] = int(rng.integers(-1000, 1000))
+        elif kind == 3:
+            tree[key] = float(rng.standard_normal())
+        elif kind == 4:
+            tree[key] = [int(v) for v in rng.integers(0, 9, size=3)]
+        elif kind == 5:
+            tree[key] = _random_tree(rng, depth + 1)
+        else:
+            tree[key] = bytes(rng.integers(0, 256, size=5).astype(np.uint8))
+    return tree
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_interop_round_trip(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    state = {"app": _random_tree(rng)}
+    path = str(tmp_path / "snap")
+    write_torchsnapshot(path, state)
+    got = read_torchsnapshot(path)
+
+    def compare(a, b, where):
+        assert type(a) is type(b) or (
+            hasattr(a, "shape") and hasattr(b, "shape")
+        ), f"{where}: {type(a)} vs {type(b)}"
+        if isinstance(a, dict):
+            assert sorted(map(str, a)) == sorted(map(str, b)), where
+            for k in a:
+                compare(a[k], b[k], f"{where}/{k}")
+        elif isinstance(a, list):
+            assert len(a) == len(b), where
+            for i, (x, y) in enumerate(zip(a, b)):
+                compare(x, y, f"{where}[{i}]")
+        elif hasattr(a, "shape"):
+            np.testing.assert_array_equal(
+                np.asarray(a).view(np.uint8) if a.dtype.name == "bfloat16"
+                else np.asarray(a),
+                np.asarray(b).view(np.uint8) if b.dtype.name == "bfloat16"
+                else np.asarray(b),
+                err_msg=where,
+            )
+        else:
+            assert a == b, f"{where}: {a!r} != {b!r}"
+
+    compare(state, got, "")
+
+
 def test_reference_restores_our_export(tmp_path):
     if not _reference_available():
         pytest.skip("reference library / torch not available")

@@ -87,6 +87,88 @@ def _cmd_steps(args) -> int:
     return 0
 
 
+def _cmd_convert(args) -> int:
+    """Re-encode a reference-format snapshot as a native one (or the
+    reverse with --to-reference): one command migrates a whole
+    checkpoint without writing any code.
+
+    Materializes one rank's fully-assembled view in host memory (for a
+    larger-than-RAM checkpoint, migrate programmatically per subtree).
+    Multi-rank snapshots must name the rank explicitly: other ranks'
+    private per-rank state is NOT part of a one-rank view, and silently
+    dropping it would corrupt a migration."""
+    from .snapshot import Snapshot
+    from .stateful import PyTreeState
+    from .tricks import read_torchsnapshot, write_torchsnapshot
+    from .tricks.torchsnapshot_reader import peek_torchsnapshot
+
+    def _require_rank(world_size: int) -> int:
+        if world_size > 1 and args.rank is None:
+            raise RuntimeError(
+                f"snapshot was taken with world_size={world_size}; convert "
+                f"materializes ONE rank's view, so other ranks' private "
+                f"per-rank state would be dropped. Pass --rank N to "
+                f"convert rank N's view deliberately (replicated and "
+                f"sharded state is complete in any rank's view)."
+            )
+        return args.rank or 0
+
+    if args.to_reference:
+        from . import knobs
+        from .batcher import batch_read_requests
+        from .flatten import inflate
+        from .manifest import is_container_entry
+        from .manifest_ops import get_manifest_for_rank
+        from .preparers import prepare_read
+        from .scheduler import (
+            get_process_memory_budget_bytes,
+            sync_execute_read_reqs,
+        )
+        from .storage import url_to_storage_plugin
+
+        snap = Snapshot(args.src)
+        rank = _require_rank(snap.metadata.world_size)
+        manifest = get_manifest_for_rank(snap.metadata, rank)
+        containers = {
+            p: e for p, e in manifest.items() if is_container_entry(e)
+        }
+        # one storage session + batched budgeted reads for ALL leaves
+        # (read_object per leaf would rebuild the manifest view and
+        # open/close a storage client every time)
+        futures = {}
+        read_reqs = []
+        for p, e in manifest.items():
+            if not is_container_entry(e):
+                reqs, fut = prepare_read(e, obj_out=None)
+                read_reqs.extend(reqs)
+                futures[p] = fut
+        if not knobs.is_batching_disabled():
+            read_reqs = batch_read_requests(read_reqs)
+        storage = url_to_storage_plugin(args.src)
+        try:
+            sync_execute_read_reqs(
+                read_reqs, storage, get_process_memory_budget_bytes(), rank
+            )
+        finally:
+            storage.sync_close()
+        leaves = {p: fut.obj for p, fut in futures.items()}
+        state = {
+            key: inflate(containers, leaves, prefix=key)
+            for key in sorted({p.split("/", 1)[0] for p in manifest})
+        }
+        write_torchsnapshot(args.dest, state)
+        print(f"exported {args.src} -> {args.dest} (reference format)")
+        return 0
+
+    rank = _require_rank(int(peek_torchsnapshot(args.src).get("world_size", 1)))
+    state = read_torchsnapshot(args.src, rank=rank)
+    Snapshot.take(
+        args.dest, {k: PyTreeState(v) for k, v in state.items()}
+    )
+    print(f"imported {args.src} -> {args.dest} (native format)")
+    return 0
+
+
 def _cmd_delete(args) -> int:
     from .manager import delete_snapshot
 
@@ -126,12 +208,36 @@ def main(argv=None) -> int:
     p.add_argument("--yes", action="store_true")
     p.set_defaults(fn=_cmd_delete)
 
+    p = sub.add_parser(
+        "convert",
+        help="migrate a snapshot between the reference's format and the "
+        "native one (default: reference -> native)",
+    )
+    p.add_argument("src")
+    p.add_argument("dest")
+    p.add_argument(
+        "--to-reference",
+        action="store_true",
+        help="native -> reference format (for handing back to torch jobs)",
+    )
+    p.add_argument(
+        "--rank",
+        type=int,
+        default=None,
+        help="which rank's view to convert (required when world_size > 1; "
+        "replicated/sharded state is complete in any rank's view, but "
+        "other ranks' private per-rank state is not carried)",
+    )
+    p.set_defaults(fn=_cmd_convert)
+
     args = parser.parse_args(argv)
     try:
         return args.fn(args)
-    except (FileNotFoundError, RuntimeError) as e:
-        # missing OR corrupt/aborted snapshots print one clean line —
-        # diagnosing exactly these is what the operator ran the tool for
+    except (FileNotFoundError, RuntimeError, ValueError, KeyError) as e:
+        # missing, corrupt/aborted, or unconvertible snapshots print one
+        # clean line — diagnosing exactly these is what the operator ran
+        # the tool for (ValueError: e.g. a dtype with no reference
+        # equivalent during convert)
         print(f"error: {e}", file=sys.stderr)
         return 1
 

@@ -261,6 +261,25 @@ def _merge_sharded_across_ranks(manifest: dict) -> dict:
     return merged
 
 
+def peek_torchsnapshot(path: str) -> Dict[str, Any]:
+    """Parse a reference snapshot's metadata without reading payloads:
+    ``{"version", "world_size", "manifest"}`` — lets callers (e.g. the
+    CLI) check world_size before committing to a one-rank view."""
+    from ..storage import url_to_storage_plugin
+
+    storage = url_to_storage_plugin(path)
+    try:
+        raw = _read_bytes(storage, ".snapshot_metadata", None)
+    finally:
+        storage.sync_close()
+    try:
+        return json.loads(raw)
+    except ValueError:  # hand-edited YAML that isn't the JSON subset
+        import yaml
+
+        return yaml.safe_load(raw)
+
+
 def read_torchsnapshot(path: str, rank: int = 0) -> Dict[str, Any]:
     """Load a reference-format snapshot into a nested state dict of host
     numpy arrays / python values.

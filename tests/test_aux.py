@@ -308,7 +308,11 @@ def test_cli_convert_refuses_multirank_without_rank(tmp_path, capsys):
     )
     assert cli(["convert", str(ref), str(tmp_path / "out")]) == 1
     assert "world_size=4" in capsys.readouterr().err
-    # explicit --rank converts deliberately
+    # out-of-range rank would take the elastic grown-world view and drop
+    # per-rank state: refused (off-by-one is the easy operator mistake)
+    assert cli(["convert", "--rank", "4", str(ref), str(tmp_path / "out")]) == 1
+    assert "out of range" in capsys.readouterr().err
+    # explicit in-range --rank converts deliberately
     assert cli(["convert", "--rank", "0", str(ref), str(tmp_path / "out")]) == 0
 
 

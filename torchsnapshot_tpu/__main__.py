@@ -111,7 +111,16 @@ def _cmd_convert(args) -> int:
                 f"convert rank N's view deliberately (replicated and "
                 f"sharded state is complete in any rank's view)."
             )
-        return args.rank or 0
+        rank = args.rank or 0
+        if not 0 <= rank < world_size:
+            # an out-of-range rank would take the elastic grown-world
+            # view (replicated/sharded only) and silently drop per-rank
+            # state — the exact hole the rank gate exists to close
+            raise RuntimeError(
+                f"--rank {rank} is out of range for world_size={world_size} "
+                f"(valid: 0..{world_size - 1})"
+            )
+        return rank
 
     if args.to_reference:
         from . import knobs
@@ -160,8 +169,9 @@ def _cmd_convert(args) -> int:
         print(f"exported {args.src} -> {args.dest} (reference format)")
         return 0
 
-    rank = _require_rank(int(peek_torchsnapshot(args.src).get("world_size", 1)))
-    state = read_torchsnapshot(args.src, rank=rank)
+    metadata = peek_torchsnapshot(args.src)
+    rank = _require_rank(int(metadata.get("world_size", 1)))
+    state = read_torchsnapshot(args.src, rank=rank, metadata=metadata)
     Snapshot.take(
         args.dest, {k: PyTreeState(v) for k, v in state.items()}
     )
@@ -233,11 +243,13 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     try:
         return args.fn(args)
-    except (FileNotFoundError, RuntimeError, ValueError, KeyError) as e:
+    except (FileNotFoundError, RuntimeError, ValueError) as e:
         # missing, corrupt/aborted, or unconvertible snapshots print one
         # clean line — diagnosing exactly these is what the operator ran
         # the tool for (ValueError: e.g. a dtype with no reference
-        # equivalent during convert)
+        # equivalent during convert).  KeyError is deliberately NOT
+        # caught: its message is just the key, so a genuine bug would
+        # print an undiagnosable one-liner instead of a traceback.
         print(f"error: {e}", file=sys.stderr)
         return 1
 

@@ -64,6 +64,7 @@ def prepare_write(
     process_index: int = 0,
     process_count: int = 1,
     writer_loads: Optional[List[int]] = None,
+    chunk_size_bytes: Optional[int] = None,
 ) -> Tuple[Entry, List[WriteReq]]:
     """Plan the write of one leaf (reference io_preparer.py:82-147).
 
@@ -96,7 +97,11 @@ def prepare_write(
             obj = _to_host_view(obj)
         namespace = "replicated" if replicated else str(rank)
         location = f"{namespace}/{logical_path}"
-        if array_nbytes(obj) > knobs.get_max_chunk_size_bytes():
+        # callers planning many leaves resolve the knob once and pass it
+        # down (per-leaf env resolution is measurable planning cost)
+        if chunk_size_bytes is None:
+            chunk_size_bytes = knobs.get_max_chunk_size_bytes()
+        if array_nbytes(obj) > chunk_size_bytes:
             return ChunkedArrayIOPreparer.prepare_write(
                 obj=obj,
                 location=location,

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import functools
 import threading
 from concurrent.futures import Executor
 from typing import Any, List, Optional, Tuple
@@ -70,13 +71,19 @@ def transfer_gate():
             jax.block_until_ready(pending)
 
 
+@functools.lru_cache(maxsize=256)
+def _root_module(tp: type) -> str:
+    # called several times per leaf on the planning path (the
+    # async_take blocked window); cached on the type object
+    return tp.__module__.split(".")[0]
+
+
 def _is_torch_tensor(obj: Any) -> bool:
-    return type(obj).__module__.split(".")[0] == "torch"
+    return _root_module(type(obj)) == "torch"
 
 
 def _is_jax_array(obj: Any) -> bool:
-    mod = type(obj).__module__.split(".")[0]
-    if mod not in ("jax", "jaxlib"):
+    if _root_module(type(obj)) not in ("jax", "jaxlib"):
         return False
     import jax
 

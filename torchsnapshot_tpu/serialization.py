@@ -56,15 +56,28 @@ _STD_DTYPES = [
 ]
 
 
+_DTYPE_NAME_CACHE: dict = {}
+
+
 def dtype_to_string(dtype: Any) -> str:
+    # memoized on the np.dtype object: the linear _ML_DTYPES scan per
+    # array leaf is measurable planning cost at tens of thousands of
+    # leaves (the async_take blocked window is exactly this planning)
     dt = np.dtype(dtype)
-    for name, mdt in _ML_DTYPES.items():
+    cached = _DTYPE_NAME_CACHE.get(dt)
+    if cached is not None:
+        return cached
+    name = None
+    for mname, mdt in _ML_DTYPES.items():
         if dt == mdt:
-            return name
-    name = dt.name
-    if name in _STD_DTYPES:
-        return name
-    raise ValueError(f"unsupported dtype for serialization: {dtype!r}")
+            name = mname
+            break
+    if name is None:
+        if dt.name not in _STD_DTYPES:
+            raise ValueError(f"unsupported dtype for serialization: {dtype!r}")
+        name = dt.name
+    _DTYPE_NAME_CACHE[dt] = name
+    return name
 
 
 def string_to_dtype(s: str) -> np.dtype:

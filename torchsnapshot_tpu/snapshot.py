@@ -647,6 +647,13 @@ class Snapshot:
             if world > 1
             else [host_est]
         )
+        # resolve the chunking knob ONCE for the whole take and pass it
+        # down: one env resolution instead of one per leaf (measurable
+        # in the blocked window at tens of thousands of leaves), a
+        # mid-take env change can't split chunking behavior across
+        # leaves, and no global override state is touched (concurrent
+        # takes from different threads must not interleave overrides)
+        chunk_size_bytes = knobs.get_max_chunk_size_bytes()
         for lpath in sorted(flattened.keys()):
             obj = flattened[lpath]
             repl = lpath in verified_repl
@@ -659,13 +666,16 @@ class Snapshot:
                 process_index=rank,
                 process_count=world,
                 writer_loads=writer_loads,
+                chunk_size_bytes=chunk_size_bytes,
             )
             entries[lpath] = entry
-            cost = sum(r.buffer_stager.get_staging_cost_bytes() for r in reqs)
+            cost = sum(
+                r.buffer_stager.get_staging_cost_bytes() for r in reqs
+            )
             if repl and not isinstance(entry, ShardedArrayEntry):
                 if isinstance(entry, ChunkedArrayEntry) and len(reqs) > 1:
                     for ci, r in enumerate(reqs):
-                        k = f"{lpath}\x00{ci}"  # \x00 can't occur in paths
+                        k = f"{lpath}\x00{ci}"  # \x00 can't be in paths
                         repl_chunk_reqs[k] = r
                         chunk_parent[k] = lpath
                         repl_items.append(

@@ -261,17 +261,7 @@ def _merge_sharded_across_ranks(manifest: dict) -> dict:
     return merged
 
 
-def peek_torchsnapshot(path: str) -> Dict[str, Any]:
-    """Parse a reference snapshot's metadata without reading payloads:
-    ``{"version", "world_size", "manifest"}`` — lets callers (e.g. the
-    CLI) check world_size before committing to a one-rank view."""
-    from ..storage import url_to_storage_plugin
-
-    storage = url_to_storage_plugin(path)
-    try:
-        raw = _read_bytes(storage, ".snapshot_metadata", None)
-    finally:
-        storage.sync_close()
+def _parse_metadata(raw: bytes) -> Dict[str, Any]:
     try:
         return json.loads(raw)
     except ValueError:  # hand-edited YAML that isn't the JSON subset
@@ -280,7 +270,25 @@ def peek_torchsnapshot(path: str) -> Dict[str, Any]:
         return yaml.safe_load(raw)
 
 
-def read_torchsnapshot(path: str, rank: int = 0) -> Dict[str, Any]:
+def peek_torchsnapshot(path: str) -> Dict[str, Any]:
+    """Parse a reference snapshot's metadata without reading payloads:
+    ``{"version", "world_size", "manifest"}`` — lets callers (e.g. the
+    CLI) check world_size before committing to a one-rank view; pass the
+    result to ``read_torchsnapshot(metadata=...)`` to avoid a second
+    metadata fetch."""
+    from ..storage import url_to_storage_plugin
+
+    storage = url_to_storage_plugin(path)
+    try:
+        raw = _read_bytes(storage, ".snapshot_metadata", None)
+    finally:
+        storage.sync_close()
+    return _parse_metadata(raw)
+
+
+def read_torchsnapshot(
+    path: str, rank: int = 0, metadata: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
     """Load a reference-format snapshot into a nested state dict of host
     numpy arrays / python values.
 
@@ -298,13 +306,10 @@ def read_torchsnapshot(path: str, rank: int = 0) -> Dict[str, Any]:
 
     storage = url_to_storage_plugin(path)
     try:
-        raw = _read_bytes(storage, ".snapshot_metadata", None)
-        try:
-            metadata = json.loads(raw)
-        except ValueError:  # hand-edited YAML that isn't the JSON subset
-            import yaml
-
-            metadata = yaml.safe_load(raw)
+        if metadata is None:
+            metadata = _parse_metadata(
+                _read_bytes(storage, ".snapshot_metadata", None)
+            )
         manifest: Dict[str, dict] = metadata["manifest"]
         sharded_full = _merge_sharded_across_ranks(manifest)
 

@@ -1,0 +1,116 @@
+"""True crash-consistency: SIGKILL a process mid-save, recover.
+
+The in-process tests abort saves by raising; a real crash is harsher —
+no finally blocks, no atexit, page cache in unknown state.  This test
+SIGKILLs a child between data writes and asserts the recovery
+invariants the commit protocol promises:
+
+- the killed step is invisible (no ``.snapshot_metadata`` => not
+  committed, manager never lists it — reference snapshot.py:849-854),
+- previously committed steps still verify deeply,
+- ``restore_latest`` resumes from the newest committed step,
+- re-saving the killed step over its partial directory succeeds.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["TSNP_REPO"])
+import numpy as np
+
+from torchsnapshot_tpu import SnapshotManager, StateDict
+from torchsnapshot_tpu.storage import fs as fs_mod
+
+root = os.environ["TSNP_ROOT"]
+mgr = SnapshotManager(root)
+
+state = {"app": StateDict(
+    **{f"w{i}": np.full(512, float(i), np.float32) for i in range(40)}
+)}
+mgr.save(state, step=1)
+print("STEP1_COMMITTED", flush=True)
+
+# slow every data write so the parent has a wide window to SIGKILL us
+# mid-step-2; emit a marker once payload bytes are actually landing
+real_write = fs_mod.FSStoragePlugin.write
+count = [0]
+async def slow_write(self, wio):
+    count[0] += 1
+    if count[0] == 3:
+        print("STEP2_WRITING", flush=True)
+    time.sleep(0.05)
+    await real_write(self, wio)
+fs_mod.FSStoragePlugin.write = slow_write
+
+import torchsnapshot_tpu.knobs as knobs
+with knobs.override_disable_batching(True):  # many writes -> wide window
+    mgr.save(state, step=2)
+print("STEP2_COMMITTED", flush=True)  # must never be reached
+"""
+
+
+def test_sigkill_mid_save_recovers(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD],
+        env={
+            **os.environ,
+            "TSNP_REPO": repo,
+            "TSNP_ROOT": str(tmp_path),
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": "",
+        },
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    killed = False
+    deadline = time.time() + 120
+    lines = []
+    for line in proc.stdout:
+        lines.append(line.strip())
+        if "STEP2_WRITING" in line:
+            proc.kill()  # SIGKILL: no cleanup of any kind runs
+            killed = True
+            break
+        if "STEP2_COMMITTED" in line or time.time() > deadline:
+            break
+    proc.wait(timeout=30)
+    assert killed, f"child finished before it could be killed: {lines}"
+    assert "STEP1_COMMITTED" in lines
+
+    from torchsnapshot_tpu import SnapshotManager, StateDict, verify_snapshot
+
+    mgr = SnapshotManager(str(tmp_path))
+    # the killed step is invisible; step 1 is the newest committed
+    assert mgr.steps() == [1]
+    assert not os.path.exists(
+        os.path.join(mgr.path_for_step(2), ".snapshot_metadata")
+    )
+    # step 1 still verifies deeply (payload bytes vs recorded checksums)
+    result = verify_snapshot(mgr.path_for_step(1), deep=True)
+    assert result.ok, result.errors
+
+    # resume restores step 1's values
+    import numpy as np
+
+    dest = {"app": StateDict(
+        **{f"w{i}": np.zeros(512, np.float32) for i in range(40)}
+    )}
+    assert mgr.restore_latest(dest) == 1
+    np.testing.assert_array_equal(
+        dest["app"]["w7"], np.full(512, 7.0, np.float32)
+    )
+
+    # re-saving the killed step over its partial directory succeeds and
+    # commits
+    state = {"app": StateDict(
+        **{f"w{i}": np.full(512, float(i), np.float32) for i in range(40)}
+    )}
+    mgr.save(state, step=2)
+    assert mgr.steps() == [1, 2]
+    assert verify_snapshot(mgr.path_for_step(2), deep=True).ok

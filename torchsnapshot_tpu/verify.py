@@ -295,10 +295,15 @@ def verify_snapshot(
     snapshot: Any, deep: bool = False, rank: Optional[int] = None
 ) -> VerifyResult:
     """Audit one rank's view of a snapshot (default: this process's
-    rank).  See module docstring for the shallow/deep contract."""
+    rank).  ``snapshot``: a ``Snapshot`` or a path/URL.  See module
+    docstring for the shallow/deep contract."""
     from .event import Event
     from .event_handlers import log_event
 
+    if isinstance(snapshot, str):
+        from .snapshot import Snapshot
+
+        snapshot = Snapshot(snapshot)
     if rank is None:
         rank = snapshot._coordinator.rank
     with log_event(

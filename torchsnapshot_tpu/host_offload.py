@@ -18,6 +18,10 @@ from typing import Any, Iterator, List
 
 _HOST_KINDS = ("pinned_host", "unpinned_host")
 
+# last eager_offload_write_reqs breakdown (see its tail) — benchmark
+# evidence of which unblock mechanism engaged
+LAST_OFFLOAD_STATS: dict = {}
+
 logger = logging.getLogger(__name__)
 
 
@@ -274,9 +278,22 @@ def eager_offload_write_reqs(
                     moved += h.nbytes
                 _release_fallbacks_on_completion(host_arrays, stager_lists)
 
+    host_copied = 0
     for st in host_stagers:
         st.arr = fast_copy(st.arr)
         st.defensive_copy = False
         st.owns_arr = True  # staging must drop the copy once consumed
         moved += st.arr.nbytes
+        host_copied += st.arr.nbytes
+    # breadcrumbs for benchmarks/diagnostics: which unblock mechanism
+    # actually engaged on this take (the pinned-host path only exists on
+    # runtimes with host memory kinds — evidence matters on hardware)
+    LAST_OFFLOAD_STATS.clear()
+    LAST_OFFLOAD_STATS.update(
+        {
+            "device_offload_bytes": moved - host_copied,
+            "host_defensive_copy_bytes": host_copied,
+            "host_memory_kinds": host_memory_supported(),
+        }
+    )
     return moved

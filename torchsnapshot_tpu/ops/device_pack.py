@@ -12,6 +12,7 @@ XLA caches the compiled pack per shape-tuple, so steady-state checkpoints
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, List
 
 import numpy as np
@@ -37,6 +38,18 @@ def _pack(arrays: List[Any]):
 
 _pack_jit = None
 
+# benchmark/diagnostic counters: how often the compiled device-side
+# pack/unpack COMPLETED (evidence that the one-DMA path engaged on
+# hardware — failed attempts that fall back must not count); lock-
+# guarded because packs run concurrently from executor threads
+CALL_COUNTS = {"pack": 0, "unpack": 0}
+_COUNT_LOCK = threading.Lock()
+
+
+def _count(kind: str) -> None:
+    with _COUNT_LOCK:
+        CALL_COUNTS[kind] += 1
+
 
 def pack_arrays_to_host(arrays: List[Any]) -> np.ndarray:
     """Pack device arrays into one uint8 host buffer (C-order bytes of each
@@ -52,7 +65,9 @@ def pack_arrays_to_host(arrays: List[Any]) -> np.ndarray:
         packed.copy_to_host_async()
     except Exception:
         pass
-    return np.asarray(packed)
+    out = np.asarray(packed)  # materializes; async failures surface here
+    _count("pack")
+    return out
 
 
 # ------------------------------------------------------------- unpack
@@ -136,4 +151,6 @@ def unpack_slab_to_device(buf, members, out_dtypes, device) -> List[Any]:
     with transfer_gate() as pending:
         slab = jax.device_put(u8, device)
         pending.append(slab)
-    return list(fn(slab))
+    out = list(fn(slab))
+    _count("unpack")  # after dispatch succeeded — fallbacks must not count
+    return out

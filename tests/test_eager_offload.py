@@ -71,6 +71,16 @@ def test_async_take_jax_state_round_trips(tmp_path):
         np.asarray(dest.tree["w"]), np.arange(1024, dtype=np.float32)
     )
     np.testing.assert_array_equal(np.asarray(dest.tree["b"]), np.ones(8))
+    # the REAL batched pinned-host offload must have engaged (this
+    # backend supports host memory kinds), not the degraded fallback —
+    # the headline unblock mechanism, asserted, not assumed
+    from torchsnapshot_tpu.host_offload import (
+        LAST_OFFLOAD_STATS,
+        host_memory_supported,
+    )
+
+    if host_memory_supported():
+        assert LAST_OFFLOAD_STATS.get("device_offload_bytes", 0) >= 1024 * 4
 
 
 def test_release_fallbacks_on_completion():

@@ -393,6 +393,33 @@ def run_child() -> None:
                 "pallas_compiled": False,
                 "why": f"bench error: {e!r}"[:300],
             }
+        print(json.dumps(result), flush=True)
+
+        # LAST phase: orbax head-to-head ON HARDWARE (the comparison a
+        # TPU user actually makes; docs/performance.md has the CPU-box
+        # table).  Small payload so a slow link still finishes; a wedge
+        # here costs nothing already printed.
+        print(
+            json.dumps({**result, "phase": "orbax_compare_start"}),
+            flush=True,
+        )
+        try:
+            import importlib.util as _ilu
+
+            spec = _ilu.spec_from_file_location(
+                "orbax_compare",
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "benchmarks",
+                    "orbax_compare.py",
+                ),
+            )
+            mod = _ilu.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            gb = min(0.25, max(0.032, total_gb / 4))
+            result["orbax_head_to_head"] = mod.run(gb)
+        except Exception as e:
+            result["orbax_head_to_head"] = {"error": f"{e!r}"[:300]}
 
     print(json.dumps(result), flush=True)
 

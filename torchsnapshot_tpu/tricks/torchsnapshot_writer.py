@@ -157,17 +157,22 @@ def write_torchsnapshot(path: str, app_state: Dict[str, Any]) -> None:
         if isinstance(obj, (bool, int, str, bytes, float)):
             manifest[logical] = _primitive_entry(obj)
             return
-        arr = _to_host_array(obj)
+        if not (hasattr(obj, "dtype") and hasattr(obj, "shape")):
+            obj = np.asarray(obj)  # np scalars / 0-d oddities: tiny
         location = logical  # one object per leaf: no byte_range needed
+        # dtype/shape come from the leaf's metadata — the host
+        # materialization (device_get for jax leaves) is deferred to the
+        # bounded write task, so exporting a device-resident checkpoint
+        # never holds the whole payload on the host at once
         manifest[logical] = {
             "type": "Tensor",
             "location": location,
             "serializer": "buffer_protocol",
-            "dtype": _torch_dtype_name(arr.dtype),
-            "shape": [int(s) for s in arr.shape],
+            "dtype": _torch_dtype_name(np.dtype(obj.dtype)),
+            "shape": [int(s) for s in obj.shape],
             "replicated": False,
         }
-        writes.append((location, arr))
+        writes.append((location, obj))
 
     for key in sorted(app_state):
         visit(f"0/{key}", app_state[key])
@@ -181,12 +186,14 @@ def write_torchsnapshot(path: str, app_state: Dict[str, Any]) -> None:
 
             sem = asyncio.Semaphore(16)
 
-            async def one(loc: str, arr: Any) -> None:
+            async def one(loc: str, leaf: Any) -> None:
                 async with sem:
-                    # .tobytes() yields C-order bytes regardless of the
-                    # source layout; materialized here, under the
-                    # semaphore, and dropped as soon as the write lands
-                    await storage.write(WriteIO(path=loc, buf=arr.tobytes()))
+                    # host materialization (device_get for jax leaves)
+                    # AND .tobytes() (C-order bytes regardless of
+                    # layout) happen here, under the semaphore, and are
+                    # dropped as soon as the write lands
+                    data = _to_host_array(leaf).tobytes()
+                    await storage.write(WriteIO(path=loc, buf=data))
 
             await asyncio.gather(*(one(l, a) for l, a in writes))
             # metadata LAST: its presence is the reference's commit

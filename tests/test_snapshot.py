@@ -27,6 +27,61 @@ from torchsnapshot_tpu.manifest import (
 )
 
 
+def test_leaf_transform_casts_on_save(tmp_path):
+    """take(leaf_transform=...) — the reference's
+    _custom_tensor_prepare_func analogue (snapshot.py:120-122): cast
+    leaves for the checkpoint without touching the live state."""
+    from torchsnapshot_tpu import PyTreeState, Snapshot
+
+    params = {"w": jnp.arange(64, dtype=jnp.float32), "n": 5}
+
+    def to_bf16(path, leaf):
+        if hasattr(leaf, "dtype") and leaf.dtype == jnp.float32:
+            return leaf.astype(jnp.bfloat16)
+        return leaf
+
+    snap = Snapshot.take(
+        str(tmp_path / "s"),
+        {"m": PyTreeState(dict(params))},
+        leaf_transform=to_bf16,
+    )
+    got = snap.read_object("0/m/w")
+    assert got.dtype.name == "bfloat16"
+    np.testing.assert_array_equal(
+        np.asarray(got, dtype=np.float32), np.arange(64, dtype=np.float32)
+    )
+    assert snap.read_object("0/m/n") == 5
+    # the live state was never touched
+    assert params["w"].dtype == jnp.float32
+
+
+def test_storage_options_forwarded(tmp_path, monkeypatch):
+    """take(storage_options=...) reaches the plugin factory (reference
+    storage_options, snapshot.py:118) on save AND on later restores
+    through the returned Snapshot."""
+    from torchsnapshot_tpu import PyTreeState, Snapshot
+    import torchsnapshot_tpu.snapshot as snap_mod
+    import torchsnapshot_tpu.storage as storage_mod
+
+    seen = []
+    real = storage_mod.url_to_storage_plugin
+
+    def spy(url, storage_options=None):
+        seen.append(storage_options)
+        return real(url)
+
+    monkeypatch.setattr(snap_mod, "url_to_storage_plugin", spy)
+    snap = Snapshot.take(
+        str(tmp_path / "s"),
+        {"m": PyTreeState({"w": np.arange(8, dtype=np.float32)})},
+        storage_options={"marker": True},
+    )
+    dest = PyTreeState({"w": np.zeros(8, dtype=np.float32)})
+    snap.restore({"m": dest})
+    assert {"marker": True} in seen
+    np.testing.assert_array_equal(dest.tree["w"], np.arange(8))
+
+
 def test_statedict_roundtrip(tmp_path, toggle_batching):
     state = StateDict(
         step=7,

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import knobs
 from .batcher import batch_read_requests, batch_write_requests
@@ -71,6 +71,15 @@ from .stateful import (
 from .storage import url_to_storage_plugin
 
 logger = logging.getLogger(__name__)
+
+def _storage_for(path: str, options: Optional[Dict[str, Any]]):
+    """Build the storage plugin, passing storage_options only when set —
+    tests and third parties monkeypatch ``url_to_storage_plugin`` with
+    single-argument factories, which must keep working."""
+    if options:
+        return url_to_storage_plugin(path, options)
+    return url_to_storage_plugin(path)
+
 
 SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 AppState = Dict[str, Stateful]
@@ -358,11 +367,17 @@ def _validate_app_state(app_state: Dict[str, Any]) -> None:
 
 class Snapshot:
     def __init__(
-        self, path: str, coordinator: Optional[Coordinator] = None
+        self,
+        path: str,
+        coordinator: Optional[Coordinator] = None,
+        storage_options: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.path = path
         self._coordinator = coordinator or get_default_coordinator()
         self._metadata_cache: Optional[SnapshotMetadata] = None
+        # forwarded to the storage plugin constructor on every access
+        # (reference storage_options, snapshot.py:118)
+        self._storage_options = storage_options
 
     # ------------------------------------------------------------------ take
 
@@ -374,9 +389,22 @@ class Snapshot:
         replicated: Sequence[str] = (),
         coordinator: Optional[Coordinator] = None,
         base: Optional[str] = None,
+        leaf_transform: Optional[Callable[[str, Any], Any]] = None,
+        storage_options: Optional[Dict[str, Any]] = None,
     ) -> "Snapshot":
         """Synchronous distributed save (reference Snapshot.take,
         snapshot.py:112-228).
+
+        ``leaf_transform(logical_path, leaf) -> leaf``: applied to every
+        flattened leaf before planning — cast to lower precision for the
+        checkpoint, quantize, redact, etc.  It must RETURN a leaf for
+        every path (dropping is not supported — the container structure
+        is already fixed; restore a subset with ``restore(paths=...)``
+        instead).  The analogue of the reference's
+        ``_custom_tensor_prepare_func`` (snapshot.py:120-122), applied
+        uniformly to all leaves, not just tensors.  Must be deterministic
+        and rank-agreed (the transformed content is what replication
+        verification fingerprints).
 
         ``base`` (beyond-parity, incremental takes): path of a previous
         committed snapshot.  Staged objects whose content checksum
@@ -395,7 +423,8 @@ class Snapshot:
                 local_entries, object_crcs,
             ) = cls._take_impl(
                 path, app_state, replicated, coordinator,
-                is_async=False, base=base,
+                is_async=False, base=base, leaf_transform=leaf_transform,
+                storage_options=storage_options,
             )
             pending_io.sync_complete()
             # content checksums became final when staging finished above;
@@ -422,7 +451,7 @@ class Snapshot:
                 )
             coordinator.barrier()
             storage.sync_close()
-        snapshot = cls(path, coordinator)
+        snapshot = cls(path, coordinator, storage_options=storage_options)
         snapshot._metadata_cache = metadata
         return snapshot
 
@@ -434,6 +463,8 @@ class Snapshot:
         replicated: Sequence[str] = (),
         coordinator: Optional[Coordinator] = None,
         base: Optional[str] = None,
+        leaf_transform: Optional[Callable[[str, Any], Any]] = None,
+        storage_options: Optional[Dict[str, Any]] = None,
     ) -> "PendingSnapshot":
         """Unblock-early save (reference Snapshot.async_take,
         snapshot.py:229-318).  Returns once the snapshot content is
@@ -452,7 +483,8 @@ class Snapshot:
                 local_entries, object_crcs,
             ) = cls._take_impl(
                 path, app_state, replicated, coordinator,
-                is_async=True, base=base,
+                is_async=True, base=base, leaf_transform=leaf_transform,
+                storage_options=storage_options,
             )
         return PendingSnapshot(
             path=path,
@@ -463,6 +495,7 @@ class Snapshot:
             commit_uid=commit_uid,
             local_entries=local_entries,
             object_crcs=object_crcs,
+            storage_options=storage_options,
         )
 
     @classmethod
@@ -474,6 +507,8 @@ class Snapshot:
         coordinator: Coordinator,
         is_async: bool,
         base: Optional[str] = None,
+        leaf_transform: Optional[Callable[[str, Any], Any]] = None,
+        storage_options: Optional[Dict[str, Any]] = None,
     ) -> Tuple[
         SnapshotMetadata, PendingIOWork, Any, str,
         Dict[str, Entry], Dict[str, int],
@@ -501,6 +536,8 @@ class Snapshot:
             return cls._take_impl_inner(
                 path, app_state, replicated, coordinator, is_async,
                 rank, world, rng_states_at_entry, base,
+                leaf_transform=leaf_transform,
+                storage_options=storage_options,
             )
         finally:
             for k, v in app_state.items():
@@ -520,6 +557,8 @@ class Snapshot:
         world: int,
         rng_states_at_entry: Dict[str, Dict[str, Any]],
         base: Optional[str] = None,
+        leaf_transform: Optional[Callable[[str, Any], Any]] = None,
+        storage_options: Optional[Dict[str, Any]] = None,
     ) -> Tuple[
         SnapshotMetadata, PendingIOWork, Any, str,
         Dict[str, Entry], Dict[str, int],
@@ -579,7 +618,7 @@ class Snapshot:
             replicated_globs = sorted(set(replicated))
             verify_mode = local_mode
 
-        storage = url_to_storage_plugin(path)
+        storage = _storage_for(path, storage_options)
 
         # gather the global key list; serialize per-key state_dict() calls
         # with barriers in case a Stateful's state_dict performs collectives
@@ -613,6 +652,13 @@ class Snapshot:
                 flattened.update(f)
             if world > 1:
                 coordinator.barrier()
+
+        if leaf_transform is not None:
+            # before replication verification, so fingerprints (and the
+            # written bytes) reflect the TRANSFORMED content
+            flattened = {
+                p: leaf_transform(p, v) for p, v in flattened.items()
+            }
 
         # plan writes per leaf (reference prepare_write dispatch,
         # io_preparer.py:82-147)
@@ -849,7 +895,7 @@ class Snapshot:
         if self._metadata_cache is None:
             from .io_types import ReadIO
 
-            storage = url_to_storage_plugin(self.path)
+            storage = _storage_for(self.path, self._storage_options)
             try:
                 read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
                 storage.sync_read(read_io)
@@ -902,7 +948,7 @@ class Snapshot:
         with log_event(Event("restore", {"path": self.path, "rank": rank})):
             metadata = self.metadata
             manifest_for_rank = get_manifest_for_rank(metadata, rank)
-            storage = url_to_storage_plugin(self.path)
+            storage = _storage_for(self.path, self._storage_options)
             local_keys = sorted(app_state.keys())
             if world > 1:
                 global_keys = sorted(
@@ -1060,7 +1106,7 @@ class Snapshot:
             reqs, fut = prepare_read(
                 entry, obj_out=obj_out, buffer_size_limit_bytes=memory_budget_bytes
             )
-            storage = url_to_storage_plugin(self.path)
+            storage = _storage_for(self.path, self._storage_options)
             try:
                 sync_execute_read_reqs(
                     reqs,
@@ -1094,8 +1140,10 @@ class PendingSnapshot:
         commit_uid: str,
         local_entries: Optional[Dict[str, Entry]] = None,
         object_crcs: Optional[Dict[str, int]] = None,
+        storage_options: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.path = path
+        self._storage_options = storage_options
         self._metadata = metadata
         self._pending_io_work = pending_io_work
         self._storage = storage
@@ -1209,7 +1257,11 @@ class PendingSnapshot:
         if self._exc is not None:
             raise self._exc
         if self._snapshot is None:
-            self._snapshot = Snapshot(self.path, self._coordinator)
+            self._snapshot = Snapshot(
+                self.path,
+                self._coordinator,
+                storage_options=self._storage_options,
+            )
             if self._coordinator.rank == 0:
                 # rank 0's commit thread merged the gathered checksums
                 # into this manifest before writing it

@@ -9,14 +9,20 @@ entry-point group.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Dict, Optional
 
 from ..io_types import StoragePlugin
 
 _ENTRY_POINT_GROUP = "torchsnapshot_tpu.storage_plugins"
 
 
-def url_to_storage_plugin(url_path: str) -> StoragePlugin:
+def url_to_storage_plugin(
+    url_path: str, storage_options: Optional[Dict[str, Any]] = None
+) -> StoragePlugin:
+    """``storage_options``: extra keyword arguments forwarded to the
+    plugin constructor (reference storage_options, snapshot.py:118 —
+    e.g. S3 session/credential config, GCS client options)."""
+    opts = storage_options or {}
     if "://" in url_path:
         scheme, path = url_path.split("://", 1)
         scheme = scheme or "fs"
@@ -26,21 +32,26 @@ def url_to_storage_plugin(url_path: str) -> StoragePlugin:
     if scheme == "fs":
         from .fs import FSStoragePlugin
 
-        return FSStoragePlugin(root=path)
+        return FSStoragePlugin(root=path, **opts)
     if scheme == "memory":
         from .memory import MemoryStoragePlugin
 
-        return MemoryStoragePlugin(namespace=path)
+        return MemoryStoragePlugin(namespace=path, **opts)
     if scheme == "gs":
         from .gcs import GCSStoragePlugin
 
-        return GCSStoragePlugin(path=path)
+        return GCSStoragePlugin(path=path, **opts)
     if scheme == "s3":
         from .s3 import S3StoragePlugin
 
-        return S3StoragePlugin(path=path)
+        return S3StoragePlugin(path=path, **opts)
 
-    # entry-point registry (reference storage_plugin.py:56-67)
+    # entry-point registry (reference storage_plugin.py:56-67).  Only
+    # the DISCOVERY is failure-tolerant; a matched plugin's load or
+    # construction errors propagate with their real cause (a swallowed
+    # TypeError from a typo'd storage_option would otherwise read as
+    # "no plugin registered" — a misdiagnosis)
+    group = ()
     try:
         from importlib.metadata import entry_points
 
@@ -50,9 +61,9 @@ def url_to_storage_plugin(url_path: str) -> StoragePlugin:
             if hasattr(eps, "select")
             else eps.get(_ENTRY_POINT_GROUP, [])
         )
-        for ep in group:
-            if ep.name == scheme:
-                return ep.load()(path)
     except Exception:
         pass
+    for ep in group:
+        if ep.name == scheme:
+            return ep.load()(path, **opts)
     raise RuntimeError(f"no storage plugin registered for scheme {scheme!r}")

@@ -159,6 +159,15 @@ def write_torchsnapshot(path: str, app_state: Dict[str, Any]) -> None:
             return
         if not (hasattr(obj, "dtype") and hasattr(obj, "shape")):
             obj = np.asarray(obj)  # np scalars / 0-d oddities: tiny
+        if getattr(obj, "is_fully_addressable", True) is False:
+            # cheap metadata check kept at PLAN time: failing inside the
+            # async write tasks would upload sibling leaves first and
+            # leave partial junk in the destination
+            raise ValueError(
+                f"{logical!r} is a partially-addressable jax.Array; gather "
+                f"it (e.g. jax.device_get on a fully-replicated resharding) "
+                f"before exporting"
+            )
         location = logical  # one object per leaf: no byte_range needed
         # dtype/shape come from the leaf's metadata — the host
         # materialization (device_get for jax leaves) is deferred to the

@@ -313,11 +313,13 @@ def verify_snapshot(
 
 
 def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
-    from .storage import url_to_storage_plugin
+    from .snapshot import _storage_for
 
     result = VerifyResult()
     manifest = dict(get_manifest_for_rank(snapshot.metadata, rank))
-    storage = url_to_storage_plugin(snapshot.path)
+    storage = _storage_for(
+        snapshot.path, getattr(snapshot, "_storage_options", None)
+    )
     try:
         extents = _expected_extents(manifest)
         # the objects table (WRITE_CHECKSUMS takes) records exact sizes —

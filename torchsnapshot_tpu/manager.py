@@ -290,8 +290,12 @@ class SnapshotManager:
         replicated: Sequence[str] = (),
         async_: bool = False,
         incremental: bool = False,
+        **take_kwargs: Any,
     ) -> Union[Snapshot, "_ManagedPendingSnapshot"]:
-        """``incremental=True`` dedups against the newest committed step:
+        """``**take_kwargs`` forward to ``Snapshot.take``/``async_take``
+        (``leaf_transform``, ``storage_options``).
+
+        ``incremental=True`` dedups against the newest committed step:
         objects whose content checksum is unchanged are hardlinked /
         server-side-copied instead of rewritten (Snapshot.take(base=)).
         Cold start (no committed step) degrades to a full save."""
@@ -307,7 +311,7 @@ class SnapshotManager:
         if async_:
             pending = Snapshot.async_take(
                 path, app_state, replicated=replicated,
-                coordinator=self._coordinator, base=base,
+                coordinator=self._coordinator, base=base, **take_kwargs,
             )
             # index/retention must not run from the commit thread (it
             # would race a training-loop save() on the index): they run
@@ -318,7 +322,7 @@ class SnapshotManager:
             return _ManagedPendingSnapshot(pending, self, step)
         snap = Snapshot.take(
             path, app_state, replicated=replicated,
-            coordinator=self._coordinator, base=base,
+            coordinator=self._coordinator, base=base, **take_kwargs,
         )
         self._after_commit(step)
         return snap

@@ -157,9 +157,14 @@ def test_fuzz_roundtrip(tmp_path, seed):
         )
         assert s2.verify(deep=True).ok
 
-        # elastic restore of the incremental snapshot onto mesh2
+        # elastic restore of the incremental snapshot onto mesh2; half
+        # the seeds force template DONATION (the 1x-restore path) across
+        # the fuzzed mix of host/device/sharded templates and verify-on-
+        # restore states
+        donate = bool(rng.integers(2))
         dest = PyTreeState(_templates_like(mutated, mesh2, rng))
-        with knobs.override_verify_on_restore(bool(rng.integers(2))):
+        with knobs.override_verify_on_restore(bool(rng.integers(2))), \
+                knobs.override_restore_donate("1" if donate else "auto"):
             s2.restore({"m": dest})
         _check(dest.tree, mutated)
 

@@ -321,14 +321,26 @@ class ArrayBufferConsumer(BufferConsumer):
         self.obj_out = obj_out
         self.fut = fut
 
+    # below this, the executor thread-hop costs more than the copy —
+    # a 20k-tiny-leaf restore spends most of its wall time in loop
+    # wakeups and submits without this short-circuit.  HOST templates
+    # only: a jax template's materialize enters transfer_gate(), whose
+    # blocking lock + block_until_ready must NEVER run on the event
+    # loop thread (a gated wedge would freeze all restore I/O).
+    _INLINE_CONSUME_MAX = 256 * 1024
+
     async def consume_buffer(
         self, buf: Any, executor: Optional[Executor] = None
     ) -> None:
         np_arr = array_from_buffer(
             buf, self.entry.dtype, tuple(self.entry.shape)
         )
-        loop = asyncio.get_running_loop()
-        if executor is not None:
+        inline = (
+            np_arr.nbytes < self._INLINE_CONSUME_MAX
+            and not _is_jax_array(self.obj_out)
+        )
+        if executor is not None and not inline:
+            loop = asyncio.get_running_loop()
             result = await loop.run_in_executor(
                 executor, materialize_into_template, np_arr, self.obj_out
             )

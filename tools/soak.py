@@ -1,0 +1,94 @@
+"""Long-running soak: continuous save/restore/verify against one manager.
+
+Exercises the async commit thread, incremental dedup, retention GC,
+donation restore and deep verify in a tight loop for N minutes —
+invariants that hold for one test iteration can still break rarely
+under thread interleavings; this is the cheap way to hunt those.
+
+Run:  PYTHONPATH= JAX_PLATFORMS=cpu python tools/soak.py [minutes]
+Exits 0 with a summary line, or 1 on the first violated invariant.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
+    deadline = time.time() + minutes * 60
+
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import PyTreeState, SnapshotManager, knobs
+
+    root = tempfile.mkdtemp(prefix="tsnp_soak_")
+    mgr = SnapshotManager(root, keep_last_n=4)
+    rng = np.random.default_rng(0)
+    step = 0
+    stats = {"saves": 0, "async": 0, "incremental": 0, "restores": 0,
+             "verifies": 0}
+
+    base_w = np.arange(4096, dtype=np.float32)
+    while time.time() < deadline:
+        step += 1
+        state = {
+            "m": PyTreeState({
+                "w": base_w + step,
+                "frozen": base_w,  # identical every step: dedup fodder
+                "j": jnp.full((256,), float(step)),
+            }),
+        }
+        async_ = bool(rng.integers(2))
+        incremental = bool(rng.integers(2)) and step > 1
+        if async_:
+            pending = mgr.save(state, step, async_=True,
+                               incremental=incremental)
+            snap = pending.wait()
+            stats["async"] += 1
+        else:
+            snap = mgr.save(state, step, incremental=incremental)
+        stats["saves"] += 1
+        stats["incremental"] += int(incremental)
+
+        committed = mgr.steps()
+        assert committed[-1] == step, (committed, step)
+        assert len(committed) <= 4, committed  # retention bound
+
+        if step % 5 == 0:
+            dest = {"m": PyTreeState({
+                "w": np.zeros(4096, np.float32),
+                "frozen": np.zeros(4096, np.float32),
+                "j": jnp.zeros((256,)),
+            })}
+            with knobs.override_restore_donate(
+                "1" if rng.integers(2) else "auto"
+            ):
+                got = mgr.restore_latest(dest)
+            assert got == step, (got, step)
+            np.testing.assert_array_equal(dest["m"].tree["w"], base_w + step)
+            np.testing.assert_array_equal(
+                np.asarray(dest["m"].tree["j"]), np.full(256, float(step))
+            )
+            stats["restores"] += 1
+        if step % 7 == 0:
+            result = snap.verify(deep=True)
+            assert result.ok, result.errors
+            stats["verifies"] += 1
+        if step % 50 == 0:
+            print(f"[soak] step {step}: {stats}", flush=True)
+
+    print(f"SOAK OK after {step} steps: {stats}", flush=True)
+    import shutil
+
+    shutil.rmtree(root, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,6 +100,40 @@ def test_imported_state_restores_into_jax(reference_snapshot):
     assert bf.dtype == jnp.bfloat16
 
 
+def test_reads_real_dtensor_snapshot(tmp_path):
+    """A DTensor checkpoint written by the actual reference through
+    torch.distributed (gloo, world=1) imports as the dense array."""
+    if not _reference_available():
+        pytest.skip("reference library / torch not available")
+    sys.path.insert(0, _REFERENCE)
+    try:
+        import torch
+        import torch.distributed as dist
+
+        os.environ.setdefault("MASTER_ADDR", "127.0.0.1")
+        os.environ.setdefault("MASTER_PORT", "29519")
+        dist.init_process_group("gloo", rank=0, world_size=1)
+        try:
+            from torch.distributed.device_mesh import init_device_mesh
+            from torch.distributed.tensor import Shard, distribute_tensor
+
+            from torchsnapshot import Snapshot as RefSnapshot, StateDict
+
+            mesh = init_device_mesh("cpu", (1,))
+            big = torch.arange(64, dtype=torch.float32).reshape(8, 8)
+            dt = distribute_tensor(big, mesh, [Shard(0)])
+            RefSnapshot.take(str(tmp_path / "snap"), {"app": StateDict(dt=dt)})
+        finally:
+            dist.destroy_process_group()
+    finally:
+        sys.path.remove(_REFERENCE)
+
+    got = read_torchsnapshot(str(tmp_path / "snap"))
+    np.testing.assert_array_equal(
+        got["app"]["dt"], np.arange(64, dtype=np.float32).reshape(8, 8)
+    )
+
+
 # ------------------------- synthetic-manifest suite (runs everywhere)
 
 
@@ -194,13 +228,13 @@ def test_synthetic_sharded_union_across_ranks(tmp_path):
     manifest = {
         "0/app": {"type": "dict", "keys": ["w"]},
         "1/app": {"type": "dict", "keys": ["w"]},
+        # real Sharded/DTensor entries carry NO top-level shape/dtype
+        # (manifest.py:118-168): both derive from the shard union
         "0/app/w": {
-            "type": "ShardedTensor", "dtype": "torch.float32",
-            "shape": [4, 3], "shards": [shard("sharded/top", 0)],
+            "type": "ShardedTensor", "shards": [shard("sharded/top", 0)],
         },
         "1/app/w": {
-            "type": "ShardedTensor", "dtype": "torch.float32",
-            "shape": [4, 3], "shards": [shard("sharded/bot", 2)],
+            "type": "ShardedTensor", "shards": [shard("sharded/bot", 2)],
         },
     }
     got = read_torchsnapshot(_write_snapshot(tmp_path, manifest, blobs))
@@ -255,14 +289,8 @@ def test_sharded_merge_dedupes_replica_boxes():
         "tensor": _tensor_entry("sharded/x", "torch.float32", (2, 2)),
     }
     manifest = {
-        "0/app/w": {
-            "type": "DTensor", "dtype": "torch.float32",
-            "shape": [2, 2], "shards": [shard],
-        },
-        "1/app/w": {
-            "type": "DTensor", "dtype": "torch.float32",
-            "shape": [2, 2], "shards": [dict(shard)],  # replica duplicate
-        },
+        "0/app/w": {"type": "DTensor", "shards": [shard]},
+        "1/app/w": {"type": "DTensor", "shards": [dict(shard)]},  # replica
     }
     merged = _merge_sharded_across_ranks(manifest)
     # one box, listed once — no double reads, exact coverage accounting
@@ -273,17 +301,50 @@ def test_synthetic_incomplete_shard_union_raises(tmp_path):
     manifest = {
         "0/app": {"type": "dict", "keys": ["w"]},
         "0/app/w": {
-            "type": "ShardedTensor", "dtype": "torch.float32",
-            "shape": [4, 3],
+            "type": "ShardedTensor",
+            # explicit shape (the ChunkedTensor-style path): rows 2-3
+            # missing from the union must raise, not return garbage
+            "dtype": "torch.float32", "shape": [4, 3],
             "shards": [{
                 "offsets": [0, 0], "sizes": [2, 3],
                 "tensor": _tensor_entry("sharded/top", "torch.float32", (2, 3)),
-            }],  # rows 2-3 missing
+            }],
         },
     }
     blobs = {"sharded/top": np.zeros((2, 3), np.float32).tobytes()}
     with pytest.raises(ValueError, match="covers 6 of 12"):
         read_torchsnapshot(_write_snapshot(tmp_path, manifest, blobs))
+
+
+def test_synthetic_dtensor_missing_rank_shards_raise(tmp_path):
+    # a LOST trailing shard shrinks the union bounding box, which plain
+    # coverage math can't see; DTensor's mesh/dim_map implies the shard
+    # count, so the loss is detected
+    shard = {
+        "offsets": [0, 0], "sizes": [2, 3],
+        "tensor": _tensor_entry("sharded/top", "torch.float32", (2, 3)),
+    }
+    manifest = {
+        "0/app": {"type": "dict", "keys": ["w"]},
+        "0/app/w": {
+            "type": "DTensor",
+            "shards": [shard],  # rank 1's shard lost
+            "mesh": [[0], [1]],  # 2x1 mesh, dim 0 sharded over mesh dim 0
+            "dim_map": [[0], [-1]],
+        },
+    }
+    blobs = {"sharded/top": np.zeros((2, 3), np.float32).tobytes()}
+    with pytest.raises(ValueError, match="1 distinct boxes .* imply 2"):
+        read_torchsnapshot(_write_snapshot(tmp_path, manifest, blobs))
+
+
+def test_synthetic_empty_shards_raise(tmp_path):
+    manifest = {
+        "0/app": {"type": "dict", "keys": ["w"]},
+        "0/app/w": {"type": "ShardedTensor", "shards": []},
+    }
+    with pytest.raises(ValueError, match="no shards"):
+        read_torchsnapshot(_write_snapshot(tmp_path, manifest, {}))
 
 
 def test_synthetic_unknown_dtype_raises(tmp_path):

@@ -202,13 +202,48 @@ def _dedup_pieces(pieces: List[dict]) -> List[dict]:
     return list(seen.values())
 
 
+def _dtensor_expected_boxes(entry: dict) -> Optional[int]:
+    """How many distinct shard boxes a DTensor's mesh+dim_map implies
+    (the product of mesh-dim sizes that appear in dim_map; reference
+    manifest.py:222-261) — lets a union-derived shape detect a LOST
+    shard that bounding-box derivation alone cannot."""
+    mesh, dim_map = entry.get("mesh"), entry.get("dim_map")
+    if mesh is None or dim_map is None:
+        return None
+    try:
+        mesh_shape = np.asarray(mesh).shape
+        sharded_mesh_dims = {
+            md
+            for dm in dim_map
+            for md in (dm if isinstance(dm, (list, tuple)) else [dm])
+            if md is not None and md >= 0
+        }
+        n = 1
+        for md in sharded_mesh_dims:
+            if md < len(mesh_shape):
+                n *= mesh_shape[md]
+        return n
+    except Exception:  # malformed mesh metadata: skip the extra check
+        return None
+
+
 def _assemble_pieces(
-    blobs: "_BlobCache", shape: List[int], dtype: str, pieces: List[dict]
+    blobs: "_BlobCache",
+    shape: List[int],
+    dtype: str,
+    pieces: List[dict],
+    expected_boxes: Optional[int] = None,
 ) -> np.ndarray:
     """Paste {offsets, sizes, tensor} pieces (chunks or shards) into a
     dense array; a union that leaves holes raises instead of returning
     uninitialized memory."""
     pieces = _dedup_pieces(pieces)
+    if expected_boxes is not None and len(pieces) != expected_boxes:
+        raise ValueError(
+            f"DTensor shard union has {len(pieces)} distinct boxes but "
+            f"mesh/dim_map imply {expected_boxes} — a rank's shards are "
+            f"missing from the manifest"
+        )
     covered = sum(int(np.prod(p["sizes"])) for p in pieces)
     total = int(np.prod(shape))
     if covered != total:
@@ -235,7 +270,33 @@ def _decode_leaf(blobs: "_BlobCache", entry: dict) -> Any:
         return _decode_tensor(blobs, entry)
     if t in ("ChunkedTensor", "ShardedTensor", "DTensor"):
         pieces = entry.get("chunks") or entry.get("shards") or []
-        return _assemble_pieces(blobs, entry["shape"], entry["dtype"], pieces)
+        if not pieces:
+            raise ValueError(
+                f"{t} entry records no shards/chunks — trimmed or "
+                f"corrupted manifest"
+            )
+        # ChunkedTensor records shape/dtype (manifest.py:171-210);
+        # Sharded/DTensor entries do NOT — the global shape is the
+        # bounding box of the shard union and the dtype comes from any
+        # shard's tensor entry (manifest.py:118-168, 211-261).  A union
+        # missing a TRAILING shard shrinks the bounding box undetectably
+        # for plain ShardedTensor; DTensor entries are additionally
+        # validated against the shard count mesh+dim_map implies.
+        shape = entry.get("shape")
+        dtype = entry.get("dtype")
+        if shape is None or dtype is None:
+            ndim = len(pieces[0]["offsets"])
+            if shape is None:
+                shape = [
+                    max(p["offsets"][d] + p["sizes"][d] for p in pieces)
+                    for d in range(ndim)
+                ]
+            if dtype is None:
+                dtype = pieces[0]["tensor"]["dtype"]
+        return _assemble_pieces(
+            blobs, shape, dtype, pieces,
+            expected_boxes=_dtensor_expected_boxes(entry),
+        )
     if t == "object":
         return _torch_load(blobs.get(entry))
     raise ValueError(f"unknown entry type {t!r}")

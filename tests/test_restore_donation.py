@@ -128,6 +128,33 @@ def test_sharded_template_donated(tmp_path):
     assert dest.tree["w"].sharding.is_equivalent_to(sharding, 1)
 
 
+def test_offloaded_template_round_trips_with_donation(tmp_path):
+    # restoring INTO a pinned-host template: the replacement must land
+    # back in the template's memory kind, and donation frees the
+    # template's host buffer like any other
+    from torchsnapshot_tpu.host_offload import (
+        host_memory_supported,
+        is_host_offloaded,
+        offload_to_host,
+    )
+
+    if not host_memory_supported():
+        pytest.skip("backend lacks host memory kinds")
+    snap = Snapshot.take(
+        str(tmp_path / "s"),
+        {"m": PyTreeState({"w": jnp.arange(64, dtype=jnp.float32)})},
+    )
+    tmpl = offload_to_host(jnp.zeros(64, jnp.float32))
+    assert is_host_offloaded(tmpl)
+    dest = PyTreeState({"w": tmpl})
+    with knobs.override_restore_donate("1"):
+        snap.restore({"m": dest})
+    out = dest.tree["w"]
+    assert out.sharding.memory_kind == "pinned_host"
+    assert tmpl.is_deleted()
+    np.testing.assert_array_equal(np.asarray(out), np.arange(64))
+
+
 def test_donate_helper_modes():
     arr = jnp.ones((4,))
     with knobs.override_restore_donate("0"):

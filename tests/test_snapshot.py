@@ -27,6 +27,29 @@ from torchsnapshot_tpu.manifest import (
 )
 
 
+def test_materialize_whole_view(tmp_path):
+    """Snapshot.materialize(): template-free read of a full rank view —
+    arrays as numpy, primitives inline, nested structure preserved."""
+    from torchsnapshot_tpu import PyTreeState, Snapshot, StateDict
+
+    snap = Snapshot.take(
+        str(tmp_path / "s"),
+        {
+            "m": PyTreeState({"w": jnp.arange(64, dtype=jnp.float32)}),
+            # StateDict keeps REAL list containers in the manifest
+            # (PyTreeState stringifies pytree paths; its treedef owns
+            # the structure instead)
+            "progress": StateDict(steps=7, items=[1, "x"]),
+        },
+    )
+    got = snap.materialize()
+    np.testing.assert_array_equal(
+        got["m"]["w"], np.arange(64, dtype=np.float32)
+    )
+    assert got["progress"]["steps"] == 7
+    assert got["progress"]["items"] == [1, "x"]
+
+
 def test_leaf_transform_casts_on_save(tmp_path):
     """take(leaf_transform=...) — the reference's
     _custom_tensor_prepare_func analogue (snapshot.py:120-122): cast

@@ -123,49 +123,9 @@ def _cmd_convert(args) -> int:
         return rank
 
     if args.to_reference:
-        from . import knobs
-        from .batcher import batch_read_requests
-        from .flatten import inflate
-        from .manifest import is_container_entry
-        from .manifest_ops import get_manifest_for_rank
-        from .preparers import prepare_read
-        from .scheduler import (
-            get_process_memory_budget_bytes,
-            sync_execute_read_reqs,
-        )
-        from .storage import url_to_storage_plugin
-
         snap = Snapshot(args.src)
         rank = _require_rank(snap.metadata.world_size)
-        manifest = get_manifest_for_rank(snap.metadata, rank)
-        containers = {
-            p: e for p, e in manifest.items() if is_container_entry(e)
-        }
-        # one storage session + batched budgeted reads for ALL leaves
-        # (read_object per leaf would rebuild the manifest view and
-        # open/close a storage client every time)
-        futures = {}
-        read_reqs = []
-        for p, e in manifest.items():
-            if not is_container_entry(e):
-                reqs, fut = prepare_read(e, obj_out=None)
-                read_reqs.extend(reqs)
-                futures[p] = fut
-        if not knobs.is_batching_disabled():
-            read_reqs = batch_read_requests(read_reqs)
-        storage = url_to_storage_plugin(args.src)
-        try:
-            sync_execute_read_reqs(
-                read_reqs, storage, get_process_memory_budget_bytes(), rank
-            )
-        finally:
-            storage.sync_close()
-        leaves = {p: fut.obj for p, fut in futures.items()}
-        state = {
-            key: inflate(containers, leaves, prefix=key)
-            for key in sorted({p.split("/", 1)[0] for p in manifest})
-        }
-        write_torchsnapshot(args.dest, state)
+        write_torchsnapshot(args.dest, snap.materialize(rank=rank))
         print(f"exported {args.src} -> {args.dest} (reference format)")
         return 0
 

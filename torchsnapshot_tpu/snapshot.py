@@ -1086,6 +1086,60 @@ class Snapshot:
 
         return verify_snapshot(self, deep=deep)
 
+    def materialize(
+        self, rank: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Read one rank's ENTIRE view into a nested state dict of host
+        values — no templates, no app_state (beyond-parity; the
+        reference's only template-free access is per-leaf read_object,
+        snapshot.py:397-501).  Arrays come back as numpy; move them to
+        device with ``jax.tree.map(jnp.asarray, ...)``.
+
+        For inspection, migration and tooling; a training restore should
+        keep using ``restore`` (sharded templates, in-place semantics,
+        donation).  Note: PyTreeState records stringified pytree paths
+        (its treedef owns the structure), so its list/tuple nodes come
+        back as index-keyed dicts here; StateDict trees keep real
+        lists."""
+        if rank is None:
+            rank = self._coordinator.rank
+        world = self.metadata.world_size
+        if not 0 <= rank < world:
+            # get_manifest_for_rank's grown-world semantics would return
+            # a replicated-only view — silently missing rank-private
+            # leaves is exactly wrong for an inspection API
+            raise ValueError(
+                f"rank {rank} out of range for world_size={world}"
+            )
+        with log_event(
+            Event("materialize", {"path": self.path, "rank": rank})
+        ):
+            manifest = get_manifest_for_rank(self.metadata, rank)
+            containers = {
+                p: e for p, e in manifest.items() if is_container_entry(e)
+            }
+            futures: Dict[str, Future] = {}
+            read_reqs: List[ReadReq] = []
+            for p, e in manifest.items():
+                if not is_container_entry(e):
+                    reqs, fut = prepare_read(e, obj_out=None)
+                    read_reqs.extend(reqs)
+                    futures[p] = fut
+            if not knobs.is_batching_disabled():
+                read_reqs = batch_read_requests(read_reqs)
+            storage = _storage_for(self.path, self._storage_options)
+            try:
+                sync_execute_read_reqs(
+                    read_reqs, storage, get_process_memory_budget_bytes(), rank
+                )
+            finally:
+                storage.sync_close()
+            leaves = {p: fut.obj for p, fut in futures.items()}
+            return {
+                key: inflate(containers, leaves, prefix=key)
+                for key in sorted({p.split("/", 1)[0] for p in manifest})
+            }
+
     def read_object(
         self,
         path: str,

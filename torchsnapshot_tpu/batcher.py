@@ -74,11 +74,19 @@ class BatchedBufferStager(BufferStager):
 
         from ._csrc import copy_digest
 
+        # with checksums disabled (max-throughput mode) the pack is a
+        # plain memcpy — computing crc+adler only to throw them away
+        # would cost ~2x on the pack pass
+        want_digests = knobs.write_checksums_enabled()
+
         def _pack_one(dst, view):
             # heavy pass (memcpy + crc32 + adler32, GIL released inside
             # the ctypes call) — big members run in the executor so the
             # loop thread stays free for other pipelines' staging and
             # I/O completions
+            if not want_digests:
+                dst[:] = view
+                return None
             d = copy_digest(dst, view)
             if d is None:  # no native lib: plain copy, no digests
                 dst[:] = view
@@ -107,8 +115,12 @@ class BatchedBufferStager(BufferStager):
             elif cost <= _INLINE_PY_MAX:
                 dst[:] = view
                 digest = (
-                    zlib.crc32(view) & 0xFFFFFFFF,
-                    zlib.adler32(view) & 0xFFFFFFFF,
+                    (
+                        zlib.crc32(view) & 0xFFFFFFFF,
+                        zlib.adler32(view) & 0xFFFFFFFF,
+                    )
+                    if want_digests
+                    else None
                 )
             elif executor is not None and cost >= _EXEC_OFFLOAD_MIN:
                 digest = await loop.run_in_executor(

@@ -1,0 +1,80 @@
+"""Contract tests for tools/bench_watch.py (the opportunistic bench
+watcher): single-instance guard, relay probe, and bench-launch gating —
+the logic that decides whether to attach to the (exclusive) TPU."""
+
+import importlib.util
+import os
+import socket
+import sys
+import threading
+
+
+def _load():
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "tools", "bench_watch.py"
+    )
+    spec = importlib.util.spec_from_file_location("bench_watch", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_relay_alive_detects_listener(monkeypatch):
+    mod = _load()
+    # no listener on the probed ports -> dead
+    monkeypatch.setattr(mod, "RELAY_PORTS", (1,))  # port 1: never bound
+    assert not mod._relay_alive()
+    # a real listener -> alive
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        monkeypatch.setattr(mod, "RELAY_PORTS", (srv.getsockname()[1],))
+        assert mod._relay_alive()
+    finally:
+        srv.close()
+
+
+def test_single_instance_guard(tmp_path, monkeypatch):
+    mod = _load()
+    monkeypatch.setattr(mod, "PIDFILE", str(tmp_path / "pid"))
+    monkeypatch.setattr(mod, "LOG", str(tmp_path / "log"))
+    # a live pid in the pidfile -> second instance exits immediately
+    (tmp_path / "pid").write_text(str(os.getpid()))
+    monkeypatch.setattr(sys, "argv", ["bench_watch.py", "0.001"])
+    mod.main()
+    assert "already running" in (tmp_path / "log").read_text()
+    # a STALE pid -> instance takes over (and cleans the pidfile on exit)
+    (tmp_path / "pid").write_text("999999999")
+    launched = []
+    monkeypatch.setattr(mod, "_relay_alive", lambda: False)
+    done = threading.Event()
+
+    def run():
+        mod.main()
+        done.set()
+
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert done.wait(timeout=10), "watcher did not exit at budget"
+    assert not launched  # relay never alive -> bench never launched
+    assert not os.path.exists(tmp_path / "pid")
+    assert "watcher exiting" in (tmp_path / "log").read_text()
+
+
+def test_never_launches_over_running_bench(tmp_path, monkeypatch):
+    mod = _load()
+    monkeypatch.setattr(mod, "PIDFILE", str(tmp_path / "pid"))
+    monkeypatch.setattr(mod, "LOG", str(tmp_path / "log"))
+    monkeypatch.setattr(mod, "_relay_alive", lambda: True)
+    monkeypatch.setattr(mod, "_bench_running", lambda: True)
+    launched = []
+    monkeypatch.setattr(
+        mod.subprocess, "run", lambda *a, **k: launched.append(a)
+    )
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    monkeypatch.setattr(sys, "argv", ["bench_watch.py", "0.0001"])
+    mod.main()
+    assert not launched, "attached while another bench held the chip"
+    assert "already runs" in (tmp_path / "log").read_text()

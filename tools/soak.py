@@ -23,9 +23,20 @@ def main() -> int:
     minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
     deadline = time.time() + minutes * 60
 
+    import jax
     import jax.numpy as jnp
 
     from torchsnapshot_tpu import PyTreeState, SnapshotManager, knobs
+
+    # with >=8 devices (XLA_FLAGS=--xla_force_host_platform_device_count=8)
+    # a 2x4-mesh sharded array joins the loop, soaking the collective-free
+    # box assignment + sharded restore path too
+    mesh = None
+    if len(jax.devices()) >= 8:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+        sharded_sharding = NamedSharding(mesh, P("dp", "tp"))
 
     root = tempfile.mkdtemp(prefix="tsnp_soak_")
     mgr = SnapshotManager(root, keep_last_n=4)
@@ -37,13 +48,16 @@ def main() -> int:
     base_w = np.arange(4096, dtype=np.float32)
     while time.time() < deadline:
         step += 1
-        state = {
-            "m": PyTreeState({
-                "w": base_w + step,
-                "frozen": base_w,  # identical every step: dedup fodder
-                "j": jnp.full((256,), float(step)),
-            }),
+        tree = {
+            "w": base_w + step,
+            "frozen": base_w,  # identical every step: dedup fodder
+            "j": jnp.full((256,), float(step)),
         }
+        if mesh is not None:
+            tree["s"] = jax.device_put(
+                jnp.full((16, 8), float(step)), sharded_sharding
+            )
+        state = {"m": PyTreeState(tree)}
         async_ = bool(rng.integers(2))
         incremental = bool(rng.integers(2)) and step > 1
         if async_:
@@ -61,11 +75,16 @@ def main() -> int:
         assert len(committed) <= 4, committed  # retention bound
 
         if step % 5 == 0:
-            dest = {"m": PyTreeState({
+            dtree = {
                 "w": np.zeros(4096, np.float32),
                 "frozen": np.zeros(4096, np.float32),
                 "j": jnp.zeros((256,)),
-            })}
+            }
+            if mesh is not None:
+                dtree["s"] = jax.device_put(
+                    jnp.zeros((16, 8)), sharded_sharding
+                )
+            dest = {"m": PyTreeState(dtree)}
             with knobs.override_restore_donate(
                 "1" if rng.integers(2) else "auto"
             ):
@@ -75,6 +94,11 @@ def main() -> int:
             np.testing.assert_array_equal(
                 np.asarray(dest["m"].tree["j"]), np.full(256, float(step))
             )
+            if mesh is not None:
+                np.testing.assert_array_equal(
+                    np.asarray(dest["m"].tree["s"]),
+                    np.full((16, 8), float(step), np.float32),
+                )
             stats["restores"] += 1
         if step % 7 == 0:
             result = snap.verify(deep=True)

@@ -96,6 +96,50 @@ def test_merge_dedups_replica_boxes():
     assert [tuple(s.offsets) for s in merged.shards] == [(0, 0), (4, 0)]
 
 
+def _twisted(rows, **twist):
+    e = _sharded(rows)
+    for k, v in twist.items():
+        setattr(e, k, v)
+    return e
+
+
+def test_merge_rejects_divergent_metadata():
+    # A dtype swap with equal itemsize would pass verify.py's extent
+    # checks and silently misinterpret every other rank's payload under
+    # entries[0]'s metadata — merging must refuse instead.
+    import pytest
+
+    a = _sharded([(0, 4)])
+    for twist in (
+        {"dtype": "int32"},  # same itemsize as float32
+        {"shape": [8, 5]},
+        {"spec": [["dp"], None]},
+        {"mesh_shape": [4, 2]},  # replica sets derive from the mesh
+        {"mesh_axis_names": ["dp", "tp"]},
+    ):
+        with pytest.raises(ValueError, match="disagree"):
+            merge_sharded_entries([a, _twisted([(4, 4)], **twist)])
+    # identical metadata still merges fine
+    assert len(merge_sharded_entries([a, _sharded([(4, 4)])]).shards) == 2
+
+
+def test_corrupt_two_rank_manifest_fails_view_build():
+    import pytest
+
+    md = SnapshotMetadata(
+        version="0.1.0",
+        world_size=2,
+        manifest={
+            "0/app": DictEntry(keys=["x"]),
+            "0/app/x": _sharded([(0, 4)]),
+            "1/app": DictEntry(keys=["x"]),
+            "1/app/x": _twisted([(4, 4)], dtype="int32"),
+        },
+    )
+    with pytest.raises(ValueError, match="disagree"):
+        get_manifest_for_rank(md, 0)
+
+
 def test_sharded_merge_across_ranks_on_restore_view():
     md = SnapshotMetadata(
         version="0.1.0",

@@ -4,8 +4,10 @@ The reference restores IN PLACE into pre-allocated tensors
 (snapshot.py:743-753, io_preparers/tensor.py:91-126), so device peak is
 ~1x payload.  jax.Arrays are immutable, so the TPU-native equivalent is
 put-then-delete: each template's device buffers are freed as soon as its
-replacement dispatches (preparers/array.py donate_template) — peak is
-~1x payload + one leaf, and a failed restore leaves templates intact.
+replacement is reachable through the leaf's Future (preparers/array.py
+donate_template) — peak is ~1x payload + one leaf.  Mid-failure
+semantics match the reference's in-place load: state ends mixed
+old/new but entirely valid (Snapshot._repair_after_failed_restore).
 On CPU the knob's "auto" resolves off; these tests force it on to
 exercise the mechanism.
 """
@@ -52,10 +54,13 @@ def test_donation_auto_is_off_on_cpu(tmp_path):
         assert not t.is_deleted()
 
 
-def test_template_survives_until_replacement_dispatched():
+def test_materialize_never_donates_itself():
     # the load-bearing ordering: donation happens strictly AFTER the
-    # replacement's device_put, so a failed put leaves the template
-    # intact (failure safety beats the one-leaf extra peak)
+    # replacement is reachable through the leaf's Future — so
+    # materialize_into_template itself must NOT donate (its caller
+    # donates after fut.set; see ArrayBufferConsumer.consume_buffer).
+    # A donated template therefore always implies a retrievable
+    # replacement, which _repair_after_failed_restore relies on.
     template = jnp.zeros((32,), jnp.float32)
     data = np.arange(32, dtype=np.float32)
     real_put = jax.device_put
@@ -72,7 +77,7 @@ def test_template_survives_until_replacement_dispatched():
         finally:
             jax.device_put = real_put
     assert deleted_at_put == [False]
-    assert template.is_deleted()  # donated once the put dispatched
+    assert not template.is_deleted()  # caller's job, after fut.set
     np.testing.assert_array_equal(np.asarray(out), data)
 
 
@@ -153,6 +158,132 @@ def test_offloaded_template_round_trips_with_donation(tmp_path):
     assert out.sharding.memory_kind == "pinned_host"
     assert tmpl.is_deleted()
     np.testing.assert_array_equal(np.asarray(out), np.arange(64))
+
+
+def test_later_leaf_failure_repairs_live_state(tmp_path):
+    # A failure on a LATER leaf after earlier templates were donated
+    # must not strand deleted arrays in the caller's state: the repair
+    # path loads already-restored leaves (mixed old/new, all VALID) —
+    # the reference's in-place-load mid-failure semantics.
+    import threading
+
+    params = {
+        "a": jnp.arange(64, dtype=jnp.float32),
+        "b": jnp.full((64,), 7.0, jnp.float32),
+    }
+    snap = Snapshot.take(str(tmp_path / "snap"), {"m": PyTreeState(params)})
+    templates = {k: jnp.zeros_like(v) for k, v in params.items()}
+    refs = dict(templates)
+    dest = PyTreeState(dict(templates))
+
+    real_put = jax.device_put
+    lock = threading.Lock()
+    calls = [0]
+
+    def second_put_fails(x, sharding=None, **kw):
+        with lock:
+            calls[0] += 1
+            n = calls[0]
+        if n == 2:
+            raise RuntimeError("injected H2D failure")
+        return real_put(x, sharding, **kw)
+
+    with knobs.override_restore_donate("1"):
+        jax.device_put = second_put_fails
+        try:
+            with pytest.raises(Exception, match="injected"):
+                snap.restore({"m": dest})
+        finally:
+            jax.device_put = real_put
+
+    donated = [k for k, t in refs.items() if t.is_deleted()]
+    assert len(donated) <= 1  # only the first put could have succeeded
+    for k in params:
+        leaf = dest.tree[k]
+        # the repaired state must never reference deleted buffers
+        assert not (hasattr(leaf, "is_deleted") and leaf.is_deleted()), k
+        if k in donated:
+            # donated ⟹ replacement was reachable ⟹ repair loaded it
+            np.testing.assert_array_equal(np.asarray(leaf), np.asarray(params[k]))
+        else:
+            # never donated ⟹ template (or its equal value) survives
+            np.testing.assert_array_equal(
+                np.asarray(leaf), np.zeros_like(np.asarray(params[k]))
+            )
+
+
+def test_later_leaf_failure_with_aliased_template(tmp_path):
+    # tied weights: ONE array object is the template for both paths.
+    # The sibling path's donation deletes the shared template; repair
+    # must substitute the sibling's replacement for the path whose own
+    # read failed — never hand back the deleted array.
+    import threading
+
+    params = {
+        "a": jnp.arange(64, dtype=jnp.float32),
+        "b": jnp.arange(64, dtype=jnp.float32) * 2,
+    }
+    snap = Snapshot.take(str(tmp_path / "snap"), {"m": PyTreeState(params)})
+    shared = jnp.zeros((64,), jnp.float32)
+    dest = PyTreeState({"a": shared, "b": shared})
+
+    real_put = jax.device_put
+    lock = threading.Lock()
+    calls = [0]
+
+    def second_put_fails(x, sharding=None, **kw):
+        with lock:
+            calls[0] += 1
+            n = calls[0]
+        if n == 2:
+            raise RuntimeError("injected H2D failure")
+        return real_put(x, sharding, **kw)
+
+    with knobs.override_restore_donate("1"):
+        jax.device_put = second_put_fails
+        try:
+            with pytest.raises(Exception, match="injected"):
+                snap.restore({"m": dest})
+        finally:
+            jax.device_put = real_put
+
+    expected = {k: np.asarray(v) for k, v in params.items()}
+    for k in params:
+        leaf = dest.tree[k]
+        assert not (hasattr(leaf, "is_deleted") and leaf.is_deleted()), k
+        got = np.asarray(leaf)
+        if shared.is_deleted():
+            # whichever leaf restored first donated the shared template;
+            # both paths must now hold SOME restored value (mixed is ok,
+            # deleted is not)
+            assert any(
+                np.array_equal(got, v) for v in expected.values()
+            ), k
+        else:
+            np.testing.assert_array_equal(got, np.zeros(64, np.float32))
+
+
+def test_failure_with_donation_off_leaves_state_untouched(tmp_path):
+    params = {"a": jnp.arange(16, dtype=jnp.float32), "b": jnp.ones((16,))}
+    snap = Snapshot.take(str(tmp_path / "snap"), {"m": PyTreeState(params)})
+    templates = {k: jnp.zeros_like(v) for k, v in params.items()}
+    refs = dict(templates)
+    dest = PyTreeState(dict(templates))
+    real_put = jax.device_put
+
+    def always_fails(x, sharding=None, **kw):
+        raise RuntimeError("injected H2D failure")
+
+    with knobs.override_restore_donate("0"):
+        jax.device_put = always_fails
+        try:
+            with pytest.raises(Exception, match="injected"):
+                snap.restore({"m": dest})
+        finally:
+            jax.device_put = real_put
+    for k, t in refs.items():
+        assert not t.is_deleted()
+        assert dest.tree[k] is t  # repair no-ops; state untouched
 
 
 def test_donate_helper_modes():

@@ -206,3 +206,22 @@ def test_reference_restores_our_export(tmp_path):
         np.testing.assert_array_equal(w.numpy(), state["model"]["w"])
     finally:
         sys.path.remove(_REFERENCE)
+
+
+def test_none_leaf_error_names_path(tmp_path):
+    # None in optimizer state is common (the reference pickles it as an
+    # object entry); this exporter is pickle-free and must say WHICH
+    # leaf failed and what it was, not np.asarray's bare dtype('O') error
+    state = {"model": {"w": np.ones(3, np.float32)},
+             "opt": {"momentum": None}}
+    with pytest.raises(ValueError, match=r"0/opt/momentum.*NoneType"):
+        write_torchsnapshot(str(tmp_path / "s"), state)
+
+
+def test_object_leaf_error_names_path(tmp_path):
+    class Opaque:
+        pass
+
+    state = {"app": {"cfg": Opaque()}}
+    with pytest.raises(ValueError, match=r"0/app/cfg.*Opaque"):
+        write_torchsnapshot(str(tmp_path / "s"), state)

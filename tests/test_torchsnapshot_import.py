@@ -356,3 +356,53 @@ def test_synthetic_unknown_dtype_raises(tmp_path):
         read_torchsnapshot(
             _write_snapshot(tmp_path, manifest, {"0/q": b"\x00\x00"})
         )
+
+
+def test_dict_key_order_preserved(tmp_path):
+    # the reference seeds containers via dict.fromkeys(entry.keys) so
+    # iteration order survives the round trip; our inflate must too —
+    # order-sensitive consumers (OrderedDict optimizer state) depend on it
+    payload = np.arange(3, dtype=np.float32).tobytes()
+    manifest = {
+        "0/app": {"type": "dict", "keys": ["zeta", "alpha", "mid"]},
+        "0/app/zeta": _tensor_entry("0/z", "torch.float32", (3,)),
+        "0/app/alpha": _tensor_entry("0/a", "torch.float32", (3,)),
+        "0/app/mid": _tensor_entry("0/m", "torch.float32", (3,)),
+    }
+    got = read_torchsnapshot(
+        _write_snapshot(
+            tmp_path, manifest, {"0/z": payload, "0/a": payload, "0/m": payload}
+        )
+    )
+    assert list(got["app"].keys()) == ["zeta", "alpha", "mid"]
+
+
+def test_blob_cache_evicts_after_last_consumer():
+    # without eviction an import peaks at raw-blobs + assembled arrays
+    # (~2x); each blob must drop as its LAST consumer decodes, with
+    # refcounts covering replicated shards that share one key
+    import asyncio
+
+    from torchsnapshot_tpu.tricks.torchsnapshot_reader import _BlobCache
+
+    reads = []
+
+    class FakeStorage:
+        async def read(self, read_io):
+            reads.append(read_io.path)
+            read_io.buf = b"\x01\x02"
+
+    shared = {"location": "blob/shared"}
+    solo = {"location": "blob/solo"}
+    cache = _BlobCache(FakeStorage())
+    # "shared" referenced by two consuming leaves, "solo" by one
+    cache.prefetch([shared, shared, solo])
+    assert sorted(reads) == ["blob/shared", "blob/solo"]  # fetched once each
+
+    assert cache.get(solo) == b"\x01\x02"
+    assert ("blob/solo", None) not in cache._blobs  # evicted immediately
+    assert cache.get(shared) == b"\x01\x02"
+    assert ("blob/shared", None) in cache._blobs  # one consumer left
+    assert cache.get(shared) == b"\x01\x02"
+    assert not cache._blobs  # last consumer: cache fully drained
+    assert sorted(reads) == ["blob/shared", "blob/solo"]  # no refetches

@@ -112,10 +112,17 @@ _DEFAULTS = {
     # replacement materializes, holding restore's device peak at ~1x
     # payload + one leaf — the jax analogue of the reference's in-place
     # load into pre-allocated tensors (snapshot.py:743-753; jax.Arrays
-    # are immutable, so "in place" becomes put-then-delete, ordered so a
-    # failed restore leaves the templates intact).  The template array
-    # objects become invalid on success (restore replaces them via
-    # load_state_dict anyway).  "auto" = on when the template lives on an
+    # are immutable, so "in place" becomes put-then-delete).  Failure
+    # semantics match the reference's in-place load: a restore that
+    # fails mid-stateful leaves the state MIXED (earlier leaves already
+    # replaced, later ones still the prior values) but entirely valid —
+    # donation happens only after each replacement is reachable, and a
+    # failed restore loads the already-restored leaves back so nothing
+    # live references deleted buffers (Snapshot._repair_after_failed_
+    # restore).  Set to 0 for all-or-nothing templates at 2x device
+    # peak.  The template array objects become invalid on success
+    # (restore replaces them via load_state_dict anyway).  "auto" = on
+    # when the template lives on an
     # accelerator (HBM is the scarce resource), off for host-resident
     # templates; "1"/"0" force.
     _RESTORE_DONATE: "auto",

@@ -40,8 +40,31 @@ def _is_replicated_entry(entry: Entry) -> bool:
 
 def merge_sharded_entries(entries: List[ShardedArrayEntry]) -> ShardedArrayEntry:
     """Merge per-rank shard lists into one global entry, deduping identical
-    boxes (replicas saved by different ranks)."""
+    boxes (replicas saved by different ranks).
+
+    Per-rank entries for the same logical path must agree on array
+    metadata — a divergence means a corrupt or hand-edited manifest, and
+    silently adopting ``entries[0]``'s dtype would misinterpret every
+    other rank's payload bytes (a dtype swap with equal itemsize would
+    even pass extent checks in ``verify.py``).  Raise instead."""
     first = entries[0]
+    for e in entries[1:]:
+        if (
+            e.dtype != first.dtype
+            or list(e.shape) != list(first.shape)
+            or e.spec != first.spec
+            or e.mesh_shape != first.mesh_shape
+            or e.mesh_axis_names != first.mesh_axis_names
+        ):
+            raise ValueError(
+                "per-rank sharded entries disagree on array metadata "
+                "(dtype/shape/spec/mesh): "
+                f"{first.dtype}/{first.shape}/{first.spec}/"
+                f"{first.mesh_shape}x{first.mesh_axis_names} vs "
+                f"{e.dtype}/{e.shape}/{e.spec}/"
+                f"{e.mesh_shape}x{e.mesh_axis_names} — corrupt or "
+                "hand-edited manifest?"
+            )
     seen = set()
     shards = []
     for e in entries:

@@ -97,9 +97,16 @@ def donate_template(arr: Any) -> None:
     the jax analogue of the reference's in-place load into pre-allocated
     tensors (snapshot.py:743-753, io_preparers/tensor.py:91-126).
 
-    Called strictly AFTER the replacement's device_put, never before: a
-    restore that fails mid-leaf (transfer wedge, H2D OOM) must leave the
-    caller's live template arrays intact, not destroyed.
+    Called strictly AFTER the replacement is visible through the leaf's
+    Future (``fut.set`` precedes donation at every call site), never
+    before: a restore that fails mid-leaf (transfer wedge, H2D OOM)
+    leaves THAT leaf's template intact, and every already-donated
+    template has a retrievable replacement.  A failure on a LATER leaf
+    of the same stateful therefore cannot strand deleted arrays in the
+    caller's live state: the repair path in
+    ``Snapshot._restore_stateful`` loads the already-restored leaves
+    (non-strict, mixed old/new — the reference's in-place load has the
+    same mid-failure semantics, snapshot.py:743-753) before re-raising.
 
     ``delete()`` frees the buffers while keeping shape/dtype/sharding
     metadata valid, which is all any later step needs.  Aliased leaves
@@ -305,9 +312,11 @@ def materialize_into_template(np_arr: np.ndarray, obj_out: Any) -> Any:
         with transfer_gate() as pending:
             out = jax.device_put(shaped, sharding)
             pending.append(out)
-        # replacement dispatched: the template's device buffer is no
-        # longer needed — free it so peak stays ~1x payload
-        donate_template(obj_out)
+        # NOTE: the template is NOT donated here.  Callers donate only
+        # after the replacement is visible through the leaf's Future
+        # (fut.set then donate_template), so a donated template always
+        # implies a retrievable replacement — the invariant the
+        # failed-restore repair path in snapshot.py relies on.
         return out
     # Template is some other leaf (e.g. a Python scalar where the saved
     # state had a traced jax scalar, like TrainState.step before/after the
@@ -347,6 +356,9 @@ class ArrayBufferConsumer(BufferConsumer):
         else:
             result = materialize_into_template(np_arr, self.obj_out)
         self.fut.set(result)
+        if result is not self.obj_out:
+            # strictly after fut.set: donated ⟹ replacement reachable
+            donate_template(self.obj_out)
 
     def get_consuming_cost_bytes(self) -> int:
         return serialized_size_bytes(self.entry.shape, string_to_dtype(self.entry.dtype))
@@ -661,7 +673,10 @@ class ChunkedArrayIOPreparer:
             if host_buf is obj_out:
                 fut.set(obj_out)
             else:
-                fut.set(materialize_into_template(host_buf, obj_out))
+                result = materialize_into_template(host_buf, obj_out)
+                fut.set(result)
+                if result is not obj_out:
+                    donate_template(obj_out)
 
         # Budget-aware tiling (reference prepare_read_tiled semantics
         # extended to chunks): a chunk is a dim-0 row range, so in flat

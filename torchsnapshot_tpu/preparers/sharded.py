@@ -45,6 +45,7 @@ from ..serialization import (
 from .array import (
     JaxArrayBufferStager,
     array_dtype_str,
+    donate_template,
     materialize_into_template,
     _Countdown,
     _is_jax_array,
@@ -274,7 +275,7 @@ class ShardedArrayIOPreparer:
             if obj_out is not None and is_multi_device_jax_array(obj_out):
                 import jax
 
-                from .array import donate_template, transfer_gate
+                from .array import transfer_gate
 
                 if target_dtype != dtype:
                     for box in list(buffers):
@@ -287,11 +288,11 @@ class ShardedArrayIOPreparer:
                     with transfer_gate() as pending:
                         out = jax.device_put(buffers[full_box], sharding)
                         pending.append(out)
-                    # replacement dispatched: free the template's device
-                    # buffers (1x-restore; a failed put above leaves the
-                    # template intact)
-                    donate_template(obj_out)
+                    # fut.set BEFORE donation: a donated template must
+                    # always imply a replacement reachable through the
+                    # Future (1x-restore; see donate_template)
                     fut.set(out)
+                    donate_template(obj_out)
                     return
                 arrays = []
                 with transfer_gate() as pending:
@@ -302,11 +303,14 @@ class ShardedArrayIOPreparer:
                 out = jax.make_array_from_single_device_arrays(
                     tuple(obj_out.shape), sharding, arrays
                 )
-                donate_template(obj_out)
                 fut.set(out)
+                donate_template(obj_out)
             else:
                 (buf,) = buffers.values()
-                fut.set(materialize_into_template(buf, obj_out))
+                result = materialize_into_template(buf, obj_out)
+                fut.set(result)
+                if result is not obj_out:
+                    donate_template(obj_out)
 
         if not plans:  # degenerate: nothing to read (e.g. zero-size array)
             assemble()

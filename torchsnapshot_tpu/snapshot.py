@@ -1030,20 +1030,106 @@ class Snapshot:
         if not knobs.is_batching_disabled():
             read_reqs = batch_read_requests(read_reqs)
         budget = get_process_memory_budget_bytes()
-        sync_execute_read_reqs(read_reqs, storage, budget, rank)
-        restored = {lpath: fut.obj for lpath, fut in futures.items()}
-        state_dict = inflate(
-            container_entries,
-            restored,
-            prefix=key,
-            allow_missing=(not strict) or paths is not None,
-        )
-        # propagate strict to load_state_dict when the stateful accepts it
-        # (reference snapshot.py:775-778 for nn.Module); a paths filter
-        # implies non-strict (unmatched leaves keep current values)
-        load_with_strict(
-            stateful, state_dict, strict and paths is None
-        )
+        try:
+            sync_execute_read_reqs(read_reqs, storage, budget, rank)
+            restored = {lpath: fut.obj for lpath, fut in futures.items()}
+            state_dict = inflate(
+                container_entries,
+                restored,
+                prefix=key,
+                allow_missing=(not strict) or paths is not None,
+            )
+            # propagate strict to load_state_dict when the stateful
+            # accepts it (reference snapshot.py:775-778 for nn.Module); a
+            # paths filter implies non-strict (unmatched leaves keep
+            # current values)
+            load_with_strict(
+                stateful, state_dict, strict and paths is None
+            )
+        except BaseException:
+            self._repair_after_failed_restore(
+                key, stateful, container_entries, futures, targets
+            )
+            raise
+
+    @staticmethod
+    def _repair_after_failed_restore(
+        key: str,
+        stateful: Any,
+        container_entries: Manifest,
+        futures: Dict[str, Future],
+        targets: Dict[str, Any],
+    ) -> None:
+        """Keep the caller's live state free of deleted arrays after a
+        mid-stateful restore failure.
+
+        Restore donation (1x device peak, see
+        ``preparers/array.py:donate_template``) frees each template's
+        buffers as soon as its replacement materializes.  A failure on a
+        LATER leaf would otherwise leave earlier templates deleted while
+        still reachable from the caller's state — any use raises XLA's
+        "Array has been deleted".  Every donation happens strictly after
+        ``fut.set``, so each donated template has a retrievable
+        replacement: load the already-restored leaves (keeping intact
+        templates for the rest, non-strict) so the state is mixed
+        old/new but entirely VALID — the same mid-failure semantics as
+        the reference's in-place tensor load (snapshot.py:743-753).
+        No-op when no template was actually donated (donation off, host
+        templates, or the failure hit the first leaf)."""
+        def _is_deleted(t: Any) -> bool:
+            is_deleted = getattr(t, "is_deleted", None)
+            if callable(is_deleted):
+                try:
+                    return bool(is_deleted())
+                except Exception:  # noqa: BLE001 — e.g. inside a transform
+                    return False
+            return False
+
+        deleted = sum(1 for t in targets.values() if _is_deleted(t))
+        if not deleted:
+            return
+        # One array object can be the template for several paths (tied
+        # weights).  Map template identity → its restored replacement so
+        # a path whose OWN read never finished but whose (shared)
+        # template was donated by a sibling path gets the sibling's
+        # replacement — never the deleted array itself.
+        replacement_by_template: Dict[int, Any] = {}
+        for lpath, fut in futures.items():
+            if fut.done and lpath in targets and fut.obj is not targets[lpath]:
+                replacement_by_template[id(targets[lpath])] = fut.obj
+        restored: Dict[str, Any] = {}
+        for lpath, fut in futures.items():
+            if fut.done:
+                restored[lpath] = fut.obj
+            elif lpath in targets:
+                t = targets[lpath]
+                if not _is_deleted(t):
+                    restored[lpath] = t
+                elif id(t) in replacement_by_template:
+                    restored[lpath] = replacement_by_template[id(t)]
+                # else: deleted with no known replacement (cannot happen
+                # given donate-after-fut.set ordering) — omit the path
+                # rather than load a dead array; allow_missing keeps the
+                # structure intact
+        try:
+            state_dict = inflate(
+                container_entries, restored, prefix=key, allow_missing=True
+            )
+            load_with_strict(stateful, state_dict, False)
+            logger.warning(
+                "restore of %r failed after donation freed %d template(s); "
+                "loaded the partially-restored state so live arrays remain "
+                "valid — the state is now MIXED (restored leaves + prior "
+                "values). Set TORCHSNAPSHOT_TPU_RESTORE_DONATE=0 to keep "
+                "templates fully intact on failure (2x device peak).",
+                key, deleted,
+            )
+        except Exception:
+            logger.exception(
+                "restore of %r failed after donation freed %d template(s), "
+                "and repairing the live state also failed — state for this "
+                "key may reference deleted arrays", key, deleted,
+            )
 
     @staticmethod
     def _map_legacy_leaf_targets(

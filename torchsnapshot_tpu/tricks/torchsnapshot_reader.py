@@ -93,12 +93,21 @@ def _read_bytes(storage, location: str, byte_range: Optional[List[int]]) -> byte
 class _BlobCache:
     """Prefetches every blob the manifest references with ONE event loop
     and bounded concurrency, so a many-entry checkpoint on object
-    storage doesn't pay per-blob loop setup + serial latency."""
+    storage doesn't pay per-blob loop setup + serial latency.
+
+    Each prefetched blob is refcounted by how many consuming leaves
+    reference it (replicated shards can share one key) and EVICTED as
+    its last ``get`` is served: without eviction, peak host memory
+    during an import is raw-blobs + assembled-arrays (~2x checkpoint
+    size), and a checkpoint that fits in RAM once can OOM mid-decode.
+    With it, raw bytes shrink as assembled arrays grow, holding the sum
+    near 1x."""
 
     def __init__(self, storage, concurrency: int = 16) -> None:
         self._storage = storage
         self._concurrency = concurrency
         self._blobs: Dict[Tuple[str, Optional[Tuple[int, int]]], bytes] = {}
+        self._refs: Dict[Tuple[str, Optional[Tuple[int, int]]], int] = {}
 
     @staticmethod
     def _key(entry: dict) -> Tuple[str, Optional[Tuple[int, int]]]:
@@ -116,6 +125,8 @@ class _BlobCache:
                 tensor = sub.get("tensor", sub)
                 if "location" in tensor:
                     keys.append(self._key(tensor))
+        for k in keys:
+            self._refs[k] = self._refs.get(k, 0) + 1
         keys = [k for k in dict.fromkeys(keys) if k not in self._blobs]
 
         async def fetch_all() -> None:
@@ -135,9 +146,19 @@ class _BlobCache:
 
     def get(self, entry: dict) -> bytes:
         key = self._key(entry)
-        if key not in self._blobs:
-            self._blobs[key] = _read_bytes(self._storage, key[0], key[1])
-        return self._blobs[key]
+        if key in self._blobs:
+            data = self._blobs[key]
+        else:
+            data = _read_bytes(self._storage, key[0], key[1])
+            if self._refs.get(key, 0) > 1:  # more consumers coming
+                self._blobs[key] = data
+        n = self._refs.get(key, 0)
+        if n <= 1:
+            self._refs.pop(key, None)
+            self._blobs.pop(key, None)  # last consumer: evict
+        else:
+            self._refs[key] = n - 1
+        return data
 
 
 def _decode_primitive(entry: dict) -> Any:
@@ -446,6 +467,17 @@ def _inflate(containers: Dict[str, dict], flat: Dict[str, Any]) -> Dict[str, Any
                     return k
         return decoded
 
+    def new_container(entry: dict) -> Any:
+        """Dicts are pre-seeded from the entry's recorded ``keys`` so the
+        imported dict keeps the reference's original iteration order
+        (reference inflate seeds via dict.fromkeys(entry.keys),
+        flatten.py:79-141) — leaves then fill the placeholder slots
+        without reordering; order-sensitive consumers (OrderedDict
+        state) see the keys exactly as saved."""
+        if entry["type"] == "list":
+            return []
+        return dict.fromkeys(entry.get("keys", ()))
+
     def ensure(path: str) -> Any:
         """The container object at logical ``path``, creating ancestors."""
         if path == "":
@@ -458,11 +490,11 @@ def _inflate(containers: Dict[str, dict], flat: Dict[str, Any]) -> Dict[str, Any
             while len(parent) <= idx:
                 parent.append(None)
             if parent[idx] is None:
-                parent[idx] = [] if entry["type"] == "list" else {}
+                parent[idx] = new_container(entry)
             return parent[idx]
         key = dict_key(parent_path, comp)
         if key not in parent or parent[key] is None:
-            parent[key] = [] if entry["type"] == "list" else {}
+            parent[key] = new_container(entry)
         return parent[key]
 
     for path, entry in sorted(containers.items()):

@@ -157,8 +157,21 @@ def write_torchsnapshot(path: str, app_state: Dict[str, Any]) -> None:
         if isinstance(obj, (bool, int, str, bytes, float)):
             manifest[logical] = _primitive_entry(obj)
             return
+        source = obj
         if not (hasattr(obj, "dtype") and hasattr(obj, "shape")):
             obj = np.asarray(obj)  # np scalars / 0-d oddities: tiny
+        if np.dtype(obj.dtype) == np.dtype(object):
+            # e.g. None in optimizer state: the reference round-trips it
+            # as a pickled object entry; this exporter is pickle-free, so
+            # name the leaf and its actual value instead of letting
+            # _torch_dtype_name fail on dtype('O') with no logical path
+            raise ValueError(
+                f"leaf {logical!r} is not exportable: "
+                f"{type(source).__name__} value {source!r:.80} has no "
+                f"torchsnapshot Tensor/primitive equivalent (the "
+                f"reference stores such leaves as pickled objects). Drop "
+                f"it or convert it to an array/primitive before exporting."
+            )
         if getattr(obj, "is_fully_addressable", True) is False:
             # cheap metadata check kept at PLAN time: failing inside the
             # async write tasks would upload sibling leaves first and

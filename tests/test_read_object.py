@@ -193,3 +193,80 @@ def test_tiled_read_into_casting_template_verifies_raw_bytes(tmp_path):
         )
         assert out2 is tmpl
         np.testing.assert_array_equal(tmpl, small.astype(np.float64))
+
+
+def _take_sharded(tmp_path, n=1 << 18):
+    # one saved shard box per device over dim 0 (8 boxes of n/8 rows)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from torchsnapshot_tpu import PyTreeState
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    arr = jax.device_put(
+        jnp.arange(n, dtype=jnp.float32),
+        NamedSharding(mesh, PartitionSpec("dp")),
+    )
+    Snapshot.take(str(tmp_path / "sh"), {"app": PyTreeState({"w": arr})})
+    return Snapshot(str(tmp_path / "sh")), np.arange(n, dtype=np.float32)
+
+
+def test_sharded_read_honors_memory_budget(tmp_path):
+    # a saved shard bigger than the budget must fetch as ranged dim-0
+    # row tiles, never whole (read_object's memory_budget_bytes contract
+    # extends to sharded entries; transient peak O(budget), not O(shard))
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+    s, expect = _take_sharded(tmp_path)  # 8 shards x 128KB
+    entry = s.get_manifest()["0/app/w"]
+    assert type(entry).__name__ == "ShardedArrayEntry"
+
+    sizes = []
+    orig = FSStoragePlugin.read
+
+    async def spy(self, read_io):
+        await orig(self, read_io)
+        sizes.append(len(memoryview(read_io.buf).cast("B")))
+
+    FSStoragePlugin.read = spy
+    try:
+        out = s.read_object("0/app/w", memory_budget_bytes=1 << 14)  # 16KB
+    finally:
+        FSStoragePlugin.read = orig
+    np.testing.assert_array_equal(out, expect)
+    payload_reads = [sz for sz in sizes if sz > 4096]  # skip metadata
+    assert payload_reads and max(payload_reads) <= (1 << 14)
+
+
+def test_sharded_tiled_read_verifies_folded_crc(tmp_path):
+    # tiling must not weaken integrity: tile crc32s fold back to the
+    # recorded whole-shard value under VERIFY_ON_RESTORE
+    import glob
+    import os
+
+    from torchsnapshot_tpu import knobs
+
+    s, expect = _take_sharded(tmp_path)
+    # shard payloads slab-batch into one object; corrupt a byte inside it
+    blobs = sorted(
+        glob.glob(str(tmp_path / "sh" / "*" / "*")), key=os.path.getsize
+    )
+    assert blobs and os.path.getsize(blobs[-1]) >= expect.nbytes
+    with open(blobs[-1], "r+b") as f:
+        f.seek(1000)
+        b = f.read(1)
+        f.seek(1000)
+        f.write(bytes([b[0] ^ 0xFF]))
+    s = Snapshot(str(tmp_path / "sh"))
+    with knobs.override_verify_on_restore(True):
+        with pytest.raises(Exception, match="crc32"):
+            s.read_object("0/app/w", memory_budget_bytes=1 << 14)
+        # unbudgeted whole-shard read catches it too (same gate)
+        with pytest.raises(Exception, match="crc32"):
+            s.read_object("0/app/w")
+    # and a pristine snapshot round-trips under the same knob + budget
+    s2, expect2 = _take_sharded(tmp_path / "clean")
+    with knobs.override_verify_on_restore(True):
+        out = s2.read_object("0/app/w", memory_budget_bytes=1 << 14)
+    np.testing.assert_array_equal(out, expect2)

@@ -148,7 +148,9 @@ def prepare_read(
         fut.set(entry.get_value())
         return [], fut
     if isinstance(entry, ShardedArrayEntry):
-        return ShardedArrayIOPreparer.prepare_read(entry, obj_out)
+        return ShardedArrayIOPreparer.prepare_read(
+            entry, obj_out, buffer_size_limit_bytes
+        )
     if isinstance(entry, ChunkedArrayEntry):
         return ChunkedArrayIOPreparer.prepare_read(
             entry, obj_out, buffer_size_limit_bytes

@@ -419,8 +419,9 @@ def _plan_flat_tiles(
 
     Returns (t0, t1, byte_range) per tile; byte_range is relative to the
     stored object (``base_byte`` = the region's offset inside it, for
-    slab-batched payloads).  Shared by the plain and chunked tiled-read
-    paths so the tile math cannot drift between them."""
+    slab-batched payloads).  Shared by the plain, chunked, and sharded
+    (one "element" per dim-0 row) tiled-read paths so the tile math
+    cannot drift between them."""
     elems_per_tile = max(1, budget_bytes // itemsize)
     tiles = []
     for t0 in range(c0, c1, elems_per_tile):

@@ -48,7 +48,9 @@ from .array import (
     donate_template,
     materialize_into_template,
     _Countdown,
+    _TileCrcFold,
     _is_jax_array,
+    _plan_flat_tiles,
 )
 from .overlap import (
     Box,
@@ -230,7 +232,9 @@ class ShardedArrayIOPreparer:
 
     @staticmethod
     def prepare_read(
-        entry: ShardedArrayEntry, obj_out: Any = None
+        entry: ShardedArrayEntry,
+        obj_out: Any = None,
+        buffer_size_limit_bytes: Optional[int] = None,
     ) -> Tuple[List[ReadReq], Future]:
         fut: Future = Future()
         shape = tuple(entry.shape)
@@ -346,21 +350,117 @@ class ShardedArrayIOPreparer:
                 # this branch reads the WHOLE shard payload: its recorded
                 # checksum applies (partial row-range reads above don't)
                 expected_crc = shard.crc32
-            read_reqs.append(
-                ReadReq(
-                    path=shard.location,
-                    byte_range=byte_range,
-                    buffer_consumer=_ShardConsumer(
-                        read_box=read_box,
-                        dtype=entry.dtype,
-                        overlaps=overlaps,
-                        buffers=buffers,
-                        countdown=countdown,
-                    ),
-                    expected_crc32=expected_crc,
+            read_reqs.extend(
+                _emit_shard_reads(
+                    shard.location,
+                    read_box,
+                    byte_range,
+                    expected_crc,
+                    entry.dtype,
+                    itemsize,
+                    overlaps,
+                    buffers,
+                    countdown,
+                    buffer_size_limit_bytes,
                 )
             )
         return read_reqs, fut
+
+
+def _emit_shard_reads(
+    location: str,
+    read_box: Box,
+    byte_range: Optional[List[int]],
+    expected_crc: Optional[int],
+    dtype: str,
+    itemsize: int,
+    overlaps: List[Tuple[Box, Box]],
+    buffers: Dict[Box, np.ndarray],
+    outer: _Countdown,
+    budget: Optional[int],
+) -> List[ReadReq]:
+    """Emit the read(s) for one saved-shard fetch, splitting an
+    over-budget fetch into dim-0 row-range tiles.
+
+    ``read_box`` is always a dim-0 row range of the saved shard (the
+    whole box, or the covering row range of the dim-0-slab fast path),
+    and shards are stored C-order — so consecutive rows are consecutive
+    payload bytes, and a row range is an exact byte range.  That makes
+    budgeted tiling a pure re-slicing of the fetch: each tile scatters
+    into the same local buffers through the overlap algebra, and peak
+    transient host memory per request is O(budget) instead of O(shard)
+    (the reference's budget stops at per-shard granularity,
+    io_preparers/tensor.py:128-181 applies only to dense tensors; this
+    extends the same contract to sharded entries).
+
+    Tiling must not weaken integrity: when the fetch covers the whole
+    shard payload (``expected_crc`` set), per-tile crc32s fold in offset
+    order back to the recorded whole-payload value (``_TileCrcFold``,
+    same VERIFY_ON_RESTORE gate as unbudgeted reads).  A single row
+    larger than the budget reads row-at-a-time (the floor; element-level
+    splits would tear rows across scatter boxes)."""
+    total_bytes = box_nelems(read_box) * itemsize
+    rows = read_box[1][0] if read_box[1] else 0
+    if (
+        budget is None
+        or total_bytes <= budget
+        or rows <= 1
+    ):
+        return [
+            ReadReq(
+                path=location,
+                byte_range=byte_range,
+                buffer_consumer=_ShardConsumer(
+                    read_box=read_box,
+                    dtype=dtype,
+                    overlaps=overlaps,
+                    buffers=buffers,
+                    countdown=outer,
+                ),
+                expected_crc32=expected_crc,
+            )
+        ]
+
+    # one "element" per dim-0 row: the shared tile math splits the row
+    # range exactly as it splits flat element ranges elsewhere
+    row_bytes = total_bytes // rows
+    base = byte_range[0] if byte_range else 0
+    tiles = _plan_flat_tiles(0, rows, row_bytes, budget, base_byte=base)
+    fold = _TileCrcFold(
+        expected_crc, what=f"sharded payload {location}", then=outer.step
+    )
+    inner = _Countdown(n=len(tiles), on_zero=fold.finish)
+    reqs: List[ReadReq] = []
+    for t0, t1, tile_byte_range in tiles:
+        offsets = list(read_box[0])
+        offsets[0] += t0
+        sizes = list(read_box[1])
+        sizes[0] = t1 - t0
+        tile_box = make_box(offsets, sizes)
+        tile_overlaps = []
+        for inter, lbox in overlaps:
+            sub = box_intersect(inter, tile_box)
+            if sub is not None:
+                tile_overlaps.append((sub, lbox))
+        # gap tiles (covering range between disjoint overlaps) still
+        # read so the crc fold sees every payload byte; their scatter
+        # list is empty
+        reqs.append(
+            ReadReq(
+                path=location,
+                byte_range=list(tile_byte_range),
+                buffer_consumer=_ShardConsumer(
+                    read_box=tile_box,
+                    dtype=dtype,
+                    overlaps=tile_overlaps,
+                    buffers=buffers,
+                    countdown=inner,
+                    crc_fold=fold,
+                    crc_key=t0,
+                ),
+            )
+        )
+    return reqs
 
 
 class _ShardConsumer(BufferConsumer):
@@ -374,16 +474,22 @@ class _ShardConsumer(BufferConsumer):
         overlaps: List[Tuple[Box, Box]],
         buffers: Dict[Box, np.ndarray],
         countdown: _Countdown,
+        crc_fold: Optional[Any] = None,
+        crc_key: int = 0,
     ) -> None:
         self.read_box = read_box
         self.dtype = dtype
         self.overlaps = overlaps
         self.buffers = buffers
         self.countdown = countdown
+        self.crc_fold = crc_fold
+        self.crc_key = crc_key
 
     async def consume_buffer(
         self, buf: Any, executor: Optional[Executor] = None
     ) -> None:
+        if self.crc_fold is not None:
+            self.crc_fold.record(self.crc_key, buf)
         src = array_from_buffer(buf, self.dtype, self.read_box[1])
 
         def scatter() -> None:

@@ -1,15 +1,17 @@
-"""TRUE multi-controller sharded save/restore: two jax.distributed
-processes, four CPU devices EACH, one global 8-device mesh — every
-process addresses only a strict subset of the mesh (the real pod
-regime; reference analogue tests/gpu_tests/test_snapshot_fsdp.py:43-100).
+"""TRUE multi-controller sharded save/restore: 2 jax.distributed
+processes x 4 CPU devices AND 4 processes x 2 devices, one global
+8-device mesh — every process addresses only a strict subset of the
+mesh (the real pod regime; reference analogue
+tests/gpu_tests/test_snapshot_fsdp.py:43-100 and the reference's
+world-size-4 elastic habit, test_utils.py:232-270).
 
 Asserts the three multi-controller invariants:
 - assign_box_writers yields a globally DISJOINT write set whose union
   covers every shard in the manifest (no rank writes a box twice, no
   box unwritten),
-- both controllers commit IDENTICAL manifests (the partition is a pure
+- all controllers commit IDENTICAL manifests (the partition is a pure
   function of globally-known sharding metadata — no gather+broadcast),
-- restore works onto a DIFFERENT topology (2x4 dp/tp → 4x2), with each
+- restore works onto a DIFFERENT topology (2x4 dp/tp ↔ 4x2), with each
   process's addressable shards reassembled from remote ranks' boxes.
 """
 
@@ -18,10 +20,16 @@ import socket
 import subprocess
 import sys
 
-_WORKER = r"""
+# Shared worker preamble: CPU-only backend (the axon TPU plugin must
+# never initialize in a subprocess test), jax.distributed bring-up from
+# TSNP_* env, and the standard globals every worker body uses.  Kept in
+# ONE string so a fix to the bring-up can't silently miss a worker.
+_PRELUDE = r"""
 import os, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=" + os.environ["TSNP_DEVS"]
+)
 sys.path.insert(0, os.environ["TSNP_REPO"])
 import jax
 from jax._src import xla_bridge
@@ -29,7 +37,7 @@ xla_bridge._backend_factories.pop("axon", None)
 jax.config.update("jax_platforms", "cpu")
 jax.distributed.initialize(
     coordinator_address=os.environ["TSNP_COORD"],
-    num_processes=2,
+    num_processes=int(os.environ["TSNP_NPROCS"]),
     process_id=int(os.environ["TSNP_RANK"]),
 )
 import numpy as np
@@ -41,14 +49,16 @@ from torchsnapshot_tpu.coordination import JaxCoordinator
 rank = int(os.environ["TSNP_RANK"])
 root = os.environ["TSNP_ROOT"]
 snap_dir = os.path.join(root, "snap")
-
+nprocs = int(os.environ["TSNP_NPROCS"])
 devs = jax.devices()
 assert len(devs) == 8
-assert len([d for d in devs if d.process_index == rank]) == 4  # strict subset
-
+# strict subset: this controller addresses only its own devices
+assert len([d for d in devs if d.process_index == rank]) == 8 // nprocs
 coord = JaxCoordinator()
+"""
 
 # log every storage write this controller performs
+_WRITE_SPY = r"""
 from torchsnapshot_tpu.storage import fs as fs_mod
 real_write = fs_mod.FSStoragePlugin.write
 async def spy(self, wio):
@@ -56,7 +66,9 @@ async def spy(self, wio):
         f.write(wio.path + "\n")
     await real_write(self, wio)
 fs_mod.FSStoragePlugin.write = spy
+"""
 
+_WORKER = _PRELUDE + _WRITE_SPY + r"""
 mesh = Mesh(np.array(devs).reshape(2, 4), ("dp", "tp"))
 W_GLOBAL = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
 B_GLOBAL = np.arange(8, dtype=np.float32) * 0.5
@@ -110,41 +122,7 @@ print(f"rank {rank} OK")
 """
 
 
-_SKEW_WORKER = r"""
-import os, sys
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-# slabs would hide per-box write locations; the test counts them
-os.environ["TORCHSNAPSHOT_TPU_DISABLE_BATCHING"] = "1"
-sys.path.insert(0, os.environ["TSNP_REPO"])
-import jax
-from jax._src import xla_bridge
-xla_bridge._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(
-    coordinator_address=os.environ["TSNP_COORD"],
-    num_processes=2,
-    process_id=int(os.environ["TSNP_RANK"]),
-)
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from torchsnapshot_tpu import PyTreeState, Snapshot
-from torchsnapshot_tpu.coordination import JaxCoordinator
-
-rank = int(os.environ["TSNP_RANK"])
-root = os.environ["TSNP_ROOT"]
-
-from torchsnapshot_tpu.storage import fs as fs_mod
-real_write = fs_mod.FSStoragePlugin.write
-async def spy(self, wio):
-    with open(os.path.join(root, f"writes_{rank}.log"), "a") as f:
-        f.write(wio.path + "\n")
-    await real_write(self, wio)
-fs_mod.FSStoragePlugin.write = spy
-
-coord = JaxCoordinator()
-devs = jax.devices()
+_SKEW_WORKER = _PRELUDE + _WRITE_SPY + r"""
 mesh = Mesh(np.array(devs).reshape(2, 4), ("dp", "tp"))
 W = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
 # dp-REPLICATED, tp-sharded: every box lives on one device of each
@@ -160,7 +138,7 @@ state = {
         else np.zeros(8, np.float32)
     ),
 }
-snap = Snapshot.take(os.path.join(root, "snap"), {"ts": PyTreeState(state)}, coordinator=coord)
+snap = Snapshot.take(snap_dir, {"ts": PyTreeState(state)}, coordinator=coord)
 manifest_repr = "\n".join(
     f"{k} {sorted((tuple(s.offsets), tuple(s.sizes), s.location) for s in e.shards)}"
     if hasattr(e, "shards") else f"{k} {type(e).__name__}"
@@ -172,49 +150,29 @@ print(f"rank {rank} SKEW-OK")
 """
 
 
-_FAULT_WORKER = r"""
-import os, sys
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-sys.path.insert(0, os.environ["TSNP_REPO"])
-import jax
-from jax._src import xla_bridge
-xla_bridge._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(
-    coordinator_address=os.environ["TSNP_COORD"],
-    num_processes=2,
-    process_id=int(os.environ["TSNP_RANK"]),
-)
-import asyncio
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from torchsnapshot_tpu import PyTreeState, Snapshot
-from torchsnapshot_tpu.coordination import JaxCoordinator
-import torchsnapshot_tpu.snapshot as snapmod
-from torchsnapshot_tpu.storage.fs import FSStoragePlugin
-
-rank = int(os.environ["TSNP_RANK"])
-root = os.environ["TSNP_ROOT"]
-snap_dir = os.path.join(root, "snap")
-
-# rank 1's storage fails LATE (during the background pipeline, after
+# One rank's storage fails LATE (during the background pipeline, after
 # async_take has unblocked): the KV-only commit protocol must propagate
 # the error to every rank's wait() and never write .snapshot_metadata
 # (reference analogue tests/test_async_take.py:96-117, but over the
-# real jax.distributed coordination service instead of a file KV)
+# real jax.distributed coordination service instead of a file KV).
+# TSNP_FAULT_RANK picks the faulty controller.
+_FAULT_WORKER = _PRELUDE + r"""
+import asyncio
+
+import torchsnapshot_tpu.snapshot as snapmod
+from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+fault_rank = int(os.environ["TSNP_FAULT_RANK"])
+
 class Faulty(FSStoragePlugin):
     async def write(self, write_io):
         await asyncio.sleep(0.2)
-        raise OSError("rank1 disk failure")
+        raise OSError(f"rank{fault_rank} disk failure")
 
-if rank == 1:
+if rank == fault_rank:
     snapmod.url_to_storage_plugin = lambda p: Faulty(root=p)
 
-coord = JaxCoordinator()
-devs = jax.devices()
-mesh = Mesh(np.array(devs).reshape(2, 4), ("dp", "tp"))
+mesh = Mesh(np.array(devs).reshape(nprocs, 8 // nprocs), ("dp", "tp"))
 W = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
 sh = NamedSharding(mesh, P("dp", "tp"))
 state = {
@@ -237,7 +195,81 @@ print(f"rank {rank} FAULT-OK")
 """
 
 
-def _launch_workers(worker_src: str, tmp_path) -> list:
+_WORKER4 = _PRELUDE + _WRITE_SPY + r"""
+# 4x2 mesh: rows = processes, cols = each process's 2 local devices
+mesh = Mesh(np.array(devs).reshape(4, 2), ("dp", "tp"))
+
+def make(global_np, spec):
+    sh = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        global_np.shape, sh, lambda idx: global_np[idx]
+    )
+
+# NamedSharding requires even tiling, so heterogeneity comes from MIXED
+# box geometries across leaves (fully sharded 4x2, dp-replicated,
+# flattened ("dp","tp") over dim 0) — partition determinism must hold
+# across heterogeneous per-leaf layouts, not just one uniform split
+W = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+# dp-replicated leaves: every process is a candidate writer for each
+# box, giving the balancer freedom to shift work between 4 controllers
+R = {f"r{i}": np.arange(8 * 4, dtype=np.float32).reshape(8, 4) * (i + 1)
+     for i in range(4)}
+state = {
+    "w": make(W, P("dp", "tp")),
+    "wflat": make(W * 3.0, P(("dp", "tp"), None)),
+    **{k: make(v, P(None, "tp")) for k, v in R.items()},
+    # skewed per-rank host state: rank 2 carries 8MB, others 32B — the
+    # balancer must shift replicated boxes AWAY from rank 2
+    "ballast": (
+        np.zeros(2_000_000, np.float32) if rank == 2
+        else np.zeros(8, np.float32)
+    ),
+}
+snap = Snapshot.take(snap_dir, {"ts": PyTreeState(state)}, coordinator=coord)
+
+manifest_repr = "\n".join(
+    f"{k} {sorted((tuple(s.offsets), tuple(s.sizes), s.location) for s in e.shards)}"
+    if hasattr(e, "shards") else f"{k} {type(e).__name__}"
+    for k, e in sorted(snap.metadata.manifest.items())
+)
+with open(os.path.join(root, f"manifest_{rank}.txt"), "w") as f:
+    f.write(manifest_repr)
+
+# restore onto a DIFFERENT topology: 2x4 (dp spans process PAIRS, tp
+# spans devices of two processes) — every box resplits across ranks
+mesh2 = Mesh(np.array(devs).reshape(2, 4), ("dp", "tp"))
+def template(shape, spec):
+    sh = NamedSharding(mesh2, spec)
+    return jax.make_array_from_callback(
+        shape, sh, lambda idx: np.zeros(shape, np.float32)[idx]
+    )
+dest = PyTreeState(
+    {
+        "w": template((16, 8), P("dp", "tp")),
+        "wflat": template((16, 8), P("tp", "dp")),
+        **{k: template((8, 4), P("tp", None)) for k in R},
+        "ballast": np.ones_like(state["ballast"]),
+    }
+)
+Snapshot(snap_dir, coordinator=coord).restore({"ts": dest})
+
+expected = {"w": W, "wflat": W * 3.0, **R, "ballast": state["ballast"]}
+for name, arr in dest.tree.items():
+    if hasattr(arr, "addressable_shards"):
+        for s in arr.addressable_shards:
+            np.testing.assert_array_equal(
+                np.asarray(s.data), expected[name][s.index],
+                err_msg=f"{name} shard {s.index} on rank {rank}",
+            )
+    else:
+        np.testing.assert_array_equal(arr, expected[name], err_msg=name)
+print(f"rank {rank} OK4")
+"""
+
+
+def _launch_workers(
+    worker_src: str, tmp_path, nprocs: int = 2, extra_env: dict = None
+) -> list:
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
@@ -247,8 +279,11 @@ def _launch_workers(worker_src: str, tmp_path) -> list:
         "TSNP_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "TSNP_COORD": f"localhost:{port}",
         "TSNP_ROOT": str(tmp_path),
+        "TSNP_NPROCS": str(nprocs),
+        "TSNP_DEVS": str(8 // nprocs),
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": "",
+        **(extra_env or {}),
     }
     procs = [
         subprocess.Popen(
@@ -258,12 +293,12 @@ def _launch_workers(worker_src: str, tmp_path) -> list:
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for r in range(2)
+        for r in range(nprocs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=240)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -272,12 +307,19 @@ def _launch_workers(worker_src: str, tmp_path) -> list:
     return [(p.returncode, out) for p, out in zip(procs, outs)]
 
 
+# slabs would hide per-box write locations from the write spy; tests
+# that count writes per box disable batching in the workers
+_NO_SLABS = {"TORCHSNAPSHOT_TPU_DISABLE_BATCHING": "1"}
+
+
 def test_multicontroller_async_take_peer_failure(tmp_path):
     # VERDICT r2 #7: the background KV commit over a REAL JaxCoordinator
     # (jax.distributed coordination service), not just FileCoordinator —
     # one rank's storage failure must raise on every rank's wait() and
     # .snapshot_metadata must never exist
-    results = _launch_workers(_FAULT_WORKER, tmp_path)
+    results = _launch_workers(
+        _FAULT_WORKER, tmp_path, extra_env={"TSNP_FAULT_RANK": "1"}
+    )
     for r, (rc, out) in enumerate(results):
         assert rc == 0, f"rank {r} failed:\n{out}"
         assert f"rank {r} FAULT-OK" in out
@@ -295,7 +337,9 @@ def test_multicontroller_skewed_host_state_shifts_boxes(tmp_path):
     # host state receives fewer sharded boxes, while both controllers
     # still commit IDENTICAL manifests (the preload vector is gathered,
     # so the balance stays a pure function of shared knowledge)
-    results = _launch_workers(_SKEW_WORKER, tmp_path)
+    results = _launch_workers(
+        _SKEW_WORKER, tmp_path, extra_env=_NO_SLABS
+    )
     for r, (rc, out) in enumerate(results):
         assert rc == 0, f"rank {r} failed:\n{out}"
         assert f"rank {r} SKEW-OK" in out
@@ -355,3 +399,70 @@ def test_multicontroller_sharded_save_restore(tmp_path):
         for s in e.shards
     }
     assert manifest_locations == shard_writes[0] | shard_writes[1]
+
+
+def test_four_controllers_mixed_geometry_skew_and_reshard(tmp_path):
+    # VERDICT r3 #2: partition determinism at 4 controllers. Every
+    # process must compute IDENTICAL collective-free partitions from the
+    # gathered vectors — across MIXED per-leaf box geometries (fully
+    # sharded, dp-replicated, dim-0-flattened), a skewed preload (rank
+    # 2's 8MB ballast), and a cross-topology restore (4x2 -> 2x4).
+    results = _launch_workers(
+        _WORKER4, tmp_path, nprocs=4, extra_env=_NO_SLABS
+    )
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} OK4" in out
+
+    manifests = [
+        (tmp_path / f"manifest_{r}.txt").read_text() for r in range(4)
+    ]
+    assert all(m == manifests[0] for m in manifests[1:])
+
+    # disjoint write sets whose union covers every manifest shard
+    writes = []
+    for r in range(4):
+        with open(tmp_path / f"writes_{r}.log") as f:
+            writes.append(
+                {line.strip() for line in f if "sharded/" in line}
+            )
+    for a in range(4):
+        for b in range(a + 1, 4):
+            assert not (writes[a] & writes[b]), (a, b)
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+    meta = SnapshotMetadata.from_yaml(
+        (tmp_path / "snap" / ".snapshot_metadata").read_text()
+    )
+    manifest_locations = {
+        s.location
+        for e in meta.manifest.values()
+        if hasattr(e, "shards")
+        for s in e.shards
+    }
+    assert manifest_locations == set().union(*writes)
+
+    # STRICTLY fewer boxes for the ballast-loaded controller: if the
+    # balancer ignored the preload vector, ties would round-robin the
+    # replicated boxes evenly ([6,6,6,6]) and this must fail
+    counts = [len(w) for w in writes]
+    assert counts[2] < min(counts[0], counts[1], counts[3]), counts
+
+
+def test_four_controllers_async_take_peer_failure(tmp_path):
+    # one rank's late storage failure must reach all FOUR ranks' wait()
+    # through the KV commit protocol, and no metadata may be committed
+    results = _launch_workers(
+        _FAULT_WORKER, tmp_path, nprocs=4, extra_env={"TSNP_FAULT_RANK": "2"}
+    )
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} FAULT-OK" in out
+    assert "rank 2 FAULT-RAISED OSError" in results[2][1]
+    for r in (0, 1, 3):
+        assert f"rank {r} FAULT-RAISED RuntimeError" in results[r][1]
+    assert not os.path.exists(tmp_path / "snap" / ".snapshot_metadata")

@@ -569,27 +569,87 @@ def _axon_holders() -> list:
     return _tunnel_holders()
 
 
+def _relay_probe(ports=_RELAY_PORTS) -> tuple:
+    """(state, detail) for the relay transport.  A bare port check says
+    nothing about REMOTE health (the relay is a dumb stdin/stdout byte
+    mux to a remote orchestrator), so diagnoses used to mislabel
+    orchestrator death as generic "transport down".  States:
+
+    - ``no-listener``   — nothing on 808x: the relay process is dead
+      (it exits when its stdin closes).
+    - ``remote-closed`` — the relay accepted but the far side closed
+      the connection within the probe window: the mux survives but the
+      remote orchestrator/terminal refused the dial; a backend init
+      would hang redialing.
+    - ``open-silent``   — accepted and held open with no early close:
+      the only state worth spending a patient backend init on.
+
+    The probe sends NOTHING: on accept, the relay emits a zero-byte
+    open marker upstream and the orchestrator dials the real terminal —
+    writing garbage into that stream could poison a healthy mux slot,
+    while a silent connect+close is indistinguishable from a client
+    giving up early."""
+    import socket
+
+    # probe EVERY port and prefer the healthiest verdict: one degraded
+    # mux channel must not mask a healthy sibling (the relay listens on
+    # several ports; init can ride any of them)
+    best = ("no-listener", "no relay listener on 127.0.0.1:808x")
+    for port in ports:
+        try:
+            conn = socket.create_connection(("127.0.0.1", port), timeout=2)
+        except OSError:
+            continue
+        try:
+            conn.settimeout(3)
+            try:
+                data = conn.recv(1)
+            except socket.timeout:
+                return (
+                    "open-silent",
+                    f"relay :{port} accepted and held the connection open",
+                )
+            except OSError:
+                data = None
+            if not data:
+                best = (
+                    "remote-closed",
+                    f"relay :{port} accepted but the remote side closed "
+                    f"immediately (orchestrator/terminal down)",
+                )
+                continue  # a later port may still be healthy
+            return (
+                "open-silent",
+                f"relay :{port} accepted and sent data",
+            )
+        finally:
+            conn.close()
+    return best
+
+
 def _tunnel_diagnosis() -> str:
     """Fast check of the axon TPU attachment's transport so a dead
-    tunnel yields a precise error instead of N slow init timeouts
-    (backend init blocks forever retrying connect when the relay is
-    gone — round 1's failure mode had no diagnostics at all)."""
+    tunnel yields a precise error naming the actual failure mode
+    instead of N slow init timeouts (backend init blocks forever
+    retrying connect when the relay is gone — round 1's failure mode
+    had no diagnostics at all; rounds 2-3 couldn't tell a dead relay
+    from a dead remote)."""
     # only when the env EXPLICITLY targets the tunneled axon backend —
     # defaulting to the probe on unset env would mislabel ordinary CPU
     # runs (no 808x listener there either) as tunnel failures
     if "axon" not in os.environ.get("JAX_PLATFORMS", ""):
         return ""
-    import socket
-
-    for port in _RELAY_PORTS:
-        try:
-            with socket.create_connection(("127.0.0.1", port), timeout=2):
-                return ""  # something listens: transport looks alive
-        except OSError:
-            continue
+    state, detail = _relay_probe()
+    if state == "open-silent":
+        return ""
+    if state == "no-listener":
+        return (
+            f"TPU tunnel transport down: {detail} (the relay process is "
+            f"dead; backend init would block indefinitely)"
+        )
     return (
-        "TPU tunnel transport down: no relay listener on 127.0.0.1:808x "
-        "(backend init would block indefinitely)"
+        f"TPU tunnel half-dead: {detail} — the local mux is alive but a "
+        f"backend init would hang redialing the remote"
     )
 
 
@@ -684,6 +744,28 @@ def main() -> None:
                 f"live TPU relay connections"
             )
         line, err, rc = _run_child_streaming(attempt_deadline)
+        if (
+            line is None
+            and not diagnosis
+            and "UNAVAILABLE" in (err or "")
+            # RE-probe at failure time: an attempt can run ~23 min and
+            # the relay is known to die mid-session — an UNAVAILABLE
+            # after a mid-attempt relay death is a transport failure,
+            # not lease poisoning (check the listener FIRST before
+            # blaming the lease)
+            and _relay_probe()[0] == "open-silent"
+        ):
+            # the transport is healthy before AND after the attempt yet
+            # init still gave up: that's the lease-poisoning signature
+            # (an earlier killed client's remote claim outliving it) or
+            # an orchestrator that accepts dials but can't reach a chip
+            diagnoses.append(
+                f"attempt {attempt}: relay transport healthy before and "
+                f"after the attempt but backend init returned "
+                f"UNAVAILABLE — remote chip lease poisoned (a killed "
+                f"client's claim not yet expired) or orchestrator up "
+                f"without a reachable chip"
+            )
         if line is not None:
             # a fresh run can be WORSE than an earlier capture (e.g. the
             # link degraded); the driver records our LAST stdout line, so

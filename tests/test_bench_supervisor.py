@@ -213,3 +213,63 @@ def test_success_prints_better_early_capture_last(
     assert rec["value"] == 8.0
     # and the worse fresh run did not overwrite the stored best
     assert json.loads(open(bench._EARLY_PATH).read())["value"] == 8.0
+
+
+def test_relay_probe_states(bench):
+    import socket
+    import threading
+
+    # no listener
+    state, _ = bench._relay_probe(ports=(1,))
+    assert state == "no-listener"
+
+    # listener that accepts and holds the connection open (healthy mux)
+    quiet = socket.socket()
+    quiet.bind(("127.0.0.1", 0))
+    quiet.listen(1)
+    try:
+        state, detail = bench._relay_probe(ports=(quiet.getsockname()[1],))
+        assert state == "open-silent", detail
+    finally:
+        quiet.close()
+
+    # listener that accepts then immediately closes (remote side dead)
+    slam = socket.socket()
+    slam.bind(("127.0.0.1", 0))
+    slam.listen(1)
+
+    def slam_loop():
+        try:
+            c, _ = slam.accept()
+            c.close()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=slam_loop, daemon=True)
+    t.start()
+    try:
+        state, detail = bench._relay_probe(ports=(slam.getsockname()[1],))
+        assert state == "remote-closed", detail
+    finally:
+        slam.close()
+        t.join(timeout=5)
+
+
+def test_tunnel_diagnosis_names_failure_mode(bench, monkeypatch):
+    # diagnosis strings must name the ACTUAL failure mode, not a
+    # generic "transport down" for every case
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(
+        bench, "_relay_probe", lambda ports=None: ("no-listener", "x")
+    )
+    assert "relay process is dead" in bench._tunnel_diagnosis()
+    monkeypatch.setattr(
+        bench, "_relay_probe", lambda ports=None: ("remote-closed", "x")
+    )
+    assert "half-dead" in bench._tunnel_diagnosis()
+    monkeypatch.setattr(
+        bench, "_relay_probe", lambda ports=None: ("open-silent", "x")
+    )
+    assert bench._tunnel_diagnosis() == ""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert bench._tunnel_diagnosis() == ""  # never mislabel CPU runs

@@ -78,3 +78,31 @@ def test_never_launches_over_running_bench(tmp_path, monkeypatch):
     mod.main()
     assert not launched, "attached while another bench held the chip"
     assert "already runs" in (tmp_path / "log").read_text()
+
+
+def test_relay_alive_rejects_remote_closed(monkeypatch):
+    # a live mux whose remote side slams the connection is NOT worth a
+    # patient backend init: the watcher must keep waiting, not launch
+    import threading
+
+    mod = _load()
+    monkeypatch.setattr(mod, "LOG", os.devnull)
+    slam = socket.socket()
+    slam.bind(("127.0.0.1", 0))
+    slam.listen(1)
+
+    def slam_loop():
+        while True:
+            try:
+                c, _ = slam.accept()
+                c.close()
+            except OSError:
+                return
+
+    t = threading.Thread(target=slam_loop, daemon=True)
+    t.start()
+    try:
+        monkeypatch.setattr(mod, "RELAY_PORTS", (slam.getsockname()[1],))
+        assert not mod._relay_alive()
+    finally:
+        slam.close()

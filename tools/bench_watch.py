@@ -32,7 +32,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 LOG = os.path.join(REPO, ".bench_watch.log")
 PIDFILE = os.path.join(REPO, ".bench_watch.pid")
-RELAY_PORTS = (8082, 8083, 8087)
+
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+import bench as _bench  # noqa: E402 — needs REPO on sys.path first
+
+RELAY_PORTS = _bench._RELAY_PORTS  # one source of truth for the ports
 
 
 def _log(msg: str) -> None:
@@ -40,14 +45,22 @@ def _log(msg: str) -> None:
         f.write(f"[{time.strftime('%H:%M:%S')}] {msg}\n")
 
 
+_last_state = [""]
+
+
 def _relay_alive() -> bool:
-    for port in RELAY_PORTS:
-        try:
-            with socket.create_connection(("127.0.0.1", port), timeout=2):
-                return True
-        except OSError:
-            continue
-    return False
+    """True only when the transport is worth a patient backend init:
+    the relay-probe handshake (bench._relay_probe) distinguishes a dead
+    relay process from a live mux whose REMOTE side is down — waiting
+    on the latter as if it were about to recover wastes the watcher's
+    budget on a state only the remote operator can fix.  State
+    transitions are logged so the round's log names the actual failure
+    mode over time."""
+    state, detail = _bench._relay_probe(RELAY_PORTS)
+    if state != _last_state[0]:
+        _log(f"relay state: {state} ({detail})")
+        _last_state[0] = state
+    return state == "open-silent"
 
 
 def _bench_running() -> bool:

@@ -292,3 +292,43 @@ def test_unpack_slab_primitives():
     for a, b in zip(arrays, out):
         assert np.asarray(a).dtype == np.asarray(b).dtype
         assert np.array_equal(np.asarray(a), np.asarray(b)), a
+
+
+def test_big_host_members_bypass_slab():
+    # a big HOST member's slab pack is a pure extra memcpy: members at
+    # or above SLAB_HOST_MEMBER_MAX_BYTES write directly; small ones
+    # still coalesce
+    import numpy as np
+
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.batcher import batch_write_requests
+    from torchsnapshot_tpu.io_types import WriteReq
+    from torchsnapshot_tpu.manifest import ArrayEntry
+    from torchsnapshot_tpu.preparers.array import HostArrayBufferStager
+
+    def req(name, nbytes):
+        entry = ArrayEntry(name, "buffer_protocol", "uint8", [nbytes], False)
+        return entry, WriteReq(
+            path=name,
+            buffer_stager=HostArrayBufferStager(
+                np.zeros(nbytes, np.uint8), defensive_copy=False
+            ),
+        )
+
+    with knobs.override_slab_host_member_max_bytes(1024):
+        entries, reqs = {}, []
+        for name, nb in [("big0", 4096), ("big1", 2048),
+                         ("s0", 100), ("s1", 200), ("s2", 300)]:
+            e, wr = req(name, nb)
+            entries[name] = e
+            reqs.append(wr)
+        out_entries, out_reqs = batch_write_requests(entries, reqs, rank=0)
+    paths = sorted(wr.path for wr in out_reqs)
+    # big members keep their own objects; the three smalls became 1 slab
+    assert "big0" in paths and "big1" in paths
+    assert any(p.startswith("0/batched.") for p in paths)
+    assert len(out_reqs) == 3
+    for name in ("s0", "s1", "s2"):
+        assert out_entries[name].location.startswith("0/batched.")
+    for name in ("big0", "big1"):
+        assert out_entries[name].location == name

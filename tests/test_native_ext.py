@@ -90,3 +90,44 @@ def test_fs_verify_detects_corruption(tmp_path, monkeypatch):
     with knobs.override_fs_verify_writes(True):
         with pytest.raises(OSError, match="crc32c mismatch"):
             plugin.sync_write(WriteIO(path="x", buf=b"payload"))
+
+
+def test_simd_digests_bit_exact_vs_zlib():
+    # the PCLMUL crc32 / AVX2 adler32 fast paths must be bit-compatible
+    # with python's zlib across awkward lengths, seeds, and alignments —
+    # recorded checksums are a durable on-disk contract
+    import random
+    import zlib
+
+    if _csrc.load() is None:
+        pytest.skip("no C++ toolchain")
+    rng = random.Random(11)
+    lengths = [0, 1, 7, 15, 16, 63, 64, 65, 255, 4095, 4096, 4097,
+               5551, 5552, 5553, 65537, 300_001]
+    for n in lengths:
+        data = bytes(rng.getrandbits(8) for _ in range(n))
+        seed = rng.getrandbits(32)
+        assert _csrc.crc32z(data, seed) == zlib.crc32(data, seed) & 0xFFFFFFFF, n
+        aseed = (seed % 65521) or 1
+        assert _csrc.adler32(data, aseed) == zlib.adler32(data, aseed) & 0xFFFFFFFF, n
+        assert _csrc.digest(data) == (
+            zlib.crc32(data) & 0xFFFFFFFF,
+            zlib.adler32(data) & 0xFFFFFFFF,
+        ), n
+    # misaligned views of a larger buffer
+    base = bytes(rng.getrandbits(8) for _ in range(200_000))
+    for off in (1, 3, 7, 15, 31, 63):
+        sub = memoryview(base)[off : off + 100_000]
+        assert _csrc.crc32z(sub, 0) == zlib.crc32(sub) & 0xFFFFFFFF, off
+        assert _csrc.adler32(sub, 1) == zlib.adler32(sub) & 0xFFFFFFFF, off
+
+
+def test_crc32_fast_falls_back_without_lib(monkeypatch):
+    import zlib
+
+    from torchsnapshot_tpu.utils.checksums import crc32_fast
+
+    data = b"fallback-path-check" * 100
+    assert crc32_fast(data) == zlib.crc32(data) & 0xFFFFFFFF
+    monkeypatch.setattr(_csrc, "crc32z", lambda d, s=0: None)
+    assert crc32_fast(data) == zlib.crc32(data) & 0xFFFFFFFF

@@ -116,3 +116,46 @@ def test_crc_combine_many_folds():
         for c in crcs[1:]:
             acc = crc32_combine(acc, c, 64)
     assert acc == zlib.crc32(b"".join(pieces))
+
+
+def test_default_knob_overhead_ratio():
+    # round-4 regression guard: defaults (batching + checksums) must stay
+    # within a small factor of the no-integrity floor on ONE core — the
+    # old behavior (slab-packing big host members + scalar-ish digests)
+    # was 11x.  Ratio, not absolute time: shared-box noise hits both
+    # sides equally.  128MB keeps the probe under a second.
+    import time
+
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict, knobs
+
+    arrs = {
+        f"a{i}": np.random.default_rng(i).integers(
+            0, 255, 16 * 1024 * 1024, dtype=np.uint8
+        )
+        for i in range(8)
+    }
+    state = {"app": StateDict(**arrs)}
+
+    def best(nobatch=False, nocksum=False):
+        from contextlib import ExitStack
+
+        b = 9e9
+        for _ in range(3):
+            with ExitStack() as st:
+                if nobatch:
+                    st.enter_context(knobs.override_disable_batching(True))
+                if nocksum:
+                    st.enter_context(knobs.override_write_checksums(False))
+                t0 = time.perf_counter()
+                Snapshot.take("memory://probe/ratio", state)
+                b = min(b, time.perf_counter() - t0)
+        return b
+
+    floor = best(nobatch=True, nocksum=True)
+    defaults = best()
+    assert defaults < floor * 6 + 0.05, (
+        f"default-knob overhead regressed: {defaults:.3f}s vs floor "
+        f"{floor:.3f}s ({defaults / floor:.1f}x; round-4 level is ~2.6x)"
+    )

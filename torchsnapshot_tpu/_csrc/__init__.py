@@ -225,6 +225,24 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint32),
         ]
+        lib.tsnp_crc32z.restype = ctypes.c_uint32
+        lib.tsnp_crc32z.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_uint32,
+        ]
+        lib.tsnp_adler32.restype = ctypes.c_uint32
+        lib.tsnp_adler32.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_uint32,
+        ]
+        lib.tsnp_digest.restype = None
+        lib.tsnp_digest.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
         _lib = lib
         return _lib
 
@@ -245,6 +263,44 @@ def crc32c(data, seed: int = 0) -> Optional[int]:
     if view.nbytes == 0:
         return int(lib.tsnp_crc32c(None, 0, seed))
     return int(lib.tsnp_crc32c(_buffer_address(view), view.nbytes, seed))
+
+
+def crc32z(data, seed: int = 0) -> Optional[int]:
+    """zlib-polynomial crc32 (bit-compatible with zlib.crc32) via the
+    native PCLMUL path; None when the lib is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    view = memoryview(data).cast("B")
+    if view.nbytes == 0:
+        return seed
+    return int(lib.tsnp_crc32z(_buffer_address(view), view.nbytes, seed))
+
+
+def adler32(data, seed: int = 1) -> Optional[int]:
+    """adler32 (bit-compatible with zlib.adler32) via the native AVX2
+    path; None when the lib is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    view = memoryview(data).cast("B")
+    if view.nbytes == 0:
+        return seed
+    return int(lib.tsnp_adler32(_buffer_address(view), view.nbytes, seed))
+
+
+def digest(data) -> Optional[tuple]:
+    """(crc32, adler32) of ``data`` in one native call (no copy); None
+    when the lib is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    view = memoryview(data).cast("B")
+    if view.nbytes == 0:
+        return (0, 1)
+    out = (ctypes.c_uint32 * 2)()
+    lib.tsnp_digest(_buffer_address(view), view.nbytes, out)
+    return (int(out[0]), int(out[1]))
 
 
 def copy_digest(dst, src) -> Optional[tuple]:

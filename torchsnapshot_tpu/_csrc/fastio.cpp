@@ -24,6 +24,20 @@
 #include <zlib.h>
 #endif
 
+// ISA fast paths: compile-time guards are safe here because the build
+// uses -march=native and caches the .so under a CPU-feature fingerprint
+// (_csrc/__init__.py) — a binary can never run on a host older than the
+// one that compiled it.
+#if defined(__PCLMUL__) && defined(__SSE4_1__)
+#define TSNP_HAVE_CLMUL 1
+#endif
+#if defined(__AVX2__)
+#define TSNP_HAVE_AVX2 1
+#endif
+#if defined(TSNP_HAVE_CLMUL) || defined(TSNP_HAVE_AVX2)
+#include <immintrin.h>
+#endif
+
 extern "C" {
 
 // Write buf[0:size] to path (create/truncate). Returns 0 on success,
@@ -143,6 +157,243 @@ __attribute__((constructor)) static void tsnp_init_crc_tables() {
   init_slice8_tables(0xEDB88320u, crc32z_table);
 }
 
+// ---------------------------------------------------------------- zlib crc32
+// Internal state convention: "state" is the inverted running register
+// (zlib value v == ~state); callers convert at the boundary.
+
+static uint32_t crc32z_slice8(uint32_t state, const uint8_t *s, int64_t n) {
+  uint32_t crc = state;
+#if TSNP_LITTLE_ENDIAN
+  while (n >= 8) {
+    uint64_t chunk;
+    memcpy(&chunk, s, 8);
+    crc ^= static_cast<uint32_t>(chunk);
+    uint32_t hi = static_cast<uint32_t>(chunk >> 32);
+    crc = crc32z_table[7][crc & 0xff] ^ crc32z_table[6][(crc >> 8) & 0xff] ^
+          crc32z_table[5][(crc >> 16) & 0xff] ^ crc32z_table[4][crc >> 24] ^
+          crc32z_table[3][hi & 0xff] ^ crc32z_table[2][(hi >> 8) & 0xff] ^
+          crc32z_table[1][(hi >> 16) & 0xff] ^ crc32z_table[0][hi >> 24];
+    s += 8;
+    n -= 8;
+  }
+#endif
+  while (n > 0) {
+    crc = crc32z_table[0][(crc ^ *s) & 0xff] ^ (crc >> 8);
+    s++;
+    n--;
+  }
+  return crc;
+}
+
+#if defined(TSNP_HAVE_CLMUL)
+// PCLMUL fold-by-4 for the reflected 0xEDB88320 polynomial (the classic
+// Gopal/Intel construction; constants are the standard IEEE-crc32 fold
+// multipliers).  Processes len bytes (len >= 64, len % 16 == 0) against
+// the inverted running state; returns the new inverted state.
+static uint32_t crc32z_clmul(uint32_t state, const uint8_t *buf,
+                             int64_t len) {
+  // _mm_set_epi64x takes (high, low): low qword folds pair with imm
+  // 0x00, high with 0x11 — k1/k3 are the low-qword multipliers
+  const __m128i k1k2 = _mm_set_epi64x(0x01c6e41596, 0x0154442bd4);
+  const __m128i k3k4 = _mm_set_epi64x(0x00ccaa009e, 0x01751997d0);
+  const __m128i k5k0 = _mm_set_epi64x(0x0000000000, 0x0163cd6124);
+  const __m128i poly = _mm_set_epi64x(0x01f7011641, 0x01db710641);
+  __m128i x1 = _mm_loadu_si128(reinterpret_cast<const __m128i *>(buf));
+  __m128i x2 = _mm_loadu_si128(reinterpret_cast<const __m128i *>(buf + 16));
+  __m128i x3 = _mm_loadu_si128(reinterpret_cast<const __m128i *>(buf + 32));
+  __m128i x4 = _mm_loadu_si128(reinterpret_cast<const __m128i *>(buf + 48));
+  x1 = _mm_xor_si128(x1, _mm_cvtsi32_si128(static_cast<int>(state)));
+  buf += 64;
+  len -= 64;
+  while (len >= 64) {
+    __m128i x5 = _mm_clmulepi64_si128(x1, k1k2, 0x00);
+    __m128i x6 = _mm_clmulepi64_si128(x2, k1k2, 0x00);
+    __m128i x7 = _mm_clmulepi64_si128(x3, k1k2, 0x00);
+    __m128i x8 = _mm_clmulepi64_si128(x4, k1k2, 0x00);
+    x1 = _mm_clmulepi64_si128(x1, k1k2, 0x11);
+    x2 = _mm_clmulepi64_si128(x2, k1k2, 0x11);
+    x3 = _mm_clmulepi64_si128(x3, k1k2, 0x11);
+    x4 = _mm_clmulepi64_si128(x4, k1k2, 0x11);
+    x1 = _mm_xor_si128(_mm_xor_si128(x1, x5),
+                       _mm_loadu_si128(reinterpret_cast<const __m128i *>(buf)));
+    x2 = _mm_xor_si128(_mm_xor_si128(x2, x6),
+                       _mm_loadu_si128(reinterpret_cast<const __m128i *>(buf + 16)));
+    x3 = _mm_xor_si128(_mm_xor_si128(x3, x7),
+                       _mm_loadu_si128(reinterpret_cast<const __m128i *>(buf + 32)));
+    x4 = _mm_xor_si128(_mm_xor_si128(x4, x8),
+                       _mm_loadu_si128(reinterpret_cast<const __m128i *>(buf + 48)));
+    buf += 64;
+    len -= 64;
+  }
+  // fold the four accumulators into one
+  __m128i x5 = _mm_clmulepi64_si128(x1, k3k4, 0x00);
+  x1 = _mm_clmulepi64_si128(x1, k3k4, 0x11);
+  x1 = _mm_xor_si128(_mm_xor_si128(x1, x5), x2);
+  x5 = _mm_clmulepi64_si128(x1, k3k4, 0x00);
+  x1 = _mm_clmulepi64_si128(x1, k3k4, 0x11);
+  x1 = _mm_xor_si128(_mm_xor_si128(x1, x5), x3);
+  x5 = _mm_clmulepi64_si128(x1, k3k4, 0x00);
+  x1 = _mm_clmulepi64_si128(x1, k3k4, 0x11);
+  x1 = _mm_xor_si128(_mm_xor_si128(x1, x5), x4);
+  // remaining whole 16-byte blocks
+  while (len >= 16) {
+    x5 = _mm_clmulepi64_si128(x1, k3k4, 0x00);
+    x1 = _mm_clmulepi64_si128(x1, k3k4, 0x11);
+    x1 = _mm_xor_si128(_mm_xor_si128(x1, x5),
+                       _mm_loadu_si128(reinterpret_cast<const __m128i *>(buf)));
+    buf += 16;
+    len -= 16;
+  }
+  // fold 128 -> 64 bits
+  const __m128i mask32 = _mm_setr_epi32(~0, 0, ~0, 0);
+  __m128i x0 = _mm_clmulepi64_si128(x1, k3k4, 0x10);
+  x1 = _mm_srli_si128(x1, 8);
+  x1 = _mm_xor_si128(x1, x0);
+  x0 = _mm_srli_si128(x1, 4);
+  x1 = _mm_and_si128(x1, mask32);
+  x1 = _mm_clmulepi64_si128(x1, k5k0, 0x00);
+  x1 = _mm_xor_si128(x1, x0);
+  // Barrett reduction 64 -> 32 bits
+  x0 = _mm_and_si128(x1, mask32);
+  x0 = _mm_clmulepi64_si128(x0, poly, 0x10);
+  x0 = _mm_and_si128(x0, mask32);
+  x0 = _mm_clmulepi64_si128(x0, poly, 0x00);
+  x1 = _mm_xor_si128(x1, x0);
+  return static_cast<uint32_t>(_mm_extract_epi32(x1, 1));
+}
+#endif  // TSNP_HAVE_CLMUL
+
+// zlib-value-convention running update: v' = update(v, bytes); matches
+// python zlib.crc32(bytes, v).
+static uint32_t crc32z_update(uint32_t v, const uint8_t *s, int64_t n) {
+  if (n <= 0)
+    return v;
+  uint32_t state = ~v;
+#if defined(TSNP_HAVE_CLMUL)
+  if (n >= 64) {
+    int64_t simd = n & ~static_cast<int64_t>(15);
+    state = crc32z_clmul(state, s, simd);
+    s += simd;
+    n -= simd;
+  }
+#elif defined(TSNP_USE_ZLIB)
+  // system zlib's crc32 is SIMD on most distros — use it when our own
+  // PCLMUL path wasn't compiled in.  Chunked: zlib takes uInt lengths,
+  // and an unchunked cast would silently truncate >=4GiB buffers.
+  while (n > 0) {
+    int64_t blk = n > (1 << 30) ? (1 << 30) : n;
+    v = static_cast<uint32_t>(
+        crc32(static_cast<uLong>(v), s, static_cast<uInt>(blk)));
+    s += blk;
+    n -= blk;
+  }
+  return v;
+#endif
+  state = crc32z_slice8(state, s, n);
+  return ~state;
+}
+
+// ---------------------------------------------------------------- adler32
+
+#if defined(TSNP_HAVE_AVX2)
+// AVX2 adler32: per 32-byte chunk c (local byte offset 32*c) keep three
+// exact vector accumulators —
+//   acc_cs  += chunk byte sums            (for S1)
+//   acc_ccs += c * chunk byte sums        (for the 32*sum(c*cs) term)
+//   acc_w   += sum_j j*s_j within chunk   (maddubs against 0..31)
+// — then close each <=4096-byte window with the same closed form the
+// scalar path uses: S2 = 32*sum(c*cs) + W, b' = b + m*a + m*S1 - S2.
+// All lanes stay far from overflow (cs<=2040/lane, c<128, W-lane <=
+// 31110 per chunk * 128 chunks).
+static void adler32_avx2_window(const uint8_t *s, int64_t m, uint32_t *pa,
+                                uint32_t *pb) {
+  const __m256i zero = _mm256_setzero_si256();
+  const __m256i jw = _mm256_setr_epi8(0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11,
+                                      12, 13, 14, 15, 16, 17, 18, 19, 20, 21,
+                                      22, 23, 24, 25, 26, 27, 28, 29, 30, 31);
+  const __m256i ones16 = _mm256_set1_epi16(1);
+  const uint32_t MOD = 65521u;
+  __m256i acc_cs = zero, acc_ccs = zero, acc_w = zero;
+  int64_t chunks = m / 32;
+  for (int64_t c = 0; c < chunks; c++) {
+    __m256i bytes =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i *>(s + c * 32));
+    __m256i cs = _mm256_sad_epu8(bytes, zero);  // 4 x u64 partial sums
+    acc_cs = _mm256_add_epi64(acc_cs, cs);
+    acc_ccs = _mm256_add_epi64(
+        acc_ccs, _mm256_mul_epu32(cs, _mm256_set1_epi32(static_cast<int>(c))));
+    __m256i w16 = _mm256_maddubs_epi16(bytes, jw);  // u8 * s8 pairs -> s16
+    acc_w = _mm256_add_epi32(acc_w, _mm256_madd_epi16(w16, ones16));
+  }
+  // horizontal sums
+  uint64_t cs_l[4], ccs_l[4];
+  uint32_t w_l[8];
+  _mm256_storeu_si256(reinterpret_cast<__m256i *>(cs_l), acc_cs);
+  _mm256_storeu_si256(reinterpret_cast<__m256i *>(ccs_l), acc_ccs);
+  _mm256_storeu_si256(reinterpret_cast<__m256i *>(w_l), acc_w);
+  uint64_t S1v = cs_l[0] + cs_l[1] + cs_l[2] + cs_l[3];
+  uint64_t CCS = ccs_l[0] + ccs_l[1] + ccs_l[2] + ccs_l[3];
+  uint64_t W = 0;
+  for (int i = 0; i < 8; i++)
+    W += w_l[i];
+  int64_t done = chunks * 32;
+  uint64_t S1 = S1v, S2 = 32u * CCS + W;
+  // scalar tail of the window
+  for (int64_t k = done; k < m; k++) {
+    S1 += s[k];
+    S2 += static_cast<uint64_t>(k) * s[k];
+  }
+  uint64_t a = *pa, b = *pb;
+  uint64_t mm = static_cast<uint64_t>(m);
+  uint64_t bb = b + mm * a + mm * S1 - S2;
+  *pa = static_cast<uint32_t>((a + S1) % MOD);
+  *pb = static_cast<uint32_t>(bb % MOD);
+}
+#endif  // TSNP_HAVE_AVX2
+
+static uint32_t adler32_update(uint32_t adler, const uint8_t *s, int64_t n) {
+  if (n <= 0)
+    return adler;
+#if defined(TSNP_HAVE_AVX2)
+  uint32_t a = adler & 0xffff, b = (adler >> 16) & 0xffff;
+  while (n > 0) {
+    int64_t m = n > 4096 ? 4096 : n;
+    adler32_avx2_window(s, m, &a, &b);
+    s += m;
+    n -= m;
+  }
+  return (b << 16) | a;
+#elif defined(TSNP_USE_ZLIB)
+  // chunked for the same uInt-truncation reason as crc32z_update
+  while (n > 0) {
+    int64_t blk = n > (1 << 30) ? (1 << 30) : n;
+    adler = static_cast<uint32_t>(
+        adler32(static_cast<uLong>(adler), s, static_cast<uInt>(blk)));
+    s += blk;
+    n -= blk;
+  }
+  return adler;
+#else
+  const uint32_t MOD = 65521u;
+  uint32_t a = adler & 0xffff, b = (adler >> 16) & 0xffff;
+  while (n > 0) {
+    int64_t m = n > 5552 ? 5552 : n;
+    uint64_t s1 = 0, s2 = 0;
+    for (int64_t k = 0; k < m; k++) {
+      s1 += s[k];
+      s2 += static_cast<uint64_t>(k) * s[k];
+    }
+    uint64_t mm = static_cast<uint64_t>(m);
+    uint64_t bb = b + mm * a + mm * s1 - s2;
+    a = static_cast<uint32_t>((a + s1) % MOD);
+    b = static_cast<uint32_t>(bb % MOD);
+    s += m;
+    n -= m;
+  }
+  return (b << 16) | a;
+#endif
+}
+
 uint32_t tsnp_crc32c(const void *buf, int64_t size, uint32_t seed) {
   uint32_t crc = ~seed;
   const uint8_t *p = static_cast<const uint8_t *>(buf);
@@ -168,8 +419,41 @@ uint32_t tsnp_crc32c(const void *buf, int64_t size, uint32_t seed) {
   return ~crc;
 }
 
+// Running zlib-polynomial crc32, bit-compatible with python's
+// zlib.crc32(data, seed).  PCLMUL fold-by-4 when compiled in, else
+// system zlib (SIMD on most distros), else slice-by-8.
+uint32_t tsnp_crc32z(const void *buf, int64_t size, uint32_t seed) {
+  return crc32z_update(seed, static_cast<const uint8_t *>(buf), size);
+}
+
+// Running adler32, bit-compatible with python's zlib.adler32(data, seed).
+uint32_t tsnp_adler32(const void *buf, int64_t size, uint32_t seed) {
+  return adler32_update(seed, static_cast<const uint8_t *>(buf), size);
+}
+
+// (crc32, adler32) of a buffer WITHOUT copying — the direct
+// (non-slabbed) write path digests the staged bytes in place.
+// Interleaved per 256KB block so the adler pass hits cache instead of
+// re-reading DRAM (same structure as tsnp_copy_digest).  Runs entirely
+// outside the GIL (ctypes).
+void tsnp_digest(const void *src, int64_t size, uint32_t *out) {
+  const uint8_t *p = static_cast<const uint8_t *>(src);
+  uint32_t crc = 0, adl = 1;
+  int64_t off = 0;
+  while (off < size) {
+    int64_t blk = size - off;
+    if (blk > 262144)
+      blk = 262144;
+    crc = crc32z_update(crc, p + off, blk);
+    adl = adler32_update(adl, p + off, blk);
+    off += blk;
+  }
+  out[0] = crc;
+  out[1] = adl;
+}
+
 // memcpy src -> dst while computing zlib crc32 AND adler32 of the bytes,
-// processed in 64KB blocks so each block is digested while still hot in
+// processed in 256KB blocks so each block is digested while still hot in
 // cache: memory traffic is one read + one write instead of the three
 // read passes of copy-then-crc-then-adler.  out[0] = crc32 (zlib
 // finalized), out[1] = adler32.  Runs entirely outside the GIL (ctypes).
@@ -177,78 +461,19 @@ void tsnp_copy_digest(void *dst, const void *src, int64_t size,
                       uint32_t *out) {
   const uint8_t *p = static_cast<const uint8_t *>(src);
   uint8_t *q = static_cast<uint8_t *>(dst);
-#if defined(TSNP_USE_ZLIB)
-  uLong zcrc = crc32(0L, Z_NULL, 0);
-  uLong zadl = adler32(0L, Z_NULL, 0);
-  int64_t zoff = 0;
-  while (zoff < size) {
-    int64_t blk = size - zoff;
-    if (blk > 65536)
-      blk = 65536;
-    memcpy(q + zoff, p + zoff, static_cast<size_t>(blk));
-    zcrc = crc32(zcrc, p + zoff, static_cast<uInt>(blk));
-    zadl = adler32(zadl, p + zoff, static_cast<uInt>(blk));
-    zoff += blk;
-  }
-  out[0] = static_cast<uint32_t>(zcrc);
-  out[1] = static_cast<uint32_t>(zadl);
-  return;
-#else
-  uint32_t crc = 0xFFFFFFFFu;
-  const uint32_t MOD = 65521u;
-  uint32_t a = 1, b = 0;
+  uint32_t crc = 0, adl = 1;
   int64_t off = 0;
   while (off < size) {
     int64_t blk = size - off;
-    if (blk > 65536)
-      blk = 65536;
+    if (blk > 262144)
+      blk = 262144;
     memcpy(q + off, p + off, static_cast<size_t>(blk));
-    const uint8_t *s = p + off;
-    int64_t n = blk;
-#if TSNP_LITTLE_ENDIAN
-    while (n >= 8) {
-      uint64_t chunk;
-      memcpy(&chunk, s, 8);
-      crc ^= static_cast<uint32_t>(chunk);
-      uint32_t hi = static_cast<uint32_t>(chunk >> 32);
-      crc = crc32z_table[7][crc & 0xff] ^ crc32z_table[6][(crc >> 8) & 0xff] ^
-            crc32z_table[5][(crc >> 16) & 0xff] ^ crc32z_table[4][crc >> 24] ^
-            crc32z_table[3][hi & 0xff] ^ crc32z_table[2][(hi >> 8) & 0xff] ^
-            crc32z_table[1][(hi >> 16) & 0xff] ^ crc32z_table[0][hi >> 24];
-      s += 8;
-      n -= 8;
-    }
-#endif
-    while (n > 0) {
-      crc = crc32z_table[0][(crc ^ *s) & 0xff] ^ (crc >> 8);
-      s++;
-      n--;
-    }
-    // adler32 per 5552-byte window via the closed form
-    //   a' = a + S1,  b' = b + m*a + m*S1 - S2
-    // with S1 = sum(s[k]), S2 = sum(k*s[k]) — both plain reductions the
-    // compiler can vectorize, unlike the scalar b += a dependency chain
-    s = p + off;
-    n = blk;
-    while (n > 0) {
-      int64_t m = n > 5552 ? 5552 : n;
-      uint64_t s1 = 0, s2 = 0;
-      for (int64_t k = 0; k < m; k++) {
-        s1 += s[k];
-        s2 += static_cast<uint64_t>(k) * s[k];
-      }
-      uint64_t mm = static_cast<uint64_t>(m);
-      uint64_t bb = b + mm * a + mm * s1 - s2;
-      a = static_cast<uint32_t>((a + s1) % MOD);
-      b = static_cast<uint32_t>(bb % MOD);
-      s += m;
-      n -= m;
-    }
+    crc = crc32z_update(crc, p + off, blk);
+    adl = adler32_update(adl, p + off, blk);
     off += blk;
   }
-  out[0] = ~crc;
-  out[1] = (b << 16) | a;
-#endif  // TSNP_USE_ZLIB
+  out[0] = crc;
+  out[1] = adl;
 }
 
 }  // extern "C"

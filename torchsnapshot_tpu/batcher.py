@@ -199,13 +199,24 @@ def batch_write_requests(
 ) -> Tuple[Dict[str, Entry], List[WriteReq]]:
     """Coalesce small array writes into ≥slab-threshold objects (reference
     batch_write_requests, batcher.py:204-355)."""
+    from .preparers.array import JaxArrayBufferStager
+
     threshold = knobs.get_slab_size_threshold_bytes()
+    host_member_max = knobs.get_slab_host_member_max_bytes()
     targets = _byte_range_targets(entries)
     small: List[Tuple[WriteReq, int]] = []
     rest: List[WriteReq] = []
     for wr in write_reqs:
         cost = wr.buffer_stager.get_staging_cost_bytes()
-        if wr.path in targets and 0 < cost < threshold:
+        # big HOST members skip the slab: their pack is a pure extra
+        # memcpy with nothing left to amortize.  Device members stay
+        # eligible at any size — the device pack collapses N transfers
+        # into one (the win that matters on a tunneled D2H link).
+        fits = 0 < cost < threshold and (
+            cost < host_member_max
+            or isinstance(wr.buffer_stager, JaxArrayBufferStager)
+        )
+        if wr.path in targets and fits:
             small.append((wr, cost))
         else:
             rest.append(wr)

@@ -106,10 +106,10 @@ def check_read_crc(read_req: "ReadReq", buf: Any) -> None:
     """VERIFY_ON_RESTORE: fail loudly when a whole-payload read doesn't
     match its manifest-recorded checksum (shared by the scheduler's
     request-level check and the batcher's per-member slice check)."""
-    import zlib
+    from .utils.checksums import crc32_fast
 
     expected = read_req.expected_crc32
-    actual = zlib.crc32(memoryview(buf).cast("B")) & 0xFFFFFFFF
+    actual = crc32_fast(memoryview(buf).cast("B"))
     if actual != expected:
         raise RuntimeError(
             f"checksum mismatch reading {read_req.path!r} "

@@ -20,6 +20,7 @@ _ENV_PREFIX = "TORCHSNAPSHOT_TPU_"
 _MAX_CHUNK_SIZE_BYTES = "MAX_CHUNK_SIZE_BYTES"
 _MAX_SHARD_SIZE_BYTES = "MAX_SHARD_SIZE_BYTES"
 _SLAB_SIZE_THRESHOLD_BYTES = "SLAB_SIZE_THRESHOLD_BYTES"
+_SLAB_HOST_MEMBER_MAX_BYTES = "SLAB_HOST_MEMBER_MAX_BYTES"
 _MAX_PER_RANK_IO_CONCURRENCY = "MAX_PER_RANK_IO_CONCURRENCY"
 _DISABLE_BATCHING = "DISABLE_BATCHING"
 _PER_RANK_MEMORY_BUDGET_BYTES = "PER_RANK_MEMORY_BUDGET_BYTES"
@@ -43,6 +44,15 @@ _DEFAULTS = {
     _MAX_CHUNK_SIZE_BYTES: 512 * 1024 * 1024,
     # Per-shard subdivision limit for sharded arrays (reference knobs.py:48-53).
     _MAX_SHARD_SIZE_BYTES: 512 * 1024 * 1024,
+    # HOST-staged members at or above this size are exempt from slab
+    # packing: for a big numpy/host buffer the pack is a pure extra
+    # memcpy (slab alloc + copy-in + copy-out) with no per-object
+    # overhead left to amortize, and it serializes behind the slab.
+    # Device (jax.Array) members stay slab-eligible at ANY size — the
+    # device pack turns N transfers into one, which dominates on a
+    # tunneled/slow D2H link.  Raise to restore old always-pack
+    # behavior; lower toward 0 to disable host packing entirely.
+    _SLAB_HOST_MEMBER_MAX_BYTES: 4 * 1024 * 1024,
     # Write requests smaller than this are coalesced into slabs
     # (reference 128MB, knobs.py:55-60).
     _SLAB_SIZE_THRESHOLD_BYTES: 128 * 1024 * 1024,
@@ -155,6 +165,10 @@ def get_max_shard_size_bytes() -> int:
 
 def get_slab_size_threshold_bytes() -> int:
     return _get_int(_SLAB_SIZE_THRESHOLD_BYTES)
+
+
+def get_slab_host_member_max_bytes() -> int:
+    return _get_int(_SLAB_HOST_MEMBER_MAX_BYTES)
 
 
 def get_max_per_rank_io_concurrency() -> int:
@@ -335,6 +349,10 @@ def override_max_shard_size_bytes(value: int):
 
 def override_slab_size_threshold_bytes(value: int):
     return _override(_SLAB_SIZE_THRESHOLD_BYTES, value)
+
+
+def override_slab_host_member_max_bytes(value: int):
+    return _override(_SLAB_HOST_MEMBER_MAX_BYTES, value)
 
 
 def override_max_per_rank_io_concurrency(value: int):

@@ -468,10 +468,10 @@ class _TileCrcFold:
     def record(self, start: int, buf) -> None:
         if not self.want:
             return
-        import zlib
+        from ..utils.checksums import crc32_fast
 
         view = memoryview(buf).cast("B")
-        self.pieces[start] = (zlib.crc32(view) & 0xFFFFFFFF, view.nbytes)
+        self.pieces[start] = (crc32_fast(view), view.nbytes)
 
     def finish(self) -> None:
         if self.want:

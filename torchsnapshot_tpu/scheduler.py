@@ -70,9 +70,12 @@ def _apply_checksum_sinks(buf, sinks, digest_sink=None, precomputed=None) -> Non
     digest FOLDS from the per-piece values (utils/checksums.py) instead
     of re-reading every byte; with a full precomputed set the staged
     data is not touched at all here."""
-    import zlib
-
-    from .utils.checksums import combine_piece_digests
+    from . import _csrc
+    from .utils.checksums import (
+        adler32_fast,
+        combine_piece_digests,
+        crc32_fast,
+    )
 
     view = memoryview(buf).cast("B")
     pre = precomputed or {}
@@ -98,10 +101,8 @@ def _apply_checksum_sinks(buf, sinks, digest_sink=None, precomputed=None) -> Non
             adler = hit[1]
         else:
             piece = view[span[0] : span[1]]
-            crc = zlib.crc32(piece) & 0xFFFFFFFF
-            adler = (
-                zlib.adler32(piece) & 0xFFFFFFFF if can_fold else None
-            )
+            crc = crc32_fast(piece)
+            adler = adler32_fast(piece) if can_fold else None
         sink(crc)
         if can_fold:
             piece_digests[span] = (crc, adler, span[1] - span[0])
@@ -113,13 +114,11 @@ def _apply_checksum_sinks(buf, sinks, digest_sink=None, precomputed=None) -> Non
         )
         digest_sink([crc, adler, total])
     else:
-        digest_sink(
-            [
-                zlib.crc32(view) & 0xFFFFFFFF,
-                zlib.adler32(view) & 0xFFFFFFFF,
-                view.nbytes,
-            ]
-        )
+        # one interleaved native pass when available; else two fast ones
+        d = _csrc.digest(view)
+        if d is None:
+            d = (crc32_fast(view), adler32_fast(view))
+        digest_sink([d[0], d[1], view.nbytes])
 
 
 def get_process_memory_budget_bytes(local_process_count: int = 1) -> int:

@@ -126,3 +126,29 @@ def combine_piece_digests(
         adler = adler32_combine(adler, pa, pn)
         total += pn
     return crc, adler, total
+
+
+def crc32_fast(data, seed: int = 0) -> int:
+    """zlib-compatible crc32 preferring the native PCLMUL path (GIL
+    released, ~2x system zlib); transparent zlib fallback."""
+    from .. import _csrc
+
+    c = _csrc.crc32z(data, seed)
+    if c is not None:
+        return c
+    import zlib
+
+    return zlib.crc32(data, seed) & 0xFFFFFFFF
+
+
+def adler32_fast(data, seed: int = 1) -> int:
+    """zlib-compatible adler32 preferring the native AVX2 path (GIL
+    released, ~3x system zlib); transparent zlib fallback."""
+    from .. import _csrc
+
+    a = _csrc.adler32(data, seed)
+    if a is not None:
+        return a
+    import zlib
+
+    return zlib.adler32(data, seed) & 0xFFFFFFFF

@@ -202,10 +202,10 @@ def _check_crcs(
     buffers its whole payload; 16 concurrent 128MB slabs would otherwise
     spike multi-GB on a small audit VM)."""
     import asyncio
-    import zlib
 
     from .io_types import ReadIO
     from .utils.asyncio_utils import run_in_fresh_loop
+    from .utils.checksums import crc32_fast
 
     targets = _crc_targets(manifest)
     if not targets:
@@ -241,10 +241,7 @@ def _check_crcs(
                         ),
                     )
                     await storage.read(read_io)
-                    actual = (
-                        zlib.crc32(memoryview(read_io.buf).cast("B"))
-                        & 0xFFFFFFFF
-                    )
+                    actual = crc32_fast(memoryview(read_io.buf).cast("B"))
                     return loc, byte_range, crc, actual, None
             except asyncio.CancelledError:
                 raise

@@ -134,15 +134,85 @@ def test_compose_limit_matches_real_gcs():
     assert gcs_mod._MAX_COMPOSE_COMPONENTS == 32
 
 
-def test_s3_fake_fidelity_gated():
-    # boto3/aiobotocore are not in this image; when they are, bind the
-    # S3 fake's recorded calls the same way (until then the S3 suite
-    # remains contract-tested against the fake only).  Deliberately
-    # FAILS the moment boto3 appears, so the gap surfaces as red
-    # instead of silently advertising coverage that doesn't exist.
-    pytest.importorskip("boto3", reason="boto3 not installed")
-    pytest.fail(
-        "boto3 is now installed: implement S3 fake-fidelity binding "
-        "(record the s3 plugin's client calls and bind them against "
-        "botocore's service model, mirroring the GCS test above)"
-    )
+def _drive_s3_flows():
+    """Exercise every boto3-path call site in the S3 plugin; the fake
+    validates each call against the vendored service-model slice
+    (s3_service_model.py) as it records it."""
+    from test_s3_storage import make_plugin as make_s3_plugin
+    from test_s3_storage import run as run_s3
+
+    p = make_s3_plugin()
+    run_s3(p.write(WriteIO(path="obj", buf=bytes(range(64)))))
+    r = ReadIO(path="obj")
+    run_s3(p.read(r))
+    assert bytes(r.buf) == bytes(range(64))
+    rr = ReadIO(path="obj", byte_range=(8, 16))
+    run_s3(p.read(rr))
+    assert bytes(rr.buf) == bytes(range(8, 16))
+    assert run_s3(p.stat("obj")) == 64
+    p._backend.objects[("bkt", "base/obj")] = b"x" * 5
+    run_s3(p.link_from("s3://bkt/base", "obj"))
+    run_s3(p.delete("obj"))
+    return p._backend.validated
+
+
+def test_s3_plugin_calls_validate_against_vendored_model():
+    # VERDICT r3 #3: the S3 fake used to encode only the builder's
+    # ASSUMPTION of the boto3 API.  Every plugin call now validates
+    # against a vendored slice of the S3 service model — the same JSON
+    # shape boto3 clients are generated from — covering operation
+    # names, required members, member-name sets, and value types.
+    validated = _drive_s3_flows()
+    ops_seen = {op for op, _ in validated}
+    assert ops_seen == {
+        "PutObject",
+        "GetObject",
+        "HeadObject",
+        "CopyObject",
+        "DeleteObject",
+    }, f"plugin flows no longer cover the full call surface: {ops_seen}"
+
+
+def test_s3_vendored_model_rejects_drifted_calls():
+    # the validator must actually bite: shapes the real client would
+    # reject (unknown member, missing required, wrong type) fail
+    import s3_service_model as m
+
+    with pytest.raises(m.S3ParamValidationError, match="Unknown param"):
+        m.validate_call("put_object", {"Bucket": "b", "Key": "k", "Rang": "x"})
+    with pytest.raises(m.S3ParamValidationError, match="Missing required"):
+        m.validate_call("get_object", {"Bucket": "b"})
+    with pytest.raises(m.S3ParamValidationError, match="expected str"):
+        m.validate_call("get_object", {"Bucket": "b", "Key": 7})
+    with pytest.raises(m.S3ParamValidationError, match="requires Bucket"):
+        m.validate_call(
+            "copy_object",
+            {"Bucket": "b", "Key": "k", "CopySource": {"Bucket": "s"}},
+        )
+    with pytest.raises(AttributeError):
+        m.validate_call("put_objcet", {"Bucket": "b", "Key": "k"})
+
+
+def test_s3_vendored_model_matches_botocore_when_available():
+    # the vendored slice's own fidelity: the moment botocore appears in
+    # the image, every transcribed operation must exist with IDENTICAL
+    # required lists and a member-name SUPERSET (the real model only
+    # ever grows) — transcription drift surfaces as red
+    botocore = pytest.importorskip("botocore", reason="botocore not installed")
+    import botocore.session
+
+    import s3_service_model as m
+
+    model = botocore.session.get_session().get_service_model("s3")
+    for op_name, slice_ in m.S3_MODEL.items():
+        op = model.operation_model(op_name)  # KeyError = renamed op
+        real_members = set(op.input_shape.members)
+        real_required = set(op.input_shape.required_members)
+        assert real_required == set(slice_["required"]), op_name
+        missing = set(slice_["members"]) - real_members
+        assert not missing, f"{op_name}: vendored members not in real model: {missing}"
+        out_missing = set(slice_["output"]) - set(op.output_shape.members)
+        assert not out_missing, f"{op_name}: outputs drifted: {out_missing}"
+        real_errors = {e.name for e in op.error_shapes}
+        err_missing = set(slice_["errors"]) - real_errors
+        assert not err_missing, f"{op_name}: error codes drifted: {err_missing}"

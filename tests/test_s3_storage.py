@@ -19,17 +19,35 @@ class NoSuchKey(Exception):
 
 
 class FakeBoto3Client:
-    """The put_object/get_object/delete_object surface the plugin uses."""
+    """The put_object/get_object/delete_object surface the plugin uses.
+
+    EVERY call is validated against the vendored S3 service-model slice
+    (s3_service_model.py) before the fake behaves — unknown kwargs,
+    missing required members, or mistyped values fail exactly where the
+    real boto3 client's ParamValidationError would, so the whole S3
+    suite doubles as a fidelity gate with no boto3 in the image."""
 
     def __init__(self):
         self.objects = {}
         self.calls = []
+        self.validated = []  # (operation, kwargs) after model validation
 
-    def put_object(self, Bucket, Key, Body):
+    def _validated(self, python_name, kwargs):
+        from s3_service_model import validate_call
+
+        op = validate_call(python_name, kwargs)
+        self.validated.append((op, dict(kwargs)))
+        return op
+
+    def put_object(self, **kw):
+        self._validated("put_object", kw)
+        Bucket, Key = kw["Bucket"], kw["Key"]
         self.calls.append(("put", Bucket, Key))
-        self.objects[(Bucket, Key)] = bytes(Body)
+        self.objects[(Bucket, Key)] = bytes(kw.get("Body", b""))
 
-    def get_object(self, Bucket, Key, Range=None):
+    def get_object(self, **kw):
+        self._validated("get_object", kw)
+        Bucket, Key, Range = kw["Bucket"], kw["Key"], kw.get("Range")
         self.calls.append(("get", Bucket, Key, Range))
         if (Bucket, Key) not in self.objects:
             raise NoSuchKey(Key)
@@ -40,20 +58,27 @@ class FakeBoto3Client:
             data = data[int(lo) : int(hi) + 1]  # S3 Range end is inclusive
         return {"Body": io.BytesIO(data)}
 
-    def head_object(self, Bucket, Key):
+    def head_object(self, **kw):
+        self._validated("head_object", kw)
+        Bucket, Key = kw["Bucket"], kw["Key"]
         self.calls.append(("head", Bucket, Key))
         if (Bucket, Key) not in self.objects:
             raise NoSuchKey(Key)
         return {"ContentLength": len(self.objects[(Bucket, Key)])}
 
-    def copy_object(self, Bucket, Key, CopySource):
+    def copy_object(self, **kw):
+        self._validated("copy_object", kw)
+        Bucket, Key = kw["Bucket"], kw["Key"]
+        CopySource = kw["CopySource"]
         self.calls.append(("copy", Bucket, Key, tuple(CopySource.items())))
         src = (CopySource["Bucket"], CopySource["Key"])
         if src not in self.objects:
             raise NoSuchKey(CopySource["Key"])
         self.objects[(Bucket, Key)] = self.objects[src]
 
-    def delete_object(self, Bucket, Key):
+    def delete_object(self, **kw):
+        self._validated("delete_object", kw)
+        Bucket, Key = kw["Bucket"], kw["Key"]
         self.calls.append(("delete", Bucket, Key))
         # S3 delete is idempotent: deleting a missing key succeeds
         self.objects.pop((Bucket, Key), None)

@@ -406,3 +406,108 @@ def test_blob_cache_evicts_after_last_consumer():
     assert cache.get(shared) == b"\x01\x02"
     assert not cache._blobs  # last consumer: cache fully drained
     assert sorted(reads) == ["blob/shared", "blob/solo"]  # no refetches
+
+
+def test_reads_real_quantized_snapshot(tmp_path):
+    # quantized embeddings are common in migrating torchrec checkpoints;
+    # the reference stores them via custom binary serializers
+    # (serialization.py:278-477) — import dequantizes to float32 with a
+    # warning instead of refusing
+    if not _reference_available():
+        pytest.skip("reference library / torch not available")
+    sys.path.insert(0, _REFERENCE)
+    try:
+        import torch
+        from torchsnapshot import Snapshot as RefSnapshot, StateDict
+        from torchsnapshot.knobs import override_max_chunk_size_bytes
+
+        torch.manual_seed(3)
+        per_tensor = torch.quantize_per_tensor(
+            torch.randn(6, 4), scale=0.07, zero_point=3, dtype=torch.qint8
+        )
+        per_channel = torch.quantize_per_channel(
+            torch.randn(5, 3),
+            scales=torch.tensor([0.1, 0.02, 0.5]),
+            zero_points=torch.tensor([0, -2, 7]),
+            axis=1,
+            dtype=torch.qint8,
+        )
+        pt32 = torch.quantize_per_tensor(
+            torch.randn(4), scale=0.001, zero_point=0, dtype=torch.qint32
+        )
+        big = torch.quantize_per_tensor(
+            torch.randn(64, 16), scale=0.05, zero_point=1, dtype=torch.qint8
+        )
+        with override_max_chunk_size_bytes(256):  # force chunked quantized
+            RefSnapshot.take(
+                str(tmp_path / "snap"),
+                {
+                    "app": StateDict(
+                        pt=per_tensor, pc=per_channel, pt32=pt32, big=big
+                    )
+                },
+            )
+    finally:
+        sys.path.remove(_REFERENCE)
+    got = read_torchsnapshot(str(tmp_path / "snap"))
+    for name, ref in (
+        ("pt", per_tensor),
+        ("pc", per_channel),
+        ("pt32", pt32),
+        ("big", big),  # ChunkedTensor of torch_save quantized pieces
+    ):
+        arr = got["app"][name]
+        assert arr.dtype == np.float32, name
+        np.testing.assert_allclose(
+            arr, ref.dequantize().numpy(), rtol=0, atol=1e-6, err_msg=name
+        )
+
+
+def test_synthetic_quantized_payloads(tmp_path):
+    # format-rule pin that runs with no torch: hand-packed per-tensor and
+    # per-channel payloads decode via the documented binary layout
+    import struct
+
+    ints = np.array([[-3, 0], [5, 127]], np.int8)
+    pt_payload = ints.tobytes() + struct.pack("d", 0.5) + struct.pack("q", 2)
+    # per-channel on axis 0: scales [1.0, 0.25], zero points [0, -1]
+    pc_ints = np.array([[10, -10], [4, 8]], np.int8)
+    pc_payload = (
+        struct.pack("q", 0)
+        + pc_ints.tobytes()
+        + np.array([1.0, 0.25], np.float64).tobytes()
+        + np.array([0, -1], np.int64).tobytes()
+    )
+    manifest = {
+        "0/app": {"type": "dict", "keys": ["pt", "pc"]},
+        "0/app/pt": {
+            "type": "Tensor", "location": "0/pt",
+            "serializer": "per_tensor_qtensor", "dtype": "torch.qint8",
+            "shape": [2, 2], "replicated": False,
+        },
+        "0/app/pc": {
+            "type": "Tensor", "location": "0/pc",
+            "serializer": "per_channel_qtensor", "dtype": "torch.qint8",
+            "shape": [2, 2], "replicated": False,
+        },
+    }
+    got = read_torchsnapshot(
+        _write_snapshot(
+            tmp_path, manifest, {"0/pt": pt_payload, "0/pc": pc_payload}
+        )
+    )
+    np.testing.assert_allclose(
+        got["app"]["pt"], (ints.astype(np.float64) - 2) * 0.5
+    )
+    np.testing.assert_allclose(
+        got["app"]["pc"],
+        np.array([[10 * 1.0, -10 * 1.0], [(4 + 1) * 0.25, (8 + 1) * 0.25]]),
+    )
+    # corrupted length is refused with the size math in the message
+    with pytest.raises(ValueError, match="implies"):
+        read_torchsnapshot(
+            _write_snapshot(
+                tmp_path / "bad", manifest, {"0/pt": pt_payload + b"x",
+                                             "0/pc": pc_payload}
+            )
+        )

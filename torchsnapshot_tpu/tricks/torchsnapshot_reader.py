@@ -42,11 +42,34 @@ from urllib.parse import unquote
 
 import numpy as np
 
+import logging
+
 from .. import knobs
 from ..io_types import ReadIO
 from ..utils.asyncio_utils import run_in_fresh_loop
 
+logger = logging.getLogger(__name__)
+
 _TORCH_DTYPES: Dict[str, Any] = {}
+
+# one summary warning per read_torchsnapshot call, not one per decoded
+# piece (a torchrec checkpoint can hold hundreds of quantized tables,
+# and a chunked tensor decodes many pieces)
+_quant_warned = False
+
+
+def _warn_dequantized(kind: str, dtype: Any) -> None:
+    global _quant_warned
+    if _quant_warned:
+        return
+    _quant_warned = True
+    logger.warning(
+        "importing quantized payload(s) (first: %s, dtype %s): "
+        "dequantized to float32 — JAX has no affine-quantized dtype, so "
+        "scales/zero-points are consumed by the import; re-quantize "
+        "after migration if needed (warning shown once per import)",
+        kind, dtype,
+    )
 
 
 def _np_dtype(torch_name: str) -> np.dtype:
@@ -75,10 +98,87 @@ def _np_dtype(torch_name: str) -> np.dtype:
         return _TORCH_DTYPES[torch_name]
     except KeyError:
         raise ValueError(
-            f"unsupported reference dtype {torch_name!r} (quantized tensors "
-            f"are not importable — dequantize before saving, or load with "
-            f"the reference library)"
+            f"unsupported reference dtype {torch_name!r} — no numpy/"
+            f"ml_dtypes equivalent (quantized payloads import via their "
+            f"own serializers and dequantize to float32)"
         ) from None
+
+
+# quantized storage dtypes: underlying integer layout per element
+# (reference serialization.py:85-87,105-108)
+_QTENSOR_STORAGE = {
+    "torch.qint8": np.dtype(np.int8),
+    "torch.quint8": np.dtype(np.uint8),
+    "torch.qint32": np.dtype(np.int32),
+}
+_QTENSOR_SERIALIZERS = ("per_tensor_qtensor", "per_channel_qtensor")
+
+
+def _decode_qtensor(
+    data: bytes, serializer: str, dtype: str, shape: List[int]
+) -> np.ndarray:
+    """Decode the reference's custom quantized-tensor payloads
+    (serialization.py:278-477), dequantizing to float32 — JAX has no
+    affine-quantized dtype, so the import surfaces VALUES, with a
+    warning that the quantization parameters are consumed.
+
+    per_tensor (serialization.py:278-311):
+      int storage | f64 q_scale | i64 q_zero_point
+    per_channel (serialization.py:368-409):
+      i64 axis | int storage | f64 scales[shape[axis]] |
+      i64 zero_points[shape[axis]]
+    Dequantization: (int_value - zero_point) * scale.
+    """
+    storage_dtype = _QTENSOR_STORAGE.get(dtype)
+    if storage_dtype is None:
+        raise ValueError(
+            f"{serializer} entry with non-quantized dtype {dtype!r}"
+        )
+    n = 1
+    for s in shape:
+        n *= s
+    data_sz = n * storage_dtype.itemsize
+    if serializer == "per_tensor_qtensor":
+        if len(data) != data_sz + 16:
+            raise ValueError(
+                f"per_tensor_qtensor payload is {len(data)} bytes; "
+                f"dtype {dtype} shape {tuple(shape)} implies {data_sz + 16}"
+            )
+        ints = np.frombuffer(data, storage_dtype, count=n).reshape(shape)
+        (scale,) = struct.unpack("d", data[data_sz : data_sz + 8])
+        (zero_point,) = struct.unpack("q", data[data_sz + 8 : data_sz + 16])
+        out = ((ints.astype(np.float64) - zero_point) * scale).astype(
+            np.float32
+        )
+    else:
+        (axis,) = struct.unpack("q", data[:8])
+        if not 0 <= axis < len(shape):
+            raise ValueError(
+                f"per_channel_qtensor axis {axis} invalid for shape "
+                f"{tuple(shape)}"
+            )
+        ch = shape[axis]
+        if len(data) != 8 + data_sz + 16 * ch:
+            raise ValueError(
+                f"per_channel_qtensor payload is {len(data)} bytes; dtype "
+                f"{dtype} shape {tuple(shape)} axis {axis} implies "
+                f"{8 + data_sz + 16 * ch}"
+            )
+        ints = np.frombuffer(data, storage_dtype, count=n, offset=8).reshape(
+            shape
+        )
+        scales = np.frombuffer(data, np.float64, count=ch, offset=8 + data_sz)
+        zero_points = np.frombuffer(
+            data, np.int64, count=ch, offset=8 + data_sz + 8 * ch
+        )
+        bshape = [1] * len(shape)
+        bshape[axis] = ch
+        out = (
+            (ints.astype(np.float64) - zero_points.reshape(bshape))
+            * scales.reshape(bshape)
+        ).astype(np.float32)
+    _warn_dequantized(serializer, dtype)
+    return out
 
 
 def _read_bytes(storage, location: str, byte_range: Optional[List[int]]) -> bytes:
@@ -180,18 +280,26 @@ def _decode_primitive(entry: dict) -> Any:
 
 def _decode_tensor(blobs: "_BlobCache", entry: dict) -> np.ndarray:
     data = blobs.get(entry)
+    if entry.get("serializer") in _QTENSOR_SERIALIZERS:
+        return _decode_qtensor(
+            data, entry["serializer"], entry["dtype"], entry["shape"]
+        )
     if entry.get("serializer") == "torch_save":
         tensor = _torch_load(data)
+        if getattr(tensor, "is_quantized", False):
+            # the CURRENT reference serializes quantized tensors via
+            # torch_save (io_preparers/tensor.py:70-73 falls back for
+            # any non-buffer-protocol dtype); the custom qtensor
+            # serializers below cover older-format snapshots
+            _warn_dequantized("torch_save", tensor.dtype)
+            return tensor.dequantize().numpy().astype(np.float32)
         try:
             return tensor.numpy()
         except TypeError:
-            # e.g. quantized tensors: torch_save round-trips them but
-            # numpy has no such dtype — surface the remediation instead
-            # of an obscure ScalarType error
             raise ValueError(
                 f"torch_save tensor of dtype {tensor.dtype} has no numpy "
-                f"equivalent (quantized?) — dequantize before saving, or "
-                f"load this snapshot once with the reference library"
+                f"equivalent — cast the leaf before saving, or load this "
+                f"snapshot once with the reference library"
             ) from None
     dtype = _np_dtype(entry["dtype"])
     arr = np.frombuffer(data, dtype=dtype)
@@ -273,7 +381,16 @@ def _assemble_pieces(
             f"shape {tuple(shape)} — incomplete or overlapping pieces "
             f"(elasticity-trimmed or corrupted manifest?)"
         )
-    out = np.empty(tuple(shape), dtype=_np_dtype(dtype))
+    # quantized pieces decode to float32: legacy custom serializers OR
+    # the current reference's torch_save chunks/shards under a
+    # quantized entry dtype (io_preparer chunks any tensor; quantized
+    # chunks get the torch_save serializer)
+    quantized = dtype in _QTENSOR_STORAGE or any(
+        p["tensor"].get("serializer") in _QTENSOR_SERIALIZERS for p in pieces
+    )
+    out = np.empty(
+        tuple(shape), dtype=np.float32 if quantized else _np_dtype(dtype)
+    )
     for piece in pieces:
         sub = _decode_tensor(blobs, piece["tensor"])
         slices = tuple(
@@ -386,6 +503,8 @@ def read_torchsnapshot(
     """
     from ..storage import url_to_storage_plugin
 
+    global _quant_warned
+    _quant_warned = False  # one summary warning per import
     storage = url_to_storage_plugin(path)
     try:
         if metadata is None:

@@ -80,9 +80,9 @@ def test_fs_verify_detects_corruption(tmp_path, monkeypatch):
     assert plugin._lib is not None
     orig_read = plugin._native_read
 
-    def corrupt_read(full, byte_range):
+    def corrupt_read(full, byte_range, into=None):
         out = orig_read(full, byte_range)
-        if out:
+        if len(out):
             out[0] ^= 0xFF
         return out
 

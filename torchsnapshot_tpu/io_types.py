@@ -127,6 +127,15 @@ class ReadReq:
     # (whole entry/shard/chunk — never a tile); checked before consume
     # when knobs VERIFY_ON_RESTORE is on
     expected_crc32: Optional[int] = None
+    # OPTIONAL destination hint: a writable buffer of exactly this
+    # read's byte length (e.g. a numpy restore template's memory).  A
+    # plugin MAY read straight into it and set ``buf = into`` (the fs
+    # plugin's native path does), making host restore a single read
+    # pass with no intermediate buffer — the reference's read-into-
+    # preallocated-tensor property.  Plugins are free to ignore it;
+    # consumers detect honor by identity (``buf is into``) and fall
+    # back to the normal copy otherwise, so ignoring is always safe.
+    into: Any = None
 
 
 @dataclass
@@ -151,6 +160,9 @@ class ReadIO:
     path: str
     byte_range: Optional[List[int]] = None
     buf: Any = field(default=None)  # filled by the plugin
+    # destination hint (see ReadReq.into); honoring plugins read into
+    # it and set ``buf = into``
+    into: Any = None
 
 
 class StoragePlugin(abc.ABC):

@@ -331,10 +331,13 @@ def materialize_into_template(np_arr: np.ndarray, obj_out: Any) -> Any:
 
 
 class ArrayBufferConsumer(BufferConsumer):
-    def __init__(self, entry: ArrayEntry, obj_out: Any, fut: Future):
+    def __init__(
+        self, entry: ArrayEntry, obj_out: Any, fut: Future, into: Any = None
+    ):
         self.entry = entry
         self.obj_out = obj_out
         self.fut = fut
+        self.into = into
 
     # below this, the executor thread-hop costs more than the copy —
     # a 20k-tiny-leaf restore spends most of its wall time in loop
@@ -347,6 +350,11 @@ class ArrayBufferConsumer(BufferConsumer):
     async def consume_buffer(
         self, buf: Any, executor: Optional[Executor] = None
     ) -> None:
+        if self.into is not None and buf is self.into:
+            # the plugin honored the in-place hint: the template already
+            # holds the payload bytes — nothing to copy or cast
+            self.fut.set(self.obj_out)
+            return
         np_arr = array_from_buffer(
             buf, self.entry.dtype, tuple(self.entry.shape)
         )
@@ -585,13 +593,36 @@ class ArrayIOPreparer:
                     )
                 )
             return read_reqs, fut
+        # In-place hint: a numpy template with the stored dtype and
+        # exactly the payload's bytes lets an honoring plugin read
+        # straight into the template (one pass, no intermediate buffer
+        # and no copy — the reference's read-into-preallocated-tensor
+        # property, io_preparers/tensor.py:91-126).  Consumers detect
+        # honor by identity, so plugins without the fast path are
+        # unaffected.
+        into = None
+        if (
+            isinstance(obj_out, np.ndarray)
+            and obj_out.dtype == string_to_dtype(entry.dtype)
+            and obj_out.flags["C_CONTIGUOUS"]
+            and not obj_out.flags["WRITEBACKIFCOPY"]
+            and obj_out.nbytes == total
+            # VERIFY_ON_RESTORE's unbudgeted contract is verify-before-
+            # copy (templates stay pristine on a crc mismatch); reading
+            # in place would dirty the template before the check runs
+            and not knobs.verify_on_restore()
+        ):
+            into = obj_out
         return (
             [
                 ReadReq(
                     path=entry.location,
                     byte_range=list(entry.byte_range) if entry.byte_range else None,
-                    buffer_consumer=ArrayBufferConsumer(entry, obj_out, fut),
+                    buffer_consumer=ArrayBufferConsumer(
+                        entry, obj_out, fut, into=into
+                    ),
                     expected_crc32=getattr(entry, "crc32", None),
+                    into=into,
                 )
             ],
             fut,

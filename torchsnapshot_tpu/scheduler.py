@@ -491,7 +491,11 @@ async def _execute_read_pipelines(
     min_pending_cost = min((p.consuming_cost for p in pipelines), default=0)
 
     async def read_one(p: _ReadPipeline) -> _ReadPipeline:
-        read_io = ReadIO(path=p.read_req.path, byte_range=p.read_req.byte_range)
+        read_io = ReadIO(
+            path=p.read_req.path,
+            byte_range=p.read_req.byte_range,
+            into=p.read_req.into,
+        )
         await storage.read(read_io)
         p.buf = read_io.buf
         return p

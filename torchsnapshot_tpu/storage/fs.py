@@ -156,7 +156,11 @@ class FSStoragePlugin(StoragePlugin):
         full = self._full(read_io.path)
         if self._lib is not None:
             read_io.buf = await asyncio.get_running_loop().run_in_executor(
-                self._executor, self._native_read, full, read_io.byte_range
+                self._executor,
+                self._native_read,
+                full,
+                read_io.byte_range,
+                read_io.into,
             )
             return
         import aiofiles
@@ -169,7 +173,9 @@ class FSStoragePlugin(StoragePlugin):
                 await f.seek(start)
                 read_io.buf = await f.read(end - start)
 
-    def _native_read(self, full: str, byte_range) -> bytearray:
+    def _native_read(self, full: str, byte_range, into=None):
+        import numpy as np
+
         from .._csrc import _buffer_address
 
         if byte_range is None:
@@ -179,15 +185,37 @@ class FSStoragePlugin(StoragePlugin):
             offset, length = 0, size
         else:
             offset, length = byte_range[0], byte_range[1] - byte_range[0]
-        out = bytearray(length)
+        # read straight into the caller's destination (a restore
+        # template's memory) when the hint matches exactly — host
+        # restore then touches the bytes ONCE; otherwise a fresh
+        # UNINITIALIZED buffer (np.empty, not bytearray: zeroing memory
+        # the read is about to overwrite costs a full extra pass)
+        dst = None
+        if into is not None:
+            try:
+                view = memoryview(into).cast("B")
+                if not view.readonly and view.nbytes == length:
+                    dst = into
+            except (TypeError, ValueError):
+                pass  # non-contiguous/exotic hint: ignore, normal path
+        out = dst if dst is not None else np.empty(length, dtype=np.uint8)
         if length:
             n = self._lib.tsnp_read_file(
-                full.encode(), _buffer_address(memoryview(out)), offset, length
+                full.encode(),
+                _buffer_address(memoryview(out).cast("B")),
+                offset,
+                length,
             )
             if n < 0:
                 raise OSError(-n, os.strerror(-n), full)
             if n != length:
-                del out[n:]
+                if dst is not None:
+                    # short read can't satisfy the in-place contract;
+                    # surface it as the I/O error it is
+                    raise OSError(
+                        5, f"short read: {n} of {length} bytes", full
+                    )
+                out = out[:n]
         return out
 
     async def delete(self, path: str) -> None:

@@ -131,3 +131,114 @@ def test_crc32_fast_falls_back_without_lib(monkeypatch):
     assert crc32_fast(data) == zlib.crc32(data) & 0xFFFFFFFF
     monkeypatch.setattr(_csrc, "crc32z", lambda d, s=0: None)
     assert crc32_fast(data) == zlib.crc32(data) & 0xFFFFFFFF
+
+
+def test_fused_write_digest_matches_zlib(tmp_path):
+    # tsnp_write_file_digest: one pass writes the file AND produces the
+    # same (crc32, adler32) zlib would; the file lands byte-identical
+    import ctypes
+    import zlib
+
+    lib = _csrc.load()
+    if lib is None or not hasattr(lib, "tsnp_write_file_digest"):
+        pytest.skip("no C++ toolchain")
+    payload = np.random.default_rng(5).integers(
+        0, 256, 3_000_001, dtype=np.uint8
+    ).tobytes()
+    out = (ctypes.c_uint32 * 2)()
+    dest = str(tmp_path / "obj").encode()
+    rc = lib.tsnp_write_file_digest(
+        dest,
+        _csrc._buffer_address(memoryview(payload)),
+        len(payload),
+        0,
+        out,
+    )
+    assert rc == 0
+    assert open(tmp_path / "obj", "rb").read() == payload
+    assert int(out[0]) == zlib.crc32(payload) & 0xFFFFFFFF
+    assert int(out[1]) == zlib.adler32(payload) & 0xFFFFFFFF
+    # empty payload: digest seeds
+    rc = lib.tsnp_write_file_digest(
+        str(tmp_path / "empty").encode(), None, 0, 0, out
+    )
+    assert rc == 0 and int(out[0]) == 0 and int(out[1]) == 1
+
+
+def test_fs_write_honors_want_digest(tmp_path):
+    import asyncio
+    import zlib
+
+    from torchsnapshot_tpu.io_types import WriteIO
+
+    p = FSStoragePlugin(root=str(tmp_path))
+    if not p.supports_fused_digest:
+        pytest.skip("no native fused digest")
+    payload = b"fused-digest-check" * 1000
+
+    def run(coro):
+        return asyncio.new_event_loop().run_until_complete(coro)
+
+    wio = WriteIO(path="obj", buf=payload, want_digest=True)
+    run(p.write(wio))
+    assert wio.digests == (
+        zlib.crc32(payload) & 0xFFFFFFFF,
+        zlib.adler32(payload) & 0xFFFFFFFF,
+    )
+    # without the request, no digest is computed
+    wio2 = WriteIO(path="obj2", buf=payload)
+    run(p.write(wio2))
+    assert wio2.digests is None
+    run(p.close())
+
+
+def test_fused_digest_checksums_match_pre_write_path(tmp_path):
+    # the fs (fused, deferred) and memory (pre-write) paths must record
+    # IDENTICAL manifest checksums and object digests for equal content.
+    # The fs array is sized ABOVE the slab member cutoff so its write is
+    # a direct whole-buffer-sink request — the deferral condition — and
+    # a spy asserts the fused path actually engaged (a slab-batched
+    # payload would fall through to piece digests and vacuously pass).
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    arrs = {
+        "w": np.random.default_rng(0).integers(
+            0, 255, 8 * 1024 * 1024, np.uint8  # > SLAB_HOST_MEMBER_MAX
+        ),
+        "b": np.arange(100, dtype=np.float64),
+    }
+    fused_writes = []
+    orig_write = FSStoragePlugin.write
+
+    async def spy(self, wio):
+        await orig_write(self, wio)
+        if wio.want_digest:
+            fused_writes.append((wio.path, wio.digests))
+
+    FSStoragePlugin.write = spy
+    try:
+        s_fs = Snapshot.take(str(tmp_path / "fs"), {"app": StateDict(**arrs)})
+    finally:
+        FSStoragePlugin.write = orig_write
+    assert any(
+        d is not None for _, d in fused_writes
+    ), f"fused digest path never engaged: {fused_writes}"
+    s_mem = Snapshot.take("memory://fused/parity", {"app": StateDict(**arrs)})
+
+    def digest_map(snap):
+        return {
+            loc.rsplit("/", 1)[-1]: tuple(d)
+            for loc, d in (snap.metadata.objects or {}).items()
+        }
+
+    def crc_map(snap):
+        return {
+            k: getattr(e, "crc32", None)
+            for k, e in snap.metadata.manifest.items()
+        }
+
+    assert crc_map(s_fs) == crc_map(s_mem)
+    fs_d, mem_d = digest_map(s_fs), digest_map(s_mem)
+    assert fs_d and set(fs_d) == set(mem_d)
+    assert fs_d == mem_d
+    assert s_fs.verify(deep=True).ok

@@ -203,6 +203,21 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_int,
         ]
+        try:
+            # newer symbol: a cached .so from older source that slips
+            # past the mtime freshness check (e.g. artifact restores
+            # stamping fresh mtimes) must degrade to the unfused path,
+            # not crash every native-ext consumer out of load()
+            lib.tsnp_write_file_digest.restype = ctypes.c_int
+            lib.tsnp_write_file_digest.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
+        except AttributeError:
+            logger.debug("loaded fastio lacks tsnp_write_file_digest")
         lib.tsnp_read_file.restype = ctypes.c_int64
         lib.tsnp_read_file.argtypes = [
             ctypes.c_char_p,

@@ -70,6 +70,15 @@ int tsnp_write_file(const char *path, const void *buf, int64_t size,
   return rc;
 }
 
+// tsnp_write_file, fused with the zlib (crc32, adler32) digest of the
+// written bytes: each 256KB block is digested while cache-hot from the
+// same pass that hands it to write(), so a checksummed direct write
+// touches the staged buffer ONCE instead of digest-pass + write-pass.
+// out[0] = crc32, out[1] = adler32.  Declared after the digest helpers;
+// defined at the bottom of this file.
+int tsnp_write_file_digest(const char *path, const void *buf, int64_t size,
+                           int fsync_mode, uint32_t *out);
+
 // Read length bytes at offset from path into buf. offset<0 means 0;
 // length<0 means "to EOF" (caller must size buf via tsnp_file_size).
 // Returns bytes read, or -errno.
@@ -450,6 +459,45 @@ void tsnp_digest(const void *src, int64_t size, uint32_t *out) {
   }
   out[0] = crc;
   out[1] = adl;
+}
+
+int tsnp_write_file_digest(const char *path, const void *buf, int64_t size,
+                           int fsync_mode, uint32_t *out) {
+  int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+  if (fd < 0)
+    return -errno;
+  const uint8_t *p = static_cast<const uint8_t *>(buf);
+  uint32_t crc = 0, adl = 1;
+  int64_t remaining = size;
+  while (remaining > 0) {
+    int64_t blk = remaining > 262144 ? 262144 : remaining;
+    // digest first (pulls the block into cache), then write() (the
+    // kernel's copy reads it back out of cache)
+    crc = crc32z_update(crc, p, blk);
+    adl = adler32_update(adl, p, blk);
+    int64_t off = 0;
+    while (off < blk) {
+      ssize_t n = write(fd, p + off, static_cast<size_t>(blk - off));
+      if (n < 0) {
+        if (errno == EINTR)
+          continue;
+        int err = errno;
+        close(fd);
+        return -err;
+      }
+      off += n;
+    }
+    p += blk;
+    remaining -= blk;
+  }
+  out[0] = crc;
+  out[1] = adl;
+  int rc = 0;
+  if (fsync_mode == 1 && fdatasync(fd) != 0)
+    rc = -errno;
+  if (close(fd) != 0 && rc == 0)
+    rc = -errno;
+  return rc;
 }
 
 // memcpy src -> dst while computing zlib crc32 AND adler32 of the bytes,

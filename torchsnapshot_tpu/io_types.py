@@ -153,6 +153,15 @@ class WriteIO:
     # write (costs write throughput).  Object stores (the production
     # target) are durable-on-success by nature and ignore all of this.
     durable: bool = False
+    # Digest request: the caller wants the zlib (crc32, adler32) of
+    # ``buf``.  A plugin MAY compute it fused with its write (the fs
+    # native path digests each block cache-hot in the same pass that
+    # hands it to write(2)) and set ``digests``; plugins that don't are
+    # fine — the scheduler computes post-write when ``digests`` is
+    # still None.  Saves one full read pass over every checksummed
+    # direct write on honoring plugins.
+    want_digest: bool = False
+    digests: Optional[Tuple[int, int]] = None  # set by honoring plugins
 
 
 @dataclass
@@ -167,6 +176,13 @@ class ReadIO:
 
 class StoragePlugin(abc.ABC):
     """Async storage backend (reference io_types.py:80-120)."""
+
+    # True when this plugin honors WriteIO.want_digest by computing the
+    # (crc32, adler32) fused with its write (one pass over the staged
+    # bytes).  The scheduler only DEFERS checksum work to the write for
+    # such plugins — on anything else the pre-write digest path keeps
+    # its staging-phase overlap.
+    supports_fused_digest: bool = False
 
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None: ...

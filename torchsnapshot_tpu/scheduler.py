@@ -173,7 +173,14 @@ class _WritePipeline:
     """One write request's journey through the pipeline (reference
     scheduler.py:70-97)."""
 
-    __slots__ = ("write_req", "staging_cost", "buf", "buf_size", "deduped")
+    __slots__ = (
+        "write_req",
+        "staging_cost",
+        "buf",
+        "buf_size",
+        "deduped",
+        "defer_digest",
+    )
 
     def __init__(self, write_req: WriteReq) -> None:
         self.write_req = write_req
@@ -181,6 +188,9 @@ class _WritePipeline:
         self.buf = None
         self.buf_size = 0
         self.deduped = False
+        # checksums deferred to the write itself (fused digest-while-
+        # writing on honoring plugins; post-write fallback otherwise)
+        self.defer_digest = False
 
 
 class PendingIOWork:
@@ -291,6 +301,25 @@ async def _execute_write_pipelines(
         if (wr.checksum_sinks or wr.digest_sink) and (
             knobs.write_checksums_enabled()
         ):
+            precomputed = getattr(wr.buffer_stager, "piece_digests", None)
+            if (
+                getattr(storage, "supports_fused_digest", False)
+                and wr.dedup is None
+                and precomputed is None
+                and all(
+                    rng is None or (rng[0] == 0 and rng[1] == p.buf_size)
+                    for _, rng in (wr.checksum_sinks or ())
+                )
+            ):
+                # whole-buffer sinks, no dedup decision pending: defer
+                # to write_one, where an honoring plugin digests each
+                # block cache-hot in the SAME pass that writes it —
+                # one read of the staged bytes instead of two.  Dedup
+                # writes can't defer (the link-vs-write decision needs
+                # the digest first), and slab writes already fold from
+                # the pack's per-member digests.
+                p.defer_digest = True
+                return p
             # content checksums into the manifest (entries are serialized
             # at commit, strictly after staging completes) — off-loop,
             # the staged buffer is immutable from here on
@@ -300,7 +329,7 @@ async def _execute_write_pipelines(
                 p.buf,
                 wr.checksum_sinks,
                 wr.digest_sink,
-                getattr(wr.buffer_stager, "piece_digests", None),
+                precomputed,
             )
         return p
 
@@ -324,7 +353,26 @@ async def _execute_write_pipelines(
                     "dedup link for %r failed (%r); writing normally",
                     wr.path, e,
                 )
-        await storage.write(WriteIO(path=wr.path, buf=p.buf))
+        wio = WriteIO(path=wr.path, buf=p.buf, want_digest=p.defer_digest)
+        await storage.write(wio)
+        if p.defer_digest:
+            d = wio.digests
+            if d is None:
+                # plugin didn't fuse: compute now (same values, one
+                # extra pass — exactly what the old order always paid)
+                await asyncio.get_running_loop().run_in_executor(
+                    executor,
+                    _apply_checksum_sinks,
+                    p.buf,
+                    wr.checksum_sinks,
+                    wr.digest_sink,
+                    None,
+                )
+            else:
+                for sink, _rng in wr.checksum_sinks or ():
+                    sink(d[0])
+                if wr.digest_sink is not None:
+                    wr.digest_sink([d[0], d[1], p.buf_size])
         return p
 
     def dispatch_staging() -> None:

@@ -58,6 +58,11 @@ class FSStoragePlugin(StoragePlugin):
             from .. import _csrc
 
             self._lib = _csrc.load()
+        # fused digest-while-writing is only real on the native path
+        self.supports_fused_digest = bool(
+            self._lib is not None
+            and hasattr(self._lib, "tsnp_write_file_digest")
+        )
         self._executor: Optional[ThreadPoolExecutor] = (
             ThreadPoolExecutor(
                 max_workers=knobs.get_max_per_rank_io_concurrency(),
@@ -88,12 +93,13 @@ class FSStoragePlugin(StoragePlugin):
         except OSError:
             pass
         if self._lib is not None:
-            await asyncio.get_running_loop().run_in_executor(
+            write_io.digests = await asyncio.get_running_loop().run_in_executor(
                 self._executor,
                 self._native_write,
                 full,
                 write_io.buf,
                 write_io.durable,
+                write_io.want_digest,
             )
             return
         if write_io.durable or knobs.is_fs_sync_data():
@@ -122,15 +128,28 @@ class FSStoragePlugin(StoragePlugin):
         if chain:
             _fsync_dir_chain(os.path.dirname(full), self.root)
 
-    def _native_write(self, full: str, buf, durable: bool = False) -> None:
+    def _native_write(
+        self, full: str, buf, durable: bool = False, want_digest: bool = False
+    ):
+        import ctypes
+
         from .._csrc import _buffer_address
 
         sync_file = durable or knobs.is_fs_sync_data()
         view = memoryview(buf).cast("B")
         addr = _buffer_address(view) if view.nbytes else None
-        rc = self._lib.tsnp_write_file(
-            full.encode(), addr, view.nbytes, 1 if sync_file else 0
-        )
+        digests = None
+        if want_digest and hasattr(self._lib, "tsnp_write_file_digest"):
+            out = (ctypes.c_uint32 * 2)()
+            rc = self._lib.tsnp_write_file_digest(
+                full.encode(), addr, view.nbytes, 1 if sync_file else 0, out
+            )
+            if rc == 0:
+                digests = (int(out[0]), int(out[1]))
+        else:
+            rc = self._lib.tsnp_write_file(
+                full.encode(), addr, view.nbytes, 1 if sync_file else 0
+            )
         if rc != 0:
             raise OSError(-rc, os.strerror(-rc), full)
         if durable:
@@ -151,6 +170,7 @@ class FSStoragePlugin(StoragePlugin):
                 raise OSError(
                     5, f"crc32c mismatch after write ({got:#x} != {expected:#x})", full
                 )
+        return digests
 
     async def read(self, read_io: ReadIO) -> None:
         full = self._full(read_io.path)

@@ -29,7 +29,13 @@ import numpy as np
 
 from . import knobs
 from .io_types import BufferConsumer, BufferStager, ReadReq, WriteReq
-from .manifest import ArrayEntry, ChunkedArrayEntry, Entry, ShardedArrayEntry
+from .manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    Entry,
+    ObjectEntry,
+    ShardedArrayEntry,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -183,7 +189,7 @@ def _byte_range_targets(entries: Dict[str, Entry]) -> Dict[str, Any]:
     re-pointed when its blob moves into a slab."""
     targets: Dict[str, Any] = {}
     for entry in entries.values():
-        if isinstance(entry, ArrayEntry):
+        if isinstance(entry, (ArrayEntry, ObjectEntry)):
             targets[entry.location] = entry
         elif isinstance(entry, ChunkedArrayEntry):
             for chunk in entry.chunks:
@@ -223,20 +229,43 @@ def batch_write_requests(
     if len(small) < 2:
         return entries, write_reqs
 
+    # Device members and host/object members slab SEPARATELY: a single
+    # host member in a slab would make _all_jax false and forfeit the
+    # device pack (one D2H transfer per slab — the win the slab exists
+    # for on a tunneled link), and symmetrically poison the read-side
+    # device unpack for every array in the merged run.
     small.sort(key=lambda x: x[0].path)  # deterministic slab layout
+    groups = [
+        [
+            (wr, c)
+            for wr, c in small
+            if isinstance(wr.buffer_stager, JaxArrayBufferStager)
+        ],
+        [
+            (wr, c)
+            for wr, c in small
+            if not isinstance(wr.buffer_stager, JaxArrayBufferStager)
+        ],
+    ]
     slabs: List[List[Tuple[WriteReq, int]]] = []
-    cur: List[Tuple[WriteReq, int]] = []
-    cur_bytes = 0
-    for wr, cost in small:
-        cur.append((wr, cost))
-        cur_bytes += cost
-        if cur_bytes >= threshold:
-            slabs.append(cur)
-            cur, cur_bytes = [], 0
-    if cur:
-        slabs.append(cur)
-
     new_reqs = list(rest)
+    for group in groups:
+        if len(group) < 2:
+            # a lone member gains nothing from a one-member slab; keep
+            # its original object
+            new_reqs.extend(wr for wr, _ in group)
+            continue
+        cur: List[Tuple[WriteReq, int]] = []
+        cur_bytes = 0
+        for wr, cost in group:
+            cur.append((wr, cost))
+            cur_bytes += cost
+            if cur_bytes >= threshold:
+                slabs.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            slabs.append(cur)
+
     for i, slab in enumerate(slabs):
         slab_location = f"{rank}/batched.{i}"
         offset = 0
@@ -261,6 +290,10 @@ def batch_write_requests(
                 checksum_sinks=sinks or None,
             )
         )
+    if len(new_reqs) == len(write_reqs):
+        # nothing actually coalesced (e.g. one device + one host small
+        # member): keep the originals untouched
+        return entries, write_reqs
     return entries, new_reqs
 
 

@@ -198,12 +198,19 @@ class ChunkedArrayEntry(Entry):
 @dataclass(init=False)
 class ObjectEntry(Entry):
     """An arbitrary Python object serialized by the object codec
-    (reference ObjectEntry, manifest.py:335+)."""
+    (reference ObjectEntry, manifest.py:335+).
+
+    ``byte_range`` makes object payloads slab-eligible like array
+    payloads: a checkpoint with thousands of tiny object leaves (e.g.
+    numpy scalars in optimizer state) coalesces into a handful of
+    storage objects, and their restore reads merge into spanning reads.
+    Absent/None for pre-round-4 snapshots and unslabbed objects."""
 
     location: str
     serializer: str
     replicated: bool
     crc32: Optional[int]
+    byte_range: Optional[List[int]]
 
     def __init__(
         self,
@@ -211,17 +218,21 @@ class ObjectEntry(Entry):
         serializer: str,
         replicated: bool,
         crc32: Optional[int] = None,
+        byte_range: Optional[List[int]] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
         self.serializer = serializer
         self.replicated = replicated
         self.crc32 = crc32
+        self.byte_range = byte_range
 
     def to_dict(self) -> Dict[str, Any]:
         d = super().to_dict()
         if d.get("crc32") is None:
             del d["crc32"]
+        if d.get("byte_range") is None:
+            del d["byte_range"]
         return d
 
 
@@ -357,6 +368,7 @@ def entry_from_dict(d: Dict[str, Any]) -> Entry:
             serializer=d["serializer"],
             replicated=bool(d["replicated"]),
             crc32=d.get("crc32"),
+            byte_range=list(d["byte_range"]) if d.get("byte_range") else None,
         )
     if t in _PRIMITIVE_TYPES:
         return PrimitiveEntry(

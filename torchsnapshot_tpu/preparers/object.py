@@ -74,10 +74,12 @@ class ObjectIOPreparer:
     @staticmethod
     def prepare_read(entry: ObjectEntry) -> Tuple[List[ReadReq], Future]:
         fut: Future = Future()
+        byte_range = getattr(entry, "byte_range", None)
         return (
             [
                 ReadReq(
                     path=entry.location,
+                    byte_range=list(byte_range) if byte_range else None,
                     buffer_consumer=ObjectBufferConsumer(entry, fut),
                     expected_crc32=getattr(entry, "crc32", None),
                 )

@@ -570,13 +570,23 @@ async def _execute_read_pipelines(
                 and len(io_tasks) < io_concurrency
                 and budget.fits(min_pending_cost)
             ):
-                # complete the full rotation even once the cap is hit so
-                # the deque's relative order is preserved (a mid-rotation
-                # break would leave later items ahead of re-appended
-                # earlier ones); cap-held items count toward new_min,
-                # which keeps the watermark conservatively low
+                # Rotation discipline: once something was RE-APPENDED
+                # (budget-skipped), the rotation must complete so the
+                # deque's relative order is preserved; but when the io
+                # CAP stops a pure-prefix admission, the remaining deque
+                # is untouched and already in order — stop immediately.
+                # A 20k-tiny-leaf restore otherwise pays a full O(n)
+                # deque rotation on every wake (measured: most of the
+                # admission loop's time).  On the early stop the min
+                # watermark keeps its previous value, which remains a
+                # valid conservative lower bound of the pending set.
                 new_min = None
+                reappended = False
+                early_stop = False
                 for _ in range(len(ready_for_io)):
+                    if len(io_tasks) >= io_concurrency and not reappended:
+                        early_stop = True
+                        break
                     p = ready_for_io.popleft()
                     if len(io_tasks) < io_concurrency and budget.fits(
                         p.consuming_cost
@@ -585,9 +595,11 @@ async def _execute_read_pipelines(
                         io_tasks.add(asyncio.ensure_future(read_one(p)))
                     else:
                         ready_for_io.append(p)
+                        reappended = True
                         if new_min is None or p.consuming_cost < new_min:
                             new_min = p.consuming_cost
-                min_pending_cost = new_min if new_min is not None else 0
+                if not early_stop:
+                    min_pending_cost = new_min if new_min is not None else 0
             if ready_for_io and not io_tasks and not consume_tasks:
                 p = ready_for_io.popleft()
                 budget.debit(p.consuming_cost)

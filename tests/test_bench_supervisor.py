@@ -179,6 +179,29 @@ def test_persist_early_keeps_best(bench):
     assert json.loads(open(bench._EARLY_PATH).read())["value"] == 3.0
 
 
+def test_persist_early_refuses_cpu_records(bench):
+    """BENCH_EARLY.json is the HARDWARE fallback: a CPU drive of bench.py
+    (tests, verify runs) must never store a record the end-of-round bench
+    would present as the round's TPU number."""
+    assert bench._persist_early(_rec(9.9, platform="cpu")) is True
+    assert not os.path.exists(bench._EARLY_PATH)
+    # with a hardware capture stored, a CPU record neither displaces it
+    # NOR wins the report: False → the caller prints the fallback
+    bench._persist_early(_rec(1.0, platform="axon"))
+    assert bench._persist_early(_rec(9.9, platform="cpu")) is False
+    assert json.loads(open(bench._EARLY_PATH).read())["value"] == 1.0
+
+
+def test_is_bench_argv_matches_elements_not_substrings(bench):
+    assert bench._is_bench_argv([b"python", b"/root/repo/bench.py"])
+    assert bench._is_bench_argv([b"python", b"bench.py", b"--child"])
+    # the round driver's wrapper mentions bench.py INSIDE a prompt arg
+    assert not bench._is_bench_argv(
+        [b"claude", b"--append-system-prompt", b"Maintain bench.py at ..."]
+    )
+    assert not bench._is_bench_argv([b"vi", b"notbench.py"])
+
+
 def test_exhaustion_falls_back_to_early_capture(
     bench, monkeypatch, tmp_path, capsys
 ):

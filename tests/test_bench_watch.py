@@ -19,8 +19,12 @@ def _load():
     return mod
 
 
-def test_relay_alive_detects_listener(monkeypatch):
+def test_relay_alive_detects_listener(monkeypatch, tmp_path):
     mod = _load()
+    # keep state-transition logging out of the REAL .bench_watch.log — a
+    # fake-relay probe on an ephemeral port once polluted the round's
+    # operational log with "open-silent (relay :41285 ...)"
+    monkeypatch.setattr(mod, "LOG", str(tmp_path / "log"))
     # no listener on the probed ports -> dead
     monkeypatch.setattr(mod, "RELAY_PORTS", (1,))  # port 1: never bound
     assert not mod._relay_alive()

@@ -1,0 +1,20 @@
+"""Shared access to the REAL reference library used as a test oracle.
+
+Three suites (export, import, interop fuzz) drive the actual reference
+package at /root/reference; the path and availability check live here so
+skip behavior can never diverge between them.
+"""
+
+import os
+
+REFERENCE = "/root/reference"
+
+
+def reference_available() -> bool:
+    try:
+        import torch  # noqa: F401
+    except ImportError:
+        # ONLY ImportError: a broken torch install (ABI OSError etc.)
+        # must fail the oracle suites loudly, not silently skip them
+        return False
+    return os.path.isdir(os.path.join(REFERENCE, "torchsnapshot"))

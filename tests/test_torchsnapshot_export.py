@@ -6,7 +6,6 @@ must be bit-exact.  A reader-based round-trip (our writer → our reader)
 covers the format everywhere else.
 """
 
-import os
 import sys
 
 import numpy as np
@@ -15,15 +14,8 @@ import pytest
 from torchsnapshot_tpu.tricks.torchsnapshot_reader import read_torchsnapshot
 from torchsnapshot_tpu.tricks.torchsnapshot_writer import write_torchsnapshot
 
-_REFERENCE = "/root/reference"
-
-
-def _reference_available() -> bool:
-    try:
-        import torch  # noqa: F401
-    except ImportError:
-        return False
-    return os.path.isdir(os.path.join(_REFERENCE, "torchsnapshot"))
+from reference_oracle import REFERENCE as _REFERENCE, \
+    reference_available as _reference_available
 
 
 def test_writer_reader_round_trip(tmp_path):

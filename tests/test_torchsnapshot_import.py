@@ -19,15 +19,8 @@ import pytest
 
 from torchsnapshot_tpu.tricks.torchsnapshot_reader import read_torchsnapshot
 
-_REFERENCE = "/root/reference"
-
-
-def _reference_available() -> bool:
-    try:
-        import torch  # noqa: F401
-    except ImportError:
-        return False
-    return os.path.isdir(os.path.join(_REFERENCE, "torchsnapshot"))
+from reference_oracle import REFERENCE as _REFERENCE, \
+    reference_available as _reference_available
 
 
 @pytest.fixture()

@@ -1,0 +1,71 @@
+"""Shared child-driver for the SIGKILL crash tests.
+
+One implementation of spawn → watch stdout → kill-at-marker, used by
+`tests/test_crash_recovery.py` (engineered kill point) and
+`tests/test_crash_fuzz.py` (randomized kill timing), so the two cannot
+drift: the killed-flag discipline (a child that finishes or dies on its
+own is NOT a successful kill) and the silent-wedge watchdog (a child
+that stops emitting lines is reaped, never hangs CI) live here.
+"""
+
+import subprocess
+import threading
+import time
+from typing import List, Optional, Tuple
+
+
+def kill_child_at(
+    proc: "subprocess.Popen[str]",
+    marker: str,
+    kill_delay: float = 0.0,
+    stop_markers: Tuple[str, ...] = (),
+    wedge_timeout: float = 90.0,
+) -> Tuple[bool, List[str]]:
+    """Read ``proc``'s stdout until ``marker`` appears, wait
+    ``kill_delay`` seconds, then SIGKILL it.
+
+    Returns ``(killed, lines)`` — ``killed`` is True only when the kill
+    was actually delivered at the marker; a child that printed a
+    ``stop_markers`` line, exited on its own, or wedged silently
+    returns False so callers fail loudly instead of mistaking a child
+    crash for a successful kill.
+
+    A watchdog reaps the child after ``wedge_timeout`` seconds of TOTAL
+    runtime: ``for line in stdout`` blocks indefinitely on a silently
+    wedged child and an in-loop deadline check would never run (the
+    exact hang a crash harness exists to surface).
+    """
+    wedged = threading.Event()
+
+    def _watchdog() -> None:
+        deadline = time.time() + wedge_timeout
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                return
+            time.sleep(0.25)
+        wedged.set()
+        proc.kill()
+
+    watchdog = threading.Thread(target=_watchdog, daemon=True)
+    watchdog.start()
+    killed = False
+    lines: List[str] = []
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        lines.append(line.strip())
+        if marker in line:
+            time.sleep(kill_delay)
+            proc.kill()  # SIGKILL: no cleanup of any kind runs
+            killed = True
+            break
+        if any(s in line for s in stop_markers):
+            break
+    try:
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    if wedged.is_set():
+        return False, lines + ["<wedged: watchdog reaped child>"]
+    return killed, lines

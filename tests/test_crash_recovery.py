@@ -13,10 +13,8 @@ invariants the commit protocol promises:
 """
 
 import os
-import signal
 import subprocess
 import sys
-import time
 
 _CHILD = r"""
 import os, sys, time
@@ -68,18 +66,14 @@ def test_sigkill_mid_save_recovers(tmp_path):
         stdout=subprocess.PIPE,
         text=True,
     )
-    killed = False
-    deadline = time.time() + 120
-    lines = []
-    for line in proc.stdout:
-        lines.append(line.strip())
-        if "STEP2_WRITING" in line:
-            proc.kill()  # SIGKILL: no cleanup of any kind runs
-            killed = True
-            break
-        if "STEP2_COMMITTED" in line or time.time() > deadline:
-            break
-    proc.wait(timeout=30)
+    from crash_harness import kill_child_at
+
+    killed, lines = kill_child_at(
+        proc,
+        "STEP2_WRITING",
+        stop_markers=("STEP2_COMMITTED",),
+        wedge_timeout=120.0,
+    )
     assert killed, f"child finished before it could be killed: {lines}"
     assert "STEP1_COMMITTED" in lines
 

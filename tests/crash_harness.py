@@ -30,16 +30,18 @@ def kill_child_at(
     returns False so callers fail loudly instead of mistaking a child
     crash for a successful kill.
 
-    A watchdog reaps the child after ``wedge_timeout`` seconds of TOTAL
-    runtime: ``for line in stdout`` blocks indefinitely on a silently
-    wedged child and an in-loop deadline check would never run (the
-    exact hang a crash harness exists to surface).
+    A watchdog reaps the child after ``wedge_timeout`` seconds of
+    OUTPUT SILENCE (the deadline resets on every received line, so a
+    slow-but-progressing child is never mistaken for a wedged one):
+    ``for line in stdout`` blocks indefinitely on a silently wedged
+    child and an in-loop deadline check would never run (the exact hang
+    a crash harness exists to surface).
     """
     wedged = threading.Event()
+    progress = [time.time()]  # [-1] = when the last line arrived
 
     def _watchdog() -> None:
-        deadline = time.time() + wedge_timeout
-        while time.time() < deadline:
+        while time.time() - progress[-1] < wedge_timeout:
             if proc.poll() is not None:
                 return
             time.sleep(0.25)
@@ -52,6 +54,7 @@ def kill_child_at(
     lines: List[str] = []
     assert proc.stdout is not None
     for line in proc.stdout:
+        progress.append(time.time())
         lines.append(line.strip())
         if marker in line:
             time.sleep(kill_delay)
@@ -66,6 +69,8 @@ def kill_child_at(
         if proc.poll() is None:
             proc.kill()
             proc.wait()
-    if wedged.is_set():
+    # a watchdog firing AFTER the marker kill landed must not demote a
+    # successful kill to a wedge (it can race into the kill_delay sleep)
+    if wedged.is_set() and not killed:
         return False, lines + ["<wedged: watchdog reaped child>"]
     return killed, lines

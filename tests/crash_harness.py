@@ -39,9 +39,15 @@ def kill_child_at(
     """
     wedged = threading.Event()
     progress = [time.time()]  # [-1] = when the last line arrived
+    # absolute cap: a LIVELOCKED child that keeps printing lines resets
+    # the silence deadline forever; total runtime still has to end
+    hard_deadline = time.time() + 4 * wedge_timeout
 
     def _watchdog() -> None:
-        while time.time() - progress[-1] < wedge_timeout:
+        while (
+            time.time() - progress[-1] < wedge_timeout
+            and time.time() < hard_deadline
+        ):
             if proc.poll() is not None:
                 return
             time.sleep(0.25)

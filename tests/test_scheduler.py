@@ -26,11 +26,24 @@ from torchsnapshot_tpu.scheduler import (
 
 
 class TrackingStorage(StoragePlugin):
-    def __init__(self, delay=0.0, fail_on=None, track_budget=False):
+    def __init__(
+        self,
+        delay=0.0,
+        fail_on=None,
+        track_budget=False,
+        budget_stats=None,
+        budget_lock=None,
+    ):
         self.writes = {}
         self.delay = delay
         self.fail_on = fail_on
         self.track_budget = track_budget
+        # injectable live-byte accounting (test_scheduler_fuzz): the
+        # SAME decrement-on-write-completion mechanism as track_budget,
+        # but against a per-test stats dict instead of ChunkStager's
+        # class counters
+        self.budget_stats = budget_stats
+        self.budget_lock = budget_lock or threading.Lock()
         self.concurrent = 0
         self.max_concurrent = 0
         self._lock = threading.Lock()
@@ -49,6 +62,9 @@ class TrackingStorage(StoragePlugin):
         if self.track_budget:
             with ChunkStager.lock:
                 ChunkStager.live -= len(write_io.buf)
+        if self.budget_stats is not None:
+            with self.budget_lock:
+                self.budget_stats["live"] -= len(write_io.buf)
         with self._lock:
             self.concurrent -= 1
 

@@ -1,0 +1,104 @@
+"""Randomized corruption campaign for the integrity subsystem.
+
+Targeted tests (`tests/test_verify.py`) flip engineered bytes; this
+file flips ONE RANDOM BIT at a RANDOM OFFSET of a RANDOM payload
+object under random knobs (batching on/off; chunking forced on half
+the seeds via a 4KB max-chunk-size, disabled on the rest) and asserts
+the integrity promises hold for any flip location:
+
+- ``verify(deep=True)`` reports the snapshot corrupt — i.e. every
+  byte of every storage object (slab members, chunk pieces, object
+  leaves) is digest-covered, no unprotected gaps;
+- a full restore under ``VERIFY_ON_RESTORE`` raises, and afterwards
+  every template byte is either still zero or equal to the original
+  value (per-leaf crc-before-copy: already-restored leaves and
+  already-landed chunks legally hold CORRECT data; WRONG bytes never
+  land in user state);
+- the clean snapshot verified ok before the flip (no false alarms).
+
+A 400-seed offline campaign of this generator passed clean; CI runs a
+slice.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs
+
+_DTYPES = [np.float32, np.float64, np.int32, np.uint8, np.int16]
+
+
+def _tree(rng):
+    t = {}
+    for i in range(int(rng.integers(2, 8))):
+        dt = _DTYPES[int(rng.integers(len(_DTYPES)))]
+        n = int(rng.integers(1, 60000))
+        t[f"w{i}"] = (rng.standard_normal(n) * 8).astype(dt)
+    t["s"] = "a string leaf"
+    t["k"] = int(rng.integers(0, 1000))
+    return t
+
+
+def _payload_files(root):
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            if f == ".snapshot_metadata":
+                continue
+            p = os.path.join(dirpath, f)
+            if os.path.getsize(p) > 0:
+                out.append(p)
+    return sorted(out)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_bit_flip_is_always_caught(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    tree = _tree(rng)
+    batching = bool(rng.integers(2))
+    chunk = int(rng.choice([4096, 512 * 1024 * 1024]))
+    snap_dir = str(tmp_path / "s")
+    with knobs.override_disable_batching(not batching), \
+            knobs.override_max_chunk_size_bytes(chunk):
+        snap = Snapshot.take(snap_dir, {"m": StateDict(**tree)})
+    assert snap.verify(deep=True).ok
+
+    files = _payload_files(snap_dir)
+    victim = files[int(rng.integers(len(files)))]
+    size = os.path.getsize(victim)
+    off = int(rng.integers(size))
+    bit = 1 << int(rng.integers(8))
+    with open(victim, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ bit]))
+
+    assert not snap.verify(deep=True).ok, (
+        f"flip at {os.path.basename(victim)}:{off} (size {size}) escaped "
+        f"deep verify — uncovered byte!"
+    )
+
+    templates = {
+        k: np.zeros_like(v) for k, v in tree.items()
+        if isinstance(v, np.ndarray)
+    }
+    dest = StateDict(**templates, s="", k=0)
+    with knobs.override_verify_on_restore(True):
+        # specifically the integrity error — a restore failing for an
+        # unrelated reason (shape/dtype bug) must not pass vacuously
+        with pytest.raises(RuntimeError, match="checksum mismatch"):
+            snap.restore({"m": dest})
+    for k, v in tree.items():
+        if not isinstance(v, np.ndarray):
+            continue
+        got_b = np.asarray(dest[k]).view(np.uint8).reshape(-1)
+        want_b = v.view(np.uint8).reshape(-1)
+        bad = (got_b != 0) & (got_b != want_b)
+        assert not bad.any(), (
+            f"template {k} holds WRONG bytes after failed verified "
+            f"restore ({int(bad.sum())} bytes)"
+        )

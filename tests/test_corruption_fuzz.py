@@ -54,6 +54,28 @@ def _payload_files(root):
     return sorted(out)
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_metadata_bit_flip_is_always_caught(tmp_path, seed):
+    """With the metadata self-checksum trailer, a flip ANYWHERE in
+    .snapshot_metadata (document body, marker, or trailer hex) fails
+    the load — completing byte coverage of the whole snapshot dir."""
+    rng = np.random.default_rng(1000 + seed)
+    tree = _tree(rng)
+    snap_dir = str(tmp_path / "s")
+    Snapshot.take(snap_dir, {"m": StateDict(**tree)})
+    meta = os.path.join(snap_dir, ".snapshot_metadata")
+    size = os.path.getsize(meta)
+    off = int(rng.integers(size))
+    bit = 1 << int(rng.integers(8))
+    with open(meta, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ bit]))
+    with pytest.raises(Exception):
+        Snapshot(snap_dir).metadata  # noqa: B018
+
+
 @pytest.mark.parametrize("seed", range(12))
 def test_random_bit_flip_is_always_caught(tmp_path, seed):
     rng = np.random.default_rng(seed)

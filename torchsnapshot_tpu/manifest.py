@@ -21,11 +21,22 @@ Key differences from the reference, by design:
 from __future__ import annotations
 
 import json
+import zlib
 from base64 import b64decode, b64encode
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Union
 
 MANIFEST_VERSION = "0.1.0"
+
+# Self-checksum trailer appended to the serialized metadata FILE (not
+# part of the JSON document).  Payload entries carry per-object digests,
+# but without this the manifest itself was the one unprotected byte
+# range in a snapshot: a flipped shape digit or location character would
+# mislead every restore (the reference has the same gap).  The marker
+# starts with a newline + '#': json.dumps escapes newlines inside
+# strings, so the raw sequence can never occur within the JSON body; a
+# plain-YAML reader treats the trailer as a comment.
+_META_CRC_MARKER = "\n#tsnp-meta-crc32:"
 
 
 @dataclass
@@ -412,11 +423,59 @@ class SnapshotMetadata:
         return json.dumps(d, sort_keys=True)
 
     # JSON is a YAML subset; emit JSON for speed, accept YAML on read
-    # (reference manifest.py:442-475).
-    to_yaml = to_json
+    # (reference manifest.py:442-475).  The stored FILE additionally
+    # carries the self-checksum trailer; ``to_json`` stays the pure
+    # document form (used for display / tests).
+    def to_yaml(self) -> str:
+        body = self.to_json()
+        return f"{body}{_META_CRC_MARKER}{zlib.crc32(body.encode()):08x}"
 
     @classmethod
     def from_yaml(cls, s: str) -> "SnapshotMetadata":
+        body, marker, trailer = s.rpartition(_META_CRC_MARKER)
+        if marker:
+            t = trailer.strip()
+            # exactly 8 lowercase hex digits (the writer's %08x): a
+            # sloppy parse (int(x, 16)) would accept case-flipped
+            # variants, breaking the every-bit-flip-fails property
+            recorded = None
+            if len(t) == 8 and t == t.lower():
+                try:
+                    recorded = int(t, 16)
+                except ValueError:
+                    pass  # non-hex: corrupt trailer, fail below
+            actual = zlib.crc32(body.encode())
+            if recorded != actual:
+                shown = (
+                    f"recorded {recorded:#010x}"
+                    if recorded is not None
+                    else f"unparseable trailer {t[:24]!r}"
+                )
+                raise RuntimeError(
+                    "metadata checksum mismatch: .snapshot_metadata is "
+                    f"corrupt ({shown}, actual {actual:#010x})"
+                )
+            s = body
+        else:
+            # trailer absent — but a flip inside the MARKER BYTES
+            # themselves must not silently downgrade to the unverified
+            # legacy path (the YAML fallback would treat the mangled
+            # trailer as a comment and load the document unchecked).
+            # Structural anchor: our writer's only comment is the final
+            # trailer line, so a trailer-SHAPED final line ('#...') that
+            # failed the exact-marker match is corruption, not legacy.
+            # (Hand-written YAML ending in a comment line is rejected
+            # with this clear error — an accepted trade against a
+            # silent integrity downgrade.)
+            last_line = s[s.rfind("\n") + 1:].strip()
+            if last_line.startswith("#"):
+                raise RuntimeError(
+                    "metadata checksum mismatch: final line is "
+                    "trailer-shaped but does not match the expected "
+                    "marker — corrupt .snapshot_metadata trailer"
+                )
+        # legacy/hand-written/plain-YAML metadata file — parse as
+        # before, no self-check available
         try:
             d = json.loads(s)
         except json.JSONDecodeError:

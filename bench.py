@@ -163,6 +163,86 @@ def _attention_bench() -> dict:
     return result
 
 
+def _quick_number(dev, init_s: float) -> None:
+    """First-number-fast phase: a tiny (64MB, link-probe-sized)
+    take/restore that prints a FULL metric line (nonzero value +
+    save + restore throughputs) within ~2 minutes of ``backend_up``.
+
+    Relay windows are ~26 minutes and can close mid-run (round 4 lost
+    its only window to exactly this); every later phase — link probe,
+    adaptive payload, attention, orbax — can exceed 2 minutes when
+    compiles are remote, so the smallest publishable number must land
+    BEFORE any of them.  Matches the reference's smallest published
+    cell (benchmarks/ddp/README.md:17).  Best-wins persistence means a
+    later, larger-payload number replaces this one when it lands."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import PyTreeState, Snapshot
+
+    n_arrays, elems = 16, 2 * 1024 * 1024  # 16 x 4MB bf16 = 64MB
+    make = jax.jit(
+        lambda i: (jnp.arange(elems, dtype=jnp.float32) * (i + 1.0)).astype(
+            jnp.bfloat16
+        )
+    )
+    params = {f"layer{i:02d}/w": make(float(i)) for i in range(n_arrays)}
+    jax.block_until_ready(params)
+    total_gb = n_arrays * elems * 2 / 1e9
+    root = tempfile.mkdtemp(prefix="tsnp_bench_quick_")
+    try:
+        # warm-up take compiles the batched pinned-host transfer
+        # program — the dominant one-time cost when compiles are remote
+        warm = (jnp.arange(1024, dtype=jnp.float32)).astype(jnp.bfloat16)
+        Snapshot.async_take(
+            os.path.join(root, "warm"), {"m": PyTreeState({"w": warm})}
+        ).wait()
+        t0 = time.perf_counter()
+        pending = Snapshot.async_take(
+            os.path.join(root, "snap"), {"m": PyTreeState(dict(params))}
+        )
+        blocked_s = time.perf_counter() - t0
+        snap = pending.wait()
+        total_s = time.perf_counter() - t0
+        zeros = jax.jit(lambda: jnp.zeros((elems,), jnp.bfloat16))
+        templates = {}
+        for k in sorted(params):
+            params.pop(k)
+            templates[k] = zeros()
+        jax.block_until_ready(templates)
+        dest = PyTreeState(templates)
+        t0 = time.perf_counter()
+        snap.restore({"m": dest})
+        jax.block_until_ready(dest.tree)
+        restore_s = time.perf_counter() - t0
+        gbps = total_gb / blocked_s
+        print(
+            json.dumps(
+                {
+                    "metric": METRIC,
+                    "unit": "GB/s/chip",
+                    "platform": dev.platform,
+                    "device": getattr(dev, "device_kind", str(dev)),
+                    "payload_gb": round(total_gb, 3),
+                    "backend_init_s": round(init_s, 2),
+                    "quick_phase": True,
+                    "value": round(gbps, 3),
+                    "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+                    "blocked_s": round(blocked_s, 4),
+                    "save_total_s": round(total_s, 2),
+                    "save_total_gbps": round(total_gb / total_s, 3),
+                    "restore_s": round(restore_s, 2),
+                    "restore_gbps": round(total_gb / restore_s, 3),
+                    "baseline": "reference 20GB/13.91s save, 1xA100 "
+                    "local FS (benchmarks/ddp/README.md:17)",
+                }
+            ),
+            flush=True,
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_child() -> None:
     import jax
     import jax.numpy as jnp
@@ -189,6 +269,22 @@ def run_child() -> None:
         ),
         flush=True,
     )
+    if on_tpu:
+        # the window can close any minute: land the smallest publishable
+        # number FIRST; every later phase only improves on it
+        try:
+            _quick_number(dev, init_s)
+        except Exception as e:
+            print(
+                json.dumps(
+                    {
+                        "metric": METRIC,
+                        "phase": "quick_failed",
+                        "why": f"{e!r}"[:200],
+                    }
+                ),
+                flush=True,
+            )
 
     n_arrays = 16
     if on_tpu:
@@ -738,9 +834,12 @@ def _persist_early(line: str) -> bool:
         return True  # unparseable: nothing to compare against
     with open(_EARLY_PATH + ".lock", "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
+        old_quick = False
         try:
             with open(_EARLY_PATH) as f:
-                old_val = float(json.load(f).get("value", 0))
+                rec_old = json.load(f)
+            old_val = float(rec_old.get("value", 0))
+            old_quick = bool(rec_old.get("quick_phase"))
         except (OSError, ValueError):
             old_val = 0.0
         if rec_new.get("platform") == "cpu":
@@ -754,7 +853,21 @@ def _persist_early(line: str) -> bool:
             return old_val <= 0
         if new_val <= 0:
             return old_val <= 0
-        if new_val <= old_val:
+        new_quick = bool(rec_new.get("quick_phase"))
+        # payload classes are not comparable: a 64MB quick-phase number
+        # can exceed the representative multi-GB one (small payloads fit
+        # staging buffers), and best-wins on raw value would let it
+        # shadow the honest measurement forever.  A representative
+        # record always replaces a quick one; a quick record never
+        # replaces a representative one.
+        if old_quick and not new_quick:
+            pass  # replace regardless of value
+        elif new_quick and not old_quick and old_val > 0:
+            # refuse ONLY when a representative record actually exists:
+            # with no stored number at all, the quick number IS the
+            # round's only measurement and must persist
+            return False
+        elif new_val <= old_val:
             return False
         rec = dict(rec_new)
         rec["captured_at_unix"] = int(time.time())
@@ -831,6 +944,29 @@ def main() -> None:
                 f"without a reachable chip"
             )
         if line is not None:
+            try:
+                quick_only = bool(json.loads(line).get("quick_phase"))
+            except ValueError:
+                quick_only = False
+            if (
+                quick_only
+                and attempt < _MAX_ATTEMPTS
+                and time.time() < deadline - 180
+            ):
+                # the child landed its first-number-fast line but died
+                # before the representative phase: bank the quick number
+                # (it persists unless a representative capture already
+                # exists) and RETRY — returning here would make a 64MB
+                # quick record the round's terminal result with budget
+                # still on the clock
+                _persist_early(line)
+                diagnoses.append(
+                    f"attempt {attempt}: quick number landed but the "
+                    f"child died before the representative phase; "
+                    f"retrying"
+                )
+                time.sleep(20)  # give the killed child's lease a beat
+                continue
             # a fresh run can be WORSE than an earlier capture (e.g. the
             # link degraded); the driver records our LAST stdout line, so
             # print the better of the two records last

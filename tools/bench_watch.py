@@ -89,6 +89,75 @@ def _bench_running() -> bool:
     return False
 
 
+def _cpu_hog_pids() -> list:
+    """PIDs of CPU-heavy test/soak processes that must not share the
+    1-core box with a bench attempt (round 4's only relay window lost
+    its first attempt to a concurrently running pytest suite).  Matches
+    argv ELEMENTS only — the round driver's wrapper embeds words like
+    "pytest" inside a giant prompt argument, and SIGSTOPping the driver
+    would wedge the whole session."""
+    import glob
+
+    me = os.getpid()
+    hogs = []
+    for path in glob.glob("/proc/[0-9]*/cmdline"):
+        try:
+            pid = int(path.split("/")[2])
+            if pid == me:
+                continue
+            with open(path, "rb") as f:
+                argv = [a for a in f.read().split(b"\0") if a]
+        except (OSError, ValueError):
+            continue
+        # only python-interpreter processes: `vim soak.py` or
+        # `grep foo soak.py` must never be SIGSTOPped for a bench
+        try:
+            exe = os.path.basename(os.readlink(f"/proc/{pid}/exe"))
+        except OSError:
+            continue
+        if not exe.startswith("python"):
+            continue
+        for a in argv:
+            if (
+                a == b"pytest"
+                or a.endswith(b"/pytest")
+                or a.endswith(b"soak.py")
+                or a.endswith(b"/py.test")
+            ):
+                hogs.append(pid)
+                break
+    return hogs
+
+
+def _pause_cpu_hogs() -> list:
+    """SIGSTOP test/soak processes for the duration of a bench attempt;
+    returns the stopped pids so the caller can SIGCONT them after."""
+    import signal
+
+    stopped = []
+    for pid in _cpu_hog_pids():
+        try:
+            os.kill(pid, signal.SIGSTOP)
+            stopped.append(pid)
+        except OSError:
+            pass
+    if stopped:
+        _log(f"paused CPU hogs for bench window: {stopped}")
+    return stopped
+
+
+def _resume_cpu_hogs(pids: list) -> None:
+    import signal
+
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGCONT)
+        except OSError:
+            pass
+    if pids:
+        _log(f"resumed CPU hogs: {pids}")
+
+
 def main() -> None:
     hours = float(sys.argv[1]) if len(sys.argv) > 1 else 9.0
     max_successes = 3
@@ -105,6 +174,20 @@ def main() -> None:
     with open(PIDFILE, "w") as f:
         f.write(str(os.getpid()))
     _log(f"watcher started, pid={os.getpid()}, budget={hours}h")
+    # self-heal: a previous watcher killed uncleanly (OOM, SIGKILL)
+    # between pause and resume leaves pytest/soak processes SIGSTOPped
+    # forever — sweep any still-frozen hogs on startup
+    import signal as _signal
+
+    for pid in _cpu_hog_pids():
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().rsplit(")", 1)[1].split()[0]
+            if state == "T":
+                os.kill(pid, _signal.SIGCONT)
+                _log(f"startup sweep: resumed frozen hog {pid}")
+        except (OSError, IndexError):
+            continue
     try:
         while time.time() < deadline:
             if not _relay_alive():
@@ -115,6 +198,8 @@ def main() -> None:
                 time.sleep(120)
                 continue
             _log("relay alive — launching bench.py")
+            hogs = _pause_cpu_hogs()
+            timed_out = False
             try:
                 out = subprocess.run(
                     [sys.executable, BENCH],
@@ -127,27 +212,62 @@ def main() -> None:
                 # bench.py's own supervisor deadline is 2400s; this is a
                 # belt-and-suspenders bound that should never fire
                 _log("bench.py exceeded 3000s (unexpected); moving on")
+                timed_out = True
+            finally:
+                # resume BEFORE any sleep: the paused workload must not
+                # stay frozen a second longer than the bench itself
+                _resume_cpu_hogs(hogs)
+            if timed_out:
                 time.sleep(600)
                 continue
-            value, platform = 0.0, ""
+            # bench.py's supervisor STREAMS every fresh child metric
+            # line to stdout as it lands, then may append a
+            # BENCH_EARLY.json replay ("source") or an exhaustion
+            # record — so scanning ALL lines distinguishes what the
+            # FRESH run actually produced, where the last line alone
+            # cannot (a fresh-but-worse run ends with a replay line).
+            fresh_representative = fresh_quick = False
+            last = {}
             for line in out.strip().splitlines():
                 try:
                     rec = json.loads(line)
-                    value = float(rec.get("value", 0))
-                    platform = rec.get("platform", "")
-                except ValueError:
+                    if not isinstance(rec, dict):
+                        continue
+                    value = float(rec.get("value", 0) or 0)
+                except (ValueError, TypeError):
                     continue
-            _log(f"bench.py finished, last value={value} platform={platform}")
-            # a HARDWARE success only: a CPU-fallback run (value > 0,
-            # platform cpu) counting toward max_successes would retire
-            # the watcher with zero hardware measurements — the same
-            # masquerade bench._persist_early refuses to store
-            if value > 0 and platform not in ("", "cpu"):
+                last = rec
+                if (
+                    value > 0
+                    and rec.get("platform", "") not in ("", "cpu")
+                    and "source" not in rec
+                    and "exhaustion_error" not in rec
+                ):
+                    if rec.get("quick_phase"):
+                        fresh_quick = True
+                    else:
+                        fresh_representative = True
+            _log(
+                f"bench.py finished, fresh_repr={fresh_representative} "
+                f"fresh_quick={fresh_quick} last_value={last.get('value')} "
+                f"platform={last.get('platform', '')}"
+            )
+            # success = a FRESH representative hardware number this run:
+            # CPU fallbacks, replays of an earlier capture, and
+            # exhaustion records must not retire the watcher (the same
+            # masquerade bench._persist_early refuses to store)
+            if fresh_representative:
                 successes += 1
                 if successes >= max_successes:
                     _log("max successes reached; exiting")
                     return
                 time.sleep(7200)  # re-measure later for a better number
+            elif fresh_quick:
+                # the run landed its quick number but died before the
+                # representative phase — the backend itself worked, so
+                # the window is likely still open; retry sooner than the
+                # unhealthy-remote cadence to upgrade the measurement
+                time.sleep(300)
             else:
                 time.sleep(600)  # listener up but remote side unhealthy
     finally:

@@ -21,6 +21,7 @@ Key differences from the reference, by design:
 from __future__ import annotations
 
 import json
+import re
 import zlib
 from base64 import b64decode, b64encode
 from dataclasses import dataclass, field
@@ -436,14 +437,12 @@ class SnapshotMetadata:
         if marker:
             t = trailer.strip()
             # exactly 8 lowercase hex digits (the writer's %08x): a
-            # sloppy parse (int(x, 16)) would accept case-flipped
-            # variants, breaking the every-bit-flip-fails property
+            # sloppy parse (int(x, 16)) would accept case-flipped,
+            # "0x"-prefixed, signed, or "_"-separated variants,
+            # breaking the every-bit-flip-fails property
             recorded = None
-            if len(t) == 8 and t == t.lower():
-                try:
-                    recorded = int(t, 16)
-                except ValueError:
-                    pass  # non-hex: corrupt trailer, fail below
+            if re.fullmatch(r"[0-9a-f]{8}", t):
+                recorded = int(t, 16)
             actual = zlib.crc32(body.encode())
             if recorded != actual:
                 shown = (

@@ -56,7 +56,32 @@ import time
 
 BASELINE_GBPS = 20.0 / 13.91  # reference: 1 node x 1 GPU, local FS
 METRIC = "async_save_blocked_throughput"
-_RELAY_PORTS = (8082, 8083, 8087)  # the axon tunnel relay's listeners
+def _parse_relay_ports(raw: str) -> tuple:
+    """A malformed TSNP_RELAY_PORTS ("", "8082,") must fall back to the
+    defaults, not kill the watcher at import time — an import crash
+    silently ends opportunistic hardware capture for the round."""
+    try:
+        ports = tuple(int(p) for p in raw.split(",") if p.strip())
+    except ValueError:
+        return (8082, 8083, 8087)
+    return ports or (8082, 8083, 8087)
+
+
+_RELAY_PORTS = _parse_relay_ports(
+    os.environ.get("TSNP_RELAY_PORTS", "8082,8083,8087")
+)  # the axon tunnel relay's listeners; env override is for the
+# TSNP_BENCH_REHEARSAL chain test, which points them at a fake relay
+
+
+def _rehearsal() -> bool:
+    """True when the watcher→bench→persist chain is being DRESS-REHEARSED
+    off-hardware (TSNP_BENCH_REHEARSAL=1): the CPU backend drives the
+    full phase sequence, every record is labeled ``"rehearsal": true``,
+    and persistence goes to BENCH_REHEARSAL.json — never to the hardware
+    fallback BENCH_EARLY.json.  The chain had executed zero times
+    end-to-end before this mode existed; windows are too rare to debug
+    the chain ON them."""
+    return os.environ.get("TSNP_BENCH_REHEARSAL") == "1"
 
 # Fewer, longer attempts: killing a child that is merely *slow* poisons
 # the TPU lease (the next backend init then blocks for minutes), so one
@@ -235,6 +260,7 @@ def _quick_number(dev, init_s: float) -> None:
                     "restore_gbps": round(total_gb / restore_s, 3),
                     "baseline": "reference 20GB/13.91s save, 1xA100 "
                     "local FS (benchmarks/ddp/README.md:17)",
+                    **({"rehearsal": True} if _rehearsal() else {}),
                 }
             ),
             flush=True,
@@ -255,6 +281,7 @@ def run_child() -> None:
     dev = jax.devices()[0]
     init_s = time.perf_counter() - t0
     on_tpu = dev.platform != "cpu"
+    rehearsal = _rehearsal()
     # immediate breadcrumb: backend init resolved.  Resets the
     # supervisor's stall clock to the (shorter) phase window, so a child
     # past the risky init can't be mistaken for one still stuck in it
@@ -269,7 +296,7 @@ def run_child() -> None:
         ),
         flush=True,
     )
-    if on_tpu:
+    if on_tpu or rehearsal:
         # the window can close any minute: land the smallest publishable
         # number FIRST; every later phase only improves on it
         try:
@@ -344,6 +371,7 @@ def run_child() -> None:
         "backend_init_s": round(init_s, 2),
         "baseline": "reference 20GB/13.91s save, 1xA100 local FS "
         "(benchmarks/ddp/README.md:17)",
+        **({"rehearsal": True} if rehearsal else {}),
     }
     if on_tpu:
         result["link_d2h_gbps"] = round(link_gbps, 4)
@@ -803,9 +831,43 @@ def _tunnel_diagnosis() -> str:
     )
 
 
-_EARLY_PATH = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "BENCH_EARLY.json"
-)
+_STATE_DIR = os.environ.get(
+    "TSNP_BENCH_STATE_DIR", os.path.dirname(os.path.abspath(__file__))
+)  # overridable so the rehearsal chain test never touches the real files
+_EARLY_PATH = os.path.join(_STATE_DIR, "BENCH_EARLY.json")
+_REHEARSAL_PATH = os.path.join(_STATE_DIR, "BENCH_REHEARSAL.json")
+
+
+def _persist_rehearsal(line: str) -> bool:
+    """Rehearsal records go to BENCH_REHEARSAL.json, unmistakably
+    labeled, and NEVER to the hardware fallback — a rehearsal that
+    leaked into BENCH_EARLY.json would let a CPU number masquerade as
+    the round's TPU measurement (the exact failure _persist_early's CPU
+    guard exists to stop)."""
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return True
+    if not isinstance(rec, dict):
+        return True
+    # same payload-class ordering as _persist_early: a banked quick
+    # record must not clobber an already-stored representative one (the
+    # chain test asserts on the representative record; a late quick
+    # overwrite would make it flaky under CPU contention)
+    if rec.get("quick_phase"):
+        try:
+            with open(_REHEARSAL_PATH) as f:
+                if not json.load(f).get("quick_phase"):
+                    return True
+        except (OSError, ValueError):
+            pass
+    rec["rehearsal"] = True
+    rec["captured_at_unix"] = int(time.time())
+    tmp = f"{_REHEARSAL_PATH}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, _REHEARSAL_PATH)
+    return True
 
 
 def _persist_early(line: str) -> bool:
@@ -832,6 +894,11 @@ def _persist_early(line: str) -> bool:
         new_val = float(rec_new.get("value", 0))
     except ValueError:
         return True  # unparseable: nothing to compare against
+    if _rehearsal() or rec_new.get("rehearsal"):
+        # belt and suspenders: both the env flag and the record label
+        # divert to the rehearsal file, so neither a mislabeled record
+        # nor a stripped env can reach the hardware fallback
+        return _persist_rehearsal(line)
     with open(_EARLY_PATH + ".lock", "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         old_quick = False

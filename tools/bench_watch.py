@@ -30,12 +30,22 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
-LOG = os.path.join(REPO, ".bench_watch.log")
-PIDFILE = os.path.join(REPO, ".bench_watch.pid")
+# state dir override serves the TSNP_BENCH_REHEARSAL chain test: a
+# rehearsal watcher must not collide with the real watcher's pidfile
+# nor write the repo's logs
+_STATE = os.environ.get("TSNP_BENCH_STATE_DIR", REPO)
+LOG = os.path.join(_STATE, ".bench_watch.log")
+PIDFILE = os.path.join(_STATE, ".bench_watch.pid")
+_POLL_S = float(os.environ.get("TSNP_WATCH_POLL_S", "60"))
 
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 import bench as _bench  # noqa: E402 — needs REPO on sys.path first
+
+# single source of truth for the rehearsal flag: two independent env
+# parses could drift and disagree about pausing the pytest suite that
+# drives the chain test
+_REHEARSAL = _bench._rehearsal()
 
 RELAY_PORTS = _bench._RELAY_PORTS  # one source of truth for the ports
 
@@ -176,29 +186,34 @@ def main() -> None:
     _log(f"watcher started, pid={os.getpid()}, budget={hours}h")
     # self-heal: a previous watcher killed uncleanly (OOM, SIGKILL)
     # between pause and resume leaves pytest/soak processes SIGSTOPped
-    # forever — sweep any still-frozen hogs on startup
-    import signal as _signal
+    # forever — sweep any still-frozen hogs on startup.  NOT in
+    # rehearsal: a rehearsal watcher sweeping hogs could un-freeze a
+    # process the REAL watcher deliberately paused for a live window.
+    if not _REHEARSAL:
+        import signal as _signal
 
-    for pid in _cpu_hog_pids():
-        try:
-            with open(f"/proc/{pid}/stat") as f:
-                state = f.read().rsplit(")", 1)[1].split()[0]
-            if state == "T":
-                os.kill(pid, _signal.SIGCONT)
-                _log(f"startup sweep: resumed frozen hog {pid}")
-        except (OSError, IndexError):
-            continue
+        for pid in _cpu_hog_pids():
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    state = f.read().rsplit(")", 1)[1].split()[0]
+                if state == "T":
+                    os.kill(pid, _signal.SIGCONT)
+                    _log(f"startup sweep: resumed frozen hog {pid}")
+            except (OSError, IndexError):
+                continue
     try:
         while time.time() < deadline:
             if not _relay_alive():
-                time.sleep(60)
+                time.sleep(_POLL_S)
                 continue
             if _bench_running():
                 _log("relay alive but a bench.py already runs; waiting")
-                time.sleep(120)
+                time.sleep(2 * _POLL_S)
                 continue
             _log("relay alive — launching bench.py")
-            hogs = _pause_cpu_hogs()
+            # a rehearsal runs UNDER pytest — pausing the very suite
+            # that is driving the chain test would freeze the test
+            hogs = [] if _REHEARSAL else _pause_cpu_hogs()
             timed_out = False
             try:
                 out = subprocess.run(

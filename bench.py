@@ -844,29 +844,37 @@ def _persist_rehearsal(line: str) -> bool:
     leaked into BENCH_EARLY.json would let a CPU number masquerade as
     the round's TPU measurement (the exact failure _persist_early's CPU
     guard exists to stop)."""
+    import fcntl
+
     try:
         rec = json.loads(line)
     except ValueError:
         return True
     if not isinstance(rec, dict):
         return True
-    # same payload-class ordering as _persist_early: a banked quick
-    # record must not clobber an already-stored representative one (the
-    # chain test asserts on the representative record; a late quick
-    # overwrite would make it flaky under CPU contention)
-    if rec.get("quick_phase"):
-        try:
-            with open(_REHEARSAL_PATH) as f:
-                if not json.load(f).get("quick_phase"):
-                    return True
-        except (OSError, ValueError):
-            pass
-    rec["rehearsal"] = True
-    rec["captured_at_unix"] = int(time.time())
-    tmp = f"{_REHEARSAL_PATH}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(rec, f)
-    os.replace(tmp, _REHEARSAL_PATH)
+    # same flock discipline as _persist_early: two rehearsal writers
+    # (watcher- and driver-launched) must not interleave the
+    # read-check-write below, or a quick record could clobber a
+    # representative one between the check and the replace
+    with open(_REHEARSAL_PATH + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        # payload-class ordering as in _persist_early: a banked quick
+        # record must not clobber an already-stored representative one
+        # (the chain test asserts on the representative record; a late
+        # quick overwrite would make it flaky under CPU contention)
+        if rec.get("quick_phase"):
+            try:
+                with open(_REHEARSAL_PATH) as f:
+                    if not json.load(f).get("quick_phase"):
+                        return True
+            except (OSError, ValueError):
+                pass
+        rec["rehearsal"] = True
+        rec["captured_at_unix"] = int(time.time())
+        tmp = f"{_REHEARSAL_PATH}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, _REHEARSAL_PATH)
     return True
 
 

@@ -195,9 +195,17 @@ print(f"rank {rank} FAULT-OK")
 """
 
 
-_WORKER4 = _PRELUDE + _WRITE_SPY + r"""
-# 4x2 mesh: rows = processes, cols = each process's 2 local devices
-mesh = Mesh(np.array(devs).reshape(4, 2), ("dp", "tp"))
+_WORKER_N = _PRELUDE + _WRITE_SPY + r"""
+# One worker body for every process count: rows = processes, cols =
+# each process's local devices.  4x2 = four 2-device controllers; 8x1 =
+# the process-per-device extreme, where every controller addresses
+# exactly ONE device — the degenerate case for assign_box_writers'
+# replica-set math: a fully-sharded box has a single candidate writer,
+# a dp-replicated box has nprocs (reference habit: world-size-4
+# elastic, test_utils.py:232-270; this drives the protocol at 4 AND 8).
+cols = 8 // nprocs
+mesh = Mesh(np.array(devs).reshape(nprocs, cols), ("dp", "tp"))
+ballast_rank = int(os.environ["TSNP_BALLAST_RANK"])
 
 def make(global_np, spec):
     sh = NamedSharding(mesh, spec)
@@ -206,22 +214,22 @@ def make(global_np, spec):
     )
 
 # NamedSharding requires even tiling, so heterogeneity comes from MIXED
-# box geometries across leaves (fully sharded 4x2, dp-replicated,
-# flattened ("dp","tp") over dim 0) — partition determinism must hold
-# across heterogeneous per-leaf layouts, not just one uniform split
+# box geometries across leaves (fully sharded, dp-replicated, flattened
+# ("dp","tp") over dim 0) — partition determinism must hold across
+# heterogeneous per-leaf layouts, not just one uniform split
 W = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
 # dp-replicated leaves: every process is a candidate writer for each
-# box, giving the balancer freedom to shift work between 4 controllers
+# box, giving the balancer freedom to shift work between controllers
 R = {f"r{i}": np.arange(8 * 4, dtype=np.float32).reshape(8, 4) * (i + 1)
-     for i in range(4)}
+     for i in range(nprocs)}
 state = {
     "w": make(W, P("dp", "tp")),
     "wflat": make(W * 3.0, P(("dp", "tp"), None)),
     **{k: make(v, P(None, "tp")) for k, v in R.items()},
-    # skewed per-rank host state: rank 2 carries 8MB, others 32B — the
-    # balancer must shift replicated boxes AWAY from rank 2
+    # skewed per-rank host state: one rank carries 8MB, others 32B —
+    # the balancer must shift replicated boxes AWAY from it
     "ballast": (
-        np.zeros(2_000_000, np.float32) if rank == 2
+        np.zeros(2_000_000, np.float32) if rank == ballast_rank
         else np.zeros(8, np.float32)
     ),
 }
@@ -235,8 +243,9 @@ manifest_repr = "\n".join(
 with open(os.path.join(root, f"manifest_{rank}.txt"), "w") as f:
     f.write(manifest_repr)
 
-# restore onto a DIFFERENT topology: 2x4 (dp spans process PAIRS, tp
-# spans devices of two processes) — every box resplits across ranks
+# restore onto a DIFFERENT topology: a 2x4 mesh (at nprocs=4 that is
+# 4x2 -> 2x4; at nprocs=8 it is 8x1 -> 2x4) — every box resplits
+# across ranks and is reassembled from remote controllers' shards
 mesh2 = Mesh(np.array(devs).reshape(2, 4), ("dp", "tp"))
 def template(shape, spec):
     sh = NamedSharding(mesh2, spec)
@@ -263,12 +272,13 @@ for name, arr in dest.tree.items():
             )
     else:
         np.testing.assert_array_equal(arr, expected[name], err_msg=name)
-print(f"rank {rank} OK4")
+print(f"rank {rank} OK{nprocs}")
 """
 
 
 def _launch_workers(
-    worker_src: str, tmp_path, nprocs: int = 2, extra_env: dict = None
+    worker_src: str, tmp_path, nprocs: int = 2, extra_env: dict = None,
+    timeout: int = 240,
 ) -> list:
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -298,7 +308,7 @@ def _launch_workers(
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -408,7 +418,8 @@ def test_four_controllers_mixed_geometry_skew_and_reshard(tmp_path):
     # sharded, dp-replicated, dim-0-flattened), a skewed preload (rank
     # 2's 8MB ballast), and a cross-topology restore (4x2 -> 2x4).
     results = _launch_workers(
-        _WORKER4, tmp_path, nprocs=4, extra_env=_NO_SLABS
+        _WORKER_N, tmp_path, nprocs=4,
+        extra_env={**_NO_SLABS, "TSNP_BALLAST_RANK": "2"},
     )
     for r, (rc, out) in enumerate(results):
         assert rc == 0, f"rank {r} failed:\n{out}"
@@ -451,6 +462,124 @@ def test_four_controllers_mixed_geometry_skew_and_reshard(tmp_path):
     # replicated boxes evenly ([6,6,6,6]) and this must fail
     counts = [len(w) for w in writes]
     assert counts[2] < min(counts[0], counts[1], counts[3]), counts
+
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def eight_proc_run(tmp_path_factory):
+    """ONE 8-process fan-out shared by both 8x1 tests (each launch
+    costs minutes of the 1-core box; the second test only needs the
+    written snapshot, not a fresh run)."""
+    root = tmp_path_factory.mktemp("mc8")
+    results = _launch_workers(
+        _WORKER_N, root, nprocs=8,
+        extra_env={**_NO_SLABS, "TSNP_BALLAST_RANK": "5"}, timeout=420,
+    )
+    return root, results
+
+
+def test_eight_controllers_process_per_device(eight_proc_run):
+    # VERDICT r4 #4: the process-per-device extreme. 8 procs x 1 device:
+    # manifest identity, globally disjoint union-covering writes, the
+    # skewed-preload balance at single-candidate/8-candidate replica
+    # sets, and a cross-topology restore (save 8x1, restore 2x4).
+    tmp_path, results = eight_proc_run
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} OK8" in out
+
+    manifests = [
+        (tmp_path / f"manifest_{r}.txt").read_text() for r in range(8)
+    ]
+    assert all(m == manifests[0] for m in manifests[1:])
+
+    writes = []
+    for r in range(8):
+        with open(tmp_path / f"writes_{r}.log") as f:
+            writes.append(
+                {line.strip() for line in f if "sharded/" in line}
+            )
+    for a in range(8):
+        for b in range(a + 1, 8):
+            assert not (writes[a] & writes[b]), (a, b)
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+    meta = SnapshotMetadata.from_yaml(
+        (tmp_path / "snap" / ".snapshot_metadata").read_text()
+    )
+    manifest_locations = {
+        s.location
+        for e in meta.manifest.values()
+        if hasattr(e, "shards")
+        for s in e.shards
+    }
+    assert manifest_locations == set().union(*writes)
+
+    # the single-candidate boxes ("w", "wflat") are pinned to their one
+    # owner, so every rank writes at least those; the balancer's freedom
+    # is only over the 8 replicated leaves — rank 5 (8MB ballast) must
+    # get STRICTLY fewer boxes than every other rank
+    counts = [len(w) for w in writes]
+    assert counts[5] < min(c for i, c in enumerate(counts) if i != 5), counts
+
+
+def test_eight_controller_snapshot_restores_single_controller_8x1(
+    eight_proc_run,
+):
+    # the reverse direction of the cross-topology pair: a snapshot
+    # written by 8 single-device controllers restores in ONE process
+    # onto an 8x1 mesh (elastic scale-down to a single controller)
+    tmp_path, results = eight_proc_run
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import jax
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from torchsnapshot_tpu import PyTreeState, Snapshot
+
+    devs = jax.devices()
+    assert len(devs) == 8
+    mesh = Mesh(np.array(devs).reshape(8, 1), ("dp", "tp"))
+    W = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+
+    def template(shape, spec):
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            shape, sh, lambda idx: np.zeros(shape, np.float32)[idx]
+        )
+
+    dest = PyTreeState(
+        {
+            "w": template((16, 8), P("dp", "tp")),
+            "wflat": template((16, 8), P(("dp", "tp"), None)),
+            **{f"r{i}": template((8, 4), P(None, "tp")) for i in range(8)},
+            "ballast": np.ones(8, np.float32),
+        }
+    )
+    Snapshot(str(tmp_path / "snap")).restore({"ts": dest}, strict=False)
+    expected = {
+        "w": W,
+        "wflat": W * 3.0,
+        **{
+            f"r{i}": np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+            * (i + 1)
+            for i in range(8)
+        },
+    }
+    for name, want in expected.items():
+        got = np.asarray(dest.tree[name])
+        np.testing.assert_array_equal(got, want, err_msg=name)
 
 
 def test_four_controllers_async_take_peer_failure(tmp_path):

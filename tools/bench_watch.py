@@ -36,7 +36,12 @@ BENCH = os.path.join(REPO, "bench.py")
 _STATE = os.environ.get("TSNP_BENCH_STATE_DIR", REPO)
 LOG = os.path.join(_STATE, ".bench_watch.log")
 PIDFILE = os.path.join(_STATE, ".bench_watch.pid")
-_POLL_S = float(os.environ.get("TSNP_WATCH_POLL_S", "60"))
+try:
+    _POLL_S = float(os.environ.get("TSNP_WATCH_POLL_S", "60"))
+except ValueError:
+    # malformed env must not kill the watcher at import — an import
+    # crash silently ends opportunistic hardware capture for the round
+    _POLL_S = 60.0
 
 if REPO not in sys.path:
     sys.path.insert(0, REPO)

@@ -8,10 +8,38 @@ own is NOT a successful kill) and the silent-wedge watchdog (a child
 that stops emitting lines is reaped, never hangs CI) live here.
 """
 
+import os
 import subprocess
+import sys
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+
+def spawn_fuzz_child(
+    child_src: str, repo_root: str, extra_env: Dict[str, str]
+) -> "subprocess.Popen[str]":
+    """Spawn a crash-fuzz child with the shared env discipline (CPU
+    backend, axon hook disabled) and stdout/stderr merged so tracebacks
+    land in the marker stream — kept here so the fuzz tests cannot
+    drift apart on spawn mechanics."""
+    env = {
+        **os.environ,
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "TSNP_REPO": repo_root,
+        **extra_env,
+    }
+    return subprocess.Popen(
+        [sys.executable, "-c", child_src],
+        stdout=subprocess.PIPE,
+        # tracebacks must land in the marker stream: a child that
+        # crashes on its own is the interesting fuzz outcome, and
+        # DEVNULL would discard the only diagnostic
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
 
 
 def kill_child_at(

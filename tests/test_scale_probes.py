@@ -155,7 +155,9 @@ def test_default_knob_overhead_ratio():
 
     floor = best(nobatch=True, nocksum=True)
     defaults = best()
-    assert defaults < floor * 6 + 0.05, (
+    # round-5 level: ~1.5x on a quiet core (fused write+digest in the
+    # memory plugin removed the second full pass over the staged bytes)
+    assert defaults < floor * 2 + 0.05, (
         f"default-knob overhead regressed: {defaults:.3f}s vs floor "
-        f"{floor:.3f}s ({defaults / floor:.1f}x; round-4 level is ~2.6x)"
+        f"{floor:.3f}s ({defaults / floor:.1f}x; round-5 level is ~1.5x)"
     )

@@ -144,6 +144,185 @@ class S3ParamValidationError(TypeError):
     call shape would be rejected client-side before any network I/O."""
 
 
+class S3ResponseShapeError(AssertionError):
+    """A fake produced a response the real service never would — the
+    response-side analogue of S3ParamValidationError (VERDICT r4 #5:
+    the vendored slice validated requests only; the members the plugin
+    CONSUMES were unmodeled)."""
+
+
+class FakeStreamingBody:
+    """botocore.response.StreamingBody's consumed surface, no looser.
+
+    Real StreamingBody is a non-seekable wrapper over the HTTP stream:
+    ``read(amt=None)`` drains (or returns at most ``amt`` bytes, then
+    b"" at EOF) and ``close()`` releases the connection.  A fake
+    returning io.BytesIO would also offer seek()/getvalue()/etc., so a
+    plugin bug that relied on seeking would pass the fake and fail
+    against real S3 — this wrapper exposes ONLY the modeled methods."""
+
+    def __init__(self, data: bytes) -> None:
+        self._data = bytes(data)
+        self._pos = 0
+        self.closed = False
+
+    def read(self, amt: int = None) -> bytes:
+        if self.closed:
+            raise ValueError("read on closed StreamingBody")
+        if amt is None:
+            out = self._data[self._pos:]
+            self._pos = len(self._data)
+        else:
+            out = self._data[self._pos : self._pos + amt]
+            self._pos += len(out)
+        return out
+
+    def close(self) -> None:
+        self.closed = True
+
+
+def validate_response(
+    python_name: str, request_kwargs: Dict[str, Any], response: Any
+) -> None:
+    """Validate a fake's RESPONSE against the consumed output shapes.
+
+    Checks, per operation, the members the s3 plugin reads (storage/
+    s3.py: GetObject → Body.read(); HeadObject → ContentLength) plus
+    the invariants the real service guarantees for them:
+
+    - GetObject: ``Body`` present with StreamingBody semantics (read,
+      close; NOT seekable); on a ranged request, ``ContentRange`` is
+      present, formatted ``bytes <lo>-<hi>/<total>``, and consistent
+      with both the requested range and ``ContentLength`` when present.
+    - HeadObject: ``ContentLength`` is a non-negative int.
+    - CopyObject: ``CopyObjectResult`` is a dict when present.
+    - Every present member must be in the modeled output list — a fake
+      inventing members the model doesn't know about is drift.
+    """
+    op = PY_TO_OP[python_name]
+    model = S3_MODEL[op]
+    if not isinstance(response, dict) and response is not None:
+        raise S3ResponseShapeError(f"{op}: response must be a dict")
+    resp = response or {}
+    unknown = set(resp) - set(model["output"]) - {"ResponseMetadata"}
+    if unknown:
+        raise S3ResponseShapeError(
+            f"{op}: unmodeled response member(s) {sorted(unknown)}"
+        )
+    if op == "GetObject":
+        body = resp.get("Body")
+        if body is None:
+            raise S3ResponseShapeError("GetObject: Body missing")
+        if not callable(getattr(body, "read", None)) or not callable(
+            getattr(body, "close", None)
+        ):
+            raise S3ResponseShapeError(
+                "GetObject: Body lacks StreamingBody read/close"
+            )
+        # seekability: real StreamingBody subclasses io.IOBase, whose
+        # inherited ``seek`` IS callable but ``seekable()`` is False —
+        # mere attribute callability would reject the real article, so
+        # ask seekable() when available and fall back to the attribute
+        # check only for non-IOBase duck types
+        seekable = getattr(body, "seekable", None)
+        is_seekable = (
+            bool(seekable())
+            if callable(seekable)
+            else callable(getattr(body, "seek", None))
+        )
+        if is_seekable:
+            raise S3ResponseShapeError(
+                "GetObject: Body is seekable — real StreamingBody is "
+                "not; a fake must not be more permissive"
+            )
+        rng = request_kwargs.get("Range")
+        if rng is not None:
+            cr = resp.get("ContentRange")
+            if not isinstance(cr, str) or not cr.startswith("bytes "):
+                raise S3ResponseShapeError(
+                    f"GetObject(Range={rng!r}): ContentRange missing or "
+                    f"malformed: {cr!r}"
+                )
+            span, _, total = cr[len("bytes "):].partition("/")
+            lo_s, _, hi_s = span.partition("-")
+            try:
+                lo, hi, tot = int(lo_s), int(hi_s), int(total)
+            except ValueError:
+                raise S3ResponseShapeError(
+                    f"GetObject: unparseable ContentRange {cr!r}"
+                ) from None
+            want_lo, _, want_hi = rng[len("bytes="):].partition("-")
+            # real S3 CLAMPS an over-long range end to size-1 (still
+            # 206) — the response hi must equal the requested hi or the
+            # clamped object end, nothing else
+            hi_ok = want_hi == "" or hi == min(int(want_hi), tot - 1)
+            if int(want_lo) != lo or not hi_ok:
+                raise S3ResponseShapeError(
+                    f"GetObject: ContentRange {cr!r} does not match the "
+                    f"requested {rng!r}"
+                )
+            if not (0 <= lo <= hi < tot):
+                raise S3ResponseShapeError(
+                    f"GetObject: ContentRange bounds invalid: {cr!r}"
+                )
+            if "ContentLength" in resp and resp["ContentLength"] != (
+                hi - lo + 1
+            ):
+                raise S3ResponseShapeError(
+                    f"GetObject: ContentLength {resp['ContentLength']} "
+                    f"inconsistent with ContentRange {cr!r}"
+                )
+        if "ContentLength" in resp and (
+            not isinstance(resp["ContentLength"], int)
+            or resp["ContentLength"] < 0
+        ):
+            raise S3ResponseShapeError(
+                f"GetObject: bad ContentLength {resp['ContentLength']!r}"
+            )
+    elif op == "HeadObject":
+        cl = resp.get("ContentLength")
+        if not isinstance(cl, int) or cl < 0:
+            raise S3ResponseShapeError(
+                f"HeadObject: ContentLength must be a non-negative int, "
+                f"got {cl!r}"
+            )
+    elif op == "CopyObject":
+        if "CopyObjectResult" in resp and not isinstance(
+            resp["CopyObjectResult"], dict
+        ):
+            raise S3ResponseShapeError(
+                "CopyObject: CopyObjectResult must be a dict"
+            )
+
+
+# S3's documented COMMON errors are raisable on any object operation
+# (the per-op "errors" lists in the service model name only the
+# operation-specific ones; botocore surfaces whatever code the service
+# returns) — e.g. CopyObject on a missing source yields NoSuchKey even
+# though the model lists only ObjectNotInActiveTierError for it.
+# InvalidRange (HTTP 416) is what the service returns for a Range whose
+# start is at or past the object size (including ANY range on an empty
+# object) — not in the per-op model error lists either.
+COMMON_ERRORS = {"NoSuchKey", "NoSuchBucket", "AccessDenied", "InvalidRange"}
+
+
+def validate_error(python_name: str, code: str) -> None:
+    """An error a fake raises must carry a code the model, the common
+    set, or the documented HEAD special case allows — inventing error
+    codes hides plugin error-mapping bugs."""
+    op = PY_TO_OP[python_name]
+    allowed = set(S3_MODEL[op]["errors"]) | COMMON_ERRORS
+    if op == "HeadObject":
+        # HEAD responses carry no XML body, so botocore surfaces the
+        # bare HTTP status as the code — both spellings are real
+        allowed |= {"404"}
+    if code not in allowed:
+        raise S3ResponseShapeError(
+            f"{op}: error code {code!r} not in modeled set "
+            f"{sorted(allowed)}"
+        )
+
+
 def validate_call(python_name: str, kwargs: Dict[str, Any]) -> str:
     """Validate a client call against the vendored model; returns the
     operation name.  Raises S3ParamValidationError exactly where real

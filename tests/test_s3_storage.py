@@ -12,20 +12,28 @@ from torchsnapshot_tpu.io_types import ReadIO, WriteIO
 from torchsnapshot_tpu.storage.s3 import S3StoragePlugin
 
 
-class NoSuchKey(Exception):
-    def __init__(self, key):
-        super().__init__(key)
-        self.response = {"Error": {"Code": "NoSuchKey"}}
+class FakeClientError(Exception):
+    """ClientError-shaped: carries response["Error"]["Code"], and the
+    code itself is validated against the model's error set — a fake
+    inventing codes would hide plugin error-mapping bugs."""
+
+    def __init__(self, python_name, code, key):
+        from s3_service_model import validate_error
+
+        validate_error(python_name, code)
+        super().__init__(f"{code}: {key}")
+        self.response = {"Error": {"Code": code}}
 
 
 class FakeBoto3Client:
     """The put_object/get_object/delete_object surface the plugin uses.
 
     EVERY call is validated against the vendored S3 service-model slice
-    (s3_service_model.py) before the fake behaves — unknown kwargs,
-    missing required members, or mistyped values fail exactly where the
-    real boto3 client's ParamValidationError would, so the whole S3
-    suite doubles as a fidelity gate with no boto3 in the image."""
+    (s3_service_model.py) before the fake behaves, and EVERY response it
+    returns is validated against the model's consumed output shapes
+    (Body stream semantics, ContentRange math, error codes) — so the
+    whole S3 suite doubles as a bidirectional fidelity gate with no
+    boto3 in the image."""
 
     def __init__(self):
         self.objects = {}
@@ -39,32 +47,73 @@ class FakeBoto3Client:
         self.validated.append((op, dict(kwargs)))
         return op
 
+    def _respond(self, python_name, kwargs, response):
+        from s3_service_model import validate_response
+
+        validate_response(python_name, kwargs, response)
+        return response
+
+    @staticmethod
+    def _etag(data: bytes) -> str:
+        import hashlib
+
+        return '"%s"' % hashlib.md5(data).hexdigest()
+
     def put_object(self, **kw):
         self._validated("put_object", kw)
         Bucket, Key = kw["Bucket"], kw["Key"]
         self.calls.append(("put", Bucket, Key))
-        self.objects[(Bucket, Key)] = bytes(kw.get("Body", b""))
+        body = kw.get("Body", b"")
+        data = body.encode() if isinstance(body, str) else bytes(body)
+        self.objects[(Bucket, Key)] = data
+        return self._respond("put_object", kw, {"ETag": self._etag(data)})
 
     def get_object(self, **kw):
+        from s3_service_model import FakeStreamingBody
+
         self._validated("get_object", kw)
         Bucket, Key, Range = kw["Bucket"], kw["Key"], kw.get("Range")
         self.calls.append(("get", Bucket, Key, Range))
         if (Bucket, Key) not in self.objects:
-            raise NoSuchKey(Key)
+            raise FakeClientError("get_object", "NoSuchKey", Key)
         data = self.objects[(Bucket, Key)]
+        resp = {"ETag": self._etag(data)}
         if Range is not None:
             assert Range.startswith("bytes=")
             lo, hi = Range[len("bytes="):].split("-")
-            data = data[int(lo) : int(hi) + 1]  # S3 Range end is inclusive
-        return {"Body": io.BytesIO(data)}
+            lo_i = int(lo)
+            if lo_i >= len(data):
+                # range start at/past the object size (incl. any range
+                # on an empty object): real S3 answers HTTP 416
+                raise FakeClientError("get_object", "InvalidRange", Key)
+            # S3 Range end is inclusive and clamped to the object size
+            hi_i = min(int(hi), len(data) - 1)
+            resp["ContentRange"] = f"bytes {lo_i}-{hi_i}/{len(data)}"
+            data = data[lo_i : hi_i + 1]
+        resp["Body"] = FakeStreamingBody(data)
+        resp["ContentLength"] = len(data)
+        return self._respond("get_object", kw, resp)
 
     def head_object(self, **kw):
+        import datetime
+
         self._validated("head_object", kw)
         Bucket, Key = kw["Bucket"], kw["Key"]
         self.calls.append(("head", Bucket, Key))
         if (Bucket, Key) not in self.objects:
-            raise NoSuchKey(Key)
-        return {"ContentLength": len(self.objects[(Bucket, Key)])}
+            # HEAD carries no XML body: real botocore surfaces the bare
+            # HTTP status as the error code (s3_service_model.py)
+            raise FakeClientError("head_object", "404", Key)
+        data = self.objects[(Bucket, Key)]
+        return self._respond(
+            "head_object",
+            kw,
+            {
+                "ContentLength": len(data),
+                "ETag": self._etag(data),
+                "LastModified": datetime.datetime.now(datetime.timezone.utc),
+            },
+        )
 
     def copy_object(self, **kw):
         self._validated("copy_object", kw)
@@ -73,8 +122,13 @@ class FakeBoto3Client:
         self.calls.append(("copy", Bucket, Key, tuple(CopySource.items())))
         src = (CopySource["Bucket"], CopySource["Key"])
         if src not in self.objects:
-            raise NoSuchKey(CopySource["Key"])
+            raise FakeClientError("copy_object", "NoSuchKey", CopySource["Key"])
         self.objects[(Bucket, Key)] = self.objects[src]
+        return self._respond(
+            "copy_object",
+            kw,
+            {"CopyObjectResult": {"ETag": self._etag(self.objects[src])}},
+        )
 
     def delete_object(self, **kw):
         self._validated("delete_object", kw)
@@ -82,6 +136,7 @@ class FakeBoto3Client:
         self.calls.append(("delete", Bucket, Key))
         # S3 delete is idempotent: deleting a missing key succeeds
         self.objects.pop((Bucket, Key), None)
+        return self._respond("delete_object", kw, {})
 
 
 def make_plugin():
@@ -190,6 +245,123 @@ def test_stat_via_head_object():
     assert ("head", "bkt", "run/1/obj") in p._backend.calls
     with pytest.raises(FileNotFoundError):
         run(p.stat("missing"))
+
+
+def test_streaming_body_semantics():
+    # the modeled StreamingBody surface: read(n) then read() then b"",
+    # close() poisons, and NO seek (io.BytesIO would offer one — a
+    # plugin relying on it would pass a loose fake and fail on real S3)
+    from s3_service_model import FakeStreamingBody
+
+    body = FakeStreamingBody(b"0123456789")
+    assert body.read(4) == b"0123"
+    assert body.read() == b"456789"
+    assert body.read() == b""
+    assert not hasattr(body, "seek") or not callable(
+        getattr(body, "seek", None)
+    )
+    body.close()
+    with pytest.raises(ValueError):
+        body.read()
+
+
+def test_response_validator_rejects_drifted_shapes():
+    from s3_service_model import (
+        FakeStreamingBody,
+        S3ResponseShapeError,
+        validate_response,
+    )
+
+    ok = {"Body": FakeStreamingBody(b"xy"), "ContentLength": 2}
+    validate_response("get_object", {"Bucket": "b", "Key": "k"}, ok)
+    # missing Body
+    with pytest.raises(S3ResponseShapeError, match="Body missing"):
+        validate_response("get_object", {"Bucket": "b", "Key": "k"}, {})
+    # seekable body (io.BytesIO) is MORE permissive than real S3
+    with pytest.raises(S3ResponseShapeError, match="seekable"):
+        validate_response(
+            "get_object",
+            {"Bucket": "b", "Key": "k"},
+            {"Body": io.BytesIO(b"xy")},
+        )
+    # ranged request without ContentRange
+    with pytest.raises(S3ResponseShapeError, match="ContentRange"):
+        validate_response(
+            "get_object",
+            {"Bucket": "b", "Key": "k", "Range": "bytes=0-1"},
+            {"Body": FakeStreamingBody(b"xy")},
+        )
+    # ContentRange inconsistent with the requested range
+    with pytest.raises(S3ResponseShapeError, match="does not match"):
+        validate_response(
+            "get_object",
+            {"Bucket": "b", "Key": "k", "Range": "bytes=5-9"},
+            {
+                "Body": FakeStreamingBody(b"xy"),
+                "ContentRange": "bytes 0-1/10",
+            },
+        )
+    # ContentLength disagreeing with ContentRange span
+    with pytest.raises(S3ResponseShapeError, match="inconsistent"):
+        validate_response(
+            "get_object",
+            {"Bucket": "b", "Key": "k", "Range": "bytes=0-3"},
+            {
+                "Body": FakeStreamingBody(b"abcd"),
+                "ContentRange": "bytes 0-3/10",
+                "ContentLength": 3,
+            },
+        )
+    # invented response members are drift
+    with pytest.raises(S3ResponseShapeError, match="unmodeled"):
+        validate_response(
+            "head_object",
+            {"Bucket": "b", "Key": "k"},
+            {"ContentLength": 3, "SurpriseMember": 1},
+        )
+    # HeadObject without ContentLength (the member the plugin consumes)
+    with pytest.raises(S3ResponseShapeError, match="ContentLength"):
+        validate_response("head_object", {"Bucket": "b", "Key": "k"}, {})
+
+
+def test_error_codes_validated_against_model():
+    from s3_service_model import S3ResponseShapeError
+
+    # modeled + common codes pass
+    FakeClientError("get_object", "NoSuchKey", "k")
+    FakeClientError("head_object", "404", "k")
+    FakeClientError("copy_object", "NoSuchKey", "k")  # common-set code
+    # invented codes fail
+    with pytest.raises(S3ResponseShapeError, match="NoSuchKeyy"):
+        FakeClientError("get_object", "NoSuchKeyy", "k")
+    with pytest.raises(S3ResponseShapeError, match="418"):
+        FakeClientError("head_object", "418", "k")
+
+
+def test_ranged_read_content_range_math():
+    # the fake's ContentRange must satisfy the validator's math for
+    # edge spans: single byte, full object, last byte, and a range end
+    # OVERSHOOTING the object (server-side clamp to size-1, still 206)
+    p = make_plugin()
+    payload = bytes(range(50))
+    run(p.write(WriteIO(path="obj", buf=payload)))
+    for lo, end in ((0, 1), (0, 50), (49, 50), (10, 200)):
+        io_ = ReadIO(path="obj", byte_range=[lo, end])
+        run(p.read(io_))
+        assert bytes(io_.buf) == payload[lo : min(end, 50)], (lo, end)
+
+
+def test_ranged_read_past_object_is_416():
+    # a Range starting at/past the object size (incl. any range on an
+    # empty object) is HTTP 416 InvalidRange on real S3 — the fake must
+    # model the failure, not invent a degenerate ContentRange
+    p = make_plugin()
+    run(p.write(WriteIO(path="empty", buf=b"")))
+    with pytest.raises(FakeClientError, match="InvalidRange"):
+        run(p.read(ReadIO(path="empty", byte_range=[0, 1])))
+    run(p.write(WriteIO(path="obj", buf=b"abc")))
+    with pytest.raises(FakeClientError, match="InvalidRange"):
+        run(p.read(ReadIO(path="obj", byte_range=[3, 10])))
 
 
 def test_link_from_server_side_copy():

@@ -29,18 +29,35 @@ def _raise_missing_as_fnf(e: Exception, uri: str) -> None:
 
 
 class S3StoragePlugin(StoragePlugin):
-    def __init__(self, path: str, num_threads: int = 16) -> None:
+    def __init__(
+        self,
+        path: str,
+        num_threads: int = 16,
+        endpoint_url: str = None,
+    ) -> None:
+        import os
+
         self.bucket, _, self.prefix = path.partition("/")
         self._backend = None
+        # emulator/alternate-endpoint support (minio, localstack, any
+        # S3-compatible store): explicit arg wins, else the env var —
+        # env-based so snapshot-level s3:// URLs resolve against the
+        # emulator too (url_to_storage_plugin has no options channel)
+        endpoint_url = endpoint_url or os.environ.get(
+            "TSNP_S3_ENDPOINT_URL"
+        ) or None
+        client_extra = {"endpoint_url": endpoint_url} if endpoint_url else {}
         try:
             import boto3
 
-            self._backend = boto3.client("s3")
+            self._backend = boto3.client("s3", **client_extra)
         except ImportError:
             try:
                 import s3fs
 
-                self._backend = s3fs.S3FileSystem()
+                self._backend = s3fs.S3FileSystem(
+                    client_kwargs=client_extra or None
+                )
                 self._is_fs = True
             except ImportError:
                 raise RuntimeError(

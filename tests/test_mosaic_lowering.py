@@ -1,0 +1,121 @@
+"""Static Mosaic-lowering checks for the pallas flash kernels, on CPU.
+
+Interpret mode (how CI exercises kernel NUMERICS) never runs the Mosaic
+lowering pipeline, so a kernel could be numerically perfect yet
+unlowerable on real TPU hardware — exactly what happened: the row-stat
+outputs used (1, BQ) blocks whose second-minor dim (1) is neither
+8-divisible nor equal to the array dim, and Mosaic rejects that at
+lowering time (VERDICT r4 #6 asked for precisely this check; the probe
+found a real bug on its first run).
+
+``jax.export`` cross-platform lowering runs the FULL jax-side Mosaic
+pipeline on a CPU-only box — `lower_jaxpr_to_module` builds and
+verifies the Mosaic MLIR and serializes it into `tpu_custom_call`.
+What remains hardware-only is the XLA TPU compiler consuming that
+module (the bench's `pallas_probe_ok` covers it when a chip is up).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+from jax import export  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+from torchsnapshot_tpu import knobs  # noqa: E402
+from torchsnapshot_tpu.ops import flash_attention as fa  # noqa: E402
+
+
+def _clear_kernel_caches():
+    # ``interpret=_use_interpret()`` is evaluated at TRACE time, so a
+    # trace made while this fixture forces compiled lowering would be
+    # replayed (with interpret=False baked in) by later interpret-mode
+    # tests sharing shapes — clear both the jit trace cache and the
+    # custom_vjp lru on entry AND exit
+    fa._flash_partials_jit.clear_cache()
+    fa._flash_bwd_jit.clear_cache()
+    fa._make_diff_partials.cache_clear()
+
+
+@pytest.fixture
+def _force_compiled_lowering(monkeypatch):
+    """Lowering for platform 'tpu' must take the compiled (Mosaic)
+    path, not interpret — that's the entire point of the check."""
+    if not fa.PALLAS_AVAILABLE:
+        pytest.skip("pallas unavailable")
+    _clear_kernel_caches()
+    monkeypatch.setattr(fa, "_use_interpret", lambda: False)
+    yield
+    _clear_kernel_caches()
+
+
+def _export_tpu(fn, *args):
+    return export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+@pytest.mark.parametrize(
+    "b,s,h,d,causal",
+    [(1, 512, 2, 128, True), (2, 1024, 4, 128, False), (1, 384, 1, 64, True)],
+)
+def test_forward_kernel_lowers_under_mosaic(_force_compiled_lowering, b, s, h, d, causal):
+    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+    with knobs.override_pallas_attention("1"):
+        exp = _export_tpu(
+            lambda q, k, v: fa.flash_attention(q, k, v, causal=causal),
+            q, q, q,
+        )
+    txt = exp.mlir_module()
+    assert txt.count("tpu_custom_call") == 1, "kernel did not lower to Mosaic"
+
+
+def test_backward_kernels_lower_under_mosaic(_force_compiled_lowering):
+    b, s, h, d = 1, 512, 2, 128
+    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        out = fa.flash_attention(q, k, v, causal=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    with knobs.override_pallas_attention("1"):
+        exp = _export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+    # forward (for residuals) + dq kernel + dkv kernel
+    assert exp.mlir_module().count("tpu_custom_call") == 3
+
+
+def test_partials_contract_lowers_with_offsets(_force_compiled_lowering):
+    # the ring-attention entry point: offsets ride scalar prefetch
+    b, s, h, d = 1, 256, 2, 128
+    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+
+    def f(q, k, v):
+        pv, m, l, valid = fa.flash_attention_partials(
+            q, k, v, q_offset=256, k_offset=0, causal=True,
+            scale=1.0 / d ** 0.5,
+        )
+        return pv, m, l, valid
+
+    with knobs.override_pallas_attention("1"):
+        exp = _export_tpu(f, q, q, q)
+    assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_interpret_numerics_match_lowerable_layout():
+    if not fa.PALLAS_AVAILABLE:
+        pytest.skip("pallas unavailable")
+    # the layout that lowers is the layout CI validates numerically:
+    # interpret-mode flash vs dense XLA attention, same [bh,1,s] stats
+    from torchsnapshot_tpu.parallel.ring_attention import dense_attention
+
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 3)
+    b, s, h, d = 1, 256, 2, 64
+    q, k, v = (
+        jax.random.normal(kk, (b, s, h, d), jnp.float32) for kk in ks
+    )
+    with knobs.override_pallas_attention("1"):
+        got = fa.flash_attention(q, k, v, causal=True)
+    want = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )

@@ -139,8 +139,12 @@ def _attend_kernel(
     k_ref,  # [1, BK, D]      (one kv block resident at a time)
     v_ref,  # [1, BK, D]
     out_ref,  # [1, BQ, D]     (index_map ignores kv dim → stays in VMEM)
-    m_ref,  # [1, BQ]
-    l_ref,  # [1, BQ]
+    m_ref,  # [1, 1, BQ]  (row stats ride a [bh, 1, s] layout: a 2-D
+    #  [bh, s] output would need a (1, BQ) block whose second-minor dim
+    #  (1) is neither 8-divisible nor equal to bh — Mosaic rejects it;
+    #  with the singleton axis the block's trailing dims (1, BQ) match
+    #  (array dim, 128-multiple) and lowering is legal)
+    l_ref,  # [1, 1, BQ]
     acc_sc,  # VMEM scratch [BQ, D]: running accumulator
     m_sc,  # VMEM scratch [BQ]: running row max
     l_sc,  # VMEM scratch [BQ]: running row sumexp
@@ -197,8 +201,8 @@ def _attend_kernel(
     @pl.when(kb == pl.num_programs(2) - 1)
     def _emit():
         out_ref[0] = acc_sc[:]
-        m_ref[0] = m_sc[:]
-        l_ref[0] = l_sc[:]
+        m_ref[0, 0] = m_sc[:]
+        l_ref[0, 0] = l_sc[:]
 
 
 def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
@@ -244,8 +248,8 @@ def _flash_partials_jit(
             ],
             out_specs=[
                 pl.BlockSpec((1, _BQ, d), lambda i, j, kb, offs: (i, j, 0)),
-                pl.BlockSpec((1, _BQ), lambda i, j, kb, offs: (i, j)),
-                pl.BlockSpec((1, _BQ), lambda i, j, kb, offs: (i, j)),
+                pl.BlockSpec((1, 1, _BQ), lambda i, j, kb, offs: (i, 0, j)),
+                pl.BlockSpec((1, 1, _BQ), lambda i, j, kb, offs: (i, 0, j)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((_BQ, d), jnp.float32),
@@ -258,15 +262,15 @@ def _flash_partials_jit(
                 (bh, sq_pad, d), jnp.float32, vma=frozenset(vma)
             ),
             jax.ShapeDtypeStruct(
-                (bh, sq_pad), jnp.float32, vma=frozenset(vma)
+                (bh, 1, sq_pad), jnp.float32, vma=frozenset(vma)
             ),
             jax.ShapeDtypeStruct(
-                (bh, sq_pad), jnp.float32, vma=frozenset(vma)
+                (bh, 1, sq_pad), jnp.float32, vma=frozenset(vma)
             ),
         ],
         interpret=_use_interpret(),
     )(offs, qp, kp, vp)
-    return out[:, :sq, :d0], m[:, :sq], l[:, :sq]
+    return out[:, :sq, :d0], m[:, 0, :sq], l[:, 0, :sq]
 
 
 def _partials_impl(q, k, v, qo, ko, causal: bool, scale: float, vma: tuple):
@@ -291,11 +295,11 @@ def _bwd_dq_kernel(
     q_ref,  # [1, BQ, D]
     k_ref,  # [1, BK, D]
     v_ref,  # [1, BK, D]
-    m_ref,  # [1, BQ]   final row max (m_safe) from the forward
+    m_ref,  # [1, 1, BQ]  final row max (m_safe) from the forward
     gpv_ref,  # [1, BQ, D]  cotangent of pv (f32)
-    gl_ref,  # [1, BQ]     cotangent of l
+    gl_ref,  # [1, 1, BQ]  cotangent of l
     dq_ref,  # [1, BQ, D]  out (f32)
-    amax_ref,  # [1, BQ]   out (i32): global col of the row max
+    amax_ref,  # [1, 1, BQ]  out (i32): global col of the row max
     dq_sc,  # VMEM [BQ, D] f32
     amax_sc,  # VMEM [BQ] i32 (-1 = none valid yet)
     runm_sc,  # VMEM [BQ] f32: running max of recomputed scores
@@ -325,9 +329,9 @@ def _bwd_dq_kernel(
     q = q_ref[0].astype(jnp.float32) * scale
     k_blk = k_ref[0].astype(jnp.float32)
     v_blk = v_ref[0].astype(jnp.float32)
-    m = m_ref[0]
+    m = m_ref[0, 0]
     gpv = gpv_ref[0].astype(jnp.float32)
-    gl = gl_ref[0]
+    gl = gl_ref[0, 0]
 
     scores, mask, k_idx = _block_scores(
         q, k_blk, jq, kb, q_offset, k_offset, sk_real, sq_real, causal
@@ -365,7 +369,7 @@ def _bwd_dq_kernel(
     @pl.when(kb == pl.num_programs(2) - 1)
     def _emit():
         dq_ref[0] = dq_sc[:]
-        amax_ref[0] = amax_sc[:]
+        amax_ref[0, 0] = amax_sc[:]
 
 
 def _bwd_dkv_kernel(
@@ -373,9 +377,9 @@ def _bwd_dkv_kernel(
     q_ref,  # [1, BQ, D]
     k_ref,  # [1, BK, D]
     v_ref,  # [1, BK, D]
-    m_ref,  # [1, BQ]
+    m_ref,  # [1, 1, BQ]
     gpv_ref,  # [1, BQ, D]
-    gl_ref,  # [1, BQ]
+    gl_ref,  # [1, 1, BQ]
     dk_ref,  # [1, BK, D] out (f32)
     dv_ref,  # [1, BK, D] out (f32)
     dk_sc,  # VMEM [BK, D] f32
@@ -399,9 +403,9 @@ def _bwd_dkv_kernel(
     q = q_ref[0].astype(jnp.float32) * scale
     k_blk = k_ref[0].astype(jnp.float32)
     v_blk = v_ref[0].astype(jnp.float32)
-    m = m_ref[0]
+    m = m_ref[0, 0]
     gpv = gpv_ref[0].astype(jnp.float32)
-    gl = gl_ref[0]
+    gl = gl_ref[0, 0]
 
     scores, mask, _ = _block_scores(
         q, k_blk, jq, kb, q_offset, k_offset, sk_real, sq_real, causal
@@ -449,8 +453,8 @@ def _flash_bwd_jit(
     kp = _pad_to(_pad_to(k, 1, _BK), 2, _LANE)
     vp = _pad_to(_pad_to(v, 1, _BK), 2, _LANE)
     gpvp = _pad_to(_pad_to(gpv.astype(jnp.float32), 1, _BQ), 2, _LANE)
-    mp = _pad_to(m, 1, _BQ)
-    glp = _pad_to(gl, 1, _BQ)
+    mp = _pad_to(m, 1, _BQ)[:, None, :]    # [bh, 1, sq_pad]
+    glp = _pad_to(gl, 1, _BQ)[:, None, :]  # [bh, 1, sq_pad]
     sq_pad, d = qp.shape[1], qp.shape[2]
     sk_pad = kp.shape[1]
     offs = jnp.concatenate(
@@ -469,13 +473,13 @@ def _flash_bwd_jit(
                 pl.BlockSpec((1, _BQ, d), lambda i, j, kb, o: (i, j, 0)),
                 pl.BlockSpec((1, _BK, d), lambda i, j, kb, o: (i, kb, 0)),
                 pl.BlockSpec((1, _BK, d), lambda i, j, kb, o: (i, kb, 0)),
-                pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
+                pl.BlockSpec((1, 1, _BQ), lambda i, j, kb, o: (i, 0, j)),
                 pl.BlockSpec((1, _BQ, d), lambda i, j, kb, o: (i, j, 0)),
-                pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
+                pl.BlockSpec((1, 1, _BQ), lambda i, j, kb, o: (i, 0, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, _BQ, d), lambda i, j, kb, o: (i, j, 0)),
-                pl.BlockSpec((1, _BQ), lambda i, j, kb, o: (i, j)),
+                pl.BlockSpec((1, 1, _BQ), lambda i, j, kb, o: (i, 0, j)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((_BQ, d), jnp.float32),
@@ -485,7 +489,7 @@ def _flash_bwd_jit(
         ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq_pad, d), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((bh, sq_pad), jnp.int32, vma=vma),
+            jax.ShapeDtypeStruct((bh, 1, sq_pad), jnp.int32, vma=vma),
         ],
         interpret=_use_interpret(),
     )(offs, qp, kp, vp, mp, gpvp, glp)
@@ -503,9 +507,9 @@ def _flash_bwd_jit(
                 pl.BlockSpec((1, _BQ, d), lambda i, kb, j, o: (i, j, 0)),
                 pl.BlockSpec((1, _BK, d), lambda i, kb, j, o: (i, kb, 0)),
                 pl.BlockSpec((1, _BK, d), lambda i, kb, j, o: (i, kb, 0)),
-                pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
+                pl.BlockSpec((1, 1, _BQ), lambda i, kb, j, o: (i, 0, j)),
                 pl.BlockSpec((1, _BQ, d), lambda i, kb, j, o: (i, j, 0)),
-                pl.BlockSpec((1, _BQ), lambda i, kb, j, o: (i, j)),
+                pl.BlockSpec((1, 1, _BQ), lambda i, kb, j, o: (i, 0, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, _BK, d), lambda i, kb, j, o: (i, kb, 0)),
@@ -526,7 +530,7 @@ def _flash_bwd_jit(
         dq[:, :sq, :d0],
         dk[:, :sk, :d0],
         dv[:, :sk, :d0],
-        amax[:, :sq],
+        amax[:, 0, :sq],
     )
 
 

@@ -147,6 +147,40 @@ def test_ring_attention_lowers_for_tpu_mesh(_force_compiled_lowering):
     assert gtxt.count("collective_permute") >= 1, "backward ring missing"
 
 
+def test_flagship_train_step_exports_for_tpu():
+    """The flagship model's FULL sharded training step (the program
+    `dryrun_multichip` executes on the virtual mesh) must also lower
+    for TPU: GSPMD programs carry sharding annotations through
+    StableHLO, so a TPU-illegal op or layout in the train step would
+    fail here on the CPU box instead of at first contact with a chip."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu.models.transformer import (
+        TransformerConfig,
+        make_train_state,
+        train_step,
+    )
+    from torchsnapshot_tpu.parallel.mesh import build_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = build_mesh(8)
+    cfg = TransformerConfig.tiny()
+    ts = make_train_state(cfg, seed=0, mesh=mesh)
+    dp = mesh.shape["dp"]
+    tokens = jax.device_put(
+        np.zeros((max(2, dp) * 2, 32), np.int32),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    with mesh:
+        exp = _export_tpu(train_step, ts, tokens)
+    txt = exp.mlir_module()
+    # GSPMD: the mesh shardings must survive into the exported module
+    # (the XLA TPU compiler partitions from these annotations)
+    assert "sharding" in txt
+    assert exp.platforms == ("tpu",)
+
+
 def test_interpret_numerics_match_lowerable_layout():
     if not fa.PALLAS_AVAILABLE:
         pytest.skip("pallas unavailable")

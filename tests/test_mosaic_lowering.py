@@ -175,9 +175,13 @@ def test_flagship_train_step_exports_for_tpu():
     with mesh:
         exp = _export_tpu(train_step, ts, tokens)
     txt = exp.mlir_module()
-    # GSPMD: the mesh shardings must survive into the exported module
-    # (the XLA TPU compiler partitions from these annotations)
-    assert "sharding" in txt
+    # the mesh shardings must survive into the exported module as
+    # CONCRETE Shardy annotations naming both mesh axes (the XLA TPU
+    # compiler partitions from these) — a bare substring check would
+    # pass on any single default annotation
+    assert txt.count("sdy.sharding") >= 4, "sharding annotations lost"
+    assert '{"dp"}' in txt, "dp axis sharding missing from export"
+    assert '{"tp"}' in txt, "tp axis sharding missing from export"
     assert exp.platforms == ("tpu",)
 
 

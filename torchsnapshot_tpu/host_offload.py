@@ -94,8 +94,14 @@ def _watch_releases(q) -> None:
     pending: List[Any] = []
     while True:
         try:
+            # the loop can block on q.get for MINUTES between takes;
+            # a lingering `job` local from the previous iteration would
+            # keep that take's pinned-host copies (2x payload) alive
+            # the whole time — clear every strong local before blocking
+            job = None
             job = q.get(timeout=0.05 if pending else None)
             pending.append(job)
+            job = None
         except _queue.Empty:
             pass
         still: List[Any] = []
@@ -122,6 +128,9 @@ def _watch_releases(q) -> None:
             for sts in stager_lists:
                 for st in sts:
                     st.fallback_arr = None
+        # the for-loop targets outlive the loop; while this thread then
+        # blocks on q.get they would pin the last job's host copies
+        host_arrays = stager_lists = None
         pending = still
 
 

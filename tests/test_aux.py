@@ -399,3 +399,31 @@ def test_serialize_transfers_auto_gates_on_tunneled_backend(monkeypatch):
     assert knobs.serialize_transfers() is False
     with knobs.override_serialize_transfers("1"):
         assert knobs.serialize_transfers() is True
+
+
+def test_device_unpack_auto_off_on_tunneled_backend(monkeypatch):
+    """auto device-unpack must resolve OFF wherever serialize_transfers
+    detects a tunneled transport: the unpack kernels compile lazily on
+    executor threads, and a non-main-thread jit compile wedges a
+    multiplexed remote PJRT attachment for minutes (hardware repro:
+    same kernel, main thread ~1.1s, worker thread never finished).  A
+    real TPU VM (no tunnel) keeps the one-DMA unpack; explicit "1"
+    still forces it anywhere (the CPU test suite relies on that)."""
+    import jax
+
+    from torchsnapshot_tpu import knobs
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,tpu")
+    assert knobs.serialize_transfers() is True
+    assert knobs.device_unpack_enabled() is False  # tunnel: host path
+    with knobs.override_device_unpack("1"):
+        assert knobs.device_unpack_enabled() is True  # forced: tests
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert knobs.device_unpack_enabled() is True  # real VM: unpack on
+    with knobs.override_serialize_transfers("1"):
+        # a manual transfer-gate override on healthy hardware must not
+        # disable the unpack — both autos key on the TRANSPORT class
+        assert knobs.device_unpack_enabled() is True
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert knobs.device_unpack_enabled() is False  # cpu: nothing to gain

@@ -232,10 +232,21 @@ def device_unpack_enabled() -> bool:
         return True
     if v in ("0", "false", "off"):
         return False
+    # auto: off on cpu (a host-memory device gains nothing from the
+    # one-DMA unpack), and off on TUNNELED attachments: the unpack
+    # kernels compile lazily on scheduler executor threads, and a jit
+    # compile issued from any non-main thread wedges a multiplexed
+    # remote PJRT transport for minutes (minimal repro on hardware: the
+    # same kernel compiled in ~1.1s from the main thread, never
+    # finished from a ThreadPoolExecutor worker — it was the whole of
+    # the 151s-vs-6.9s restore gap against orbax in the round-5
+    # capture).  _tunneled_transport() detects exactly that transport
+    # class.  The host path it falls back to does the bitcast as a
+    # zero-copy numpy view and compiles nothing.
     try:
         import jax
 
-        return jax.default_backend() != "cpu"
+        return jax.default_backend() != "cpu" and not _tunneled_transport()
     except Exception:  # no jax: the host path needs none
         return False
 
@@ -249,10 +260,18 @@ def serialize_transfers() -> bool:
     # auto: the pathological interleaving this guards against (concurrent
     # H2D puts thrashing a single multiplexed stream) is a property of
     # TUNNELED/proxied attachments, not of TPUs — a real TPU VM has
-    # independent DMA engines and wants overlap.  Gate only when the
-    # process targets a tunneled PJRT plugin (via env var or the
-    # programmatic jax.config path); direct-attached backends (cpu, tpu,
-    # gpu) resolve off.
+    # independent DMA engines and wants overlap.
+    return _tunneled_transport()
+
+
+def _tunneled_transport() -> bool:
+    """True when the process targets a tunneled/proxied PJRT plugin (via
+    env var or the programmatic jax.config path); direct-attached
+    backends (cpu, tpu, gpu) resolve False.  Shared by the
+    serialize_transfers and device_unpack autos — they gate on the
+    TRANSPORT class, not on each other's resolved value (a manual
+    SERIALIZE override on healthy hardware must not disable the
+    one-DMA unpack)."""
     explicit = os.environ.get("JAX_PLATFORMS", "") or ""
     try:
         import jax

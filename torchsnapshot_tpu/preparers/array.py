@@ -51,16 +51,22 @@ _TRANSFER_LOCK = threading.Lock()
 
 
 @contextlib.contextmanager
-def transfer_gate():
+def transfer_gate(gated: "bool | None" = None):
     """Serialize H2D transfers across consumer threads when
     ``knobs.serialize_transfers()`` resolves on (see knobs.py).
 
     Yields a list the caller appends in-flight arrays to; when gating is
     active the gate blocks on them BEFORE releasing the lock —
     ``device_put`` returns before the DMA completes, so releasing at
-    dispatch would let other threads' transfers overlap anyway."""
+    dispatch would let other threads' transfers overlap anyway.
+
+    ``gated`` lets a caller that already read the knob pin the decision
+    (a caller branching on its own read while the gate re-reads would
+    race a concurrent override into compiling outside the lock)."""
     pending: List[Any] = []
-    if not knobs.serialize_transfers():
+    if gated is None:
+        gated = knobs.serialize_transfers()
+    if not gated:
         yield pending
         return
     import jax

@@ -179,6 +179,68 @@ def test_persist_early_keeps_best(bench):
     assert json.loads(open(bench._EARLY_PATH).read())["value"] == 3.0
 
 
+def test_persist_early_carries_aux_blocks_forward(bench):
+    """A winning record whose child died before the aux phases must not
+    ERASE evidence an earlier capture carried (round-5 live lesson: run
+    2 beat run 1 on blocked value, died at the supervisor deadline
+    after restore, and best-wins dropped the on-chip Mosaic verdict +
+    orbax head-to-head from the stored record)."""
+    assert bench._persist_early(
+        _rec(
+            2.0,
+            attention={"pallas_compiled": True},
+            orbax_head_to_head={"speedup": {"blocked_s": 1000.0}},
+            incremental_save_s=200.0,
+        )
+    )
+    assert bench._persist_early(_rec(5.0))  # wins, but no aux blocks
+    stored = json.loads(open(bench._EARLY_PATH).read())
+    assert stored["value"] == 5.0
+    assert stored["attention"] == {"pallas_compiled": True}
+    assert stored["orbax_head_to_head"]["speedup"]["blocked_s"] == 1000.0
+    assert stored["incremental_save_s"] == 200.0
+    assert set(stored["aux_carried_from_capture"]) == {
+        "attention", "orbax_head_to_head", "incremental_save_s",
+    }
+    # a record that HAS its own aux block keeps it (no stale carry)
+    assert bench._persist_early(
+        _rec(6.0, attention={"pallas_compiled": False})
+    )
+    stored = json.loads(open(bench._EARLY_PATH).read())
+    assert stored["attention"] == {"pallas_compiled": False}
+    assert "attention" not in stored["aux_carried_from_capture"]
+    # chained carries keep the ORIGINAL measuring capture's stamp, not
+    # the intermediate record's
+    orbax_stamp = stored["aux_carried_from_capture"]["orbax_head_to_head"]
+    assert bench._persist_early(_rec(7.0))
+    stored = json.loads(open(bench._EARLY_PATH).read())
+    assert (
+        stored["aux_carried_from_capture"]["orbax_head_to_head"]
+        == orbax_stamp
+    )
+
+
+def test_persist_early_loss_path_merges_fresh_aux(bench):
+    """Mirror image of carry-forward: a fresh run that LOSES on value
+    but completed the aux phases is the only source of those blocks
+    when the stored winner's child died before them — they must land
+    in the stored record (stamps may postdate its headline capture)."""
+    assert bench._persist_early(_rec(9.0))  # winner, no aux blocks
+    assert bench._persist_early(
+        _rec(4.0, orbax_head_to_head={"speedup": {"restore_s": 0.93}})
+    ) is False  # value loses...
+    stored = json.loads(open(bench._EARLY_PATH).read())
+    assert stored["value"] == 9.0  # ...headline unchanged
+    assert stored["orbax_head_to_head"]["speedup"]["restore_s"] == 0.93
+    assert stored["aux_carried_from_capture"]["orbax_head_to_head"] > 0
+    # an existing stored block is NOT clobbered by a losing run's copy
+    assert bench._persist_early(
+        _rec(4.5, orbax_head_to_head={"speedup": {"restore_s": 0.05}})
+    ) is False
+    stored = json.loads(open(bench._EARLY_PATH).read())
+    assert stored["orbax_head_to_head"]["speedup"]["restore_s"] == 0.93
+
+
 def test_persist_early_refuses_cpu_records(bench):
     """BENCH_EARLY.json is the HARDWARE fallback: a CPU drive of bench.py
     (tests, verify runs) must never store a record the end-of-round bench

@@ -79,7 +79,14 @@ def _relay_alive() -> bool:
 
 
 def _bench_running() -> bool:
-    """True when a real bench.py process (supervisor or child) exists.
+    """True when a bench.py process (supervisor or child) of OUR KIND
+    exists — rehearsal watchers count only rehearsal benches and real
+    watchers only real ones, decided by TSNP_BENCH_REHEARSAL in each
+    candidate's /proc environ.  Without that scoping the two chains
+    deadlock each other: a live hardware bench made every rehearsal
+    watcher in the round-5 CI suite wait out its budget ("bench.py
+    already runs"), and a rehearsal running under pytest would
+    symmetrically stall a real window launch.
 
     NOT ``pgrep -f bench.py``: the round driver's own wrapper process
     embeds the literal string "bench.py" inside a giant prompt argument,
@@ -99,7 +106,25 @@ def _bench_running() -> bool:
                 argv = f.read().split(b"\0")
         except (OSError, ValueError):
             continue
-        if _bench._is_bench_argv(argv):
+        if not _bench._is_bench_argv(argv):
+            continue
+        try:
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                # exact NUL-delimited entry, mirroring bench._rehearsal's
+                # == "1" test: a substring match would misread
+                # TSNP_BENCH_REHEARSAL=10 or X_TSNP_BENCH_REHEARSAL=1
+                # and let a real watcher double-launch over the
+                # exclusive chip claim
+                their_rehearsal = (
+                    b"TSNP_BENCH_REHEARSAL=1"
+                    in f.read().split(b"\0")
+                )
+        except OSError:
+            # can't read environ (process exited, or not ours): treat
+            # as our kind — waiting is the safe direction for a REAL
+            # watcher, and rehearsal state dirs isolate everything else
+            their_rehearsal = _REHEARSAL
+        if their_rehearsal == _REHEARSAL:
             return True
     return False
 

@@ -26,6 +26,16 @@ def main() -> None:
     parser.add_argument("--gb", type=float, default=1.0)
     parser.add_argument("--budget-mb", type=int, default=100)
     parser.add_argument("--work-dir", default=None)
+    parser.add_argument(
+        "--device-template",
+        action="store_true",
+        help="read into a jax DEVICE template (the donated tile-chain "
+        "path: host stays O(budget), device at ~1x target + one tile). "
+        "NOTE: on a TUNNELED attachment the PJRT client itself retains "
+        "~1x host mirrors of device bytes (measured: 500MB RSS for raw "
+        "5x100MB device_puts with handles dropped), so end-to-end RSS "
+        "there reflects the transport, not the library",
+    )
     args = parser.parse_args()
 
     import numpy as np
@@ -43,20 +53,39 @@ def main() -> None:
     work = args.work_dir or tempfile.mkdtemp(prefix="tsnp_load_")
     try:
         snap = Snapshot.take(os.path.join(work, "snap"), {"t": StateDict(x=arr)})
-        out = np.zeros_like(arr)
-        # make every output page resident BEFORE measuring: np.zeros is
-        # calloc-backed, so otherwise the read faulting pages in counts
-        # the 1x output buffer itself as "RSS delta" and masks whether
-        # the library's transient buffers respect the budget
-        out.fill(0)
-        rss = []
-        with measure_rss_deltas(rss):
-            t0 = time.perf_counter()
-            snap.read_object(
-                "0/t/x", obj_out=out, memory_budget_bytes=args.budget_mb * 1024 * 1024
-            )
-            elapsed = time.perf_counter() - t0
-        assert np.array_equal(out, arr)
+        if args.device_template:
+            import jax
+            import jax.numpy as jnp
+
+            out = jnp.zeros((elems,), jnp.float32)
+            jax.block_until_ready(out)
+            rss = []
+            with measure_rss_deltas(rss):
+                t0 = time.perf_counter()
+                got = snap.read_object(
+                    "0/t/x",
+                    obj_out=out,
+                    memory_budget_bytes=args.budget_mb * 1024 * 1024,
+                )
+                jax.block_until_ready(got)
+                elapsed = time.perf_counter() - t0
+            assert np.array_equal(np.asarray(got[: 1 << 20]), arr[: 1 << 20])
+            assert np.array_equal(np.asarray(got[-(1 << 20):]), arr[-(1 << 20):])
+        else:
+            out = np.zeros_like(arr)
+            # make every output page resident BEFORE measuring: np.zeros is
+            # calloc-backed, so otherwise the read faulting pages in counts
+            # the 1x output buffer itself as "RSS delta" and masks whether
+            # the library's transient buffers respect the budget
+            out.fill(0)
+            rss = []
+            with measure_rss_deltas(rss):
+                t0 = time.perf_counter()
+                snap.read_object(
+                    "0/t/x", obj_out=out, memory_budget_bytes=args.budget_mb * 1024 * 1024
+                )
+                elapsed = time.perf_counter() - t0
+            assert np.array_equal(out, arr)
         print(
             f"read {args.gb:.2f} GB under {args.budget_mb} MB budget in "
             f"{elapsed:.2f}s ({args.gb / elapsed:.2f} GB/s) | "

@@ -42,7 +42,7 @@ _pack_jit = None
 # pack/unpack COMPLETED (evidence that the one-DMA path engaged on
 # hardware — failed attempts that fall back must not count); lock-
 # guarded because packs run concurrently from executor threads
-CALL_COUNTS = {"pack": 0, "unpack": 0}
+CALL_COUNTS = {"pack": 0, "unpack": 0, "tile_update": 0}
 _COUNT_LOCK = threading.Lock()
 
 
@@ -124,6 +124,91 @@ def _jitted_unpack(dtype_str, shape, out_dtype_str):
         return arr
 
     return jax.jit(unpack_one)
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_tile_update(acc_n, acc_dtype_str, tile_n, tile_dtype_str,
+                          device):
+    """AOT-compiled donated flat-accumulator tile write:
+    acc[off:off+tile_n] = tile (cast to the accumulator dtype on
+    device).  One small executable per (accumulator, tile) SIGNATURE —
+    budgeted device reads touch two signatures per array (full tiles +
+    the remainder tile), reused across arrays of the same shape class.
+    donate_argnums=0 makes the chain in-place: device peak stays at
+    ~1x the target plus one tile.
+
+    AOT (``.lower().compile()``) rather than lazy jit so callers can
+    force the compile onto the PLAN-TIME caller thread
+    (``warm_tile_updates``): the per-tile dispatch runs on the
+    scheduler loop thread, where a lazy first-call compile would wedge
+    a tunneled transport (non-main-thread compile — see
+    ``device_unpack_enabled``).  With only precompiled executables
+    dispatched there, this path is safe on EVERY transport."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import SingleDeviceSharding
+
+    try:
+        import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
+    except Exception:
+        pass
+
+    acc_dt = np.dtype(acc_dtype_str)
+    tile_dt = np.dtype(tile_dtype_str)
+    cast = acc_dt != tile_dt
+
+    def upd(acc, tile, off):
+        if cast:
+            tile = tile.astype(jnp.dtype(acc_dt))
+        return lax.dynamic_update_slice(acc, tile, (off,))
+
+    sharding = SingleDeviceSharding(device)
+    return (
+        jax.jit(upd, donate_argnums=0)
+        .lower(
+            jax.ShapeDtypeStruct((acc_n,), acc_dt, sharding=sharding),
+            jax.ShapeDtypeStruct((tile_n,), tile_dt, sharding=sharding),
+            jax.ShapeDtypeStruct((), np.int32),
+        )
+        .compile()
+    )
+
+
+def warm_tile_updates(acc_n, acc_dtype, tile_sigs, device) -> None:
+    """Compile every (tile_n, tile_dtype) signature the read plan will
+    dispatch — called at plan time on the CALLER thread (see
+    _compiled_tile_update's thread-safety note)."""
+    for tile_n, tile_dtype in tile_sigs:
+        _compiled_tile_update(
+            int(acc_n), str(np.dtype(acc_dtype)),
+            int(tile_n), str(np.dtype(tile_dtype)), device,
+        )
+
+
+def tile_update_device(acc, tile_np: np.ndarray, off: int):
+    """Write one host tile into a flat device accumulator, donating the
+    previous accumulator handle.  The tile H2D and the executable
+    dispatch ride the transfer gate like every other restore
+    transfer."""
+    import jax
+
+    from ..preparers.array import transfer_gate
+
+    device = list(acc.sharding.device_set)[0]
+    fn = _compiled_tile_update(
+        int(acc.shape[0]),
+        str(np.dtype(acc.dtype)),
+        int(tile_np.shape[0]),
+        str(np.dtype(tile_np.dtype)),
+        device,
+    )
+    with transfer_gate() as pending:
+        tile = jax.device_put(tile_np, device)
+        pending.append(tile)
+        out = fn(acc, tile, np.int32(off))
+    _count("tile_update")
+    return out
 
 
 def unpack_slab_to_device(buf, members, out_dtypes, device) -> List[Any]:

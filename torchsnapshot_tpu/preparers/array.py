@@ -418,6 +418,125 @@ class _TiledConsumer(BufferConsumer):
         return self.tile_bytes
 
 
+class _DeviceTileAcc:
+    """Shared flat device accumulator for a budgeted read into a jax
+    template: each tile chains a donated ``dynamic_update_slice``
+    (``ops.device_pack.tile_update_device``), so device peak stays at
+    ~1x the target plus one tile and host peak at O(budget) — the
+    reference's bounded-RSS random-access property
+    (benchmarks/load_tensor) extended to DEVICE targets, which is the
+    TPU-native case.  The user's template seeds the chain and is
+    consumed by the first update; on a mid-read failure the template is
+    therefore already donated — accessing it raises jax's
+    deleted-buffer error, a LOUDER outcome than the host tiled path's
+    documented garbage-contents one (_TileCrcFold CONTRACT note).
+
+    Updates are dispatched onto the scheduler's executor (the gate's
+    lock + transfer block must NEVER run on the loop thread — see
+    ArrayBufferConsumer), so concurrent tiles of the same read race on
+    the chain: a per-accumulator lock serializes them.  Tiles cover
+    disjoint ranges, so completion order is irrelevant.  Construction
+    happens at PLAN time on the caller thread and pre-compiles every
+    executable the chain will dispatch — flatten, tile updates, final
+    reshape (``warm_tile_updates``) — so worker threads never compile,
+    which keeps this path safe on tunneled transports where a
+    non-main-thread compile wedges (see knobs.device_unpack_enabled
+    for that failure mode)."""
+
+    def __init__(self, template, tile_sigs, payload_dtype) -> None:
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        from ..ops.device_pack import warm_tile_updates
+
+        self.out_shape = tuple(template.shape)
+        self.lock = threading.Lock()
+        device = list(template.sharding.device_set)[0]
+        n = int(np.prod(self.out_shape)) if self.out_shape else 1
+        acc_dt = np.dtype(template.dtype)
+        sharding = SingleDeviceSharding(device)
+
+        def _aot(fn, *avals):
+            return jax.jit(fn, donate_argnums=0).lower(*avals).compile()
+
+        flat_aval = jax.ShapeDtypeStruct((n,), acc_dt, sharding=sharding)
+        if self.out_shape != (n,):
+            # seed the chain with a DONATED flatten: a plain .reshape(-1)
+            # of a multi-d template leaves the caller's array alive for
+            # the whole read (2x device peak, no deleted-buffer signal)
+            shaped_aval = jax.ShapeDtypeStruct(
+                self.out_shape, acc_dt, sharding=sharding
+            )
+            self.acc = _aot(lambda a: a.reshape((n,)), shaped_aval)(template)
+            out_shape = self.out_shape
+            self._reshape = _aot(lambda a: a.reshape(out_shape), flat_aval)
+        else:
+            self.acc = template
+            self._reshape = None
+        warm_tile_updates(
+            n,
+            acc_dt,
+            tuple(
+                (t1 - t0, np.dtype(string_to_dtype(payload_dtype)))
+                for t0, t1 in tile_sigs
+            ),
+            device,
+        )
+
+    def update(self, tile_np: np.ndarray, off: int) -> None:
+        from ..ops.device_pack import tile_update_device
+
+        with self.lock:
+            self.acc = tile_update_device(self.acc, tile_np, off)
+
+    def finish(self):
+        if self._reshape is None:
+            return self.acc
+        return self._reshape(self.acc)
+
+
+class _DeviceTiledConsumer(BufferConsumer):
+    """Consume one byte-range tile into a shared device accumulator
+    (the jax-template twin of _TiledConsumer)."""
+
+    def __init__(
+        self,
+        acc: "_DeviceTileAcc",
+        elem_range: Tuple[int, int],
+        countdown: "_Countdown",
+        tile_bytes: int,
+        dtype: str,
+        crc_fold: Optional["_TileCrcFold"] = None,
+    ):
+        self.acc = acc
+        self.elem_range = elem_range
+        self.countdown = countdown
+        self.tile_bytes = tile_bytes
+        self.dtype = dtype
+        self.crc_fold = crc_fold
+
+    async def consume_buffer(
+        self, buf: Any, executor: Optional[Executor] = None
+    ) -> None:
+        start, end = self.elem_range
+        if self.crc_fold is not None:
+            self.crc_fold.record(start, buf)
+        np_arr = array_from_buffer(buf, self.dtype, (end - start,))
+        if executor is not None:
+            # the update runs transfer_gate (lock + block on the DMA),
+            # which must never block the scheduler loop thread — same
+            # rule as ArrayBufferConsumer's materialize dispatch
+            await asyncio.get_running_loop().run_in_executor(
+                executor, self.acc.update, np_arr, start
+            )
+        else:
+            self.acc.update(np_arr, start)
+        self.countdown.step()
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self.tile_bytes
+
+
 class _Countdown:
     """Run ``on_zero`` after N consume steps complete (consumers all run on
     the scheduler's single loop thread, so a plain counter suffices)."""
@@ -557,45 +676,92 @@ class ArrayIOPreparer:
             and (obj_out is None or isinstance(obj_out, np.ndarray)
                  or _is_torch_tensor(obj_out))
         )
-        if can_tile:
+        # jax-template twin: tiles stream through a donated device
+        # accumulator chain, keeping host at O(budget) and device at
+        # ~1x target + one tile (_DeviceTileAcc).  Single-device,
+        # default-memory templates of the exact stored shape only.
+        # Safe on every transport: ALL executables the chain dispatches
+        # are AOT-compiled at plan time on the caller thread
+        # (_DeviceTileAcc.__init__), never lazily on a worker thread
+        # (see knobs.device_unpack_enabled for the tunnel wedge that
+        # rule avoids).  Element offsets ride int32 dynamic-slice
+        # indices, so ≥2^31-element arrays (8GB+ float32 — only
+        # reachable with the chunking knob raised) fall back to the
+        # whole-buffer path rather than overflow.
+        can_device_tile = (
+            not can_tile
+            and buffer_size_limit_bytes is not None
+            and total > buffer_size_limit_bytes
+            and entry.byte_range is None
+            and _is_jax_array(obj_out)
+            and len(obj_out.sharding.device_set) == 1
+            and getattr(obj_out.sharding, "memory_kind", None)
+            in (None, "device")
+            and tuple(obj_out.shape) == tuple(entry.shape)
+            and total // itemsize < np.iinfo(np.int32).max
+        )
+        if can_tile or can_device_tile:
             # Tile the flat element range so host memory stays O(limit).
-            if obj_out is None:
-                target = np.empty(
-                    tuple(entry.shape), dtype=string_to_dtype(entry.dtype)
-                )
-            elif isinstance(obj_out, np.ndarray):
-                target = obj_out
+            if can_device_tile:
+                n_elems = int(np.prod(entry.shape)) if entry.shape else 1
             else:
-                target = obj_out.detach().cpu().numpy()
-            target_flat = target.reshape(-1)
-            n_elems = target_flat.shape[0]
+                if obj_out is None:
+                    target = np.empty(
+                        tuple(entry.shape), dtype=string_to_dtype(entry.dtype)
+                    )
+                elif isinstance(obj_out, np.ndarray):
+                    target = obj_out
+                else:
+                    target = obj_out.detach().cpu().numpy()
+                target_flat = target.reshape(-1)
+                n_elems = target_flat.shape[0]
             tiles = _plan_flat_tiles(
                 0, n_elems, itemsize, buffer_size_limit_bytes
             )
-            fold = _TileCrcFold(
-                getattr(entry, "crc32", None),
-                f"{entry.location} (tiled)",
-                lambda: fut.set(
+            if can_device_tile:
+                acc = _DeviceTileAcc(
+                    obj_out,
+                    {(t0, t1) for t0, t1, _ in tiles},
+                    entry.dtype,
+                )
+                on_all_tiles = lambda: fut.set(acc.finish())  # noqa: E731
+            else:
+                on_all_tiles = lambda: fut.set(  # noqa: E731
                     target
                     if obj_out is None or isinstance(obj_out, np.ndarray)
                     else obj_out
-                ),
+                )
+            fold = _TileCrcFold(
+                getattr(entry, "crc32", None),
+                f"{entry.location} (tiled)",
+                on_all_tiles,
             )
             countdown = _Countdown(n=len(tiles), on_zero=fold.finish)
             read_reqs: List[ReadReq] = []
             for start, end, byte_range in tiles:
+                if can_device_tile:
+                    consumer: BufferConsumer = _DeviceTiledConsumer(
+                        acc=acc,
+                        elem_range=(start, end),
+                        countdown=countdown,
+                        tile_bytes=(end - start) * itemsize,
+                        dtype=entry.dtype,
+                        crc_fold=fold,
+                    )
+                else:
+                    consumer = _TiledConsumer(
+                        target_flat=target_flat,
+                        elem_range=(start, end),
+                        countdown=countdown,
+                        tile_bytes=(end - start) * itemsize,
+                        dtype=entry.dtype,
+                        crc_fold=fold,
+                    )
                 read_reqs.append(
                     ReadReq(
                         path=entry.location,
                         byte_range=byte_range,
-                        buffer_consumer=_TiledConsumer(
-                            target_flat=target_flat,
-                            elem_range=(start, end),
-                            countdown=countdown,
-                            tile_bytes=(end - start) * itemsize,
-                            dtype=entry.dtype,
-                            crc_fold=fold,
-                        ),
+                        buffer_consumer=consumer,
                     )
                 )
             return read_reqs, fut

@@ -246,13 +246,28 @@ def main() -> None:
             hogs = [] if _REHEARSAL else _pause_cpu_hogs()
             timed_out = False
             try:
-                out = subprocess.run(
+                proc = subprocess.run(
                     [sys.executable, BENCH],
                     capture_output=True,
                     text=True,
                     timeout=3000,
                     cwd=REPO,
-                ).stdout
+                )
+                out = proc.stdout
+                # keep the raw streams of the LAST run: when a phase
+                # dies mid-window (fresh_repr=False) this file is the
+                # only diagnosis trail — the summary line cannot say
+                # WHICH phase ended the run or why
+                try:
+                    with open(
+                        os.path.join(_STATE, ".bench_watch_last_run.log"),
+                        "w",
+                    ) as f:
+                        f.write(out[-65536:])
+                        f.write("\n--- stderr ---\n")
+                        f.write((proc.stderr or "")[-65536:])
+                except OSError:
+                    pass
             except subprocess.TimeoutExpired:
                 # bench.py's own supervisor deadline is 2400s; this is a
                 # belt-and-suspenders bound that should never fire

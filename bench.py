@@ -203,7 +203,7 @@ def _quick_number(dev, init_s: float) -> None:
     import jax
     import jax.numpy as jnp
 
-    from torchsnapshot_tpu import PyTreeState, Snapshot
+    from torchsnapshot_tpu import PyTreeState, Snapshot, obs
 
     n_arrays, elems = 16, 2 * 1024 * 1024  # 16 x 4MB bf16 = 64MB
     make = jax.jit(
@@ -222,6 +222,10 @@ def _quick_number(dev, init_s: float) -> None:
         Snapshot.async_take(
             os.path.join(root, "warm"), {"m": PyTreeState({"w": warm})}
         ).wait()
+        # the embedded metrics block must describe THIS phase's
+        # take/restore only, not the warm-up (or anything earlier in
+        # the process)
+        obs.reset_metrics()
         t0 = time.perf_counter()
         pending = Snapshot.async_take(
             os.path.join(root, "snap"), {"m": PyTreeState(dict(params))}
@@ -251,6 +255,10 @@ def _quick_number(dev, init_s: float) -> None:
                     "payload_gb": round(total_gb, 3),
                     "backend_init_s": round(init_s, 2),
                     "quick_phase": True,
+                    # internals of THIS phase's take/restore (registry
+                    # reset above): bytes staged/written, budget
+                    # high-water, per-backend latency histograms
+                    "metrics": obs.metrics_snapshot(),
                     "value": round(gbps, 3),
                     "vs_baseline": round(gbps / BASELINE_GBPS, 3),
                     "blocked_s": round(blocked_s, 4),
@@ -393,6 +401,14 @@ def run_child() -> None:
         from torchsnapshot_tpu.ops import device_pack
 
         pack_base = dict(device_pack.CALL_COUNTS)
+        # same discipline for the obs registry and span tracer: the
+        # embedded metrics block and BENCH_TRACE.json cover the
+        # measured save/restore phases, not the quick phase or warm-up
+        # that ran earlier in this process
+        from torchsnapshot_tpu import obs
+
+        obs.reset_metrics()
+        obs.get_tracer().reset()
         print(json.dumps({"metric": METRIC, "phase": "warmup_done"}), flush=True)
 
         t0 = time.perf_counter()
@@ -500,6 +516,22 @@ def run_child() -> None:
                 for k, v in device_pack.CALL_COUNTS.items()
             },
         }
+        # per-phase observability internals (obs/): bytes staged/written,
+        # budget high-water, io queue depth, per-backend latency
+        # histograms — the machine-readable breakdown behind `value`
+        # (registry reset at warmup_done, so this covers the measured
+        # phases only)
+        result["metrics"] = obs.metrics_snapshot()
+        if obs.tracing_enabled():
+            # TORCHSNAPSHOT_TPU_TRACE=1 drives: the span trace of the
+            # measured phases lands next to the BENCH record, loadable
+            # in ui.perfetto.dev
+            trace_path = os.path.join(_STATE_DIR, "BENCH_TRACE.json")
+            try:
+                result["trace_spans"] = obs.write_trace(trace_path)
+                result["trace_path"] = trace_path
+            except OSError as e:
+                result["trace_error"] = f"{e!r}"[:200]
         print(json.dumps(result), flush=True)
         # spot-check one leaf round-tripped
         import ml_dtypes

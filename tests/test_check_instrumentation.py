@@ -1,0 +1,67 @@
+"""Tier-1 wiring for tools/check_instrumentation.py: the repo's
+Snapshot/SnapshotManager public methods must all carry a
+log_event/span bracket, and the checker itself must actually detect
+violations (a checker that can't fail is no check)."""
+
+import importlib.util
+import os
+import textwrap
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_instrumentation",
+        os.path.join(_REPO_ROOT, "tools", "check_instrumentation.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_public_methods_are_instrumented():
+    checker = _load_checker()
+    assert checker.check_repo(_REPO_ROOT) == []
+
+
+def test_checker_flags_uninstrumented_method():
+    checker = _load_checker()
+    src = textwrap.dedent(
+        """
+        class Snapshot:
+            def covered(self):
+                with log_event(Event("covered")):
+                    return 1
+
+            def covered_by_span(self):
+                with obs.span("x"):
+                    return 2
+
+            async def covered_async(self):
+                async with thing:
+                    with span("y", bytes=3):
+                        return 3
+
+            def naked(self):
+                return 4
+
+            def _private_is_fine(self):
+                return 5
+        """
+    )
+    violations = checker.check_source(src, {"Snapshot": set()}, "x.py")
+    assert len(violations) == 1
+    assert "Snapshot.naked" in violations[0]
+
+
+def test_checker_honors_allowlist():
+    checker = _load_checker()
+    src = "class Snapshot:\n    def naked(self):\n        return 1\n"
+    assert checker.check_source(src, {"Snapshot": {"naked"}}, "x.py") == []
+
+
+def test_checker_main_exit_codes(capsys):
+    checker = _load_checker()
+    assert checker.main([_REPO_ROOT]) == 0
+    assert "OK" in capsys.readouterr().out

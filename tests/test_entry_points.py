@@ -94,6 +94,56 @@ def test_unknown_scheme_raises():
         url_to_storage_plugin("nosuchscheme://x")
 
 
+def test_raising_handler_does_not_break_log_event():
+    from torchsnapshot_tpu.event import Event
+    from torchsnapshot_tpu.event_handlers import (
+        log_event,
+        register_event_handler,
+        unregister_event_handler,
+    )
+
+    seen = []
+
+    def bad_handler(event):
+        raise RuntimeError("handler bug")
+
+    register_event_handler(bad_handler)
+    register_event_handler(seen.append)
+    try:
+        with log_event(Event("op")) as event:
+            pass  # must not raise despite bad_handler
+    finally:
+        unregister_event_handler(bad_handler)
+        unregister_event_handler(seen.append)
+    # later handlers still ran, and the event completed normally
+    assert [e.name for e in seen] == ["op"]
+    assert event.metadata["is_success"] is True
+
+
+def test_unregister_never_registered_handler_raises_clear_error():
+    from torchsnapshot_tpu.event_handlers import unregister_event_handler
+
+    with pytest.raises(ValueError, match="never registered"):
+        unregister_event_handler(lambda e: None)
+
+
+def test_log_event_stamps_monotonic_timestamp():
+    import time
+
+    from torchsnapshot_tpu.event import Event
+    from torchsnapshot_tpu.event_handlers import log_event
+
+    before = time.monotonic()
+    with log_event(Event("first")) as e1:
+        pass
+    with log_event(Event("second")) as e2:
+        pass
+    after = time.monotonic()
+    # stamped at fire time, ordered, and on the monotonic clock
+    assert before <= e1.timestamp <= e2.timestamp <= after
+    assert e1.metadata["duration_s"] >= 0
+
+
 def test_event_handler_discovered_from_entry_points(tmp_path):
     _fake_dist(
         str(tmp_path),

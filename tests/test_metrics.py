@@ -1,0 +1,211 @@
+"""Metrics registry: instrument semantics, histogram bucketing,
+snapshot/reset, hot-path integration (take/restore populate the
+registry), the rss_profiler gauge, and the CLI `stats` command on a real
+snapshot.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, obs
+from torchsnapshot_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+def test_counter_and_gauge_semantics():
+    c = Counter("c")
+    c.inc()
+    c.inc(41)
+    assert c.value == 42
+    g = Gauge("g")
+    g.set(10)
+    g.set(3)
+    assert g.value == 3 and g.max == 10  # high-water survives lower sets
+    g.set_max(99)
+    assert g.value == 3 and g.max == 99
+
+
+def test_histogram_bucketing_edges():
+    h = Histogram("h", bounds=(1.0, 10.0, 100.0))
+    for v in (0.5, 1.0):  # upper edges are inclusive
+        h.observe(v)
+    h.observe(5.0)
+    h.observe(10.0)
+    h.observe(100.5)  # overflow bucket
+    d = h.to_dict()
+    assert d["bounds"] == [1.0, 10.0, 100.0]
+    assert d["counts"] == [2, 2, 0, 1]
+    assert d["count"] == 5
+    assert d["min"] == 0.5 and d["max"] == 100.5
+    assert d["sum"] == pytest.approx(117.0)
+
+
+def test_histogram_rejects_unsorted_bounds():
+    with pytest.raises(ValueError):
+        Histogram("h", bounds=(10.0, 1.0))
+
+
+def test_registry_get_or_create_snapshot_reset():
+    reg = MetricsRegistry()
+    assert reg.counter("a") is reg.counter("a")
+    reg.counter("a").inc(5)
+    reg.gauge("b").set(2.5)
+    reg.histogram("c", bounds=(1.0,)).observe(0.5)
+    snap = reg.snapshot()
+    assert snap["counters"]["a"] == 5
+    assert snap["gauges"]["b"] == {"value": 2.5, "max": 2.5}
+    assert snap["histograms"]["c"]["counts"] == [1, 0]
+    # snapshot is strict-JSON safe (no Infinity literals)
+    json.loads(json.dumps(snap))
+    reg.reset()
+    snap2 = reg.snapshot()
+    assert snap2["counters"]["a"] == 0
+    assert snap2["gauges"]["b"] == {"value": 0.0, "max": 0.0}
+    assert snap2["histograms"]["c"]["count"] == 0
+    # instrument identity survives reset (instrumented code holds refs)
+    assert reg.counter("a") is reg.counter("a")
+
+
+def test_counter_thread_safety():
+    c = Counter("c")
+
+    def work():
+        for _ in range(10_000):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 80_000
+
+
+def test_buf_nbytes_extension_dtypes_and_fallbacks():
+    import ml_dtypes
+
+    # bf16 (the primary TPU dtype) rejects memoryview(...).cast("B");
+    # a len() fallback would report the first-dim length, not bytes
+    arr = np.ones((4, 3), dtype=ml_dtypes.bfloat16)
+    assert obs.buf_nbytes(arr) == 24
+    assert obs.buf_nbytes(np.zeros(10, np.float64)) == 80
+    assert obs.buf_nbytes(b"abc") == 3
+    assert obs.buf_nbytes(memoryview(b"abcd")) == 4
+    assert obs.buf_nbytes(bytearray(5)) == 5
+    assert obs.buf_nbytes(None) == 0
+
+
+def test_rss_profiler_publishes_peak_gauge():
+    from torchsnapshot_tpu.rss_profiler import measure_rss_deltas
+
+    g = obs.gauge(obs.RSS_PEAK_DELTA_BYTES)
+    deltas = []
+    with measure_rss_deltas(deltas):
+        _ = bytearray(8 << 20)  # force some RSS movement
+    assert deltas
+    assert g.value == max(deltas)
+
+
+def test_take_restore_populate_registry(tmp_path):
+    obs.reset_metrics()
+    path = str(tmp_path / "snap")
+    state = StateDict(x=np.arange(50000.0), n=3)
+    Snapshot.take(path, {"m": state})
+    out = StateDict(x=np.zeros(50000), n=0)
+    Snapshot(path).restore({"m": out})
+    snap = obs.metrics_snapshot()
+    nbytes = state["x"].nbytes
+    assert snap["counters"][obs.BYTES_STAGED] >= nbytes
+    assert snap["counters"][obs.BYTES_WRITTEN] >= nbytes
+    assert snap["counters"][obs.BYTES_READ] >= nbytes
+    assert snap["gauges"][obs.BUDGET_BYTES_IN_USE]["max"] >= nbytes
+    # the read pipeline reports through its own gauge (an async_take's
+    # background drain can overlap a restore)
+    assert snap["gauges"]["budget_bytes_in_use_read"]["max"] >= nbytes
+    # per-backend storage latency histograms recorded both directions
+    assert snap["histograms"]["storage.fs.write_latency_s"]["count"] > 0
+    assert snap["histograms"]["storage.fs.read_latency_s"]["count"] > 0
+    assert snap["counters"]["storage.fs.write_bytes"] > 0
+
+
+def _take_stats_fixture(tmp_path):
+    path = str(tmp_path / "snap")
+    Snapshot.take(
+        path,
+        {
+            "m": StateDict(
+                big=np.arange(100000, dtype=np.float32),
+                small=np.ones(10, dtype=np.float64),
+                n=5,
+                label="hello",
+            )
+        },
+    )
+    return path
+
+
+def test_cli_stats_human_output(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    path = _take_stats_fixture(tmp_path)
+    assert main(["stats", path]) == 0
+    out = capsys.readouterr().out
+    assert "entries" in out
+    assert "by dtype:" in out
+    assert "float32" in out
+    assert "m/big" in out  # largest-entries table names the big leaf
+    assert "390.6KB" in out  # 100000 * 4 bytes, human-formatted
+
+
+def test_cli_stats_json_output(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    path = _take_stats_fixture(tmp_path)
+    assert main(["stats", path, "--json", "--top", "2"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["entries"] == 4
+    assert stats["total_bytes"] >= 100000 * 4 + 10 * 8
+    assert stats["by_dtype"]["float32"]["bytes"] == 100000 * 4
+    assert len(stats["largest"]) == 2
+    assert stats["largest"][0]["path"].endswith("m/big")
+    kinds = set(stats["by_kind"])
+    assert any(k in kinds for k in ("Array", "array"))
+
+
+def test_cli_stats_zero_dim_array_shape(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    path = str(tmp_path / "snap")
+    Snapshot.take(
+        path,
+        {"m": StateDict(scale=np.array(2.5, dtype=np.float32))},
+    )
+    assert main(["stats", path, "--json"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    (entry,) = [e for e in stats["largest"] if e["path"].endswith("scale")]
+    assert entry["shape"] == []  # 0-d array, NOT null
+
+
+def test_cli_stats_missing_snapshot_errors(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    rc = main(["stats", str(tmp_path / "nope")])
+    assert rc == 1
+    assert "error:" in capsys.readouterr().err
+
+
+def test_human_formatter_tb_sizes():
+    from torchsnapshot_tpu.__main__ import _human
+
+    # the pre-fix fallthrough printed multi-TB sizes as "2048.0B"
+    assert _human(2048 * 1024**4) == "2048.0TB"
+    assert _human(3 * 1024**4) == "3.0TB"
+    assert _human(1536) == "1.5KB"
+    assert _human(100) == "100B"

@@ -1,0 +1,263 @@
+"""Span tracer: nesting across threads/tasks, the zero-cost disabled
+path, log_event composition, and the Perfetto export of a real fs-backend
+take+restore roundtrip (the acceptance path for the observability layer).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+from torchsnapshot_tpu.obs import tracer as tracer_mod
+
+
+@pytest.fixture
+def traced():
+    """Tracing on + a clean global tracer; restores the off default."""
+    tr = obs.get_tracer()
+    with knobs.override_trace(1):
+        tr.reset()
+        yield tr
+    tr.reset()
+
+
+def test_tracing_off_by_default_returns_shared_null_cm():
+    assert not obs.tracing_enabled()
+    # allocation-free disabled path: the SAME singleton every call, and
+    # nothing recorded
+    before = len(obs.get_tracer())
+    assert obs.span("anything", bytes=123) is tracer_mod.NULL_CM
+    with obs.span("nothing") as s:
+        assert s is None
+    assert len(obs.get_tracer()) == before
+
+
+def test_span_nesting_and_attrs(traced):
+    with obs.span("outer", a=1) as outer:
+        with obs.span("inner") as inner:
+            inner.attrs["late"] = True
+        assert outer is not None
+    spans = {s.name: s for s in traced.spans()}
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id is None
+    assert spans["outer"].attrs == {"a": 1}
+    assert spans["inner"].attrs == {"late": True}
+    assert spans["inner"].start_ns >= spans["outer"].start_ns
+    assert spans["inner"].end_ns <= spans["outer"].end_ns
+
+
+def test_span_nesting_across_threads(traced):
+    def worker():
+        with obs.span("w_outer"):
+            with obs.span("w_inner"):
+                pass
+
+    with obs.span("main_outer"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        with obs.span("main_inner"):
+            pass
+    spans = {s.name: s for s in traced.spans()}
+    assert spans["main_inner"].parent_id == spans["main_outer"].span_id
+    assert spans["w_inner"].parent_id == spans["w_outer"].span_id
+    # a fresh thread has a fresh context: no cross-thread parent leak
+    assert spans["w_outer"].parent_id is None
+    assert spans["w_outer"].thread_id != spans["main_outer"].thread_id
+
+
+def test_error_span_records_and_flags(traced):
+    with pytest.raises(RuntimeError):
+        with obs.span("boom"):
+            raise RuntimeError("x")
+    (s,) = traced.spans()
+    assert s.attrs.get("error") is True
+    assert s.end_ns > 0
+
+
+def test_begin_end_idempotent(traced):
+    s = traced.begin("manual", k="v")
+    traced.end(s)
+    end = s.end_ns
+    traced.end(s)  # second end is a no-op
+    assert s.end_ns == end
+    assert [sp.name for sp in traced.spans()] == ["manual"]
+
+
+def test_log_event_creates_span_and_span_feeds_handlers(traced):
+    from torchsnapshot_tpu.event import Event
+    from torchsnapshot_tpu.event_handlers import (
+        log_event,
+        register_event_handler,
+        unregister_event_handler,
+    )
+
+    seen = []
+    handler = seen.append
+    register_event_handler(handler)
+    try:
+        with log_event(Event("my_op", {"k": 1})):
+            with obs.span("child_work", bytes=7):
+                pass
+    finally:
+        unregister_event_handler(handler)
+    # the log_event bracket became a span; the nested span parented to it
+    spans = {s.name: s for s in traced.spans()}
+    assert spans["child_work"].parent_id == spans["my_op"].span_id
+    # the finished child span fed the handler fan-out as span/<name>;
+    # the log_event bracket fired once as the event itself (no echo)
+    names = [e.name for e in seen]
+    assert "span/child_work" in names
+    assert names.count("my_op") == 1
+    assert "span/my_op" not in names
+
+
+def test_max_span_cap(traced):
+    old = tracer_mod._MAX_SPANS
+    tracer_mod._MAX_SPANS = 5
+    try:
+        for i in range(8):
+            with obs.span(f"s{i}"):
+                pass
+        assert len(traced) == 5
+        assert traced.dropped == 3
+    finally:
+        tracer_mod._MAX_SPANS = old
+
+
+def test_perfetto_overlapping_stage_spans_get_sibling_tracks(traced):
+    # two concurrent staging spans must not share a tid (complete
+    # events on one tid must nest); a later sequential one reuses slot 0
+    a = traced.begin("pipeline/staging", idx=1)
+    b = traced.begin("pipeline/staging", idx=2)
+    traced.end(a)
+    traced.end(b)
+    c = traced.begin("pipeline/staging", idx=3)
+    traced.end(c)
+    doc = obs.to_trace_events(traced.spans())
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    tid_by_idx = {e["args"]["idx"]: e["tid"] for e in xs}
+    assert tid_by_idx[1] != tid_by_idx[2]
+    assert tid_by_idx[3] == tid_by_idx[1]
+    tracks = {
+        e["args"]["name"] for e in doc["traceEvents"] if e["ph"] == "M"
+    }
+    assert {"pipeline/staging", "pipeline/staging #2"} <= tracks
+
+
+def test_perfetto_slot_cap_bounds_track_explosion(traced):
+    # admission spans all open at pipeline start: without the cap this
+    # would mint one track per span and an O(n^2) scan
+    spans = [traced.begin("pipeline/budget_admission", i=i) for i in range(100)]
+    for s in spans:
+        traced.end(s)
+    doc = obs.to_trace_events(traced.spans())
+    tids = {e["tid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(tids) <= 32
+    assert sum(1 for e in doc["traceEvents"] if e["ph"] == "X") == 100
+
+
+def _containment(child, parent):
+    return (
+        child["ts"] >= parent["ts"]
+        and child["ts"] + child["dur"] <= parent["ts"] + parent["dur"]
+    )
+
+
+def test_roundtrip_take_restore_produces_valid_perfetto_trace(tmp_path):
+    """Acceptance: TORCHSNAPSHOT_TPU_TRACE=1 roundtrip against the fs
+    backend yields loadable trace_event JSON with staging,
+    budget-admission and storage-I/O spans, properly nested, with
+    non-zero durations."""
+    path = str(tmp_path / "snap")
+    state = StateDict(
+        w=np.arange(200000, dtype=np.float32),
+        b=np.ones(1000, dtype=np.float64),
+        step=7,
+    )
+    tr = obs.get_tracer()
+    with knobs.override_trace(1):
+        tr.reset()
+        Snapshot.take(path, {"m": state})
+        out = StateDict(
+            w=np.zeros(200000, dtype=np.float32),
+            b=np.zeros(1000, dtype=np.float64),
+            step=0,
+        )
+        Snapshot(path).restore({"m": out})
+        trace_path = str(tmp_path / "trace.json")
+        n = obs.write_trace(trace_path)
+    assert np.array_equal(out["w"], state["w"])
+    assert n > 0
+
+    with open(trace_path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    by_name: dict = {}
+    for e in xs:
+        by_name.setdefault(e["name"], []).append(e)
+
+    # the three pipeline phases + both storage directions are present
+    for required in (
+        "pipeline/staging",
+        "pipeline/budget_admission",
+        "pipeline/io",
+        "storage/write",
+        "storage/read",
+        "take",
+        "restore",
+    ):
+        assert required in by_name, sorted(by_name)
+    # non-zero durations for the real work phases
+    for name in ("pipeline/staging", "pipeline/io", "storage/write",
+                 "storage/read", "take", "restore"):
+        assert all(e["dur"] > 0 for e in by_name[name]), name
+
+    # span tree survives the export: storage/write nests (by parent_id
+    # AND by time containment) inside a pipeline/io span
+    by_id = {e["args"]["span_id"]: e for e in xs}
+    nested = 0
+    for e in by_name["storage/write"]:
+        parent = by_id.get(e["args"]["parent_id"])
+        if parent is not None and parent["name"] == "pipeline/io":
+            assert _containment(e, parent)
+            nested += 1
+    assert nested > 0
+
+    # async-arrow linkage: staging completion -> io start flow events
+    flow_starts = {e["id"] for e in events if e["ph"] == "s"}
+    flow_ends = {e["id"] for e in events if e["ph"] == "f"}
+    assert flow_starts and flow_starts & flow_ends
+
+    # one named track per pipeline stage
+    track_names = {
+        e["args"]["name"] for e in events
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert {"pipeline/staging", "pipeline/io",
+            "pipeline/budget_admission"} <= track_names
+
+    # with the knob released, tracing is off again and records nothing
+    assert not obs.tracing_enabled()
+    tr.reset()
+    Snapshot(path).restore({"m": out})
+    assert len(tr) == 0
+
+
+def test_cli_trace_command(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": StateDict(x=np.arange(64.0), n=1)})
+    out = str(tmp_path / "out.json")
+    rc = main(["trace", path, "--out", out])
+    assert rc == 0
+    assert "wrote" in capsys.readouterr().out
+    doc = json.load(open(out))
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert "storage/read" in names and "materialize" in names
+    assert not obs.tracing_enabled()  # CLI restored the knob

@@ -15,7 +15,7 @@ facebookresearch/torchsnapshot, re-designed for TPU/XLA:
 - random access to individual snapshot objects under a memory budget.
 """
 
-from . import knobs  # noqa: F401
+from . import knobs, obs  # noqa: F401
 from .coordination import (  # noqa: F401
     Coordinator,
     FileCoordinator,
@@ -59,4 +59,5 @@ __all__ = [
     "register_event_handler",
     "unregister_event_handler",
     "knobs",
+    "obs",
 ]

@@ -1,10 +1,12 @@
 """Operator CLI: inspect, audit and manage snapshots from a shell.
 
     python -m torchsnapshot_tpu ls        <snapshot-path>
+    python -m torchsnapshot_tpu stats     <snapshot-path> [--json] [--top N]
     python -m torchsnapshot_tpu manifest  <snapshot-path>
     python -m torchsnapshot_tpu verify    <snapshot-path> [--deep] [--rank N]
     python -m torchsnapshot_tpu steps     <manager-root>
     python -m torchsnapshot_tpu delete    <snapshot-path> --yes
+    python -m torchsnapshot_tpu trace     <snapshot-path> [--out FILE]
 
 Paths take any storage URL the library accepts (plain/fs, gs://, s3://).
 Exit code is non-zero when a verify fails or a delete is refused —
@@ -18,12 +20,17 @@ import json
 import sys
 
 
-def _human(n: int) -> str:
-    for unit in ("B", "KB", "MB", "GB", "TB"):
-        if n < 1024 or unit == "TB":
-            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+def _human(n: float) -> str:
+    # bytes print exact; everything else one decimal.  The loop exits
+    # via the TB arm for any size ≥ 1024 TB (no unformatted fallthrough:
+    # a pre-fix version printed multi-TB sizes as e.g. "2048.0B")
+    if n < 1024:
+        return f"{int(n)}B"
+    for unit in ("KB", "MB", "GB", "TB"):
         n /= 1024.0
-    return f"{n}B"
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}"
+    raise AssertionError("unreachable")
 
 
 def _cmd_ls(args) -> int:
@@ -50,6 +57,133 @@ def _cmd_ls(args) -> int:
         size = _human(nbytes) if nbytes else ""
         print(f"{lpath:<{width}}  {kind:<12} {detail:<24} {size}")
     print(f"{len(rows)} entries")
+    return 0
+
+
+def _entry_stats(entry) -> dict:
+    """(nbytes, dtype, pieces) rollup for one non-container manifest
+    entry — manifest-only, no storage reads.  Byte sizes prefer recorded
+    byte_range extents (exact, covers slabbed objects) and fall back to
+    the dtype/shape product for array entries written before ranges."""
+    from .serialization import serialized_size_bytes, string_to_dtype
+
+    def _extent(byte_range) -> int:
+        return byte_range[1] - byte_range[0] if byte_range else 0
+
+    dtype = getattr(entry, "dtype", None)
+    nbytes = 0
+    pieces = 0
+    for attr in ("shards", "chunks"):
+        for piece in getattr(entry, attr, None) or ():
+            pieces += 1
+            nbytes += _extent(piece.byte_range) or (
+                serialized_size_bytes(piece.sizes, string_to_dtype(dtype))
+                if dtype is not None
+                else 0
+            )
+    if not pieces:
+        nbytes = _extent(getattr(entry, "byte_range", None))
+        shape = getattr(entry, "shape", None)
+        if not nbytes and shape is not None and dtype is not None:
+            nbytes = serialized_size_bytes(shape, string_to_dtype(dtype))
+    shape = getattr(entry, "shape", None)
+    return {
+        "kind": entry.type,
+        "dtype": dtype,
+        # [] is a real shape (0-d array) and must stay distinct from
+        # "entry has no shape" (None)
+        "shape": list(shape) if shape is not None else None,
+        "nbytes": nbytes,
+        "pieces": pieces,
+    }
+
+
+def _cmd_stats(args) -> int:
+    """Per-entry size/dtype/chunk rollups from the manifest (the
+    operator's "where did my bytes go" view; machine-readable with
+    --json for dashboards)."""
+    from .manifest import is_container_entry
+    from .snapshot import Snapshot
+
+    snap = Snapshot(args.path)
+    metadata = snap.metadata
+    entries = {
+        p: _entry_stats(e)
+        for p, e in metadata.manifest.items()
+        if not is_container_entry(e)
+    }
+    by_dtype: dict = {}
+    by_kind: dict = {}
+    total = 0
+    pieces = 0
+    for st in entries.values():
+        total += st["nbytes"]
+        pieces += st["pieces"]
+        d = by_dtype.setdefault(st["dtype"] or "(none)",
+                                {"count": 0, "bytes": 0})
+        d["count"] += 1
+        d["bytes"] += st["nbytes"]
+        k = by_kind.setdefault(st["kind"], {"count": 0, "bytes": 0})
+        k["count"] += 1
+        k["bytes"] += st["nbytes"]
+    largest = sorted(
+        entries.items(), key=lambda kv: kv[1]["nbytes"], reverse=True
+    )[: args.top]
+    stats = {
+        "path": args.path,
+        "world_size": metadata.world_size,
+        "entries": len(entries),
+        "total_bytes": total,
+        "pieces": pieces,
+        "by_kind": by_kind,
+        "by_dtype": by_dtype,
+        "largest": [
+            {"path": p, **st} for p, st in largest
+        ],
+    }
+    if args.json:
+        print(json.dumps(stats, indent=2))
+        return 0
+    print(f"{args.path}")
+    print(
+        f"  {len(entries)} entries, {pieces} shard/chunk pieces, "
+        f"{_human(total)} total, world_size={metadata.world_size}"
+    )
+    print("  by kind:")
+    for kind, st in sorted(by_kind.items(), key=lambda kv: -kv[1]["bytes"]):
+        print(f"    {kind:<14} {st['count']:>6}  {_human(st['bytes'])}")
+    print("  by dtype:")
+    for dt, st in sorted(by_dtype.items(), key=lambda kv: -kv[1]["bytes"]):
+        print(f"    {dt:<14} {st['count']:>6}  {_human(st['bytes'])}")
+    print(f"  largest {len(largest)}:")
+    width = max((len(p) for p, _ in largest), default=10)
+    for p, st in largest:
+        detail = (
+            f"{st['dtype']}{st['shape']}" if st["dtype"] else st["kind"]
+        )
+        pieces_s = f" x{st['pieces']}" if st["pieces"] > 1 else ""
+        print(
+            f"    {p:<{width}}  {detail:<28} "
+            f"{_human(st['nbytes'])}{pieces_s}"
+        )
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    """Traced read of a snapshot: materialize every entry with span
+    tracing enabled and write the Perfetto trace_event JSON — open it at
+    https://ui.perfetto.dev.  (Write-path traces come from running a
+    take with TORCHSNAPSHOT_TPU_TRACE=1 and calling obs.write_trace, as
+    bench.py does.)"""
+    from . import knobs, obs
+    from .snapshot import Snapshot
+
+    out = args.out or "trace.json"
+    with knobs.override_trace(1):
+        obs.get_tracer().reset()
+        Snapshot(args.path).materialize(rank=args.rank)
+        n = obs.write_trace(out)
+    print(f"wrote {n} spans to {out}")
     return 0
 
 
@@ -157,6 +291,28 @@ def main(argv=None) -> int:
     p = sub.add_parser("ls", help="list a snapshot's logical entries")
     p.add_argument("path")
     p.set_defaults(fn=_cmd_ls)
+
+    p = sub.add_parser(
+        "stats",
+        help="size/dtype/chunk rollups from the manifest (no data reads)",
+    )
+    p.add_argument("path")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--top", type=int, default=10,
+                   help="how many largest entries to list (default 10)")
+    p.set_defaults(fn=_cmd_stats)
+
+    p = sub.add_parser(
+        "trace",
+        help="read the whole snapshot with tracing on; write Perfetto "
+        "trace_event JSON for ui.perfetto.dev",
+    )
+    p.add_argument("path")
+    p.add_argument("--out", default=None,
+                   help="output file (default ./trace.json)")
+    p.add_argument("--rank", type=int, default=0)
+    p.set_defaults(fn=_cmd_trace)
 
     p = sub.add_parser("manifest", help="dump snapshot metadata as JSON")
     p.add_argument("path")

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import knobs
+from . import knobs, obs
 from .io_types import BufferConsumer, BufferStager, ReadReq, WriteReq
 from .manifest import (
     ArrayEntry,
@@ -60,6 +60,18 @@ class BatchedBufferStager(BufferStager):
         )
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> memoryview:
+        with obs.span(
+            "pipeline/slab_pack",
+            members=len(self.stagers),
+            bytes=self.total,
+        ):
+            buf = await self._stage_buffer_impl(executor)
+        obs.counter(obs.SLABS_PACKED).inc()
+        return buf
+
+    async def _stage_buffer_impl(
+        self, executor: Optional[Executor] = None
+    ) -> memoryview:
         # Members already offloaded to host memory kind must NOT go through
         # the device pack: computing (concat) on host-kind arrays is not a
         # supported XLA path — copy them out individually instead.

@@ -28,7 +28,13 @@ def register_event_handler(handler: Callable[[Event], None]) -> None:
 
 
 def unregister_event_handler(handler: Callable[[Event], None]) -> None:
-    _handlers.remove(handler)
+    try:
+        _handlers.remove(handler)
+    except ValueError:
+        raise ValueError(
+            f"cannot unregister event handler {handler!r}: it was never "
+            f"registered (or was already unregistered)"
+        ) from None
 
 
 def _load_entry_point_handlers() -> None:
@@ -56,6 +62,8 @@ def _load_entry_point_handlers() -> None:
 
 def _fire(event: Event) -> None:
     _load_entry_point_handlers()
+    if event.timestamp is None:
+        event.timestamp = time.monotonic()
     for handler in _handlers + _entry_point_handlers:
         try:
             handler(event)
@@ -63,18 +71,35 @@ def _fire(event: Event) -> None:
             logger.exception("event handler raised for %r", event.name)
 
 
+def _obs_span_cm(event: Event):
+    """A tracer span bracketing the event's operation when tracing is
+    enabled, else the shared no-op (lazy import: obs.tracer fires span
+    events back through this module)."""
+    from .obs import tracer as _tracer
+
+    if not _tracer.ENABLED:
+        return _tracer.NULL_CM
+    # fire_event=False: the event itself fires below — a span/<name>
+    # echo of the same bracket would double every telemetry record
+    return _tracer.span(event.name, fire_event=False)
+
+
 @contextlib.contextmanager
 def log_event(event: Event) -> Iterator[Event]:
-    """Bracket an operation: fires the event on exit with unique_id,
-    duration and is_success attached."""
+    """Bracket an operation: fires the event on exit with a monotonic
+    timestamp, unique_id, duration and is_success attached.  When span
+    tracing is enabled, the bracket also records a span of the same
+    name, so top-level API events appear in Perfetto traces."""
     event.metadata.setdefault("unique_id", uuid.uuid4().hex)
     begin = time.monotonic()
-    try:
-        yield event
-        event.metadata["is_success"] = True
-    except BaseException:
-        event.metadata["is_success"] = False
-        raise
-    finally:
-        event.metadata["duration_s"] = time.monotonic() - begin
-        _fire(event)
+    with _obs_span_cm(event):
+        try:
+            yield event
+            event.metadata["is_success"] = True
+        except BaseException:
+            event.metadata["is_success"] = False
+            raise
+        finally:
+            event.timestamp = time.monotonic()
+            event.metadata["duration_s"] = event.timestamp - begin
+            _fire(event)

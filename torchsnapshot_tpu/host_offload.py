@@ -207,6 +207,17 @@ def eager_offload_write_reqs(
     defensive-copy-only pass when the runtime lacks host memory kinds
     (e.g. CPU meshes).
     """
+    from . import obs
+
+    with obs.span("offload/eager", reqs=len(write_reqs)) as sp:
+        moved = _eager_offload_impl(write_reqs, budget_bytes)
+        if sp is not None:
+            sp.attrs["bytes"] = moved
+    obs.counter(obs.BYTES_OFFLOADED).inc(moved)
+    return moved
+
+
+def _eager_offload_impl(write_reqs, budget_bytes: int | None = None) -> int:
     from .serialization import fast_copy
     from .preparers.array import (
         HostArrayBufferStager,

@@ -37,6 +37,7 @@ _WRITE_CHECKSUMS = "WRITE_CHECKSUMS"
 _VERIFY_ON_RESTORE = "VERIFY_ON_RESTORE"
 _DEVICE_UNPACK = "DEVICE_UNPACK"
 _RESTORE_DONATE = "RESTORE_DONATE"
+_TRACE = "TRACE"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -136,6 +137,16 @@ _DEFAULTS = {
     # accelerator (HBM is the scarce resource), off for host-resident
     # templates; "1"/"0" force.
     _RESTORE_DONATE: "auto",
+    # Structured span tracing (obs/tracer.py).  Off by default: the
+    # disabled path is one module-flag check with no allocation; on, a
+    # take/restore records a span tree exportable as Perfetto JSON
+    # (`python -m torchsnapshot_tpu trace`, obs.write_trace).  Unlike
+    # every other knob this one is resolved into obs.tracer.ENABLED at
+    # import and by override_trace — the zero-cost check can't re-read
+    # the env per span.  Set the env var BEFORE importing (or call
+    # obs.refresh_enabled() after mutating it); gate runtime decisions
+    # on obs.tracing_enabled(), which reports what is actually recorded.
+    _TRACE: 0,
 }
 
 _OVERRIDES: dict = {}
@@ -305,6 +316,10 @@ def _tunneled_transport() -> bool:
     return "axon" in names.lower()
 
 
+def is_trace_enabled() -> bool:
+    return bool(_get_int(_TRACE))
+
+
 def restore_donation() -> str:
     """One of "on" | "off" | "auto" (see _RESTORE_DONATE above).
 
@@ -436,3 +451,18 @@ def override_replication_verify(value: str):
 
 def override_restore_donate(value):
     return _override(_RESTORE_DONATE, value)
+
+
+@contextlib.contextmanager
+def override_trace(value) -> Iterator[None]:
+    """Override TRACE and refresh the tracer's module-level enabled flag
+    on entry AND exit (the flag is the zero-cost disabled-path check, so
+    it must track the knob rather than re-resolve it per span)."""
+    from .obs import tracer as _tracer
+
+    try:
+        with _override(_TRACE, int(bool(int(value)))):
+            _tracer.refresh_enabled()
+            yield
+    finally:
+        _tracer.refresh_enabled()

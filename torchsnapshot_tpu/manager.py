@@ -270,7 +270,10 @@ class SnapshotManager:
 
     def steps(self) -> List[int]:
         """Committed steps, ascending (index ∪ local scan)."""
-        return list(self._committed())
+        from .obs import span
+
+        with span("manager/steps", root=self.root):
+            return list(self._committed())
 
     def latest_step(self) -> Optional[int]:
         steps = self.steps()
@@ -299,6 +302,26 @@ class SnapshotManager:
         objects whose content checksum is unchanged are hardlinked /
         server-side-copied instead of rewritten (Snapshot.take(base=)).
         Cold start (no committed step) degrades to a full save."""
+        with log_event(
+            Event(
+                "manager_save",
+                {"root": self.root, "step": step, "async": async_},
+            )
+        ):
+            return self._save_impl(
+                app_state, step, replicated, async_, incremental,
+                **take_kwargs,
+            )
+
+    def _save_impl(
+        self,
+        app_state: Dict[str, Any],
+        step: int,
+        replicated: Sequence[str] = (),
+        async_: bool = False,
+        incremental: bool = False,
+        **take_kwargs: Any,
+    ) -> Union[Snapshot, "_ManagedPendingSnapshot"]:
         path = self.path_for_step(step)
         base: Optional[str] = None
         if incremental:
@@ -337,13 +360,17 @@ class SnapshotManager:
         or ``None`` on cold start (nothing committed yet).  All ranks
         agree on the choice: rank 0 resolves, everyone else follows.
         ``paths`` filters to matching leaves (Snapshot.restore)."""
-        step = self._coord.broadcast_object(
-            self.latest_step() if self._coord.rank == 0 else None, src=0
-        )
-        if step is None:
-            return None
-        self.snapshot(step).restore(app_state, strict=strict, paths=paths)
-        return step
+        with log_event(
+            Event("manager_restore_latest", {"root": self.root})
+        ) as event:
+            step = self._coord.broadcast_object(
+                self.latest_step() if self._coord.rank == 0 else None, src=0
+            )
+            event.metadata["step"] = step
+            if step is None:
+                return None
+            self.snapshot(step).restore(app_state, strict=strict, paths=paths)
+            return step
 
     # ------------------------------------------------------- retention
 

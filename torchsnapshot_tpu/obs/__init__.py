@@ -1,0 +1,145 @@
+"""Observability: structured spans, a metrics registry, Perfetto export.
+
+Three layers, one import surface:
+
+- **Spans** (``span``, ``get_tracer``) — opt-in via the
+  ``TORCHSNAPSHOT_TPU_TRACE`` knob; zero-cost (one module-flag check,
+  no allocation) when disabled.  See ``tracer.py``.
+- **Metrics** (``counter``/``gauge``/``histogram``,
+  ``metrics_snapshot``) — always on; the instrumented hot path records
+  bytes staged/written, budget high-water, queue depths and per-backend
+  storage latency.  See ``metrics.py``.
+- **Export** (``write_trace``) — dump recorded spans as Chrome
+  ``trace_event`` JSON for ui.perfetto.dev.  See ``perfetto.py``.
+
+Operator surface: ``python -m torchsnapshot_tpu stats|trace`` and the
+metrics block ``bench.py`` embeds in its BENCH records.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any
+
+from .metrics import (  # noqa: F401
+    BUDGET_BYTES_IN_USE,
+    BYTES_DEDUPED,
+    BYTES_OFFLOADED,
+    BYTES_READ,
+    BYTES_STAGED,
+    BYTES_WRITTEN,
+    BYTES_BUCKETS,
+    IO_QUEUE_DEPTH,
+    LATENCY_BUCKETS_S,
+    REGISTRY,
+    RSS_PEAK_DELTA_BYTES,
+    SLABS_PACKED,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+    metrics_snapshot,
+    record_storage_io,
+    reset_metrics,
+)
+from .perfetto import to_trace_events, write_trace  # noqa: F401
+from .tracer import (  # noqa: F401
+    Span,
+    Tracer,
+    current_span,
+    get_tracer,
+    next_flow_id,
+    refresh_enabled,
+    set_tracing,
+    span,
+    tracing_enabled,
+)
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "span",
+    "get_tracer",
+    "current_span",
+    "tracing_enabled",
+    "set_tracing",
+    "refresh_enabled",
+    "counter",
+    "gauge",
+    "histogram",
+    "metrics_snapshot",
+    "reset_metrics",
+    "record_storage_io",
+    "buf_nbytes",
+    "instrument_storage",
+    "to_trace_events",
+    "write_trace",
+    "REGISTRY",
+    "MetricsRegistry",
+]
+
+
+def buf_nbytes(buf: Any) -> int:
+    """Byte length of a staged/read buffer, 0 for None.  ``.nbytes``
+    first: extension-dtype numpy arrays (bfloat16/fp8 — the primary TPU
+    dtypes, handed out raw by read-into plugins) reject
+    ``memoryview(...).cast("B")``, and ``len()`` on a multi-dim array
+    is the first-dim length, not bytes."""
+    if buf is None:
+        return 0
+    n = getattr(buf, "nbytes", None)
+    if isinstance(n, int):
+        return n
+    try:
+        return memoryview(buf).cast("B").nbytes
+    except (TypeError, ValueError):
+        try:
+            return len(buf)
+        except TypeError:
+            return 0
+
+
+def instrument_storage(backend: str):
+    """Class decorator for ``StoragePlugin`` subclasses: wraps ``write``
+    and ``read`` with a (knob-gated) span plus always-on per-backend
+    latency/byte metrics.  Subclasses that override ``write``/``read``
+    (e.g. fault-injection test doubles) simply shadow the wrapper —
+    behavior is unchanged for them."""
+
+    def deco(cls):
+        orig_write = cls.write
+        orig_read = cls.read
+
+        @functools.wraps(orig_write)
+        async def write(self, write_io):
+            nbytes = buf_nbytes(write_io.buf)
+            with span(
+                "storage/write", backend=backend,
+                path=write_io.path, bytes=nbytes,
+            ):
+                t0 = time.perf_counter()
+                await orig_write(self, write_io)
+                record_storage_io(
+                    backend, "write", nbytes, time.perf_counter() - t0
+                )
+
+        @functools.wraps(orig_read)
+        async def read(self, read_io):
+            with span(
+                "storage/read", backend=backend, path=read_io.path
+            ) as s:
+                t0 = time.perf_counter()
+                await orig_read(self, read_io)
+                nbytes = buf_nbytes(read_io.buf)
+                if s is not None:
+                    s.attrs["bytes"] = nbytes
+                record_storage_io(
+                    backend, "read", nbytes, time.perf_counter() - t0
+                )
+
+        cls.write = write
+        cls.read = read
+        return cls
+
+    return deco

@@ -1,0 +1,268 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+Always-on by design (unlike spans): the instruments below are updated a
+handful of times per storage op / pipeline transition, and each update
+is one lock-protected arithmetic op — cheap enough to leave running so
+benchmarks and the CLI can read real numbers without flipping any knob.
+
+Snapshot format (``snapshot()``) is plain JSON-safe dicts so ``bench.py``
+can embed it verbatim in BENCH records:
+
+    {"counters": {name: int},
+     "gauges": {name: {"value": float, "max": float}},
+     "histograms": {name: {"count": int, "sum": float, "min": float,
+                           "max": float, "bounds": [...], "counts": [...]}}}
+
+``counts`` has ``len(bounds) + 1`` entries; the last is the overflow
+bucket (values above every bound) — no ``Infinity`` literals, so the
+snapshot survives strict JSON parsers.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Default bucket ladders.  Latency in seconds (sub-ms to a minute);
+# bytes from 1KB to 4GB in powers of ~4 — both chosen to straddle the
+# ranges the storage plugins and scheduler actually produce.
+LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+BYTES_BUCKETS: Tuple[float, ...] = (
+    1024.0, 16384.0, 262144.0, 1048576.0, 4194304.0, 16777216.0,
+    67108864.0, 268435456.0, 1073741824.0, 4294967296.0,
+)
+
+# Well-known instrument names (the instrumented hot path uses these; a
+# single source of truth keeps bench/docs/tests from drifting).
+BYTES_STAGED = "bytes_staged"
+BYTES_WRITTEN = "bytes_written"
+BYTES_READ = "bytes_read"
+BYTES_DEDUPED = "bytes_deduped"
+BYTES_OFFLOADED = "bytes_offloaded"
+BUDGET_BYTES_IN_USE = "budget_bytes_in_use"
+IO_QUEUE_DEPTH = "io_queue_depth"
+# the read pipeline's twins: an async_take's background drain can
+# overlap a restore in the same process, so the two pipelines must not
+# interleave writes to one gauge
+BUDGET_BYTES_IN_USE_READ = "budget_bytes_in_use_read"
+IO_QUEUE_DEPTH_READ = "io_queue_depth_read"
+RSS_PEAK_DELTA_BYTES = "rss_peak_delta_bytes"
+SLABS_PACKED = "slabs_packed"
+
+
+class Counter:
+    """Monotonically-increasing integer."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-set value plus its high-water mark (``max``) since reset —
+    the high-water is what budget/queue-depth gauges exist for."""
+
+    __slots__ = ("name", "_value", "_max", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0.0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+            if v > self._max:
+                self._max = v
+
+    def set_max(self, v: float) -> None:
+        """Record ``v`` only as a high-water candidate (value untouched)."""
+        with self._lock:
+            if v > self._max:
+                self._max = v
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            return self._max
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+            self._max = 0.0
+
+
+class Histogram:
+    """Fixed-bucket histogram: ``bounds`` are inclusive upper edges,
+    observations above every bound land in the overflow bucket."""
+
+    __slots__ = ("name", "bounds", "_counts", "_sum", "_min", "_max",
+                 "_count", "_lock")
+
+    def __init__(
+        self, name: str, bounds: Sequence[float] = LATENCY_BUCKETS_S
+    ) -> None:
+        if list(bounds) != sorted(bounds) or len(set(bounds)) != len(bounds):
+            raise ValueError(f"histogram bounds must be strictly increasing: {bounds}")
+        self.name = name
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in bounds)
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        idx = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "bounds": list(self.bounds),
+                "counts": list(self._counts),
+            }
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._sum = 0.0
+            self._min = None
+            self._max = None
+            self._count = 0
+
+
+class MetricsRegistry:
+    """Name → instrument, get-or-create.  One process-global instance
+    (``REGISTRY``); independent registries exist only for tests."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(
+        self, name: str, bounds: Sequence[float] = LATENCY_BUCKETS_S
+    ) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, bounds)
+            return h
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            histograms = list(self._histograms.values())
+        return {
+            "counters": {c.name: c.value for c in counters},
+            "gauges": {
+                g.name: {"value": g.value, "max": g.max} for g in gauges
+            },
+            "histograms": {h.name: h.to_dict() for h in histograms},
+        }
+
+    def reset(self) -> None:
+        """Zero every instrument (instrument objects stay registered, so
+        references held by instrumented code remain live)."""
+        with self._lock:
+            instruments: List[Any] = [
+                *self._counters.values(),
+                *self._gauges.values(),
+                *self._histograms.values(),
+            ]
+        for inst in instruments:
+            inst._reset()
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(
+    name: str, bounds: Sequence[float] = LATENCY_BUCKETS_S
+) -> Histogram:
+    return REGISTRY.histogram(name, bounds)
+
+
+def metrics_snapshot() -> Dict[str, Any]:
+    return REGISTRY.snapshot()
+
+
+def reset_metrics() -> None:
+    REGISTRY.reset()
+
+
+def record_storage_io(backend: str, op: str, nbytes: int, seconds: float) -> None:
+    """One storage write/read completed: latency histogram + byte counter,
+    labeled per backend (``storage.fs.write_latency_s`` …)."""
+    REGISTRY.histogram(
+        f"storage.{backend}.{op}_latency_s", LATENCY_BUCKETS_S
+    ).observe(seconds)
+    REGISTRY.counter(f"storage.{backend}.{op}_bytes").inc(nbytes)

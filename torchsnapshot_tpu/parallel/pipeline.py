@@ -69,6 +69,21 @@ def pipeline_forward(
     x: [B, d] (B divisible by n_microbatches), replicated.
     Returns [B, d] (replicated), bitwise the composition of the stages.
     """
+    from ..obs import span
+
+    # span covers shard_map construction + (first call) XLA tracing —
+    # the host-side cost a trace of a training loop needs attributed
+    with span(
+        "pp/forward", axis=axis_name, n_microbatches=n_microbatches
+    ):
+        return _pipeline_forward_impl(
+            params, x, mesh, axis_name, n_microbatches
+        )
+
+
+def _pipeline_forward_impl(
+    params, x, mesh, axis_name: str, n_microbatches: int
+):
     n_stages = mesh.shape[axis_name]
     if params["w"].shape[0] != n_stages:
         # a user-facing precondition (e.g. weights restored onto a mesh
@@ -175,9 +190,12 @@ def pipeline_train_step(
 ) -> Tuple[dict, jax.Array]:
     """One SGD step through the pipelined forward (grads flow through
     scan + ppermute).  Compiled once per (mesh, schedule) config."""
-    return _jitted_train_step(mesh, axis_name, n_microbatches, float(lr))(
-        params, x, y
-    )
+    from ..obs import span
+
+    with span("pp/train_step", axis=axis_name, n_microbatches=n_microbatches):
+        return _jitted_train_step(mesh, axis_name, n_microbatches, float(lr))(
+            params, x, y
+        )
 
 
 def shard_pipeline_params(params, mesh, axis_name: str = "pp"):

@@ -50,6 +50,9 @@ from .io_types import (
     WriteReq,
     check_read_crc,
 )
+from .obs import buf_nbytes as _buf_nbytes
+from .obs import metrics as obs_metrics
+from .obs import tracer as obs_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -289,14 +292,54 @@ async def _execute_write_pipelines(
     io_tasks: set = set()
     io_concurrency = knobs.get_max_per_rank_io_concurrency()
     reporter = _WriteReporter(budget, stats)
+    # observability: counters/gauges are always on (one locked arithmetic
+    # op per pipeline transition); spans exist only under the TRACE knob.
+    # Budget-admission spans open per request at pipeline start and close
+    # at admission, so queue-wait time is first-class in the trace; a
+    # flow id recorded at staging completion links each staging span to
+    # its storage-I/O span (the Perfetto async arrow).
+    m_staged = obs_metrics.counter(obs_metrics.BYTES_STAGED)
+    m_written = obs_metrics.counter(obs_metrics.BYTES_WRITTEN)
+    m_deduped = obs_metrics.counter(obs_metrics.BYTES_DEDUPED)
+    m_budget = obs_metrics.gauge(obs_metrics.BUDGET_BYTES_IN_USE)
+    m_ioq = obs_metrics.gauge(obs_metrics.IO_QUEUE_DEPTH)
+    tracer = obs_tracer.get_tracer()
+    adm_spans: dict = {}
+    flow_ids: dict = {}
+    if obs_tracer.ENABLED:
+        for p in pipelines:
+            adm_spans[id(p)] = tracer.begin(
+                "pipeline/budget_admission",
+                path=p.write_req.path,
+                bytes=p.staging_cost,
+            )
+
+    def _admitted(p: _WritePipeline) -> None:
+        m_budget.set(budget.used)
+        sp = adm_spans.pop(id(p), None)
+        if sp is not None:
+            tracer.end(sp, fire_event=True)
+
     # smallest pending staging cost: lets a wake where nothing can fit
     # skip the admission scan in O(1) instead of rotating the whole
     # deque on every task completion (O(n^2) across a large take)
     min_pending_cost = min((p.staging_cost for p in pipelines), default=0)
 
     async def stage_one(p: _WritePipeline) -> _WritePipeline:
+        with obs_tracer.span(
+            "pipeline/staging", path=p.write_req.path, cost=p.staging_cost
+        ) as sp:
+            await _stage_one_inner(p)
+            if sp is not None:
+                sp.attrs["bytes"] = p.buf_size
+                # flow arrow anchor: this staging span's end links to
+                # the matching pipeline/io span's start in the export
+                sp.flow_out = flow_ids[id(p)] = obs_tracer.next_flow_id()
+        return p
+
+    async def _stage_one_inner(p: _WritePipeline) -> _WritePipeline:
         p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
-        p.buf_size = len(memoryview(p.buf).cast("B")) if p.buf is not None else 0
+        p.buf_size = _buf_nbytes(p.buf)
         wr = p.write_req
         if (wr.checksum_sinks or wr.digest_sink) and (
             knobs.write_checksums_enabled()
@@ -334,6 +377,16 @@ async def _execute_write_pipelines(
         return p
 
     async def write_one(p: _WritePipeline) -> _WritePipeline:
+        with obs_tracer.span(
+            "pipeline/io", path=p.write_req.path, bytes=p.buf_size
+        ) as sp:
+            if sp is not None:
+                fid = flow_ids.pop(id(p), None)
+                if fid is not None:
+                    sp.flow_in = fid
+            return await _write_one_inner(p)
+
+    async def _write_one_inner(p: _WritePipeline) -> _WritePipeline:
         wr = p.write_req
         if wr.dedup is not None and wr.object_digest == wr.dedup[1]:
             # content unchanged vs the base snapshot: link/server-side
@@ -391,6 +444,7 @@ async def _execute_write_pipelines(
                 p = ready_for_staging.popleft()
                 if budget.fits(p.staging_cost):
                     budget.debit(p.staging_cost)
+                    _admitted(p)
                     staging_tasks.add(asyncio.ensure_future(stage_one(p)))
                 else:
                     ready_for_staging.append(p)
@@ -404,6 +458,7 @@ async def _execute_write_pipelines(
             # the largest pending item; admitting it leaves min unchanged
             p = ready_for_staging.popleft()
             budget.debit(p.staging_cost)
+            _admitted(p)
             staging_tasks.add(asyncio.ensure_future(stage_one(p)))
             if not ready_for_staging:
                 min_pending_cost = 0
@@ -412,6 +467,7 @@ async def _execute_write_pipelines(
         while ready_for_io and len(io_tasks) < io_concurrency:
             p = ready_for_io.popleft()
             io_tasks.add(asyncio.ensure_future(write_one(p)))
+        m_ioq.set(len(ready_for_io))
 
     try:
         while ready_for_staging or staging_tasks or ready_for_io or io_tasks:
@@ -439,13 +495,20 @@ async def _execute_write_pipelines(
                     # correct declared cost to actual buffer size
                     # (reference scheduler.py:308-312)
                     budget.credit(p.staging_cost - p.buf_size)
+                    m_budget.set(budget.used)
+                    m_staged.inc(p.buf_size)
                     ready_for_io.append(p)
+                    m_ioq.set(len(ready_for_io))
                 else:
                     io_tasks.discard(task)
                     p = task.result()
                     if not p.deduped:  # linked objects moved no bytes
                         stats["bytes_written"] += p.buf_size
+                        m_written.inc(p.buf_size)
+                    else:
+                        m_deduped.inc(p.buf_size)
                     budget.credit(p.buf_size)
+                    m_budget.set(budget.used)
                     p.buf = None
             if not ready_for_staging and not staging_tasks:
                 staging_done.set()
@@ -456,6 +519,13 @@ async def _execute_write_pipelines(
         for t in staging_tasks | io_tasks:
             t.cancel()
         raise
+    finally:
+        # requests never admitted (error/cancel path) close their
+        # admission spans here so the trace has no dangling opens
+        for sp in adm_spans.values():
+            sp.attrs["error"] = True
+            tracer.end(sp, fire_event=True)
+        adm_spans.clear()
 
 
 def sync_execute_write_reqs(
@@ -534,31 +604,70 @@ async def _execute_read_pipelines(
     io_tasks: set = set()
     consume_tasks: set = set()
     io_concurrency = knobs.get_max_per_rank_io_concurrency()
+    # observability twins of the write loop's instruments, direction-
+    # suffixed: an async_take's background drain can overlap a restore
+    # in this process, so the pipelines get separate gauges
+    m_read = obs_metrics.counter(obs_metrics.BYTES_READ)
+    m_budget = obs_metrics.gauge(obs_metrics.BUDGET_BYTES_IN_USE_READ)
+    m_ioq = obs_metrics.gauge(obs_metrics.IO_QUEUE_DEPTH_READ)
+    tracer = obs_tracer.get_tracer()
+    adm_spans: dict = {}
+    if obs_tracer.ENABLED:
+        for p in pipelines:
+            adm_spans[id(p)] = tracer.begin(
+                "pipeline/budget_admission",
+                path=p.read_req.path,
+                bytes=p.consuming_cost,
+            )
+
+    def _admitted(p: _ReadPipeline) -> None:
+        m_budget.set(budget.used)
+        sp = adm_spans.pop(id(p), None)
+        if sp is not None:
+            tracer.end(sp, fire_event=True)
+
     # smallest pending consuming cost — O(1) skip of the admission scan
     # on wakes where nothing can fit (see the write loop's twin)
     min_pending_cost = min((p.consuming_cost for p in pipelines), default=0)
 
     async def read_one(p: _ReadPipeline) -> _ReadPipeline:
-        read_io = ReadIO(
+        with obs_tracer.span(
+            "pipeline/io",
             path=p.read_req.path,
-            byte_range=p.read_req.byte_range,
-            into=p.read_req.into,
-        )
-        await storage.read(read_io)
-        p.buf = read_io.buf
-        return p
+            cost=p.consuming_cost,
+            op="read",
+        ) as sp:
+            read_io = ReadIO(
+                path=p.read_req.path,
+                byte_range=p.read_req.byte_range,
+                into=p.read_req.into,
+            )
+            await storage.read(read_io)
+            p.buf = read_io.buf
+            if sp is not None:
+                sp.attrs["bytes"] = _buf_nbytes(p.buf)
+            return p
 
     async def consume_one(p: _ReadPipeline) -> _ReadPipeline:
-        if (
-            p.read_req.expected_crc32 is not None
-            and knobs.verify_on_restore()
-        ):
-            await asyncio.get_running_loop().run_in_executor(
-                executor, check_read_crc, p.read_req, p.buf
-            )
-        await p.read_req.buffer_consumer.consume_buffer(p.buf, executor)
-        p.buf = None
-        return p
+        with obs_tracer.span(
+            "pipeline/consume",
+            path=p.read_req.path,
+            cost=p.consuming_cost,
+        ) as sp:
+            if sp is not None:
+                # actual size, not the pre-read estimate (object entries
+                # declare cost 1) — p.buf is released below, measure now
+                sp.attrs["bytes"] = _buf_nbytes(p.buf)
+            if (
+                p.read_req.expected_crc32 is not None
+                and knobs.verify_on_restore()
+            ):
+                await asyncio.get_running_loop().run_in_executor(
+                    executor, check_read_crc, p.read_req, p.buf
+                )
+            await p.read_req.buffer_consumer.consume_buffer(p.buf, executor)
+            p.buf = None
+            return p
 
     try:
         while ready_for_io or io_tasks or consume_tasks:
@@ -592,6 +701,7 @@ async def _execute_read_pipelines(
                         p.consuming_cost
                     ):
                         budget.debit(p.consuming_cost)
+                        _admitted(p)
                         io_tasks.add(asyncio.ensure_future(read_one(p)))
                     else:
                         ready_for_io.append(p)
@@ -603,10 +713,12 @@ async def _execute_read_pipelines(
             if ready_for_io and not io_tasks and not consume_tasks:
                 p = ready_for_io.popleft()
                 budget.debit(p.consuming_cost)
+                _admitted(p)
                 io_tasks.add(asyncio.ensure_future(read_one(p)))
                 min_pending_cost = min(
                     (q.consuming_cost for q in ready_for_io), default=0
                 )
+            m_ioq.set(len(ready_for_io))
             if not io_tasks and not consume_tasks:
                 continue
             done, _ = await asyncio.wait(
@@ -615,17 +727,28 @@ async def _execute_read_pipelines(
             for task in done:
                 if task in io_tasks:
                     io_tasks.discard(task)
-                    consume_tasks.add(
-                        asyncio.ensure_future(consume_one(task.result()))
-                    )
+                    p = task.result()
+                    # count ACTUAL bytes, not the consuming-cost estimate
+                    # (object entries declare cost 1 before the read —
+                    # the estimate would undercount them by orders of
+                    # magnitude); p.buf is released by consume_one, so
+                    # this is the last cheap place to measure it
+                    m_read.inc(_buf_nbytes(p.buf))
+                    consume_tasks.add(asyncio.ensure_future(consume_one(p)))
                 else:
                     consume_tasks.discard(task)
                     p = task.result()
                     budget.credit(p.consuming_cost)
+                    m_budget.set(budget.used)
     except BaseException:
         for t in io_tasks | consume_tasks:
             t.cancel()
         raise
+    finally:
+        for sp in adm_spans.values():
+            sp.attrs["error"] = True
+            tracer.end(sp, fire_event=True)
+        adm_spans.clear()
 
 
 def sync_execute_read_reqs(

@@ -27,7 +27,7 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from . import knobs
+from . import knobs, obs
 from .batcher import batch_read_requests, batch_write_requests
 from .coordination import Coordinator, get_default_coordinator
 from .event import Event
@@ -700,39 +700,42 @@ class Snapshot:
         # leaves, and no global override state is touched (concurrent
         # takes from different threads must not interleave overrides)
         chunk_size_bytes = knobs.get_max_chunk_size_bytes()
-        for lpath in sorted(flattened.keys()):
-            obj = flattened[lpath]
-            repl = lpath in verified_repl
-            entry, reqs = prepare_write(
-                obj=obj,
-                logical_path=lpath,
-                rank=rank,
-                replicated=repl,
-                is_async_snapshot=is_async,
-                process_index=rank,
-                process_count=world,
-                writer_loads=writer_loads,
-                chunk_size_bytes=chunk_size_bytes,
-            )
-            entries[lpath] = entry
-            cost = sum(
-                r.buffer_stager.get_staging_cost_bytes() for r in reqs
-            )
-            if repl and not isinstance(entry, ShardedArrayEntry):
-                if isinstance(entry, ChunkedArrayEntry) and len(reqs) > 1:
-                    for ci, r in enumerate(reqs):
-                        k = f"{lpath}\x00{ci}"  # \x00 can't be in paths
-                        repl_chunk_reqs[k] = r
-                        chunk_parent[k] = lpath
-                        repl_items.append(
-                            (k, r.buffer_stager.get_staging_cost_bytes())
-                        )
+        # planning (prepare_write fan-out) is the dominant blocked-path
+        # CPU cost at high leaf counts — first-class in traces
+        with obs.span("take/plan", leaves=len(flattened), rank=rank):
+            for lpath in sorted(flattened.keys()):
+                obj = flattened[lpath]
+                repl = lpath in verified_repl
+                entry, reqs = prepare_write(
+                    obj=obj,
+                    logical_path=lpath,
+                    rank=rank,
+                    replicated=repl,
+                    is_async_snapshot=is_async,
+                    process_index=rank,
+                    process_count=world,
+                    writer_loads=writer_loads,
+                    chunk_size_bytes=chunk_size_bytes,
+                )
+                entries[lpath] = entry
+                cost = sum(
+                    r.buffer_stager.get_staging_cost_bytes() for r in reqs
+                )
+                if repl and not isinstance(entry, ShardedArrayEntry):
+                    if isinstance(entry, ChunkedArrayEntry) and len(reqs) > 1:
+                        for ci, r in enumerate(reqs):
+                            k = f"{lpath}\x00{ci}"  # \x00 can't be in paths
+                            repl_chunk_reqs[k] = r
+                            chunk_parent[k] = lpath
+                            repl_items.append(
+                                (k, r.buffer_stager.get_staging_cost_bytes())
+                            )
+                    else:
+                        repl_reqs[lpath] = reqs
+                        repl_items.append((lpath, cost))
                 else:
-                    repl_reqs[lpath] = reqs
-                    repl_items.append((lpath, cost))
-            else:
-                write_reqs.extend(reqs)
-                local_bytes += cost
+                    write_reqs.extend(reqs)
+                    local_bytes += cost
 
         # balance replicated host-state writes across ranks
         # (reference partition_write_reqs, partitioner.py:216-310)
@@ -982,6 +985,22 @@ class Snapshot:
         paths: Optional[Sequence[str]] = None,
     ) -> None:
         # reference _load_stateful, snapshot.py:727-782
+        with obs.span("restore/load_stateful", key=key, rank=rank):
+            self._load_stateful_impl(
+                key, stateful, manifest_for_rank, storage, strict, rank,
+                paths=paths,
+            )
+
+    def _load_stateful_impl(
+        self,
+        key: str,
+        stateful: Any,
+        manifest_for_rank: Manifest,
+        storage: Any,
+        strict: bool,
+        rank: int,
+        paths: Optional[Sequence[str]] = None,
+    ) -> None:
         key_manifest = {
             p: e
             for p, e in manifest_for_rank.items()
@@ -1170,6 +1189,9 @@ class Snapshot:
         dry-run-restores every entry.  Returns a ``VerifyResult``."""
         from .verify import verify_snapshot
 
+        # no bracket here: verify_snapshot brackets itself with
+        # log_event(Event("verify", ...)) (verify.py) — a second one
+        # would double-count the operation for every handler
         return verify_snapshot(self, deep=deep)
 
     def materialize(

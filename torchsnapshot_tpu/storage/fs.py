@@ -22,7 +22,7 @@ import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from .. import knobs
+from .. import knobs, obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 
@@ -49,6 +49,7 @@ def _fsync_dir_chain(leaf_dir: str, stop_below: str) -> None:
         cur = os.path.dirname(cur)
 
 
+@obs.instrument_storage("fs")
 class FSStoragePlugin(StoragePlugin):
     def __init__(self, root: str) -> None:
         self.root = root

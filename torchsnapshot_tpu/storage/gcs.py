@@ -31,6 +31,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
+from .. import obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 logger = logging.getLogger(__name__)
@@ -86,6 +87,7 @@ class _CollectiveProgressRetry:
         await asyncio.sleep(min(2**attempt, 32) * (0.5 + self._rng.random()))
 
 
+@obs.instrument_storage("gcs")
 class GCSStoragePlugin(StoragePlugin):
     def __init__(
         self,

@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
+from .. import obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 _NAMESPACES: Dict[str, Dict[str, bytes]] = {}
@@ -21,6 +22,7 @@ def reset_namespace(namespace: str) -> None:
         _NAMESPACES.pop(namespace, None)
 
 
+@obs.instrument_storage("memory")
 class MemoryStoragePlugin(StoragePlugin):
     def __init__(self, namespace: str) -> None:
         self.namespace = namespace

@@ -12,6 +12,7 @@ import asyncio
 import functools
 from concurrent.futures import ThreadPoolExecutor
 
+from .. import obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 
@@ -28,6 +29,7 @@ def _raise_missing_as_fnf(e: Exception, uri: str) -> None:
     raise e
 
 
+@obs.instrument_storage("s3")
 class S3StoragePlugin(StoragePlugin):
     def __init__(
         self,

@@ -277,6 +277,64 @@ def _quick_number(dev, init_s: float) -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _tier_probe(payload_mb: int = 32) -> dict:
+    """Small write-back tiered roundtrip on local dirs (host arrays
+    only — never touches the device mid-bench): records fast-tier
+    hit/miss/repair counts, the promotion lag, and the fast-vs-durable
+    restore latencies so the tier's restore-latency win (and promotion
+    health) shows up in the BENCH trajectory."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict, drain_promotions, obs
+
+    root = tempfile.mkdtemp(prefix="tsnp_bench_tier_")
+    fast = os.path.join(root, "fast")
+    durable = os.path.join(root, "durable")
+    opts = {"tier": {"fast_url": fast, "policy": "write_back"}}
+    n = payload_mb * (1 << 20) // 8
+    out: dict = {"payload_mb": payload_mb, "policy": "write_back"}
+    try:
+        c0 = obs.metrics_snapshot()["counters"]
+        t0 = time.perf_counter()
+        Snapshot.take(
+            durable,
+            {"m": StateDict(w=np.arange(n, dtype=np.float64))},
+            storage_options=opts,
+        )
+        out["save_ack_s"] = round(time.perf_counter() - t0, 4)
+        drain_promotions()
+        out["save_durable_s"] = round(time.perf_counter() - t0, 4)
+        dest = {"m": StateDict(w=np.zeros(n, dtype=np.float64))}
+        t0 = time.perf_counter()
+        Snapshot(durable, storage_options=opts).restore(dest)
+        out["restore_fast_s"] = round(time.perf_counter() - t0, 4)
+        shutil.rmtree(fast)  # lost-host shape: durable fallback + repair
+        dest = {"m": StateDict(w=np.zeros(n, dtype=np.float64))}
+        t0 = time.perf_counter()
+        Snapshot(durable, storage_options=opts).restore(dest)
+        out["restore_durable_fallback_s"] = round(
+            time.perf_counter() - t0, 4
+        )
+        c1 = obs.metrics_snapshot()["counters"]
+        for name in (
+            "tier.fast_hits",
+            "tier.fast_misses",
+            "tier.fast_repairs",
+            "tier.bytes_promoted",
+        ):
+            out[name.removeprefix("tier.")] = c1.get(name, 0) - c0.get(
+                name, 0
+            )
+        lag = obs.metrics_snapshot()["histograms"].get(
+            "tier.promotion_lag_s"
+        )
+        if lag and lag.get("count"):
+            out["promotion_lag_max_s"] = round(lag["max"], 4)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def run_child() -> None:
     import jax
     import jax.numpy as jnp
@@ -532,6 +590,13 @@ def run_child() -> None:
                 result["trace_path"] = trace_path
             except OSError as e:
                 result["trace_error"] = f"{e!r}"[:200]
+        # tiered-storage probe AFTER the measured-phase metrics snapshot
+        # (its counters must not pollute the headline breakdown); host
+        # arrays + local dirs only, so it cannot perturb the device
+        try:
+            result["tier"] = _tier_probe()
+        except Exception as e:  # headline metric survives regardless
+            result["tier"] = {"error": f"{e!r}"[:200]}
         print(json.dumps(result), flush=True)
         # spot-check one leaf round-tripped
         import ml_dtypes

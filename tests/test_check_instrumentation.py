@@ -61,6 +61,43 @@ def test_checker_honors_allowlist():
     assert checker.check_source(src, {"Snapshot": {"naked"}}, "x.py") == []
 
 
+def test_checker_covers_module_level_functions():
+    """GC-path coverage: delete_snapshot (module-level) is required to
+    carry a bracket, and the checker detects a naked one."""
+    checker = _load_checker()
+    import os
+
+    assert "delete_snapshot" in checker.MODULE_FUNCTIONS[
+        os.path.join("torchsnapshot_tpu", "manager.py")
+    ]
+    src = textwrap.dedent(
+        """
+        def delete_snapshot(path):
+            return path
+
+        def helper_is_fine(path):
+            return path
+        """
+    )
+    violations = checker.check_source(
+        src, {}, "x.py", module_functions={"delete_snapshot"}
+    )
+    assert len(violations) == 1 and "delete_snapshot" in violations[0]
+    src_ok = textwrap.dedent(
+        """
+        def delete_snapshot(path):
+            with log_event(Event("delete_snapshot")):
+                return path
+        """
+    )
+    assert (
+        checker.check_source(
+            src_ok, {}, "x.py", module_functions={"delete_snapshot"}
+        )
+        == []
+    )
+
+
 def test_checker_main_exit_codes(capsys):
     checker = _load_checker()
     assert checker.main([_REPO_ROOT]) == 0

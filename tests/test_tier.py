@@ -1,0 +1,489 @@
+"""Tiered checkpoint storage (tier/): fast tier + durable tier.
+
+Covers the acceptance matrix of the subsystem:
+
+- a write-back tiered snapshot restores from (a) the fast tier alone,
+  (b) the durable tier after a fast-tier wipe (repairing the fast copy),
+  and (c) a peer replica with the durable tier absent;
+- interrupted promotion (crash window between fast-tier commit and
+  durable commit) never yields a step that a durable-only
+  ``restore_latest`` treats as committed;
+- injected fast-tier corruption silently falls back to the durable tier
+  and repairs the fast copy (both data payloads and the metadata file);
+- cross-tier GC: fast copies evicted independently of durable retention,
+  never evicting the only (unpromoted) copy, and retention never breaks
+  an incremental dedup chain;
+- ``delete_snapshot`` emits the ``snapshot.gc.bytes_reclaimed`` counter;
+- the ``tiers`` CLI reports residency + promotion progress.
+
+Multi-host peer-replica placement runs in tests/test_tier_replica.py
+(``slow`` marker — real subproces ranks).
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import (
+    Snapshot,
+    SnapshotManager,
+    StateDict,
+    TierConfig,
+    delete_snapshot,
+    drain_promotions,
+    knobs,
+    obs,
+)
+from torchsnapshot_tpu.tier import get_promoter
+from test_corruption_fuzz import _payload_files
+
+
+@pytest.fixture(autouse=True)
+def _drained_promoter():
+    """Leave no cross-test promotion state: resume + drain afterwards."""
+    promoter = get_promoter()
+    yield promoter
+    promoter.resume()
+    promoter.drain(raise_on_error=False)
+
+
+def _counters(*names):
+    snap = obs.metrics_snapshot()["counters"]
+    return [snap.get(n, 0) for n in names]
+
+
+def _state(v: float) -> StateDict:
+    return StateDict(w=np.full(2048, float(v), dtype=np.float32), step=int(v))
+
+
+def _tier_opts(fast, policy, **extra):
+    return {"tier": {"fast_url": str(fast), "policy": policy, **extra}}
+
+
+# ------------------------------------------------------------ roundtrips
+
+
+def test_write_through_roundtrip_both_tiers(tmp_path):
+    fast, durable = str(tmp_path / "fast"), str(tmp_path / "durable")
+    opts = _tier_opts(fast, "write_through")
+    Snapshot.take(durable, {"app": _state(7)}, storage_options=opts)
+    # both tiers committed synchronously
+    assert os.path.exists(os.path.join(durable, ".snapshot_metadata"))
+    assert os.path.exists(os.path.join(fast, ".snapshot_metadata"))
+    hits0, misses0 = _counters("tier.fast_hits", "tier.fast_misses")
+    dest = {"app": _state(0)}
+    Snapshot(durable, storage_options=opts).restore(dest)
+    assert dest["app"]["step"] == 7
+    assert np.array_equal(dest["app"]["w"], np.full(2048, 7.0, np.float32))
+    hits1, misses1 = _counters("tier.fast_hits", "tier.fast_misses")
+    assert hits1 > hits0  # reads served by the fast tier
+    assert misses1 == misses0
+
+
+def test_write_back_promotes_then_survives_fast_wipe(tmp_path):
+    """Acceptance paths (a) fast alone and (b) durable after fast wipe,
+    plus repair-on-fallback."""
+    fast, durable = str(tmp_path / "fast"), str(tmp_path / "durable")
+    opts = _tier_opts(fast, "write_back")
+    get_promoter().pause()
+    Snapshot.take(durable, {"app": _state(3)}, storage_options=opts)
+    # (a) durable tier has nothing yet — restore comes from fast alone
+    assert not os.path.exists(os.path.join(durable, ".snapshot_metadata"))
+    dest = {"app": _state(0)}
+    Snapshot(durable, storage_options=opts).restore(dest)
+    assert dest["app"]["step"] == 3
+    get_promoter().resume()
+    drain_promotions()
+    assert os.path.exists(os.path.join(durable, ".snapshot_metadata"))
+    # (b) wipe the fast tier: restore falls back and repairs
+    shutil.rmtree(fast)
+    repairs0 = _counters("tier.fast_repairs")[0]
+    dest = {"app": _state(0)}
+    Snapshot(durable, storage_options=opts).restore(dest)
+    assert dest["app"]["step"] == 3
+    assert np.array_equal(dest["app"]["w"], np.full(2048, 3.0, np.float32))
+    assert _counters("tier.fast_repairs")[0] > repairs0
+    assert os.path.isdir(fast)  # data objects re-materialized
+    # repaired copy serves the next restore without falling back
+    misses0 = _counters("tier.fast_misses")[0]
+    dest = {"app": _state(0)}
+    Snapshot(durable, storage_options=opts).restore(dest)
+    assert dest["app"]["step"] == 3
+    # metadata is deliberately not repaired (read from durable), but no
+    # DATA read missed the fast tier
+    assert _counters("tier.fast_misses")[0] - misses0 <= 1
+
+
+def test_interrupted_promotion_is_not_durably_committed(tmp_path):
+    """Crash window between fast-tier commit and durable commit: the
+    durable tier must show an aborted (metadata-less) snapshot, so a
+    durable-only restore_latest never serves the step."""
+    dur = str(tmp_path / "dur")
+    fast = str(tmp_path / "fast")
+    tier = TierConfig(fast_root=fast, policy="write_back")
+    mgr = SnapshotManager(dur, tier=tier)
+    get_promoter().pause()
+    mgr.save({"app": _state(1)}, step=1)
+    # the step is restorable through the tiered manager (fast tier)...
+    assert mgr.steps() == [1]
+    assert mgr.durable_steps() == []
+    dest = {"app": _state(0)}
+    assert mgr.restore_latest(dest) == 1
+    # ...but a durable-only view treats it as uncommitted
+    plain = SnapshotManager(dur)
+    assert plain.restore_latest({"app": _state(0)}) is None
+    # even with data partially promoted, metadata-last means uncommitted
+    get_promoter().resume()
+    drain_promotions()
+    assert mgr.durable_steps() == [1]
+    assert SnapshotManager(dur).restore_latest({"app": _state(0)}) == 1
+
+
+# ----------------------------------------------------- corruption fallback
+
+
+def test_fast_corruption_silently_falls_back_and_repairs(tmp_path):
+    fast, durable = str(tmp_path / "fast"), str(tmp_path / "durable")
+    opts = _tier_opts(fast, "write_through")
+    rng = np.random.default_rng(0)
+    tree = {"w": (rng.standard_normal(50000) * 8).astype(np.float32),
+            "b": np.arange(333, dtype=np.int32)}
+    Snapshot.take(durable, {"m": StateDict(**tree)}, storage_options=opts)
+    files = _payload_files(fast)
+    assert files
+    victim = files[0]
+    size = os.path.getsize(victim)
+    off = int(rng.integers(size))
+    with open(victim, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0x40]))
+    corrupt0, repairs0 = _counters("tier.fast_corrupt", "tier.fast_repairs")
+    dest = StateDict(w=np.zeros(50000, np.float32),
+                     b=np.zeros(333, np.int32))
+    # restore must succeed SILENTLY (no error), with correct content
+    Snapshot(durable, storage_options=opts).restore({"m": dest})
+    assert np.array_equal(dest["w"], tree["w"])
+    assert np.array_equal(dest["b"], tree["b"])
+    corrupt1, repairs1 = _counters("tier.fast_corrupt", "tier.fast_repairs")
+    assert corrupt1 > corrupt0
+    assert repairs1 > repairs0
+    # the fast copy was repaired in place: bytes now match the durable one
+    rel = os.path.relpath(victim, fast)
+    with open(victim, "rb") as f_fast, \
+            open(os.path.join(durable, rel), "rb") as f_dur:
+        assert f_fast.read() == f_dur.read()
+    # a second restore trusts the repaired fast tier again
+    corrupt_before = _counters("tier.fast_corrupt")[0]
+    dest2 = StateDict(w=np.zeros(50000, np.float32),
+                      b=np.zeros(333, np.int32))
+    Snapshot(durable, storage_options=opts).restore({"m": dest2})
+    assert np.array_equal(dest2["w"], tree["w"])
+    assert _counters("tier.fast_corrupt")[0] == corrupt_before
+
+
+def test_fast_metadata_corruption_falls_back(tmp_path):
+    """A flipped byte in the FAST tier's .snapshot_metadata must not
+    poison restore: the self-checksum trailer fails the parse and the
+    read falls back to the durable copy."""
+    fast, durable = str(tmp_path / "fast"), str(tmp_path / "durable")
+    opts = _tier_opts(fast, "write_through")
+    Snapshot.take(durable, {"app": _state(5)}, storage_options=opts)
+    meta = os.path.join(fast, ".snapshot_metadata")
+    with open(meta, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 1]))
+    dest = {"app": _state(0)}
+    Snapshot(durable, storage_options=opts).restore(dest)
+    assert dest["app"]["step"] == 5
+
+
+# ------------------------------------------------------------ peer replicas
+
+
+def test_peer_fallback_without_durable(tmp_path):
+    """Acceptance path (c), single-process shape: the host's own fast
+    tier is empty AND the durable tier is absent — every read must come
+    from a peer's fast root, and the durable tier is never touched."""
+    peer_fast = str(tmp_path / "peer_fast")
+    my_fast = str(tmp_path / "my_fast")
+    durable = str(tmp_path / "durable")  # never created
+    # the "peer host" took a write-back snapshot whose promotion never
+    # landed (its fast root holds the only copy)
+    get_promoter().pause()
+    Snapshot.take(
+        durable, {"app": _state(9)},
+        storage_options=_tier_opts(peer_fast, "write_back"),
+    )
+    shutil.rmtree(durable, ignore_errors=True)
+    assert not os.path.exists(durable)
+    peer_hits0 = _counters("tier.peer_hits")[0]
+    opts = _tier_opts(
+        my_fast, "write_back", peer_fast_urls=[my_fast, peer_fast]
+    )
+    dest = {"app": _state(0)}
+    Snapshot(durable, storage_options=opts).restore(dest)
+    assert dest["app"]["step"] == 9
+    assert np.array_equal(dest["app"]["w"], np.full(2048, 9.0, np.float32))
+    assert _counters("tier.peer_hits")[0] > peer_hits0
+    assert not os.path.exists(durable)  # cloud-free restore
+
+
+def test_replica_placement_writes_to_peers(tmp_path):
+    """finalize_take mirrors this rank's fast payloads (and the commit
+    write mirrors metadata) into the next replica_count peers' roots."""
+    from torchsnapshot_tpu.coordination import LocalCoordinator
+    from torchsnapshot_tpu.io_types import WriteIO
+    from torchsnapshot_tpu.storage import url_to_storage_plugin
+
+    f0, f1, f2 = (str(tmp_path / f"fast{i}") for i in range(3))
+    durable = str(tmp_path / "durable")
+    plugin = url_to_storage_plugin(
+        durable,
+        {
+            "tier": {
+                "fast_url": f0,
+                "policy": "write_through",
+                "replica_count": 1,
+                "peer_fast_urls": [f0, f1, f2],
+            }
+        },
+    )
+    plugin.sync_write(WriteIO(path="0/obj_a", buf=b"payload-a"))
+    plugin.sync_write(WriteIO(path="0/obj_b", buf=b"payload-b"))
+    plugin.finalize_take(LocalCoordinator(), "commit/0")
+    # replica_count=1 → exactly the next peer (f1) holds the copies
+    assert open(os.path.join(f1, "0", "obj_a"), "rb").read() == b"payload-a"
+    assert open(os.path.join(f1, "0", "obj_b"), "rb").read() == b"payload-b"
+    assert not os.path.exists(os.path.join(f2, "0"))
+    # the commit-point write is mirrored too
+    plugin.sync_write(
+        WriteIO(path=".snapshot_metadata", buf=b"{}", durable=True)
+    )
+    assert os.path.exists(os.path.join(f1, ".snapshot_metadata"))
+    plugin.sync_close()
+
+
+# -------------------------------------------------------------- cross-tier GC
+
+
+def test_cross_tier_gc_evicts_fast_independently(tmp_path):
+    dur, fast = str(tmp_path / "dur"), str(tmp_path / "fast")
+    tier = TierConfig(
+        fast_root=fast, policy="write_through", fast_keep_last_n=1
+    )
+    mgr = SnapshotManager(dur, keep_last_n=3, tier=tier)
+    for s in (1, 2, 3):
+        mgr.save({"app": _state(s)}, step=s)
+    # fast tier keeps only the newest step; durable keeps all three
+    assert mgr._scan_dir(fast) == [3]
+    assert sorted(
+        d for d in os.listdir(dur) if d.startswith("step_")
+    ) == [f"step_{s:010d}" for s in (1, 2, 3)]
+    assert mgr.steps() == [1, 2, 3]
+    # an evicted-fast step restores via the durable tier
+    dest = {"app": _state(0)}
+    mgr.snapshot(1).restore(dest)
+    assert dest["app"]["step"] == 1
+
+
+def test_fast_retention_never_evicts_unpromoted_step(tmp_path):
+    """A write-back step whose promotion hasn't landed holds the ONLY
+    copy — fast retention must keep it regardless of fast_keep_last_n."""
+    dur, fast = str(tmp_path / "dur"), str(tmp_path / "fast")
+    tier = TierConfig(
+        fast_root=fast, policy="write_back", fast_keep_last_n=1
+    )
+    mgr = SnapshotManager(dur, tier=tier)
+    get_promoter().pause()
+    for s in (1, 2, 3):
+        mgr.save({"app": _state(s)}, step=s)
+    # nothing promoted: every fast copy survives the keep-last-1 sweeps
+    assert mgr._scan_dir(fast) == [1, 2, 3]
+    get_promoter().resume()
+    drain_promotions()
+    mgr.gc()
+    assert mgr._scan_dir(fast, require_metadata=False) == [3]
+    assert mgr.durable_steps() == [1, 2, 3]
+    dest = {"app": _state(0)}
+    mgr.snapshot(1).restore(dest)  # durable fallback still fine
+    assert dest["app"]["step"] == 1
+
+
+def test_retention_gc_never_breaks_incremental_dedup_chain(tmp_path):
+    """Regression (GC × incremental dedup): evicting the BASE of a
+    newer incremental step must leave the newer step fully readable —
+    each snapshot owns its objects (hardlinks/server-side copies)."""
+    mgr = SnapshotManager(str(tmp_path), keep_last_n=1)
+    frozen = np.arange(4096, dtype=np.float64)
+    with knobs.override_disable_batching(True):
+        mgr.save({"app": StateDict(emb=frozen, step=1)}, step=1)
+        mgr.save(
+            {"app": StateDict(emb=frozen, step=2)}, step=2,
+            incremental=True,
+        )
+    # retention evicted the base
+    assert mgr.steps() == [2]
+    assert not os.path.exists(mgr.path_for_step(1))
+    dest = StateDict(emb=np.zeros_like(frozen), step=0)
+    assert mgr.restore_latest({"app": dest}) == 2
+    assert np.array_equal(dest["emb"], frozen)
+    assert mgr.snapshot(2).verify(deep=True).ok
+
+
+def test_delete_newer_incremental_step_keeps_base_readable(tmp_path):
+    """The other direction: deleting the NEWER step that dedup-linked
+    against the base must leave the base restorable."""
+    arr = np.arange(8192, dtype=np.float32)
+    with knobs.override_disable_batching(True):
+        Snapshot.take(str(tmp_path / "s1"), {"app": StateDict(w=arr)})
+        Snapshot.take(
+            str(tmp_path / "s2"), {"app": StateDict(w=arr)},
+            base=str(tmp_path / "s1"),
+        )
+    delete_snapshot(str(tmp_path / "s2"))
+    assert not os.path.exists(tmp_path / "s2")
+    dest = StateDict(w=np.zeros_like(arr))
+    s1 = Snapshot(str(tmp_path / "s1"))
+    s1.restore({"app": dest})
+    assert np.array_equal(dest["w"], arr)
+    assert s1.verify(deep=True).ok
+
+
+def test_delete_snapshot_reclaims_bytes_metric(tmp_path):
+    snap = Snapshot.take(
+        str(tmp_path / "s"), {"app": _state(1)}
+    )
+    payload = sum(
+        os.path.getsize(os.path.join(dp, f))
+        for dp, _, files in os.walk(tmp_path / "s")
+        for f in files
+        if f != ".snapshot_metadata"
+    )
+    before = obs.metrics_snapshot()["counters"].get(
+        "snapshot.gc.bytes_reclaimed", 0
+    )
+    delete_snapshot(str(tmp_path / "s"), manifest=snap.get_manifest())
+    after = obs.metrics_snapshot()["counters"]["snapshot.gc.bytes_reclaimed"]
+    # manifest extents bound the payload from below (slab padding/
+    # alignment may make files slightly larger than the recorded ranges)
+    assert 0 < after - before <= payload
+
+
+def test_repromote_recovers_orphaned_promotion(tmp_path, monkeypatch):
+    """A crash between fast-tier commit and durable commit orphans the
+    in-memory promotion queue; a fresh tiered manager must re-promote
+    the step (automatically, before its first save)."""
+    import torchsnapshot_tpu.tier.promoter as promoter_mod
+
+    dur, fast = str(tmp_path / "dur"), str(tmp_path / "fast")
+    tier = TierConfig(fast_root=fast, policy="write_back")
+    mgr = SnapshotManager(dur, tier=tier)
+    get_promoter().pause()
+    mgr.save({"app": _state(1)}, step=1)
+    assert mgr.durable_steps() == []
+    # simulate the crash: the paused promoter (with the queued jobs)
+    # dies with the process; a fresh one knows nothing
+    monkeypatch.setattr(promoter_mod, "_PROMOTER", promoter_mod.Promoter())
+    # fresh-process manager: explicit repromote path
+    mgr2 = SnapshotManager(dur, tier=tier)
+    assert mgr2.repromote() == [1]
+    drain_promotions()
+    assert mgr2.durable_steps() == [1]
+    assert SnapshotManager(dur).restore_latest({"app": _state(0)}) == 1
+    # idempotent: nothing left to recover
+    assert mgr2.repromote() == []
+
+
+def test_repromote_partial_recovery_withholds_commit(tmp_path, monkeypatch):
+    """Recovery promotion must NOT write the durable commit marker while
+    any manifest location is still missing from the durable tier (e.g.
+    another host's share of a multi-host snapshot)."""
+    import torchsnapshot_tpu.tier.promoter as promoter_mod
+
+    dur, fast = str(tmp_path / "dur"), str(tmp_path / "fast")
+    tier = TierConfig(fast_root=fast, policy="write_back")
+    mgr = SnapshotManager(dur, tier=tier)
+    get_promoter().pause()
+    mgr.save({"app": _state(1)}, step=1)
+    monkeypatch.setattr(promoter_mod, "_PROMOTER", promoter_mod.Promoter())
+    # delete one data object from the fast root (stands in for "another
+    # host's object that this host never had")
+    fast_step = mgr.fast_path_for_step(1)
+    victims = _payload_files(fast_step)
+    os.remove(victims[0])
+    mgr2 = SnapshotManager(dur, tier=tier)
+    assert mgr2.repromote() == [1]
+    with pytest.raises(RuntimeError, match="promotion"):
+        drain_promotions()
+    # commit marker withheld: never a committed-but-incomplete snapshot
+    assert not os.path.exists(os.path.join(dur, "step_0000000001",
+                                           ".snapshot_metadata"))
+    assert SnapshotManager(dur).restore_latest({"app": _state(0)}) is None
+
+
+def test_fast_read_io_error_falls_back(tmp_path, monkeypatch):
+    """A degraded fast tier raising raw OSError (EIO — not
+    FileNotFoundError, not a digest mismatch) must fall back to the
+    durable tier instead of aborting the restore."""
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+    fast, durable = str(tmp_path / "fast"), str(tmp_path / "durable")
+    opts = _tier_opts(fast, "write_through")
+    Snapshot.take(durable, {"app": _state(6)}, storage_options=opts)
+
+    orig_read = FSStoragePlugin.read
+
+    async def eio_on_fast(self, read_io):
+        if self.root.startswith(fast):
+            raise OSError(5, "Input/output error", read_io.path)
+        await orig_read(self, read_io)
+
+    monkeypatch.setattr(FSStoragePlugin, "read", eio_on_fast)
+    dest = {"app": _state(0)}
+    Snapshot(durable, storage_options=opts).restore(dest)
+    assert dest["app"]["step"] == 6
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def test_tiers_cli_reports_residency(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    dur, fast = str(tmp_path / "dur"), str(tmp_path / "fast")
+    tier = TierConfig(
+        fast_root=fast, policy="write_back", fast_keep_last_n=2
+    )
+    mgr = SnapshotManager(dur, tier=tier)
+    mgr.save({"app": _state(1)}, step=1)
+    drain_promotions()
+    get_promoter().pause()
+    mgr.save({"app": _state(2)}, step=2)
+    assert main(["tiers", dur, "--fast", fast]) == 0
+    out = capsys.readouterr().out
+    assert "durable+fast" in out and "promoting" in out
+    assert main(["tiers", dur, "--fast", fast, "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    by_step = {r["step"]: r for r in data["steps"]}
+    assert by_step[1]["durable_committed"] is True
+    assert by_step[2]["durable_committed"] is False
+    assert by_step[2]["fast_committed"] is True
+    assert by_step[2]["durable_objects"] < by_step[2]["objects"] or (
+        by_step[2]["objects"] == 0
+    )
+
+
+def test_tiered_read_object(tmp_path):
+    fast, durable = str(tmp_path / "fast"), str(tmp_path / "durable")
+    opts = _tier_opts(fast, "write_through")
+    Snapshot.take(durable, {"app": _state(4)}, storage_options=opts)
+    snap = Snapshot(durable, storage_options=opts)
+    w = snap.read_object("0/app/w")
+    assert np.array_equal(np.asarray(w), np.full(2048, 4.0, np.float32))

@@ -1,0 +1,91 @@
+"""Peer-replica placement across real multi-process ranks (slow tier).
+
+Two subprocess ranks coordinate through FileCoordinator; each has its
+own fast root.  With ``replica_count=1`` every rank's fast-tier
+payloads (and rank 0's commit marker) are mirrored into the next rank's
+fast root — so after (a) the durable tier is destroyed and (b) one
+host's fast tier is wiped (a "lost host"), a full 2-rank restore still
+succeeds entirely from fast tiers + peer replicas, cloud-free.
+
+Acceptance path (c) of the tier subsystem; the single-process shape is
+covered in tests/test_tier.py (tier-1).
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from test_distributed import run_workers
+from torchsnapshot_tpu import Snapshot, StateDict
+
+pytestmark = pytest.mark.slow
+
+
+_TAKE_BODY = """
+import os
+fast_roots = [snap_dir + f"_fast{r}" for r in range(world)]
+opts = {"tier": {"fast_url": fast_roots[rank], "policy": "write_back",
+                 "replica_count": 1, "peer_fast_urls": fast_roots}}
+state = StateDict(
+    mine=np.full(1024, float(rank)),
+    shared=np.arange(64, dtype=np.float64),
+)
+Snapshot.take(snap_dir, {"app": state}, replicated=["app/shared"],
+              coordinator=coord, storage_options=opts)
+# block until this process's write-back promotions settled, so worker
+# exit can't race the background promoter mid-copy
+from torchsnapshot_tpu import drain_promotions
+drain_promotions(raise_on_error=False)
+"""
+
+_RESTORE_BODY = """
+import os
+coord = FileCoordinator({kv2!r}, rank, world)
+fast_roots = [snap_dir + f"_fast{{r}}" for r in range(world)]
+opts = {{"tier": {{"fast_url": fast_roots[rank], "policy": "write_back",
+                  "replica_count": 1, "peer_fast_urls": fast_roots}}}}
+dest = StateDict(mine=np.zeros(1024), shared=np.zeros(64))
+snap = Snapshot(snap_dir, coordinator=coord, storage_options=opts)
+snap.restore({{"app": dest}})
+assert np.array_equal(dest["mine"], np.full(1024, float(rank))), rank
+assert np.array_equal(dest["shared"], np.arange(64, dtype=np.float64))
+# the durable tier was destroyed before this restore and must never be
+# re-created by it: peers + fast tiers carried everything
+assert not os.path.exists(snap_dir), "restore touched the durable tier"
+"""
+
+
+def test_lost_host_restores_from_peer_replica(tmp_path):
+    run_workers(tmp_path, 2, _TAKE_BODY)
+    snap_dir = str(tmp_path / "snap")
+    # replica placement landed: rank 1's fast root carries rank 0's
+    # objects (and vice versa) plus the mirrored commit marker
+    for r, peer in ((0, 1), (1, 0)):
+        peer_root = f"{snap_dir}_fast{peer}"
+        own = set()
+        for dirpath, _dirs, files in os.walk(f"{snap_dir}_fast{r}"):
+            own |= {
+                os.path.relpath(os.path.join(dirpath, f),
+                                f"{snap_dir}_fast{r}")
+                for f in files
+            }
+        assert own, f"rank {r} wrote nothing to its fast root"
+        for rel in own:
+            assert os.path.exists(os.path.join(peer_root, rel)), (
+                f"rank {r}'s {rel} not replicated to rank {peer}"
+            )
+    # simulated disaster: the cloud tier is gone AND host 0 lost its SSD
+    shutil.rmtree(snap_dir, ignore_errors=True)
+    shutil.rmtree(f"{snap_dir}_fast0")
+    run_workers(
+        tmp_path, 2, _RESTORE_BODY.format(kv2=str(tmp_path / "kv2"))
+    )
+
+
+def test_single_process_tier_sanity():
+    """Keep at least one (fast) assertion in this module importable
+    without subprocesses, so a slow-marker misconfiguration is caught by
+    collection rather than silence."""
+    assert Snapshot is not None and StateDict is not None

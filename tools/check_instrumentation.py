@@ -37,8 +37,20 @@ TARGETS: Dict[str, Dict[str, Set[str]]] = {
     os.path.join("torchsnapshot_tpu", "manager.py"): {
         # path arithmetic and delegating one-liners (steps() — which
         # does the real discovery I/O — is bracketed and checked)
-        "SnapshotManager": {"path_for_step", "latest_step", "snapshot"},
+        "SnapshotManager": {
+            "path_for_step", "fast_path_for_step", "latest_step",
+            "snapshot",
+        },
     },
+}
+
+# file (repo-relative) -> module-level functions that MUST be bracketed
+# (the inverse discipline of TARGETS: module functions are mostly
+# helpers, so coverage is opt-in per reviewed hot-path function).  The
+# GC path is here: deletions are exactly the operations an incident
+# review needs to reconstruct.
+MODULE_FUNCTIONS: Dict[str, Set[str]] = {
+    os.path.join("torchsnapshot_tpu", "manager.py"): {"delete_snapshot"},
 }
 
 _BRACKET_NAMES = {"log_event", "span"}
@@ -65,11 +77,27 @@ def _method_is_bracketed(fn: ast.AST) -> bool:
 
 
 def check_source(
-    src: str, classes: Dict[str, Set[str]], filename: str = "<source>"
+    src: str,
+    classes: Dict[str, Set[str]],
+    filename: str = "<source>",
+    module_functions: Set[str] | None = None,
 ) -> List[str]:
-    """Violation strings for ``src`` (empty list == clean)."""
+    """Violation strings for ``src`` (empty list == clean).
+
+    ``module_functions``: module-level function names that must carry a
+    bracket (MODULE_FUNCTIONS coverage — e.g. the GC path)."""
     tree = ast.parse(src, filename)
     violations: List[str] = []
+    for item in tree.body:
+        if (
+            isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and item.name in (module_functions or ())
+            and not _method_is_bracketed(item)
+        ):
+            violations.append(
+                f"{filename}:{item.lineno}: {item.name} is a covered "
+                f"module-level function without a log_event/span bracket"
+            )
     for node in ast.walk(tree):
         if not isinstance(node, ast.ClassDef) or node.name not in classes:
             continue
@@ -93,11 +121,18 @@ def check_source(
 
 def check_repo(root: str) -> List[str]:
     violations: List[str] = []
-    for rel, classes in TARGETS.items():
+    for rel in sorted(set(TARGETS) | set(MODULE_FUNCTIONS)):
         path = os.path.join(root, rel)
         with open(path) as f:
             src = f.read()
-        violations.extend(check_source(src, classes, rel))
+        violations.extend(
+            check_source(
+                src,
+                TARGETS.get(rel, {}),
+                rel,
+                MODULE_FUNCTIONS.get(rel),
+            )
+        )
     return violations
 
 

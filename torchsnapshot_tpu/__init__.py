@@ -26,6 +26,11 @@ from .coordination import (  # noqa: F401
 from .event import Event  # noqa: F401
 from .event_handlers import register_event_handler, unregister_event_handler  # noqa: F401
 from .manager import SnapshotManager, delete_snapshot  # noqa: F401
+from .tier import (  # noqa: F401
+    TierConfig,
+    TieredStoragePlugin,
+    drain_promotions,
+)
 from .verify import VerifyResult, verify_snapshot  # noqa: F401
 from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
 from .stateful import (  # noqa: F401
@@ -43,6 +48,9 @@ __all__ = [
     "PendingSnapshot",
     "SnapshotManager",
     "delete_snapshot",
+    "TierConfig",
+    "TieredStoragePlugin",
+    "drain_promotions",
     "VerifyResult",
     "verify_snapshot",
     "Stateful",

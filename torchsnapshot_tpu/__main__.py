@@ -5,6 +5,7 @@
     python -m torchsnapshot_tpu manifest  <snapshot-path>
     python -m torchsnapshot_tpu verify    <snapshot-path> [--deep] [--rank N]
     python -m torchsnapshot_tpu steps     <manager-root>
+    python -m torchsnapshot_tpu tiers     <durable-root> --fast <fast-root> [--json]
     python -m torchsnapshot_tpu delete    <snapshot-path> --yes
     python -m torchsnapshot_tpu trace     <snapshot-path> [--out FILE]
 
@@ -221,6 +222,100 @@ def _cmd_steps(args) -> int:
     return 0
 
 
+def _cmd_tiers(args) -> int:
+    """Per-step tier residency + durability for a tiered manager root:
+    which steps are fast-resident, which are durably committed, and how
+    many of each step's data objects each tier actually holds (a
+    write-back step mid-promotion shows partial durable residency)."""
+    from .manager import SnapshotManager, entry_locations
+    from .snapshot import Snapshot
+    from .storage import url_to_storage_plugin
+
+    mgr = SnapshotManager(args.root, tier={"fast_root": args.fast})
+
+    def _residency(storage_root, locations):
+        """(present, bytes) across ``locations`` under ``storage_root``."""
+        storage = url_to_storage_plugin(storage_root)
+        present = 0
+        nbytes = 0
+        try:
+            for loc in locations:
+                try:
+                    nbytes += storage.sync_stat(loc)
+                    present += 1
+                except Exception:  # noqa: BLE001 — absent either way
+                    continue
+        finally:
+            storage.sync_close()
+        return present, nbytes
+
+    rows = []
+    candidates = sorted(
+        set(mgr._read_index()) | set(mgr._scan_fs())
+    )
+    for step in candidates:
+        durable_path = mgr.path_for_step(step)
+        fast_path = mgr.fast_path_for_step(step)
+        manifest = None
+        durable_committed = False
+        fast_committed = False
+        try:
+            manifest = Snapshot(durable_path).get_manifest()
+            durable_committed = True
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            fast_manifest = Snapshot(fast_path).get_manifest()
+            fast_committed = True
+            manifest = manifest or fast_manifest
+        except Exception:  # noqa: BLE001
+            pass
+        locations = entry_locations(manifest) if manifest else []
+        fast_n, fast_b = _residency(fast_path, locations)
+        dur_n, dur_b = _residency(durable_path, locations)
+        status = (
+            "durable+fast" if durable_committed and fast_n
+            else "durable" if durable_committed
+            else "promoting" if fast_committed
+            else "aborted"
+        )
+        rows.append(
+            {
+                "step": step,
+                "status": status,
+                "durable_committed": durable_committed,
+                "fast_committed": fast_committed,
+                "objects": len(locations),
+                "fast_objects": fast_n,
+                "fast_bytes": fast_b,
+                "durable_objects": dur_n,
+                "durable_bytes": dur_b,
+            }
+        )
+    if args.json:
+        print(
+            json.dumps(
+                {"root": args.root, "fast_root": args.fast, "steps": rows},
+                indent=2,
+            )
+        )
+        return 0
+    if not rows:
+        print("(no snapshots found)", file=sys.stderr)
+        return 0
+    print(f"{'step':>10}  {'status':<13} {'fast':>14}  {'durable':>14}")
+    for r in rows:
+        fast_s = f"{r['fast_objects']}/{r['objects']} {_human(r['fast_bytes'])}"
+        dur_s = (
+            f"{r['durable_objects']}/{r['objects']} "
+            f"{_human(r['durable_bytes'])}"
+        )
+        print(
+            f"{r['step']:>10}  {r['status']:<13} {fast_s:>14}  {dur_s:>14}"
+        )
+    return 0
+
+
 def _cmd_convert(args) -> int:
     """Re-encode a reference-format snapshot as a native one (or the
     reverse with --to-reference): one command migrates a whole
@@ -328,6 +423,17 @@ def main(argv=None) -> int:
     p = sub.add_parser("steps", help="list a manager root's committed steps")
     p.add_argument("root")
     p.set_defaults(fn=_cmd_steps)
+
+    p = sub.add_parser(
+        "tiers",
+        help="per-step tier residency + durability for a tiered manager "
+        "root (fast copies, promotion progress)",
+    )
+    p.add_argument("root", help="durable-tier manager root")
+    p.add_argument("--fast", required=True, help="fast-tier root")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.set_defaults(fn=_cmd_tiers)
 
     p = sub.add_parser("delete", help="delete one snapshot (metadata-first)")
     p.add_argument("path")

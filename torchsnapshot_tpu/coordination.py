@@ -85,6 +85,28 @@ class Coordinator(abc.ABC):
         self._op_counter = n + 1
         return f"{op}/{n}"
 
+    def kv_exchange(
+        self,
+        prefix: str,
+        value: str,
+        timeout_s: float = _DEFAULT_TIMEOUT_S,
+    ) -> List[str]:
+        """KV-only allgather of one small STRING per rank under EXPLICIT
+        keys (``{prefix}/{rank}``) — no barrier, no uid counters, no
+        collectives, so it is safe from background threads (async-commit
+        and tier-promotion threads, where ``all_gather_object`` is
+        forbidden: its per-instance uid counter belongs to the foreground
+        program order).  ``prefix`` must be unique per use across the job
+        (callers derive it from a commit uid); keys are idempotent —
+        re-setting the same value is harmless."""
+        if self.world_size == 1:
+            return [value]
+        self.kv_set(f"{prefix}/{self.rank}", value)
+        return [
+            self.kv_get(f"{prefix}/{r}", timeout_s)
+            for r in range(self.world_size)
+        ]
+
     def all_gather_object(self, obj: Any) -> List[Any]:
         """Gather an object from every rank (reference
         pg_wrapper.py all_gather_object)."""

@@ -38,6 +38,9 @@ _VERIFY_ON_RESTORE = "VERIFY_ON_RESTORE"
 _DEVICE_UNPACK = "DEVICE_UNPACK"
 _RESTORE_DONATE = "RESTORE_DONATE"
 _TRACE = "TRACE"
+_TIER_POLICY = "TIER_POLICY"
+_TIER_FAST_KEEP_LAST_N = "TIER_FAST_KEEP_LAST_N"
+_TIER_VERIFY_FAST_READS = "TIER_VERIFY_FAST_READS"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -147,6 +150,23 @@ _DEFAULTS = {
     # obs.refresh_enabled() after mutating it); gate runtime decisions
     # on obs.tracing_enabled(), which reports what is actually recorded.
     _TRACE: 0,
+    # Default policy for tiered storage (tier/) when the tier options
+    # don't name one: "write_back" acks a take when the FAST tier
+    # commits and promotes to the durable tier in the background (the
+    # durable commit point — .snapshot_metadata — lands only after every
+    # data object promoted); "write_through" commits both tiers
+    # synchronously.
+    _TIER_POLICY: "write_back",
+    # How many committed steps keep a fast-tier copy under a tiered
+    # SnapshotManager; older steps' fast copies are evicted (durable
+    # copies follow keep_last_n independently).  A fast copy is never
+    # evicted before its step is durably committed.
+    _TIER_FAST_KEEP_LAST_N: 2,
+    # Verify each fast-tier object against its manifest-recorded digest
+    # on first read (one extra local read per object when the first read
+    # is ranged); a mismatch silently falls back to a peer/durable copy
+    # and repairs the fast one.  Needs WRITE_CHECKSUMS at take time.
+    _TIER_VERIFY_FAST_READS: 1,
 }
 
 _OVERRIDES: dict = {}
@@ -320,6 +340,24 @@ def is_trace_enabled() -> bool:
     return bool(_get_int(_TRACE))
 
 
+def get_tier_policy() -> str:
+    v = str(_get_raw(_TIER_POLICY)).lower()
+    if v not in ("write_back", "write_through"):
+        raise ValueError(
+            f"TORCHSNAPSHOT_TPU_TIER_POLICY must be write_back|"
+            f"write_through, got {v!r}"
+        )
+    return v
+
+
+def get_tier_fast_keep_last_n() -> int:
+    return max(1, _get_int(_TIER_FAST_KEEP_LAST_N))
+
+
+def tier_verify_fast_reads() -> bool:
+    return bool(_get_int(_TIER_VERIFY_FAST_READS))
+
+
 def restore_donation() -> str:
     """One of "on" | "off" | "auto" (see _RESTORE_DONATE above).
 
@@ -451,6 +489,18 @@ def override_replication_verify(value: str):
 
 def override_restore_donate(value):
     return _override(_RESTORE_DONATE, value)
+
+
+def override_tier_policy(value: str):
+    return _override(_TIER_POLICY, value)
+
+
+def override_tier_fast_keep_last_n(value: int):
+    return _override(_TIER_FAST_KEEP_LAST_N, value)
+
+
+def override_tier_verify_fast_reads(value: bool):
+    return _override(_TIER_VERIFY_FAST_READS, int(value))
 
 
 @contextlib.contextmanager

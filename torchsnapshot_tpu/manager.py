@@ -34,6 +34,7 @@ import logging
 import weakref
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+from . import knobs, obs
 from .coordination import Coordinator, get_default_coordinator
 from .event import Event
 from .event_handlers import log_event
@@ -45,6 +46,7 @@ from .snapshot import (
     Snapshot,
 )
 from .storage import url_to_storage_plugin
+from .tier import TierConfig
 
 logger = logging.getLogger(__name__)
 
@@ -80,17 +82,24 @@ def delete_snapshot(
 
     ``manifest``, when the caller already verified/parsed it, skips the
     metadata re-read (one fewer cloud round-trip per eviction)."""
+    with log_event(Event("delete_snapshot", {"path": path})), obs.span(
+        "manager/delete_snapshot", path=path
+    ):
+        _delete_snapshot_impl(path, manifest)
+
+
+def _delete_snapshot_impl(
+    path: str, manifest: Optional[Dict[str, Entry]] = None
+) -> None:
     storage = url_to_storage_plugin(path)
     try:
         locations: List[str] = []
-        if manifest is not None:
-            locations = entry_locations(manifest)
-        else:
+        if manifest is None:
             try:
                 read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
                 storage.sync_read(read_io)
                 md = SnapshotMetadata.from_yaml(bytes(read_io.buf).decode())
-                locations = entry_locations(md.manifest)
+                manifest = md.manifest
             except FileNotFoundError:
                 pass  # aborted snapshot: no manifest to enumerate
             except Exception as e:  # noqa: BLE001 — corrupt metadata
@@ -102,15 +111,24 @@ def delete_snapshot(
                     "data objects may be left behind",
                     SNAPSHOT_METADATA_FNAME, path, e,
                 )
+        if manifest is not None:
+            locations = entry_locations(manifest)
         try:
             storage.sync_delete(SNAPSHOT_METADATA_FNAME)
         except FileNotFoundError:
             pass
+        reclaimed = 0
+        extents = (
+            _expected_extents_safe(manifest) if manifest is not None else {}
+        )
         for loc in locations:
             try:
                 storage.sync_delete(loc)
+                reclaimed += extents.get(loc, 0)
             except FileNotFoundError:
                 pass  # idempotent: partial previous GC
+        if reclaimed:
+            obs.counter(obs.GC_BYTES_RECLAIMED).inc(reclaimed)
     finally:
         storage.sync_close()
     # local fs roots: clear leftover (now-empty) directory skeleton
@@ -118,6 +136,15 @@ def delete_snapshot(
         import shutil
 
         shutil.rmtree(path.split("://", 1)[-1], ignore_errors=True)
+
+
+def _expected_extents_safe(manifest: Dict[str, Entry]) -> Dict[str, int]:
+    from .verify import _expected_extents
+
+    try:
+        return _expected_extents(manifest)
+    except Exception:  # noqa: BLE001 — metric only, never fail a delete
+        return {}
 
 
 class SnapshotManager:
@@ -141,12 +168,19 @@ class SnapshotManager:
         keep_last_n: Optional[int] = None,
         prefix: str = "step_",
         coordinator: Optional[Coordinator] = None,
+        tier: Optional[Union[TierConfig, Dict[str, Any]]] = None,
     ) -> None:
         if keep_last_n is not None and keep_last_n < 1:
             raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
         self.root = root.rstrip("/")
         self.keep_last_n = keep_last_n
         self.prefix = prefix
+        # tiered storage (tier/): ``root`` names the DURABLE tier; per-
+        # step snapshots also land under ``tier.fast_root`` and reads go
+        # fast-first.  Fast-tier retention (fast_keep_last_n) runs on
+        # EVERY rank against its own local fast root; durable retention
+        # stays rank-0-only like the index.
+        self.tier = TierConfig(**tier) if isinstance(tier, dict) else tier
         self._coordinator = coordinator
         # rank 0 only: async saves not yet recorded in the index,
         # step -> weakref to its PendingSnapshot.  done() distinguishes
@@ -161,12 +195,50 @@ class SnapshotManager:
         # steps the last _verify call could not read metadata for
         # (possible transient outage — kept in the index, not committed)
         self._last_unverifiable: set = set()
+        # tiered: steps whose DURABLE commit marker has been observed
+        # (durability is monotonic, so each costs at most one cloud
+        # metadata read per manager lifetime — fast-retention sweeps
+        # would otherwise re-fetch for every old fast step every save)
+        self._durable_confirmed: set = set()
+        # tiered: the crash-recovery re-promotion sweep runs once, at
+        # the first post-commit hook (see repromote)
+        self._repromoted = False
 
     # ------------------------------------------------------------ paths
 
     def path_for_step(self, step: int) -> str:
         # fixed-width so lexicographic listing == numeric ordering
         return f"{self.root}/{self.prefix}{step:010d}"
+
+    def fast_path_for_step(self, step: int) -> str:
+        assert self.tier is not None
+        return (
+            f"{self.tier.fast_root.rstrip('/')}/{self.prefix}{step:010d}"
+        )
+
+    def _tier_storage_options(
+        self, step: int
+    ) -> Optional[Dict[str, Any]]:
+        """The ``storage_options`` that make this step's Snapshot
+        tiered; None for untiered managers."""
+        if self.tier is None:
+            return None
+        t = self.tier
+        peer_urls = None
+        if t.peer_fast_roots:
+            peer_urls = [
+                f"{r.rstrip('/')}/{self.prefix}{step:010d}"
+                for r in t.peer_fast_roots
+            ]
+        return {
+            "tier": {
+                "fast_url": self.fast_path_for_step(step),
+                "policy": t.policy,
+                "replica_count": t.replica_count,
+                "peer_fast_urls": peer_urls,
+                "verify_fast_reads": t.verify_fast_reads,
+            }
+        }
 
     @property
     def _coord(self) -> Coordinator:
@@ -200,13 +272,27 @@ class SnapshotManager:
 
     def _scan_fs(self) -> List[int]:
         """Local-fs fallback: find committed snapshots by directory scan
-        (also catches snapshots taken without the manager)."""
+        (also catches snapshots taken without the manager).  Tiered
+        managers additionally scan the fast root — a write-back step
+        whose promotion hasn't landed is only discoverable there."""
+        steps = set(self._scan_dir(self.root))
+        if self.tier is not None:
+            steps |= set(self._scan_dir(self.tier.fast_root))
+        return sorted(steps)
+
+    def _scan_dir(
+        self, root: str, require_metadata: bool = True
+    ) -> List[int]:
+        """``require_metadata=False`` (fast-tier retention only): count a
+        step dir as resident even without its commit marker — a durable
+        fallback repairs data objects but deliberately not metadata, and
+        those part-repaired dirs must stay evictable."""
         import os
         import re
 
-        if "://" in self.root and not self.root.startswith("file://"):
+        if "://" in root and not root.startswith("file://"):
             return []
-        base = self.root.split("://", 1)[-1]
+        base = root.split("://", 1)[-1]
         pat = re.compile(re.escape(self.prefix) + r"(\d+)$")
         steps = []
         try:
@@ -215,8 +301,11 @@ class SnapshotManager:
             return []
         for name in names:
             m = pat.fullmatch(name)
-            if m and os.path.exists(
-                os.path.join(base, name, SNAPSHOT_METADATA_FNAME)
+            if m and (
+                not require_metadata
+                or os.path.exists(
+                    os.path.join(base, name, SNAPSHOT_METADATA_FNAME)
+                )
             ):
                 steps.append(int(m.group(1)))
         return sorted(steps)
@@ -241,7 +330,10 @@ class SnapshotManager:
             if use_cache and step in self._verified:
                 committed[step] = self._verified[step]
                 continue
-            snap = Snapshot(self.path_for_step(step))
+            snap = Snapshot(
+                self.path_for_step(step),
+                storage_options=self._tier_storage_options(step),
+            )
             try:
                 snap.metadata
             except FileNotFoundError:
@@ -281,8 +373,27 @@ class SnapshotManager:
 
     def snapshot(self, step: int) -> Snapshot:
         return Snapshot(
-            self.path_for_step(step), coordinator=self._coordinator
+            self.path_for_step(step),
+            coordinator=self._coordinator,
+            storage_options=self._tier_storage_options(step),
         )
+
+    def durable_steps(self) -> List[int]:
+        """Steps whose DURABLE-tier commit marker is readable — the
+        steps that would survive losing every fast tier.  A write-back
+        step appears in ``steps()`` (restorable from its fast tier) as
+        soon as its fast commit lands, but only joins this list once the
+        background promoter finished.  Untiered managers: == steps()."""
+        with obs.span("manager/durable_steps", root=self.root):
+            if self.tier is None:
+                return self.steps()
+            return [
+                step
+                for step in sorted(
+                    set(self._read_index()) | set(self._scan_fs())
+                )
+                if self._durable_ok(step)
+            ]
 
     # ------------------------------------------------------- save/load
 
@@ -322,7 +433,18 @@ class SnapshotManager:
         incremental: bool = False,
         **take_kwargs: Any,
     ) -> Union[Snapshot, "_ManagedPendingSnapshot"]:
+        # crash-recovery sweep BEFORE the first take of this process:
+        # at that point nothing from this process is in the promotion
+        # queue, so only steps orphaned by a previous crash re-enqueue
+        if self.tier is not None and not self._repromoted:
+            self.repromote()
         path = self.path_for_step(step)
+        tier_opts = self._tier_storage_options(step)
+        if tier_opts is not None:
+            take_kwargs["storage_options"] = {
+                **(take_kwargs.get("storage_options") or {}),
+                **tier_opts,
+            }
         base: Optional[str] = None
         if incremental:
             prev = self._coord.broadcast_object(
@@ -374,7 +496,77 @@ class SnapshotManager:
 
     # ------------------------------------------------------- retention
 
+    def repromote(self) -> List[int]:
+        """Crash recovery for write-back tiers: re-enqueue promotion for
+        every fast-committed step whose durable commit marker is missing
+        (the promotion queue is in-memory, so a crash between fast-tier
+        commit and durable commit would otherwise leave acked steps
+        non-durable forever).  Rank-local — each host contributes the
+        objects its own fast root holds; the durable marker is written
+        only once every manifest location is durable-resident
+        (PromotionGroup.recovery), so partial multi-host recovery can
+        never fabricate a committed-but-incomplete durable snapshot.
+        Runs automatically once per manager at the first post-commit
+        sweep; returns the steps enqueued."""
+        with obs.span("manager/repromote", root=self.root):
+            self._repromoted = True
+            if self.tier is None:
+                return []
+            from .tier.promoter import PromotionGroup, get_promoter
+
+            enqueued = []
+            idx = set(self._read_index())
+            for step in self._scan_dir(self.tier.fast_root):
+                if self._durable_ok(step):
+                    continue
+                # same guard as _apply_fast_retention: a step the index
+                # no longer lists (with a newer indexed step present)
+                # was durably EVICTED by retention — its fast leftovers
+                # are garbage, and re-promoting would resurrect a
+                # deleted snapshot into the durable tier
+                if idx and step not in idx and step < max(idx):
+                    continue
+                try:
+                    manifest = Snapshot(
+                        self.fast_path_for_step(step)
+                    ).get_manifest()
+                except Exception:  # noqa: BLE001 — not fast-committed
+                    continue
+                group = PromotionGroup(
+                    self.fast_path_for_step(step),
+                    self.path_for_step(step),
+                )
+                group.paths = set(entry_locations(manifest))
+                group.recovery = True
+                promoter = get_promoter()
+                promoter.enqueue_data(group)
+                promoter.enqueue_commit(group)
+                logger.warning(
+                    "re-promoting step %d: fast-committed but no durable "
+                    "commit marker (promotion interrupted by a previous "
+                    "crash?)", step,
+                )
+                enqueued.append(step)
+            return enqueued
+
+    def _durable_ok(self, step: int) -> bool:
+        """Durable commit marker readable? Cached positively (durability
+        is monotonic)."""
+        if self.tier is None:
+            return True
+        if step in self._durable_confirmed:
+            return True
+        try:
+            Snapshot(self.path_for_step(step)).metadata  # noqa: B018
+        except Exception:  # noqa: BLE001 — absent or unreachable
+            return False
+        self._durable_confirmed.add(step)
+        return True
+
     def _after_commit(self, step: Optional[int]) -> None:
+        # fast-tier retention is rank-LOCAL (each host owns its fast
+        # root), so it runs before the rank-0 gate below
+        self._apply_fast_retention()
         if self._coord.rank != 0:
             return
         # sweep async saves whose commit has landed by now (index-first
@@ -413,10 +605,13 @@ class SnapshotManager:
 
     def gc(self) -> None:
         """Apply retention: delete all but the newest ``keep_last_n``
-        committed snapshots.  Rank-0 only; safe to call any time."""
-        if self._coord.rank != 0 or self.keep_last_n is None:
-            return
+        committed snapshots (rank 0), and — tiered — all but the newest
+        ``fast_keep_last_n`` fast-tier copies (every rank, own fast root
+        only).  Safe to call any time."""
         with log_event(Event("manager_gc", {"root": self.root})):
+            self._apply_fast_retention()
+            if self._coord.rank != 0 or self.keep_last_n is None:
+                return
             self._apply_retention(self._committed())
 
     def _apply_retention(self, committed: Dict[int, Snapshot]) -> None:
@@ -426,11 +621,27 @@ class SnapshotManager:
         for step in evict:
             logger.info("retention: deleting snapshot step %d", step)
             # reuse the just-verified manifest: no metadata re-read
+            manifest = committed[step].get_manifest()
             delete_snapshot(
-                self.path_for_step(step),
-                manifest=committed[step].get_manifest(),
+                self.path_for_step(step), manifest=manifest
             )
+            if self.tier is not None:
+                # the evicted step's fast copy goes with it (this rank's
+                # fast root; peers evict theirs in their own
+                # _apply_fast_retention sweeps).  A degraded fast disk
+                # must not fail a save whose checkpoint already
+                # committed — the leftover is retried by later sweeps.
+                try:
+                    delete_snapshot(
+                        self.fast_path_for_step(step), manifest=manifest
+                    )
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "fast-tier delete of evicted step %d failed "
+                        "(%r); leftover will be retried", step, e,
+                    )
             self._verified.pop(step, None)
+            self._durable_confirmed.discard(step)
         if evict:
             # keep transiently-unverifiable steps in the index here too
             # (same invariant as _after_commit's union-preserving write)
@@ -440,6 +651,70 @@ class SnapshotManager:
                     | self._last_unverifiable
                 )
             )
+
+    def _apply_fast_retention(self) -> None:
+        """Evict old fast-tier copies INDEPENDENTLY of durable
+        retention: the newest ``fast_keep_last_n`` fast-resident steps
+        keep their local copies; older ones are deleted from this
+        rank's fast root only — IF the step is safe to lose locally
+        (its durable commit marker is readable, or the index shows it
+        was evicted entirely).  A write-back step whose promotion
+        hasn't landed holds the only copy and is never evicted."""
+        if self.tier is None:
+            return
+        keep = (
+            self.tier.fast_keep_last_n
+            if self.tier.fast_keep_last_n is not None
+            else knobs.get_tier_fast_keep_last_n()
+        )
+        fast_steps = self._scan_dir(
+            self.tier.fast_root, require_metadata=False
+        )
+        for step in fast_steps[:-keep] if keep else fast_steps:
+            manifest = None
+            # _durable_ok caches positives, so a step stuck unpromoted
+            # (cloud outage) costs ONE metadata probe per sweep and a
+            # confirmed-durable step costs none
+            durable_ok = self._durable_ok(step)
+            if durable_ok:
+                try:
+                    manifest = Snapshot(
+                        self.path_for_step(step)
+                    ).get_manifest()
+                except Exception:  # noqa: BLE001 — fall through below
+                    pass
+            if not durable_ok:
+                # durable-evicted steps (no longer in the index, and a
+                # newer indexed step exists) lost their durable copy on
+                # purpose — their fast leftovers are garbage, not the
+                # last line of defense
+                idx = set(self._read_index())
+                if not (idx and step not in idx and step < max(idx)):
+                    logger.info(
+                        "fast-tier retention: keeping step %d — not "
+                        "durably committed yet", step,
+                    )
+                    continue
+                try:
+                    manifest = Snapshot(
+                        self.fast_path_for_step(step)
+                    ).get_manifest()
+                except Exception:  # noqa: BLE001
+                    manifest = None
+            logger.info(
+                "fast-tier retention: evicting local copy of step %d",
+                step,
+            )
+            try:
+                delete_snapshot(
+                    self.fast_path_for_step(step), manifest=manifest
+                )
+            except Exception as e:  # noqa: BLE001 — degraded fast disk
+                # must not abort an already-committed save
+                logger.warning(
+                    "fast-tier eviction of step %d failed (%r); "
+                    "leftover will be retried next sweep", step, e,
+                )
 
 
 class _ManagedPendingSnapshot:

@@ -26,15 +26,24 @@ from .metrics import (  # noqa: F401
     BUDGET_BYTES_IN_USE,
     BYTES_DEDUPED,
     BYTES_OFFLOADED,
+    BYTES_PROMOTED,
     BYTES_READ,
+    BYTES_REPLICATED,
     BYTES_STAGED,
     BYTES_WRITTEN,
     BYTES_BUCKETS,
+    GC_BYTES_RECLAIMED,
     IO_QUEUE_DEPTH,
     LATENCY_BUCKETS_S,
+    PROMOTION_LAG_S,
     REGISTRY,
     RSS_PEAK_DELTA_BYTES,
     SLABS_PACKED,
+    TIER_FAST_CORRUPT,
+    TIER_FAST_HITS,
+    TIER_FAST_MISSES,
+    TIER_FAST_REPAIRS,
+    TIER_PEER_HITS,
     MetricsRegistry,
     counter,
     gauge,

@@ -52,6 +52,22 @@ BUDGET_BYTES_IN_USE_READ = "budget_bytes_in_use_read"
 IO_QUEUE_DEPTH_READ = "io_queue_depth_read"
 RSS_PEAK_DELTA_BYTES = "rss_peak_delta_bytes"
 SLABS_PACKED = "slabs_packed"
+# tiered storage (tier/): read-path residency + write-back promotion.
+# hits/misses count tier-plugin reads served by the fast tier vs fallen
+# back (peer or durable); repairs count fast-tier copies rewritten from
+# a fallback source; corrupt counts fast copies that failed their
+# digest/parse check.  bytes_promoted/promotion_lag_s describe the
+# write-back promoter (fast-commit → durable-commit).
+TIER_FAST_HITS = "tier.fast_hits"
+TIER_FAST_MISSES = "tier.fast_misses"
+TIER_FAST_REPAIRS = "tier.fast_repairs"
+TIER_FAST_CORRUPT = "tier.fast_corrupt"
+TIER_PEER_HITS = "tier.peer_hits"
+BYTES_PROMOTED = "tier.bytes_promoted"
+BYTES_REPLICATED = "tier.bytes_replicated"
+PROMOTION_LAG_S = "tier.promotion_lag_s"
+# GC/retention: bytes of storage objects reclaimed by delete_snapshot
+GC_BYTES_RECLAIMED = "snapshot.gc.bytes_reclaimed"
 
 
 class Counter:

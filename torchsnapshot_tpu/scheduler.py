@@ -585,6 +585,85 @@ def sync_execute_write_reqs(
     return pending
 
 
+async def _execute_copy_pipelines(
+    paths: List[str],
+    src_storage: StoragePlugin,
+    dst_storage: StoragePlugin,
+    budget: _Budget,
+    io_concurrency: int,
+    counter_name: str,
+) -> int:
+    """Copy whole objects src→dst, admitted under the host-memory budget
+    (each in-flight copy buffers its full payload; an oversized object is
+    admitted alone — the same progress rule as the write pipeline)."""
+    m_promoted = obs_metrics.counter(counter_name)
+    sem = asyncio.Semaphore(io_concurrency)
+    cond = asyncio.Condition()
+    in_use = 0
+
+    async def one(path: str) -> int:
+        nonlocal in_use
+        nbytes = await src_storage.stat(path)
+        async with cond:
+            await cond.wait_for(
+                lambda: in_use == 0 or in_use + nbytes <= budget.total
+            )
+            in_use += nbytes
+        try:
+            async with sem:
+                with obs_tracer.span(
+                    "tier/promote_object", path=path, bytes=nbytes
+                ):
+                    read_io = ReadIO(path=path)
+                    await src_storage.read(read_io)
+                    await dst_storage.write(
+                        WriteIO(path=path, buf=read_io.buf)
+                    )
+            m_promoted.inc(nbytes)
+            return nbytes
+        finally:
+            async with cond:
+                in_use -= nbytes
+                cond.notify_all()
+
+    copied = await asyncio.gather(*(one(p) for p in paths))
+    return sum(copied)
+
+
+def sync_execute_copy_reqs(
+    paths: List[str],
+    src_storage: StoragePlugin,
+    dst_storage: StoragePlugin,
+    memory_budget_bytes: int,
+    counter_name: Optional[str] = None,
+) -> int:
+    """Copy the named objects from ``src_storage`` to ``dst_storage``
+    under the staging memory budget; returns bytes copied.  This is the
+    tier promoter's engine (tier/promoter.py): write-back fast-tier
+    payloads ride this to the durable tier in the background, with the
+    same budget discipline as staging so a promotion burst can never
+    OOM a host that sized its budget for takes.  Peer replication
+    (tier/plugin.py) reuses it with ``counter_name`` pointed at the
+    replication counter."""
+    if not paths:
+        return 0
+    budget = _Budget(memory_budget_bytes)
+    loop_thread = _LoopThread(name="tsnp-promote-loop")
+    try:
+        return loop_thread.submit(
+            _execute_copy_pipelines(
+                paths,
+                src_storage,
+                dst_storage,
+                budget,
+                knobs.get_max_per_rank_io_concurrency(),
+                counter_name or obs_metrics.BYTES_PROMOTED,
+            )
+        ).result()
+    finally:
+        loop_thread.shutdown()
+
+
 class _ReadPipeline:
     __slots__ = ("read_req", "consuming_cost", "buf")
 

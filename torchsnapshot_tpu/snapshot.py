@@ -427,6 +427,13 @@ class Snapshot:
                 storage_options=storage_options,
             )
             pending_io.sync_complete()
+            # tiered storage: replicate fast-tier payloads to peers and
+            # enqueue write-back promotion, strictly after this rank's
+            # writes landed and strictly before the commit barrier (so
+            # the durable commit marker can only ever trail the data)
+            finalize = getattr(storage, "finalize_take", None)
+            if finalize is not None:
+                finalize(coordinator, commit_uid)
             # content checksums became final when staging finished above;
             # gather them (foreground path: collectives are fine) and
             # merge into every rank's metadata copy
@@ -927,6 +934,15 @@ class Snapshot:
     def get_manifest(self) -> Dict[str, Entry]:
         return dict(self.metadata.manifest)
 
+    def _prime_tier_digests(self, storage: Any) -> None:
+        """Tiered storage: install the committed metadata's whole-object
+        digest table on the plugin so fast/peer-tier reads verify before
+        they are trusted (and silently fall back + repair on mismatch).
+        No-op for ordinary plugins."""
+        prime = getattr(storage, "prime_digests", None)
+        if prime is not None:
+            prime(self.metadata.objects or {})
+
     def restore(
         self,
         app_state: AppState,
@@ -952,6 +968,7 @@ class Snapshot:
             metadata = self.metadata
             manifest_for_rank = get_manifest_for_rank(metadata, rank)
             storage = _storage_for(self.path, self._storage_options)
+            self._prime_tier_digests(storage)
             local_keys = sorted(app_state.keys())
             if world > 1:
                 global_keys = sorted(
@@ -1236,6 +1253,7 @@ class Snapshot:
             if not knobs.is_batching_disabled():
                 read_reqs = batch_read_requests(read_reqs)
             storage = _storage_for(self.path, self._storage_options)
+            self._prime_tier_digests(storage)
             try:
                 sync_execute_read_reqs(
                     read_reqs, storage, get_process_memory_budget_bytes(), rank
@@ -1269,6 +1287,7 @@ class Snapshot:
                 entry, obj_out=obj_out, buffer_size_limit_bytes=memory_budget_bytes
             )
             storage = _storage_for(self.path, self._storage_options)
+            self._prime_tier_digests(storage)
             try:
                 sync_execute_read_reqs(
                     reqs,
@@ -1329,6 +1348,12 @@ class PendingSnapshot:
         status = "ok"
         try:
             self._pending_io_work.sync_complete()
+            # tiered storage: peer replication + write-back promotion
+            # hand-off.  KV-only (explicit keys), so it is legal here;
+            # runs only when this rank's writes all succeeded.
+            finalize = getattr(self._storage, "finalize_take", None)
+            if finalize is not None:
+                finalize(coord, uid)
         except BaseException as e:  # noqa: BLE001
             self._exc = e
             status = f"err:{e!r}"

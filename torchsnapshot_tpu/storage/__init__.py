@@ -21,8 +21,20 @@ def url_to_storage_plugin(
 ) -> StoragePlugin:
     """``storage_options``: extra keyword arguments forwarded to the
     plugin constructor (reference storage_options, snapshot.py:118 —
-    e.g. S3 session/credential config, GCS client options)."""
-    opts = storage_options or {}
+    e.g. S3 session/credential config, GCS client options).
+
+    The reserved key ``"tier"`` (a dict, see tier.build_tiered: at least
+    ``fast_url``; optionally ``policy``, ``replica_count``,
+    ``peer_fast_urls``, ``verify_fast_reads``) layers a fast local tier
+    over the plugin built from ``url_path`` — the url names the DURABLE
+    tier, and the returned plugin is a ``TieredStoragePlugin``."""
+    opts = dict(storage_options or {})
+    tier_opts = opts.pop("tier", None)
+    if tier_opts is not None:
+        from ..tier import build_tiered
+
+        durable = url_to_storage_plugin(url_path, opts or None)
+        return build_tiered(durable, url_path, **tier_opts)
     if "://" in url_path:
         scheme, path = url_path.split("://", 1)
         scheme = scheme or "fs"

@@ -1,0 +1,239 @@
+"""Background write-back promoter: fast-tier payloads → durable tier.
+
+One process-global worker thread drains a FIFO of promotion jobs.  Two
+job kinds, always enqueued in this order per take (so FIFO alone gives
+the durability invariant):
+
+- ``data`` — copy one rank's fast-tier data objects to the durable tier
+  under the scheduler's memory budget (scheduler.sync_execute_copy_reqs),
+  then publish this rank's done-key over the coordination KV.
+- ``commit`` — rank 0 only: wait for every rank's done-key, then copy
+  ``.snapshot_metadata`` (fsync'd, the commit point) and record the
+  promotion lag.  Because the metadata copy runs strictly after all
+  ranks' data promotions, a crash anywhere in between leaves the durable
+  tier WITHOUT metadata — an aborted snapshot by the restore-side
+  contract (snapshot.py:645), never a committed-but-incomplete one.
+
+The KV handshake uses only explicit keys (``{uid}/tierdone/{rank}``) —
+no collectives, no uid counters — so it is legal from this background
+thread under the same rules as the async-commit thread.
+
+``pause()``/``resume()`` exist for tests (deterministic "interrupted
+promotion" scenarios); ``drain()`` blocks until the queue is empty and
+surfaces any job errors.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import List, Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+_METADATA_FNAME = ".snapshot_metadata"  # == snapshot.SNAPSHOT_METADATA_FNAME
+_DONE_TIMEOUT_S = 600.0
+
+
+class PromotionGroup:
+    """One take's promotion state on one rank: which fast-tier paths
+    need copying (linked/deduped objects are already durable) plus the
+    coordination handle for the cross-rank done handshake."""
+
+    def __init__(self, fast_url: str, durable_url: str) -> None:
+        self.fast_url = fast_url
+        self.durable_url = durable_url
+        self.paths: Set[str] = set()
+        self.linked: Set[str] = set()
+        self.coordinator = None
+        self.uid: Optional[str] = None
+        self.commit_enqueued_ts: Optional[float] = None
+        # set when this rank's data job failed: the commit job fails
+        # fast instead of stalling the FIFO for the full done-key
+        # timeout (cross-RANK failures still time out — rank 0 cannot
+        # see a peer's failure except by its key never appearing)
+        self.failed = False
+        # crash-recovery re-promotion (SnapshotManager.repromote): paths
+        # are the GLOBAL manifest locations, of which this host's fast
+        # root may hold only its own rank's share — the data job skips
+        # absent objects, and the commit job writes the durable marker
+        # only once EVERY location is durable-resident (so a partial
+        # multi-host recovery can never fabricate a committed-but-
+        # incomplete durable snapshot)
+        self.recovery = False
+
+
+class Promoter:
+    """Process-global promotion queue (see module docstring)."""
+
+    def __init__(self) -> None:
+        self._queue: "queue.Queue[Tuple[str, PromotionGroup]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._resume = threading.Event()
+        self._resume.set()
+        self._errors: List[Tuple[str, BaseException]] = []
+
+    # ------------------------------------------------------------ queue
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="tsnp-tier-promoter", daemon=True
+                )
+                self._thread.start()
+
+    def enqueue_data(self, group: PromotionGroup) -> None:
+        self._ensure_thread()
+        self._queue.put(("data", group))
+
+    def enqueue_commit(self, group: PromotionGroup) -> None:
+        group.commit_enqueued_ts = time.monotonic()
+        self._ensure_thread()
+        self._queue.put(("commit", group))
+
+    # ------------------------------------------------------- test hooks
+
+    def pause(self) -> None:
+        """Stop processing (jobs keep queueing) — simulates a promotion
+        stall/crash window for tests."""
+        self._resume.clear()
+
+    def resume(self) -> None:
+        self._resume.set()
+
+    def drain(self, raise_on_error: bool = True) -> None:
+        """Block until every queued job finished; re-raise the first job
+        error (promotion failures are otherwise background warnings)."""
+        self._queue.join()
+        with self._lock:
+            errors, self._errors = self._errors, []
+        if errors and raise_on_error:
+            raise RuntimeError(
+                f"{len(errors)} promotion job(s) failed"
+            ) from errors[0][1]
+
+    # ------------------------------------------------------------ worker
+
+    def _run(self) -> None:
+        while True:
+            kind, group = self._queue.get()
+            try:
+                self._resume.wait()
+                self._run_job(kind, group)
+            except BaseException as e:  # noqa: BLE001 — background thread
+                group.failed = True
+                logger.exception(
+                    "tier promotion %s job for %r failed", kind,
+                    group.durable_url,
+                )
+                with self._lock:
+                    self._errors.append((kind, e))
+            finally:
+                self._queue.task_done()
+
+    def _run_job(self, kind: str, group: PromotionGroup) -> None:
+        from .. import obs
+        from ..scheduler import (
+            get_process_memory_budget_bytes,
+            sync_execute_copy_reqs,
+        )
+        from ..storage import url_to_storage_plugin
+
+        src = url_to_storage_plugin(group.fast_url)
+        dst = url_to_storage_plugin(group.durable_url)
+        try:
+            if kind == "data":
+                paths = sorted(group.paths - group.linked)
+                if group.recovery:
+                    # this host's fast root holds only its own share of
+                    # the global manifest — copy what exists locally
+                    paths = [p for p in paths if _stat_ok(src, p)]
+                with obs.span(
+                    "tier/promote_data", durable=group.durable_url,
+                    objects=len(paths),
+                ):
+                    sync_execute_copy_reqs(
+                        paths,
+                        src,
+                        dst,
+                        get_process_memory_budget_bytes(),
+                    )
+                coord = group.coordinator
+                if coord is not None and group.uid is not None:
+                    coord.kv_set(
+                        f"{group.uid}/tierdone/{coord.rank}", "ok"
+                    )
+                return
+            # commit: all ranks durable → metadata last
+            with obs.span(
+                "tier/promote_commit", durable=group.durable_url
+            ):
+                if group.failed:
+                    raise RuntimeError(
+                        f"durable commit for {group.durable_url!r} "
+                        f"withheld: this rank's data promotion failed"
+                    )
+                coord = group.coordinator
+                if coord is not None and group.uid is not None:
+                    for r in range(coord.world_size):
+                        coord.kv_get(
+                            f"{group.uid}/tierdone/{r}", _DONE_TIMEOUT_S
+                        )
+                if group.recovery:
+                    # no cross-rank handshake in recovery mode: gate the
+                    # commit marker on every manifest location actually
+                    # being durable-resident instead
+                    missing = [
+                        p for p in sorted(group.paths)
+                        if not _stat_ok(dst, p)
+                    ]
+                    if missing:
+                        raise RuntimeError(
+                            f"recovery promotion for {group.durable_url!r}"
+                            f" incomplete: {len(missing)} object(s) not "
+                            f"yet durable (other hosts' shares?); durable"
+                            f" commit marker withheld — e.g. {missing[:3]}"
+                        )
+                from ..io_types import ReadIO, WriteIO
+
+                read_io = ReadIO(path=_METADATA_FNAME)
+                src.sync_read(read_io)
+                dst.sync_write(
+                    WriteIO(
+                        path=_METADATA_FNAME,
+                        buf=bytes(memoryview(read_io.buf).cast("B")),
+                        durable=True,
+                    )
+                )
+            if group.commit_enqueued_ts is not None:
+                obs.histogram(obs.PROMOTION_LAG_S).observe(
+                    time.monotonic() - group.commit_enqueued_ts
+                )
+        finally:
+            src.sync_close()
+            dst.sync_close()
+
+
+def _stat_ok(storage, path: str) -> bool:
+    try:
+        storage.sync_stat(path)
+        return True
+    except Exception:  # noqa: BLE001 — absent or unreachable
+        return False
+
+
+_PROMOTER = Promoter()
+
+
+def get_promoter() -> Promoter:
+    return _PROMOTER
+
+
+def drain_promotions(raise_on_error: bool = True) -> None:
+    """Block until all pending write-back promotions landed (tests,
+    benchmarks, and clean shutdowns before the host may be lost)."""
+    _PROMOTER.drain(raise_on_error=raise_on_error)

@@ -277,6 +277,19 @@ def _quick_number(dev, init_s: float) -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _lint_probe() -> dict:
+    """Current snaplint rollup (tools/lint) for the BENCH record: the
+    static-analysis finding trajectory belongs next to the perf numbers
+    so a PR that buys speed with hygiene debt shows both moves.  Pure
+    AST work on host — cannot perturb the measured phases."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.lint import repo_summary
+
+    return repo_summary(repo)
+
+
 def _tier_probe(payload_mb: int = 32) -> dict:
     """Small write-back tiered roundtrip on local dirs (host arrays
     only — never touches the device mid-bench): records fast-tier
@@ -597,6 +610,12 @@ def run_child() -> None:
             result["tier"] = _tier_probe()
         except Exception as e:  # headline metric survives regardless
             result["tier"] = {"error": f"{e!r}"[:200]}
+        # static-analysis trajectory: unbaselined/baselined/allowlisted
+        # snaplint finding counts (tools/lint) ride every BENCH record
+        try:
+            result["lint"] = _lint_probe()
+        except Exception as e:  # repo tooling absent (installed pkg)
+            result["lint"] = {"error": f"{e!r}"[:200]}
         print(json.dumps(result), flush=True)
         # spot-check one leaf round-tripped
         import ml_dtypes

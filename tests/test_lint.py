@@ -1,0 +1,909 @@
+"""Tier-1 wiring for the snaplint suite (tools/lint): the repo must be
+clean under all five passes (modulo the reviewed allowlist and the
+baseline ratchet), each pass must actually detect its bug class (a
+checker that can't fail is no check), and the allowlist/baseline
+machinery must enforce its contracts (written justifications; finding
+counts only ratchet down)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.lint import (  # noqa: E402
+    ALL_PASSES,
+    ALLOWLIST,
+    Allow,
+    LintConfigError,
+    check_ratchet,
+    load_baseline,
+    run_repo,
+    run_source,
+    save_baseline,
+    validate_allowlist,
+)
+from tools.lint.cli import DEFAULT_BASELINE, main, repo_summary  # noqa: E402
+
+_BY_ID = {p.pass_id: p for p in ALL_PASSES}
+
+
+def _run(pass_id, src, filename="torchsnapshot_tpu/example.py"):
+    return run_source(
+        textwrap.dedent(src), filename, [_BY_ID[pass_id]]
+    )
+
+
+# ------------------------------------------------------- repo-wide gate
+
+
+def test_repo_is_clean():
+    """THE gate: zero unbaselined findings repo-wide.  New findings must
+    be fixed or allowlisted with a written justification — see
+    docs/static_analysis.md."""
+    result = run_repo(
+        _REPO_ROOT,
+        ALL_PASSES,
+        allowlist=ALLOWLIST,
+        baseline=load_baseline(DEFAULT_BASELINE),
+    )
+    assert result.files_scanned > 50  # the scan actually covered the repo
+    assert [f.render() for f in result.unbaselined] == []
+    # every allowlist entry still matches something (no stale entries)
+    assert [
+        f"{a.pass_id}:{a.file}:{a.context}" for a in result.unused_allows
+    ] == []
+
+
+def test_cli_main_clean_and_json(capsys):
+    assert main([]) == 0
+    capsys.readouterr()
+    assert main(["--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["ok"] is True and data["unbaselined"] == []
+
+
+def test_repo_summary_shape():
+    s = repo_summary(_REPO_ROOT)
+    assert s["unbaselined"] == 0
+    assert isinstance(s["unbaselined_by_pass"], dict)
+
+
+# ---------------------------------------------------- collective-safety
+
+
+def test_collective_under_rank_branch_flagged():
+    findings = _run(
+        "collective-safety",
+        """
+        def commit(coord):
+            if coord.rank == 0:
+                coord.barrier()
+        """,
+    )
+    assert len(findings) == 1
+    assert "barrier" in findings[0].message
+    assert findings[0].context == "commit"
+
+
+def test_collective_in_else_and_elif_flagged():
+    findings = _run(
+        "collective-safety",
+        """
+        def commit(coord, rank):
+            if rank != 0:
+                pass
+            elif rank == 1:
+                coord.kv_exchange("k", "v")
+            else:
+                coord.all_gather_object(1)
+        """,
+    )
+    assert len(findings) == 2
+
+
+def test_collective_outside_branch_clean():
+    findings = _run(
+        "collective-safety",
+        """
+        def commit(coord, metadata):
+            coord.barrier()
+            if coord.rank == 0:
+                storage.sync_write(metadata)  # rank-0 WORK is fine
+            coord.barrier()
+        """,
+    )
+    assert findings == []
+
+
+def test_rank_conditional_ternary_argument_clean():
+    # broadcast_object runs on ALL ranks; only its argument is
+    # rank-conditional — the sanctioned manager.py pattern
+    findings = _run(
+        "collective-safety",
+        """
+        def restore_latest(self):
+            step = self._coord.broadcast_object(
+                self.latest_step() if self._coord.rank == 0 else None,
+                src=0,
+            )
+            return step
+        """,
+    )
+    assert findings == []
+
+
+def test_rank_conditional_kv_ops_clean():
+    # explicit-key KV is the sanctioned asymmetric-protocol pattern
+    # (coordination.py _barrier_impl itself is built on it)
+    findings = _run(
+        "collective-safety",
+        """
+        def _barrier_impl(self, name):
+            self.kv_set(f"{name}/arrive/{self._rank}", "1")
+            if self._rank == 0:
+                for r in range(self._world):
+                    self.kv_get(f"{name}/arrive/{r}")
+                self.kv_set(f"{name}/depart", "1")
+            else:
+                self.kv_get(f"{name}/depart")
+        """,
+    )
+    assert findings == []
+
+
+def test_collective_after_rank_gate_flagged():
+    findings = _run(
+        "collective-safety",
+        """
+        def gc(self):
+            if self._coord.rank != 0:
+                return
+            self._coord.barrier()
+        """,
+    )
+    assert len(findings) == 1
+    assert "early exit" in findings[0].message
+
+
+def test_collective_after_rank_gate_inside_with_flagged():
+    # the gate sits inside `with log_event(...)`: divergence must
+    # propagate through linear containers
+    findings = _run(
+        "collective-safety",
+        """
+        def gc(self):
+            with log_event(Event("gc")):
+                if self._coord.rank != 0:
+                    return
+                self._coord.barrier()
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_collective_in_ternary_branch_flagged():
+    # `coord.barrier() if rank == 0 else None` calls the collective on
+    # rank 0 only — the IfExp form of the same deadlock
+    findings = _run(
+        "collective-safety",
+        """
+        def f(coord, rank):
+            x = coord.barrier() if rank == 0 else None
+            return x
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_collective_behind_short_circuit_flagged():
+    findings = _run(
+        "collective-safety",
+        """
+        def f(coord, rank):
+            if rank == 0 and coord.barrier():
+                pass
+            ok = rank != 0 or coord.kv_exchange("k", "v")
+            return ok
+        """,
+    )
+    assert len(findings) == 2
+
+
+def test_collective_before_rank_in_boolop_clean():
+    # the collective operand evaluates UNconditionally here
+    findings = _run(
+        "collective-safety",
+        """
+        def f(coord, rank):
+            ok = coord.barrier() and rank == 0
+            return ok
+        """,
+    )
+    assert findings == []
+
+
+def test_rank_gated_return_inside_loop_flagged():
+    # a return inside a loop leaves the whole function: collectives
+    # after the loop deadlock too (continue/break must NOT propagate)
+    findings = _run(
+        "collective-safety",
+        """
+        def f(coord, rank, items):
+            for it in items:
+                if rank != 0:
+                    return
+            coord.barrier()
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_rank_gate_in_elif_chain_flagged():
+    # `elif rank != 0: return` is an If nested in the outer If's
+    # orelse — divergence must propagate out of non-rank branches
+    findings = _run(
+        "collective-safety",
+        """
+        def f(coord, rank, step):
+            if step is None:
+                prepare()
+            elif rank != 0:
+                return
+            coord.barrier()
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_rank_gate_nested_in_plain_if_flagged():
+    findings = _run(
+        "collective-safety",
+        """
+        def f(coord, rank, retry):
+            if retry:
+                if rank == 0:
+                    return
+            coord.barrier()
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_rank_gate_in_try_else_flagged():
+    # try/else runs whenever the body completes — a rank gate there
+    # diverges everything after the try statement
+    findings = _run(
+        "collective-safety",
+        """
+        def f(coord, rank):
+            try:
+                x = prepare()
+            except OSError:
+                x = None
+            else:
+                if rank != 0:
+                    return
+            coord.barrier()
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_rank_gated_continue_dies_at_loop_boundary():
+    findings = _run(
+        "collective-safety",
+        """
+        def f(coord, rank, items):
+            for it in items:
+                if rank != 0:
+                    continue
+                publish(it)
+            coord.barrier()
+        """,
+    )
+    assert findings == []
+
+
+def test_collective_in_nested_function_not_flagged():
+    # a closure's body runs when CALLED — the lexical analysis stops at
+    # function boundaries (documented false-negative, pinned here)
+    findings = _run(
+        "collective-safety",
+        """
+        def setup(coord):
+            if coord.rank == 0:
+                def job():
+                    coord.barrier()
+                return job
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------ lock-discipline
+
+
+def test_open_under_lock_flagged():
+    findings = _run(
+        "lock-discipline",
+        """
+        def save(self, path):
+            with self._lock:
+                with open(path, "w") as f:
+                    f.write("x")
+        """,
+    )
+    assert len(findings) == 1
+    assert "open" in findings[0].message
+
+
+def test_storage_io_and_barrier_under_lock_flagged():
+    findings = _run(
+        "lock-discipline",
+        """
+        def promote(self, storage, coord):
+            with _STATE_LOCK:
+                storage.sync_write(io)
+                coord.barrier()
+        """,
+    )
+    assert {f.message.split("'")[1] for f in findings} == {
+        "sync_write", "barrier",
+    }
+
+
+def test_async_with_lock_flagged():
+    findings = _run(
+        "lock-discipline",
+        """
+        async def drain(self):
+            async with self._lock:
+                await self.storage.sync_read(io)
+                time.sleep(1)
+        """,
+    )
+    assert len(findings) == 2
+
+
+def test_fast_lock_body_clean():
+    findings = _run(
+        "lock-discipline",
+        """
+        def inc(self, n=1):
+            with self._lock:
+                self._value += n
+        """,
+    )
+    assert findings == []
+
+
+def test_nested_locks_report_each_call_once():
+    findings = _run(
+        "lock-discipline",
+        """
+        def f(self, path):
+            with self._lock:
+                with self._other_lock:
+                    open(path)
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_lock_like_name_needs_word_boundary():
+    # `clock`/`blocked` merely CONTAIN "lock" — not locks; `_TRANSFER_LOCK`
+    # and `self.lock` are
+    findings = _run(
+        "lock-discipline",
+        """
+        def timed(self, path):
+            with self.clock:
+                open(path)
+
+        def guarded(self, path):
+            with _TRANSFER_LOCK:
+                open(path)
+        """,
+    )
+    assert len(findings) == 1
+    assert findings[0].context == "guarded"
+
+
+def test_nested_def_under_lock_clean():
+    # defining a closure under a lock is fine — its body executes
+    # elsewhere (the _csrc lazy-build pattern)
+    findings = _run(
+        "lock-discipline",
+        """
+        def load(self):
+            with _lock:
+                def _fresh(path):
+                    with open(path) as f:
+                        return f.read()
+                self._loader = _fresh
+        """,
+    )
+    assert findings == []
+
+
+def test_acquire_without_release_flagged():
+    findings = _run(
+        "lock-discipline",
+        """
+        def leak(self):
+            self._lock.acquire()
+            do_work()
+        """,
+    )
+    assert len(findings) == 1
+    assert "release" in findings[0].message
+
+
+def test_blocking_with_item_after_lock_flagged():
+    # `with self._lock, open(p) as f:` — open() runs while the lock is
+    # already held; later with-items are part of the critical section
+    findings = _run(
+        "lock-discipline",
+        """
+        def save(self, path):
+            with self._lock, open(path) as f:
+                f.read()
+        """,
+    )
+    assert len(findings) == 1
+    assert "open" in findings[0].message
+
+
+def test_with_item_before_lock_clean():
+    # items BEFORE the lock item evaluate lock-free
+    findings = _run(
+        "lock-discipline",
+        """
+        def save(self, path):
+            with open(path) as f, self._lock:
+                self._cache = f
+        """,
+    )
+    assert findings == []
+
+
+def test_acquire_with_release_clean():
+    findings = _run(
+        "lock-discipline",
+        """
+        def ok(self):
+            self._lock.acquire()
+            try:
+                do_work()
+            finally:
+                self._lock.release()
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------- exception-hygiene
+
+
+@pytest.mark.parametrize(
+    "handler",
+    ["except:", "except BaseException:", "except Exception:"],
+)
+def test_silent_swallow_flagged(handler):
+    findings = _run(
+        "exception-hygiene",
+        f"""
+        def f():
+            try:
+                work()
+            {handler}
+                pass
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_narrow_pass_only_clean():
+    findings = _run(
+        "exception-hygiene",
+        """
+        def f():
+            try:
+                work()
+            except (OSError, ValueError):
+                pass
+        """,
+    )
+    assert findings == []
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        "raise",  # re-raise
+        "self._exc = e",  # captured for later re-raise
+        "errors.append(e)",  # handed to state
+        "callback(exc=e)",  # handed off via keyword argument
+        "logger.exception('boom')",  # logged
+        "obs.swallowed_exception('site', e)",  # sanctioned one-liner
+        "obs.counter('x').inc()",  # counted
+    ],
+)
+def test_baseexception_with_escape_clean(body):
+    findings = _run(
+        "exception-hygiene",
+        f"""
+        def f(self):
+            try:
+                work()
+            except BaseException as e:
+                {body}
+        """,
+    )
+    assert findings == []
+
+
+def test_escape_inside_nested_def_does_not_count():
+    # a raise/log inside a closure only runs if the closure is called —
+    # it is no escape for the handler itself
+    findings = _run(
+        "exception-hygiene",
+        """
+        def f(self):
+            try:
+                work()
+            except BaseException:
+                def report():
+                    raise ValueError("never runs")
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_baseexception_without_escape_flagged():
+    findings = _run(
+        "exception-hygiene",
+        """
+        def f(self):
+            try:
+                work()
+            except BaseException as e:
+                self.status = "failed"
+        """,
+    )
+    assert len(findings) == 1
+    assert "BaseException" in findings[0].message
+
+
+# -------------------------------------------------------- knob-registry
+
+
+@pytest.mark.parametrize(
+    "expr",
+    [
+        "os.environ.get('TORCHSNAPSHOT_TPU_TRACE')",
+        "os.environ['TORCHSNAPSHOT_TPU_TRACE']",
+        "os.getenv('TORCHSNAPSHOT_TPU_TRACE', '0')",
+        "os.environ.setdefault('TORCHSNAPSHOT_TPU_TRACE', '1')",
+        "os.environ.get('TSNP_S3_ENDPOINT_URL')",
+        "getenv('TORCHSNAPSHOT_TPU_TRACE')",  # from os import getenv
+    ],
+)
+def test_env_read_outside_knobs_flagged(expr):
+    findings = _run(
+        "knob-registry",
+        f"""
+        import os
+
+        def f():
+            return {expr}
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_env_read_inside_knobs_clean():
+    findings = _run(
+        "knob-registry",
+        """
+        import os
+
+        def get_trace():
+            return os.environ.get("TORCHSNAPSHOT_TPU_TRACE")
+        """,
+        filename="torchsnapshot_tpu/knobs.py",
+    )
+    assert findings == []
+
+
+def test_tool_tsnp_env_read_clean():
+    # TSNP_BENCH_* process controls in repo tooling are not library
+    # knobs; only the package itself must route TSNP_* through knobs.py
+    findings = _run(
+        "knob-registry",
+        """
+        import os
+
+        STATE = os.environ.get("TSNP_BENCH_STATE_DIR", ".")
+        """,
+        filename="tools/bench_watch.py",
+    )
+    assert findings == []
+
+
+def test_unrelated_env_read_clean():
+    findings = _run(
+        "knob-registry",
+        """
+        import os
+
+        def f():
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------ instrumentation
+
+
+def test_instrumentation_pass_flags_naked_public_method():
+    findings = _run(
+        "instrumentation",
+        """
+        class Snapshot:
+            def restore(self, app_state):
+                with log_event(Event("restore")):
+                    return 1
+
+            async def async_probe(self):
+                async with thing:
+                    with span("y"):
+                        return 3
+
+            def naked(self):
+                return 2
+        """,
+        filename="torchsnapshot_tpu/snapshot.py",
+    )
+    assert len(findings) == 1
+    assert "Snapshot.naked" in findings[0].message
+
+
+def test_instrumentation_scoped_to_target_files():
+    findings = _run(
+        "instrumentation",
+        """
+        class Snapshot:
+            def naked(self):
+                return 2
+        """,
+        filename="torchsnapshot_tpu/other.py",
+    )
+    assert findings == []
+
+
+def test_sibling_method_findings_have_distinct_fingerprints():
+    # two unbracketed public methods of one class must not collapse to
+    # one fingerprint, or the baseline ratchet couldn't tell "fixed A"
+    # from "fixed A, regressed B"
+    findings = _run(
+        "instrumentation",
+        """
+        class Snapshot:
+            def naked_a(self):
+                return 1
+
+            def naked_b(self):
+                return 2
+        """,
+        filename="torchsnapshot_tpu/snapshot.py",
+    )
+    assert len(findings) == 2
+    assert len({f.fingerprint for f in findings}) == 2
+    assert {f.context for f in findings} == {
+        "Snapshot.naked_a", "Snapshot.naked_b",
+    }
+
+
+def test_check_source_without_module_functions_ignores_global_coverage():
+    # the pre-migration API applied `module_functions or ()`: calling
+    # check_source on a covered path WITHOUT module_functions must not
+    # leak the global MODULE_FUNCTIONS entry into the check
+    from tools.lint.passes import instrumentation as instr
+
+    src = "def delete_snapshot(p):\n    return p\n"
+    assert instr.check_source(src, {}, "torchsnapshot_tpu/manager.py") == []
+    # and the real registry entry survives the temporary masking
+    assert "delete_snapshot" in instr.MODULE_FUNCTIONS[
+        "torchsnapshot_tpu/manager.py"
+    ]
+
+
+def test_check_instrumentation_shim_back_compat():
+    """The deprecation shim keeps the original module API working."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_instrumentation_shim",
+        os.path.join(_REPO_ROOT, "tools", "check_instrumentation.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check_repo(_REPO_ROOT) == []
+    src = "class Snapshot:\n    def naked(self):\n        return 1\n"
+    violations = mod.check_source(src, {"Snapshot": set()}, "x.py")
+    assert len(violations) == 1 and "Snapshot.naked" in violations[0]
+
+
+# --------------------------------------------- allowlist + baseline law
+
+
+def test_allowlist_requires_written_justification():
+    with pytest.raises(LintConfigError):
+        validate_allowlist(
+            [
+                Allow(
+                    pass_id="exception-hygiene",
+                    file="x.py",
+                    context="f",
+                    justification="ok",  # token-length: rejected
+                )
+            ]
+        )
+    validate_allowlist(list(ALLOWLIST))  # the shipped entries comply
+
+
+def test_allowlist_suppresses_only_matching_context(tmp_path):
+    pkg = tmp_path / "torchsnapshot_tpu"
+    pkg.mkdir()
+    (pkg / "x.py").write_text(
+        textwrap.dedent(
+            """
+            def allowed():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+            def not_allowed():
+                try:
+                    work()
+                except Exception:
+                    pass
+            """
+        )
+    )
+    allow = Allow(
+        pass_id="exception-hygiene",
+        file="torchsnapshot_tpu/x.py",
+        context="allowed",
+        justification=(
+            "fixture: this swallow is the documented contract of "
+            "allowed(), reviewed here"
+        ),
+    )
+    result = run_repo(str(tmp_path), ALL_PASSES, allowlist=[allow])
+    assert len(result.allowlisted) == 1
+    assert len(result.unbaselined) == 1
+    assert result.unbaselined[0].context == "not_allowed"
+
+
+def test_baseline_tolerates_then_ratchets(tmp_path):
+    pkg = tmp_path / "torchsnapshot_tpu"
+    pkg.mkdir()
+    violating = textwrap.dedent(
+        """
+        def legacy():
+            try:
+                work()
+            except Exception:
+                pass
+        """
+    )
+    (pkg / "x.py").write_text(violating)
+    # 1) baseline the legacy finding → run is clean
+    first = run_repo(str(tmp_path), ALL_PASSES)
+    assert len(first.unbaselined) == 1
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(str(bl_path), first.unbaselined)
+    baseline = load_baseline(str(bl_path))
+    second = run_repo(str(tmp_path), ALL_PASSES, baseline=baseline)
+    assert second.ok and len(second.baselined) == 1
+    # 2) a NEW finding (same file, new context) is NOT covered
+    (pkg / "x.py").write_text(
+        violating + textwrap.dedent(
+            """
+            def fresh():
+                try:
+                    work()
+                except Exception:
+                    pass
+            """
+        )
+    )
+    third = run_repo(str(tmp_path), ALL_PASSES, baseline=baseline)
+    assert not third.ok
+    assert [f.context for f in third.unbaselined] == ["fresh"]
+    # 3) the ratchet refuses growth, permits shrink-to-empty
+    assert check_ratchet(baseline, third.baselined + third.unbaselined)
+    assert check_ratchet(baseline, []) == []
+
+
+def test_update_baseline_conflicts_with_no_baseline(capsys):
+    assert main(["--update-baseline", "--no-baseline"]) == 2
+    assert "conflict" in capsys.readouterr().err
+
+
+def test_malformed_baseline_is_config_error(tmp_path):
+    # hand-edited/merge-damaged baseline values must hit the exit-2
+    # LintConfigError contract, not an interpreter traceback
+    bad = tmp_path / "baseline.json"
+    bad.write_text('{"findings": {"a:b:c": "three"}}')
+    with pytest.raises(LintConfigError):
+        load_baseline(str(bad))
+    assert main(["--baseline", str(bad)]) == 2
+
+
+def test_update_baseline_refuses_partial_scope(tmp_path, capsys):
+    # a pass-subset (or foreign-root) rewrite would erase every other
+    # pass's baselined fingerprints — must be refused, not honored
+    assert main(["--pass", "exception-hygiene", "--update-baseline"]) == 2
+    assert "full run" in capsys.readouterr().err
+    assert main([str(tmp_path), "--update-baseline"]) == 2
+    assert "refusing" in capsys.readouterr().err
+    assert load_baseline(DEFAULT_BASELINE) == {}  # untouched
+    # a RELATIVE spelling of the repo root is still the same checkout —
+    # the guard normalizes paths instead of comparing raw strings
+    cwd = os.getcwd()
+    os.chdir(_REPO_ROOT)
+    try:
+        assert main([".", "--update-baseline"]) == 0
+    finally:
+        os.chdir(cwd)
+    assert load_baseline(DEFAULT_BASELINE) == {}  # clean repo: no-op
+
+
+def test_pass_subset_does_not_report_skipped_passes_allows_stale(capsys):
+    # exception-hygiene allowlist entries can't match a knob-registry
+    # subset run; reporting them stale would invite deleting entries
+    # the full run still needs
+    assert main(["--pass", "knob-registry"]) == 0
+    captured = capsys.readouterr()
+    assert "stale" not in captured.err
+    assert main(["--pass", "knob-registry", "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["unused_allows"] == []
+
+
+def test_json_output_reports_stale_allows(capsys, monkeypatch):
+    import tools.lint.cli as cli_mod
+
+    stale = Allow(
+        pass_id="exception-hygiene",
+        file="nonexistent.py",
+        context="ghost",
+        justification=(
+            "fixture: deliberately matches nothing so the staleness "
+            "report path is exercised"
+        ),
+    )
+    monkeypatch.setattr(
+        cli_mod, "ALLOWLIST", tuple(ALLOWLIST) + (stale,)
+    )
+    assert main(["--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert "exception-hygiene:nonexistent.py:ghost" in data["unused_allows"]
+
+
+def test_shipped_baseline_is_empty():
+    """The repo starts clean: every real finding this PR surfaced was
+    fixed or allowlisted — the ratchet exists for future legacy debt,
+    and an empty baseline means none was grandfathered in."""
+    assert load_baseline(DEFAULT_BASELINE) == {}

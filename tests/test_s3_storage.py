@@ -377,3 +377,26 @@ def test_link_from_server_side_copy():
     # missing copy source maps to the cross-plugin contract
     with pytest.raises(FileNotFoundError):
         run(p.link_from("s3://bkt/base/7", "nope"))
+
+
+def test_s3_endpoint_knob_resolution(monkeypatch):
+    """The endpoint env read is routed through knobs.py (snaplint
+    knob-registry pass): new spelling wins over the legacy one, and an
+    active override masks BOTH — including override(None), which must
+    force the AWS default even with a legacy env var set."""
+    from torchsnapshot_tpu import knobs
+
+    monkeypatch.delenv("TORCHSNAPSHOT_TPU_S3_ENDPOINT_URL", raising=False)
+    monkeypatch.delenv("TSNP_S3_ENDPOINT_URL", raising=False)
+    assert knobs.get_s3_endpoint_url() is None
+    monkeypatch.setenv("TSNP_S3_ENDPOINT_URL", "http://legacy:9000")
+    assert knobs.get_s3_endpoint_url() == "http://legacy:9000"
+    monkeypatch.setenv(
+        "TORCHSNAPSHOT_TPU_S3_ENDPOINT_URL", "http://new:9000"
+    )
+    assert knobs.get_s3_endpoint_url() == "http://new:9000"
+    with knobs.override_s3_endpoint_url("http://override:9000"):
+        assert knobs.get_s3_endpoint_url() == "http://override:9000"
+    with knobs.override_s3_endpoint_url(None):
+        assert knobs.get_s3_endpoint_url() is None
+    assert knobs.get_s3_endpoint_url() == "http://new:9000"

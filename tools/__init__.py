@@ -1,0 +1,2 @@
+# Repo tooling namespace (python -m tools.lint et al.).  Not shipped
+# with the torchsnapshot_tpu package — checkout-only developer tools.

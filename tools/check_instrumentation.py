@@ -1,155 +1,33 @@
 #!/usr/bin/env python
-"""Static check: every public method of Snapshot/SnapshotManager must be
-bracketed by ``log_event`` or a tracer ``span``.
+"""DEPRECATED shim: the instrumentation check now lives in the snaplint
+framework as ``tools/lint/passes/instrumentation.py`` (pass id
+``instrumentation``; run it via ``python -m tools.lint``).
 
-Observability only helps if it stays complete: a new public API method
-that silently skips telemetry would punch a hole in traces and event
-streams that nobody notices until an incident needs them.  This check is
-AST-based (no imports of the checked modules, so it runs anywhere) and
-is wired into a tier-1 test (tests/test_check_instrumentation.py) so
-regressions fail fast.
-
-A method passes when anywhere in its body there is a ``with`` (or
-``async with``) whose context expression calls ``log_event(...)`` or
-``span(...)`` / ``obs.span(...)``.  Trivial accessors that neither do
-I/O nor mutate state are exempted via the explicit allowlist below — a
-deliberate, reviewed decision, not a detection heuristic.
+This file keeps the original CLI (``python tools/check_instrumentation.py
+[root]``) and module API (``check_source``/``check_repo``/``main``,
+``TARGETS``/``MODULE_FUNCTIONS``) working unchanged — including when it
+is loaded directly by file path (importlib, as
+tests/test_check_instrumentation.py does), where no package context
+exists.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Dict, List, Set
 
-# file (repo-relative) -> {class name -> allowlisted method names}
-TARGETS: Dict[str, Dict[str, Set[str]]] = {
-    os.path.join("torchsnapshot_tpu", "snapshot.py"): {
-        # metadata/get_manifest are cached-accessor reads of the already
-        # fetched manifest; the storage fetch itself happens inside
-        # methods that ARE bracketed.  verify delegates to
-        # verify_snapshot, which brackets itself (verify.py) — the AST
-        # check can't see through the delegation, and a second bracket
-        # here would double-fire the event
-        "Snapshot": {"metadata", "get_manifest", "verify"},
-    },
-    os.path.join("torchsnapshot_tpu", "manager.py"): {
-        # path arithmetic and delegating one-liners (steps() — which
-        # does the real discovery I/O — is bracketed and checked)
-        "SnapshotManager": {
-            "path_for_step", "fast_path_for_step", "latest_step",
-            "snapshot",
-        },
-    },
-}
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
-# file (repo-relative) -> module-level functions that MUST be bracketed
-# (the inverse discipline of TARGETS: module functions are mostly
-# helpers, so coverage is opt-in per reviewed hot-path function).  The
-# GC path is here: deletions are exactly the operations an incident
-# review needs to reconstruct.
-MODULE_FUNCTIONS: Dict[str, Set[str]] = {
-    os.path.join("torchsnapshot_tpu", "manager.py"): {"delete_snapshot"},
-}
-
-_BRACKET_NAMES = {"log_event", "span"}
-
-
-def _is_bracket_call(expr: ast.expr) -> bool:
-    if not isinstance(expr, ast.Call):
-        return False
-    func = expr.func
-    if isinstance(func, ast.Name):
-        return func.id in _BRACKET_NAMES
-    if isinstance(func, ast.Attribute):  # obs.span(...), tracer.span(...)
-        return func.attr in _BRACKET_NAMES
-    return False
-
-
-def _method_is_bracketed(fn: ast.AST) -> bool:
-    for node in ast.walk(fn):
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            for item in node.items:
-                if _is_bracket_call(item.context_expr):
-                    return True
-    return False
-
-
-def check_source(
-    src: str,
-    classes: Dict[str, Set[str]],
-    filename: str = "<source>",
-    module_functions: Set[str] | None = None,
-) -> List[str]:
-    """Violation strings for ``src`` (empty list == clean).
-
-    ``module_functions``: module-level function names that must carry a
-    bracket (MODULE_FUNCTIONS coverage — e.g. the GC path)."""
-    tree = ast.parse(src, filename)
-    violations: List[str] = []
-    for item in tree.body:
-        if (
-            isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and item.name in (module_functions or ())
-            and not _method_is_bracketed(item)
-        ):
-            violations.append(
-                f"{filename}:{item.lineno}: {item.name} is a covered "
-                f"module-level function without a log_event/span bracket"
-            )
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ClassDef) or node.name not in classes:
-            continue
-        allow = classes[node.name]
-        for item in node.body:
-            if not isinstance(
-                item, (ast.FunctionDef, ast.AsyncFunctionDef)
-            ):
-                continue
-            if item.name.startswith("_") or item.name in allow:
-                continue
-            if not _method_is_bracketed(item):
-                violations.append(
-                    f"{filename}:{item.lineno}: {node.name}.{item.name} is "
-                    f"a public method without a log_event/span bracket "
-                    f"(add one, or allowlist it in "
-                    f"tools/check_instrumentation.py with justification)"
-                )
-    return violations
-
-
-def check_repo(root: str) -> List[str]:
-    violations: List[str] = []
-    for rel in sorted(set(TARGETS) | set(MODULE_FUNCTIONS)):
-        path = os.path.join(root, rel)
-        with open(path) as f:
-            src = f.read()
-        violations.extend(
-            check_source(
-                src,
-                TARGETS.get(rel, {}),
-                rel,
-                MODULE_FUNCTIONS.get(rel),
-            )
-        )
-    return violations
-
-
-def main(argv: List[str] | None = None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))
-    )
-    violations = check_repo(root)
-    for v in violations:
-        print(v, file=sys.stderr)
-    if violations:
-        print(f"{len(violations)} instrumentation violation(s)", file=sys.stderr)
-        return 1
-    print("instrumentation check OK")
-    return 0
-
+from tools.lint.passes.instrumentation import (  # noqa: E402,F401
+    MODULE_FUNCTIONS,
+    TARGETS,
+    InstrumentationPass,
+    check_repo,
+    check_source,
+    main,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

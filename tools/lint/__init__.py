@@ -1,0 +1,28 @@
+"""snaplint — pass-based AST static analysis for this repo.
+
+``python -m tools.lint`` runs five passes repo-wide (collective-safety,
+lock-discipline, exception-hygiene, knob-registry, instrumentation)
+with a per-pass allowlist requiring written justifications and a
+``baseline.json`` ratchet (legacy finding counts may only decrease).
+See docs/static_analysis.md and tools/lint/core.py.
+"""
+
+from __future__ import annotations
+
+from .allowlists import ALLOWLIST  # noqa: F401
+from .cli import DEFAULT_BASELINE, main, repo_summary  # noqa: F401
+from .core import (  # noqa: F401
+    Allow,
+    FileUnit,
+    Finding,
+    LintConfigError,
+    LintPass,
+    LintResult,
+    check_ratchet,
+    load_baseline,
+    run_repo,
+    run_source,
+    save_baseline,
+    validate_allowlist,
+)
+from .passes import ALL_PASSES  # noqa: F401

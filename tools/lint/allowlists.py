@@ -1,0 +1,46 @@
+"""Reviewed snaplint suppressions.  Every entry names the pass, the
+file, the enclosing def/class qualname, and — mandatorily — a written
+justification explaining why the finding is acceptable THERE.  The
+driver rejects entries whose justification is blank or token-length
+(core.validate_allowlist); an entry matching nothing prints a staleness
+warning so dead suppressions get cleaned up.
+
+Etiquette (docs/static_analysis.md): an allowlist entry is a reviewed
+decision, not an escape hatch.  Prefer fixing the finding; allowlist
+only when the flagged shape IS the contract (e.g. a CLI probe whose
+output literally reports "this read failed"), and say so in prose a
+future reviewer can re-evaluate.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from .core import Allow
+
+ALLOWLIST: Tuple[Allow, ...] = (
+    Allow(
+        pass_id="exception-hygiene",
+        file="torchsnapshot_tpu/__main__.py",
+        context="_cmd_tiers",
+        justification=(
+            "The tiers CLI probes each step's metadata in BOTH tiers to "
+            "classify residency; any failure (absent, aborted, corrupt, "
+            "unreachable backend) IS the datum being measured and is "
+            "reported in the command's status column — logging here "
+            "would spam stderr once per uncommitted step on every run."
+        ),
+    ),
+    Allow(
+        pass_id="exception-hygiene",
+        file="bench.py",
+        context="run_child",
+        justification=(
+            "Optional HBM telemetry: jax CPU fallback backends expose "
+            "no memory_stats(); the BENCH record simply omits the "
+            "hbm_* block then.  The headline metric must never fail "
+            "on a telemetry probe, and the omission is visible in the "
+            "record itself."
+        ),
+    ),
+)

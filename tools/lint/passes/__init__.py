@@ -1,0 +1,21 @@
+"""The registered snaplint passes.  Order here is presentation order in
+``--list-passes``; findings are sorted by location regardless."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..core import LintPass
+from .collective_safety import CollectiveSafetyPass
+from .exception_hygiene import ExceptionHygienePass
+from .instrumentation import InstrumentationPass
+from .knob_registry import KnobRegistryPass
+from .lock_discipline import LockDisciplinePass
+
+ALL_PASSES: Tuple[LintPass, ...] = (
+    CollectiveSafetyPass(),
+    LockDisciplinePass(),
+    ExceptionHygienePass(),
+    KnobRegistryPass(),
+    InstrumentationPass(),
+)

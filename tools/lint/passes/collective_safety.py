@@ -1,0 +1,245 @@
+"""collective-safety: collectives must be reachable by EVERY rank.
+
+The SPMD contract of ``Coordinator`` collectives (``barrier``,
+``kv_exchange``, ``all_gather_object``, ``broadcast_object``,
+``gather_object``) is that all ranks call them in the same program
+order.  A collective nested under a rank-conditional branch — or placed
+after a rank-conditional early return — is called by a subset of ranks,
+and the rest of the fleet blocks on it until the barrier timeout: the
+classic SPMD deadlock (MPI-Checker's collective-matching analysis
+targets the same bug class).
+
+Two rules, both lexical and function-local:
+
+1. **Conditional reach** — a collective call whose ancestor chain (up
+   to the nearest enclosing function) contains an ``if``/``elif`` whose
+   test mentions a rank is flagged.  Ternary *arguments* are fine
+   (``broadcast_object(x if rank == 0 else None)`` runs on all ranks),
+   and rank-conditional KV ops (``kv_set``/``kv_get`` under explicit
+   keys) are the sanctioned pattern for asymmetric protocols — only the
+   collective names above are checked.
+
+2. **Divergent early exit** — a collective that appears after a
+   statement of the form ``if <rank test>: return/raise`` (at any block
+   depth reached via with/try bodies) is flagged: the guarded ranks
+   never arrive.
+
+Both rules stop at nested function boundaries: a closure's body runs
+when *called*, which this file-local analysis cannot place.  A
+collective inside a nested def under ``if rank == 0:`` is therefore NOT
+flagged — keep collectives out of rank-gated closures anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..core import (
+    SCOPE_NODES,
+    FileUnit,
+    Finding,
+    LintPass,
+    call_name,
+    calls_in_body,
+)
+
+COLLECTIVE_NAMES = frozenset(
+    {
+        "barrier",
+        "kv_exchange",
+        "all_gather_object",
+        "broadcast_object",
+        "gather_object",
+    }
+)
+
+
+def _mentions_rank(test: ast.expr) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = node.id if isinstance(node, ast.Name) else node.attr
+            if name.rstrip("_").rsplit("_", 1)[-1] == "rank":
+                return True
+    return False
+
+
+def _leaves_function(branch: List[ast.stmt]) -> bool:
+    """Branch ends by leaving the FUNCTION — this divergence survives
+    every enclosing block, loops included."""
+    return bool(branch) and isinstance(
+        branch[-1], (ast.Return, ast.Raise)
+    )
+
+
+def _leaves_iteration(branch: List[ast.stmt]) -> bool:
+    """Branch ends by leaving only the current loop iteration — the
+    divergence taints the rest of the loop body but not code after the
+    loop (every rank still reaches that)."""
+    return bool(branch) and isinstance(
+        branch[-1], (ast.Continue, ast.Break)
+    )
+
+
+class CollectiveSafetyPass(LintPass):
+    pass_id = "collective-safety"
+    description = (
+        "Coordinator collectives must not be rank-conditional "
+        "(SPMD deadlock)"
+    )
+
+    def run(self, unit: FileUnit) -> Iterable[Finding]:
+        out: List[Finding] = []
+        flagged: Set[int] = set()
+        # Rule 1: conditional reach (ancestor rank-if).
+        for node in ast.walk(unit.tree):
+            if (
+                isinstance(node, ast.Call)
+                and call_name(node) in COLLECTIVE_NAMES
+                and self._under_rank_if(unit, node)
+            ):
+                flagged.add(id(node))
+                out.append(
+                    self.finding(
+                        unit,
+                        node,
+                        f"collective '{call_name(node)}' is reachable "
+                        f"only under a rank-conditional branch — ranks "
+                        f"that skip it deadlock the ones that don't; "
+                        f"hoist it out of the branch or use "
+                        f"explicit-key kv_set/kv_get",
+                    )
+                )
+        # Rule 2: divergent early exit, per function scope.
+        for node in ast.walk(unit.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_block(unit, node.body, 0, out, flagged)
+        self._scan_block(unit, unit.tree.body, 0, out, flagged)
+        out.sort(key=lambda f: f.line)
+        return out
+
+    # Divergence levels (returned/threaded by _scan_block): 0 none,
+    # 1 iteration-scoped (continue/break — dies at the loop boundary),
+    # 2 function-scoped (return/raise — survives everything).
+    def _scan_block(
+        self,
+        unit: FileUnit,
+        stmts: List[ast.stmt],
+        diverged: int,
+        out: List[Finding],
+        flagged: Set[int],
+    ) -> int:
+        """Walk one statement list in execution order tracking whether a
+        rank-conditional early exit already happened; returns the state
+        at the end so enclosing blocks propagate it (with/try pass it
+        through; loops keep only the function-scoped level)."""
+        for st in stmts:
+            if isinstance(st, SCOPE_NODES):
+                continue  # separate scope — run() walks it
+            if diverged:
+                for call in calls_in_body(st):
+                    name = call_name(call)
+                    if name in COLLECTIVE_NAMES and id(call) not in flagged:
+                        flagged.add(id(call))
+                        out.append(
+                            self.finding(
+                                unit,
+                                call,
+                                f"collective '{name}' sits after a "
+                                f"rank-conditional early exit — the "
+                                f"filtered ranks never arrive and the "
+                                f"rest deadlock; move the collective "
+                                f"above the gate",
+                            )
+                        )
+                continue  # state can't un-diverge; nothing else to track
+            if isinstance(st, ast.If):
+                if _mentions_rank(st.test) and (
+                    _leaves_function(st.body)
+                    or _leaves_function(st.orelse)
+                ):
+                    diverged = 2
+                elif _mentions_rank(st.test) and (
+                    _leaves_iteration(st.body)
+                    or _leaves_iteration(st.orelse)
+                ):
+                    diverged = 1
+                else:
+                    # branches of a non-rank if (or a rank-if with no
+                    # terminal exit) can still contain rank gates —
+                    # `elif rank != 0: return` is an If nested in
+                    # orelse.  If EITHER branch rank-diverges, some
+                    # ranks may have left by the join point, so the
+                    # divergence propagates (max: function-scoped wins)
+                    b = self._scan_block(
+                        unit, st.body, diverged, out, flagged
+                    )
+                    o = self._scan_block(
+                        unit, st.orelse, diverged, out, flagged
+                    )
+                    diverged = max(diverged, b, o)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                diverged = self._scan_block(
+                    unit, st.body, diverged, out, flagged
+                )
+            elif isinstance(st, ast.Try):
+                diverged = self._scan_block(
+                    unit, st.body, diverged, out, flagged
+                )
+                for h in st.handlers:
+                    self._scan_block(unit, h.body, diverged, out, flagged)
+                # else: runs whenever the body completes — its end
+                # state flows on exactly like the body's (handler
+                # divergence stays local: the exception path is already
+                # conditional)
+                diverged = self._scan_block(
+                    unit, st.orelse, diverged, out, flagged
+                )
+                diverged = self._scan_block(
+                    unit, st.finalbody, diverged, out, flagged
+                )
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                body_div = self._scan_block(
+                    unit, st.body, diverged, out, flagged
+                )
+                self._scan_block(unit, st.orelse, diverged, out, flagged)
+                if body_div == 2:
+                    # a rank-gated return/raise inside the loop exits
+                    # the whole function — code after the loop is
+                    # unreachable for the gated ranks too
+                    diverged = 2
+        return diverged
+
+    @staticmethod
+    def _under_rank_if(unit: FileUnit, call: ast.Call) -> bool:
+        """Any rank-conditional ancestor between the call and its
+        enclosing scope: an ``if``/ternary whose test mentions a rank
+        (with the call in a BRANCH, not the test), or a short-circuit
+        ``and``/``or`` where a rank-mentioning operand guards the
+        operand holding the call (``rank == 0 and coord.barrier()``)."""
+        cur: ast.AST = call
+        for anc in unit.ancestors(call):
+            if isinstance(anc, SCOPE_NODES) or isinstance(anc, ast.Module):
+                return False
+            if (
+                isinstance(anc, (ast.If, ast.IfExp))
+                and _mentions_rank(anc.test)
+                and cur is not anc.test
+            ):
+                return True
+            if isinstance(anc, ast.BoolOp):
+                # cur is the operand on the path down to the call;
+                # operands BEFORE it short-circuit its evaluation
+                idx = next(
+                    (
+                        i for i, v in enumerate(anc.values)
+                        if v is cur
+                    ),
+                    len(anc.values),
+                )
+                if any(
+                    _mentions_rank(v) for v in anc.values[:idx]
+                ):
+                    return True
+            cur = anc
+        return False

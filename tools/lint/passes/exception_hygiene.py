@@ -1,0 +1,145 @@
+"""exception-hygiene: no silent swallows on fallback paths.
+
+The tiered/async subsystems lean hard on broad ``except`` fallbacks —
+peer-read fallback, best-effort checksums, background promotion.  Those
+are legitimate ONLY while each swallow leaves a trace: a counter, a log
+line, or the exception captured for a later re-raise.  A silent
+``except BaseException: pass`` on a data path hides data loss (and eats
+``KeyboardInterrupt``/``SystemExit``, making the process unkillable).
+
+What is flagged:
+
+- ``except:`` (bare) and ``except BaseException`` (alone or in a
+  tuple) handlers with no recognized escape;
+- ``except Exception`` handlers whose body is ONLY ``pass`` (the pure
+  silent swallow — generic catch, zero trace).
+
+Recognized escapes (any one suffices):
+
+- a ``raise`` anywhere in the handler (re-raise or translate);
+- the bound exception captured into state — any assignment or call
+  argument that references ``as e``'s name (``self._exc = e``,
+  ``errors.append(e)``) counts: the exception survives for a later
+  re-raise/report;
+- a logging call — ``logger.exception/error/warning/info/debug``;
+- an obs trace — a ``.inc(...)`` counter increment or
+  ``obs.swallowed_exception(...)`` (the sanctioned one-liner: counter
+  plus debug log).
+
+Handlers catching narrow types (``except OSError: pass``) are NOT
+flagged: naming the exact expected failure is itself the
+justification.  Anything broader needs an allowlist entry with written
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from ..core import FileUnit, Finding, LintPass, walk_skipping_nested_defs
+
+_LOG_METHOD_NAMES = frozenset(
+    {"exception", "error", "warning", "info", "debug", "log"}
+)
+_TRACE_CALL_NAMES = frozenset({"swallowed_exception", "inc"})
+
+
+def _caught_names(type_node: Optional[ast.expr]) -> Tuple[str, ...]:
+    if type_node is None:
+        return ("",)  # bare except
+    items = (
+        list(type_node.elts)
+        if isinstance(type_node, ast.Tuple)
+        else [type_node]
+    )
+    names = []
+    for it in items:
+        if isinstance(it, ast.Name):
+            names.append(it.id)
+        elif isinstance(it, ast.Attribute):
+            names.append(it.attr)
+        else:
+            names.append("?")
+    return tuple(names)
+
+
+def _has_escape(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    # body-local walk: a raise/log inside a nested def only runs if the
+    # closure is called — it is no escape for THIS handler
+    for node in walk_skipping_nested_defs(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = (
+                func.attr
+                if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else ""
+            )
+            if name in _LOG_METHOD_NAMES or name in _TRACE_CALL_NAMES:
+                return True
+            if bound and any(
+                isinstance(a, ast.Name) and a.id == bound
+                for arg in [*node.args, *(kw.value for kw in node.keywords)]
+                for a in ast.walk(arg)
+            ):
+                return True  # exception handed to something
+        if isinstance(node, (ast.Assign, ast.AugAssign)) and bound:
+            value = node.value
+            if any(
+                isinstance(n, ast.Name) and n.id == bound
+                for n in ast.walk(value)
+            ):
+                return True  # exception captured into state
+    return False
+
+
+def _is_pass_only(handler: ast.ExceptHandler) -> bool:
+    return len(handler.body) == 1 and isinstance(handler.body[0], ast.Pass)
+
+
+class ExceptionHygienePass(LintPass):
+    pass_id = "exception-hygiene"
+    description = (
+        "bare/BaseException handlers must re-raise, capture or log; "
+        "no silent `except Exception: pass`"
+    )
+
+    def run(self, unit: FileUnit) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            caught = _caught_names(node.type)
+            broad = "" in caught or "BaseException" in caught
+            if broad and not _has_escape(node):
+                what = (
+                    "bare `except:`" if "" in caught
+                    else "`except BaseException`"
+                )
+                out.append(
+                    self.finding(
+                        unit,
+                        node,
+                        f"{what} swallows the exception silently "
+                        f"(including KeyboardInterrupt/SystemExit) — "
+                        f"re-raise, capture it for a later re-raise, "
+                        f"log it, or record it via "
+                        f"obs.swallowed_exception()",
+                    )
+                )
+            elif "Exception" in caught and _is_pass_only(node):
+                out.append(
+                    self.finding(
+                        unit,
+                        node,
+                        "`except Exception: pass` is a silent swallow "
+                        "— narrow the exception type, log it, or "
+                        "record it via obs.swallowed_exception() "
+                        "(allowlist with justification if the silence "
+                        "is truly the contract)",
+                    )
+                )
+        return out

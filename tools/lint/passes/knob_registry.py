@@ -1,0 +1,110 @@
+"""knob-registry: every tunable is read through knobs.py, nowhere else.
+
+knobs.py is the single resolution chain (override → env → default) for
+every ``TORCHSNAPSHOT_TPU_*`` variable: that is what makes the
+context-manager test overrides, the documented default table, and the
+api_reference knob listing complete.  A direct ``os.environ`` read
+elsewhere forks the source of truth — the knob silently stops honoring
+``knobs.override_*`` in tests and disappears from the docs.
+
+Flagged env-read forms (``os.environ.get``/``[...]``/``setdefault``/
+``pop``, ``os.getenv``) with a string-literal key:
+
+- keys starting with ``TORCHSNAPSHOT_TPU_`` anywhere except
+  ``torchsnapshot_tpu/knobs.py``;
+- keys starting with ``TSNP_`` inside the ``torchsnapshot_tpu``
+  package (library code must route legacy-prefixed tunables through a
+  knobs.py accessor too; repo tooling like bench.py may keep its own
+  ``TSNP_BENCH_*`` process controls).
+
+Non-literal keys can't be checked lexically; the prefix constant in
+knobs.py stays the one sanctioned concatenation site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import FileUnit, Finding, LintPass
+
+_KNOBS_FILE = "torchsnapshot_tpu/knobs.py"
+_PKG_PREFIX = "torchsnapshot_tpu/"
+_ENV_METHODS = frozenset({"get", "setdefault", "pop", "getenv"})
+
+
+def _literal_key(call_or_sub: ast.AST) -> Optional[str]:
+    """The string-literal env key of an environ access, else None."""
+    if isinstance(call_or_sub, ast.Call):
+        if not call_or_sub.args:
+            return None
+        arg = call_or_sub.args[0]
+    elif isinstance(call_or_sub, ast.Subscript):
+        arg = call_or_sub.slice
+    else:
+        return None
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _is_environ_access(node: ast.AST) -> bool:
+    """``os.environ.get/.setdefault/.pop``, ``os.environ[...]``,
+    ``environ.get``, ``os.getenv``."""
+    if isinstance(node, ast.Subscript):
+        target = node.value
+        return isinstance(target, ast.Attribute) and (
+            target.attr == "environ"
+        ) or (isinstance(target, ast.Name) and target.id == "environ")
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        # `from os import getenv; getenv(...)` — bare-name form
+        return node.func.id == "getenv"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        func = node.func
+        if func.attr == "getenv":
+            return True
+        if func.attr in _ENV_METHODS and (
+            (
+                isinstance(func.value, ast.Attribute)
+                and func.value.attr == "environ"
+            )
+            or (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "environ"
+            )
+        ):
+            return True
+    return False
+
+
+class KnobRegistryPass(LintPass):
+    pass_id = "knob-registry"
+    description = (
+        "TORCHSNAPSHOT_TPU_*/TSNP_* env reads belong in knobs.py only"
+    )
+
+    def run(self, unit: FileUnit) -> Iterable[Finding]:
+        if unit.relpath == _KNOBS_FILE:
+            return []
+        in_pkg = unit.relpath.startswith(_PKG_PREFIX)
+        out: List[Finding] = []
+        for node in ast.walk(unit.tree):
+            if not _is_environ_access(node):
+                continue
+            key = _literal_key(node)
+            if key is None:
+                continue
+            if key.startswith("TORCHSNAPSHOT_TPU_") or (
+                in_pkg and key.startswith("TSNP_")
+            ):
+                out.append(
+                    self.finding(
+                        unit,
+                        node,
+                        f"direct environment read of {key!r} — route "
+                        f"it through a knobs.py accessor so override_* "
+                        f"test hooks, the default table and the "
+                        f"api_reference knob listing stay complete",
+                    )
+                )
+        return out

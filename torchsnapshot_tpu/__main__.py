@@ -8,6 +8,7 @@
     python -m torchsnapshot_tpu tiers     <durable-root> --fast <fast-root> [--json]
     python -m torchsnapshot_tpu delete    <snapshot-path> --yes
     python -m torchsnapshot_tpu trace     <snapshot-path> [--out FILE]
+    python -m torchsnapshot_tpu lint      [root] [--json] [--pass ID]
 
 Paths take any storage URL the library accepts (plain/fs, gs://, s3://).
 Exit code is non-zero when a verify fails or a delete is refused —
@@ -368,6 +369,37 @@ def _cmd_convert(args) -> int:
     return 0
 
 
+def _cmd_lint(args) -> int:
+    """Run the snaplint static-analysis suite (tools/lint) over the
+    repo checkout this package is running from; ``args`` is the raw
+    argv tail forwarded to ``tools.lint.main``.  The lint framework is
+    repo tooling, not part of the installed package — from a pip
+    install there is no checkout to scan, and this explains that
+    instead of ImportError-ing."""
+    import os
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    if not os.path.isdir(os.path.join(repo_root, "tools", "lint")):
+        # genuinely no checkout (pip install): explain instead of
+        # ImportError-ing.  When the directory EXISTS, import errors
+        # propagate with their real traceback — a broken pass module
+        # must not masquerade as "no checkout"
+        print(
+            "error: the lint suite (tools/lint) is repo tooling and "
+            "needs a checkout — run from the repository root, or "
+            "`python -m tools.lint` there",
+            file=sys.stderr,
+        )
+        return 2
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tools.lint import main as lint_main
+
+    return lint_main(list(args))
+
+
 def _cmd_delete(args) -> int:
     from .manager import delete_snapshot
 
@@ -380,6 +412,12 @@ def _cmd_delete(args) -> int:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "lint":
+        # forwarded verbatim (argparse.REMAINDER can't capture a
+        # leading option like `lint --json`, so the dispatch happens
+        # before the parser)
+        return _cmd_lint(argv[1:])
     parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -434,6 +472,18 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="machine-readable output")
     p.set_defaults(fn=_cmd_tiers)
+
+    p = sub.add_parser(
+        "lint",
+        help="run the snaplint static-analysis suite over this repo "
+        "checkout (collective-safety, lock-discipline, "
+        "exception-hygiene, knob-registry, instrumentation); all "
+        "arguments are forwarded to `python -m tools.lint` "
+        "(e.g. --json, --list-passes, --pass exception-hygiene)",
+    )
+    # dispatch happens before the parser (see main's lint intercept);
+    # this registration exists for `--help` discoverability
+    p.set_defaults(fn=lambda _args: _cmd_lint([]))
 
     p = sub.add_parser("delete", help="delete one snapshot (metadata-first)")
     p.add_argument("path")

@@ -29,6 +29,7 @@ import uuid
 from base64 import b64decode, b64encode
 from typing import Any, List, Optional
 
+from . import obs
 from .serialization import deserialize_object, serialize_object
 
 _DEFAULT_TIMEOUT_S = 600.0
@@ -278,6 +279,12 @@ def get_default_coordinator() -> Coordinator:
 
         if distributed.global_state.client is not None:
             return JaxCoordinator()
-    except Exception:
-        pass
+    except Exception as e:
+        # jax absent or its internal layout changed: single-process
+        # coordination is the right degraded mode, but record the
+        # fallback — a pod job silently coordinating locally is exactly
+        # the misconfiguration this trace exists to diagnose (obs is a
+        # module-level import: a lazy import here could itself raise
+        # and replace the exception being handled)
+        obs.swallowed_exception("coordination.jax_probe", e)
     return LocalCoordinator()

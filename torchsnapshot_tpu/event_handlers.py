@@ -15,6 +15,13 @@ from typing import Callable, Iterator, List
 
 from .event import Event
 
+# module-level on purpose: these run inside `except` blocks, where a
+# lazy import could itself raise during interpreter teardown and
+# escape into the operation being observed (obs never imports this
+# module at import time, so no cycle)
+from .obs import swallowed_exception
+from .obs.metrics import EVENT_HANDLER_ERRORS, counter
+
 logger = logging.getLogger(__name__)
 
 _ENTRY_POINT_GROUP = "torchsnapshot_tpu.event_handlers"
@@ -56,8 +63,12 @@ def _load_entry_point_handlers() -> None:
                 _entry_point_handlers.append(ep.load())
             except Exception:
                 logger.exception("failed to load event handler %r", ep.name)
-    except Exception:
-        pass
+    except Exception as e:
+        # no importlib.metadata / broken distribution metadata: events
+        # still fire to directly-registered handlers — but leave a
+        # trace, a silently-skipped discovery would read as "my
+        # entry-point collector never sees events" with zero evidence
+        swallowed_exception("event_handlers.entry_point_discovery", e)
 
 
 def _fire(event: Event) -> None:
@@ -68,7 +79,10 @@ def _fire(event: Event) -> None:
         try:
             handler(event)
         except Exception:
+            # log first: telemetry accounting must never displace the
+            # primary evidence if the inc itself misbehaves
             logger.exception("event handler raised for %r", event.name)
+            counter(EVENT_HANDLER_ERRORS).inc()
 
 
 def _obs_span_cm(event: Event):

@@ -38,6 +38,7 @@ _VERIFY_ON_RESTORE = "VERIFY_ON_RESTORE"
 _DEVICE_UNPACK = "DEVICE_UNPACK"
 _RESTORE_DONATE = "RESTORE_DONATE"
 _TRACE = "TRACE"
+_S3_ENDPOINT_URL = "S3_ENDPOINT_URL"
 _TIER_POLICY = "TIER_POLICY"
 _TIER_FAST_KEEP_LAST_N = "TIER_FAST_KEEP_LAST_N"
 _TIER_VERIFY_FAST_READS = "TIER_VERIFY_FAST_READS"
@@ -150,6 +151,12 @@ _DEFAULTS = {
     # obs.refresh_enabled() after mutating it); gate runtime decisions
     # on obs.tracing_enabled(), which reports what is actually recorded.
     _TRACE: 0,
+    # Alternate S3 endpoint (minio, localstack, any S3-compatible
+    # store) for the s3:// plugin.  None/"" = AWS default.  Env-based
+    # so snapshot-level s3:// URLs resolve against the emulator too
+    # (url_to_storage_plugin has no options channel); the legacy
+    # TSNP_S3_ENDPOINT_URL spelling is still honored as a fallback.
+    _S3_ENDPOINT_URL: None,
     # Default policy for tiered storage (tier/) when the tier options
     # don't name one: "write_back" acks a take when the FAST tier
     # commits and promotes to the durable tier in the background (the
@@ -340,6 +347,24 @@ def is_trace_enabled() -> bool:
     return bool(_get_int(_TRACE))
 
 
+def get_s3_endpoint_url() -> Optional[str]:
+    """Alternate S3 endpoint, or None for the AWS default.  Resolution:
+    override → TORCHSNAPSHOT_TPU_S3_ENDPOINT_URL → the pre-knob legacy
+    name TSNP_S3_ENDPOINT_URL (kept so existing emulator setups don't
+    break) → None.  This is the ONLY sanctioned read of either variable
+    (tools/lint knob-registry pass)."""
+    if _S3_ENDPOINT_URL in _OVERRIDES:
+        # an active override masks BOTH env spellings — including
+        # override_s3_endpoint_url(None), which forces the AWS default
+        # (None is a meaningful override value here, so the _get_raw
+        # chain, where None means "unset", cannot express it)
+        return _OVERRIDES[_S3_ENDPOINT_URL] or None
+    v = os.environ.get(_ENV_PREFIX + _S3_ENDPOINT_URL)
+    if v is None:
+        v = os.environ.get("TSNP_S3_ENDPOINT_URL")
+    return v or None
+
+
 def get_tier_policy() -> str:
     v = str(_get_raw(_TIER_POLICY)).lower()
     if v not in ("write_back", "write_through"):
@@ -489,6 +514,10 @@ def override_replication_verify(value: str):
 
 def override_restore_donate(value):
     return _override(_RESTORE_DONATE, value)
+
+
+def override_s3_endpoint_url(value):
+    return _override(_S3_ENDPOINT_URL, value)
 
 
 def override_tier_policy(value: str):

@@ -681,8 +681,12 @@ class SnapshotManager:
                     manifest = Snapshot(
                         self.path_for_step(step)
                     ).get_manifest()
-                except Exception:  # noqa: BLE001 — fall through below
-                    pass
+                except Exception as e:  # noqa: BLE001 — fall through below
+                    logger.debug(
+                        "fast-tier retention: durable manifest read for "
+                        "step %d failed (%r); evicting without the "
+                        "object list", step, e,
+                    )
             if not durable_ok:
                 # durable-evicted steps (no longer in the index, and a
                 # newer indexed step exists) lost their durable copy on
@@ -699,7 +703,12 @@ class SnapshotManager:
                     manifest = Snapshot(
                         self.fast_path_for_step(step)
                     ).get_manifest()
-                except Exception:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
+                    logger.debug(
+                        "fast-tier retention: fast manifest read for "
+                        "step %d failed (%r); evicting without the "
+                        "object list", step, e,
+                    )
                     manifest = None
             logger.info(
                 "fast-tier retention: evicting local copy of step %d",

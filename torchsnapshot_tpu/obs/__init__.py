@@ -19,6 +19,7 @@ metrics block ``bench.py`` embeds in its BENCH records.
 from __future__ import annotations
 
 import functools
+import logging
 import time
 from typing import Any
 
@@ -32,6 +33,8 @@ from .metrics import (  # noqa: F401
     BYTES_STAGED,
     BYTES_WRITTEN,
     BYTES_BUCKETS,
+    EVENT_HANDLER_ERRORS,
+    EXCEPTIONS_SWALLOWED,
     GC_BYTES_RECLAIMED,
     IO_QUEUE_DEPTH,
     LATENCY_BUCKETS_S,
@@ -81,12 +84,28 @@ __all__ = [
     "reset_metrics",
     "record_storage_io",
     "buf_nbytes",
+    "swallowed_exception",
     "instrument_storage",
     "to_trace_events",
     "write_trace",
     "REGISTRY",
     "MetricsRegistry",
 ]
+
+
+_swallow_logger = logging.getLogger(__name__)
+
+
+def swallowed_exception(site: str, exc: BaseException) -> None:
+    """Record a deliberately-swallowed exception on a fallback path:
+    one counter increment (``exceptions.swallowed``) plus a debug log
+    carrying the site and the exception.  One shared counter, not one
+    per site — site names are free-form and must not grow the registry
+    unboundedly; per-site attribution lives in the log line.  Cheap
+    enough for hot paths (a lock-guarded int add; the log call is lazy
+    below DEBUG level)."""
+    counter(EXCEPTIONS_SWALLOWED).inc()
+    _swallow_logger.debug("swallowed exception at %s: %r", site, exc)
 
 
 def buf_nbytes(buf: Any) -> int:

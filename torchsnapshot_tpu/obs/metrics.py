@@ -68,6 +68,16 @@ BYTES_REPLICATED = "tier.bytes_replicated"
 PROMOTION_LAG_S = "tier.promotion_lag_s"
 # GC/retention: bytes of storage objects reclaimed by delete_snapshot
 GC_BYTES_RECLAIMED = "snapshot.gc.bytes_reclaimed"
+# Exception hygiene (tools/lint exception-hygiene pass): every
+# deliberate broad-except swallow on a fallback path increments this
+# via obs.swallowed_exception, so "how often are we falling back" is a
+# dashboard number instead of an invisible `pass`.
+EXCEPTIONS_SWALLOWED = "exceptions.swallowed"
+# Registered event handlers that raised from the log_event fan-out
+# (the handler error is logged and suppressed so telemetry can never
+# break the operation it observes — this counter keeps the failure
+# visible).
+EVENT_HANDLER_ERRORS = "events.handler_errors"
 
 
 class Counter:

@@ -17,6 +17,8 @@ from typing import Any, List
 
 import numpy as np
 
+from .. import obs
+
 
 def _pack(arrays: List[Any]):
     import jax.numpy as jnp
@@ -65,8 +67,8 @@ def pack_arrays_to_host(arrays: List[Any]) -> np.ndarray:
     packed = _pack_jit(arrays)
     try:
         packed.copy_to_host_async()
-    except Exception:
-        pass
+    except Exception as e:
+        obs.swallowed_exception("device_pack.copy_to_host_async", e)
     out = np.asarray(packed)  # materializes; async failures surface here
     _count("pack")
     return out
@@ -95,8 +97,8 @@ def _jitted_unpack(dtype_str, shape, out_dtype_str):
 
     try:
         import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
-    except Exception:
-        pass
+    except ImportError:
+        pass  # numpy-native dtypes still work; bf16/fp8 names won't parse
 
     dt = np.dtype(dtype_str)
     out_dt = None if out_dtype_str is None else np.dtype(out_dtype_str)
@@ -151,8 +153,8 @@ def _compiled_tile_update(acc_n, acc_dtype_str, tile_n, tile_dtype_str,
 
     try:
         import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
-    except Exception:
-        pass
+    except ImportError:
+        pass  # numpy-native dtypes still work; bf16/fp8 names won't parse
 
     acc_dt = np.dtype(acc_dtype_str)
     tile_dt = np.dtype(tile_dtype_str)

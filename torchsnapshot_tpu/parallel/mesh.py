@@ -9,10 +9,13 @@ reference's benchmarks exercise DDP/FSDP/torchrec layouts
 
 from __future__ import annotations
 
+import logging
 import re
 from typing import Optional, Tuple
 
 import numpy as np
+
+_logger = logging.getLogger(__name__)
 
 
 def get_shard_map():
@@ -42,13 +45,16 @@ def ensure_cpu_devices(min_devices: int = 1) -> None:
         from jax._src import xla_bridge
 
         xla_bridge._backend_factories.pop("axon", None)
-    except Exception:
-        pass
+    except Exception as e:
+        # jax-internal layout changed: the tunnel factory (if any)
+        # stays registered — JAX_PLATFORMS=cpu below still wins
+        # selection, so log-and-continue is safe
+        _logger.debug("force_cpu: xla_bridge factory drop failed: %r", e)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     try:
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    except Exception as e:
+        _logger.debug("force_cpu: jax.config update failed: %r", e)
 
 
 def build_mesh(n_devices: Optional[int] = None, tp: Optional[int] = None):

@@ -28,7 +28,7 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-from .. import knobs
+from .. import knobs, obs
 from ..io_types import BufferConsumer, BufferStager, Future, ReadReq, WriteReq
 from ..manifest import ArrayEntry, ChunkedArrayEntry, Shard
 import logging
@@ -226,8 +226,11 @@ class JaxArrayBufferStager(BufferStager):
             a = src if self.index is None else src[self.index]
             try:
                 a.copy_to_host_async()
-            except Exception:
-                pass  # some array types (fully replicated committed) may decline
+            except Exception as e:
+                # some array types (fully replicated committed) decline
+                # the async prefetch; np.asarray below does the copy
+                # synchronously either way
+                obs.swallowed_exception("array_stager.copy_to_host_async", e)
             return np.asarray(a)
 
         async def _run(src: Any) -> np.ndarray:

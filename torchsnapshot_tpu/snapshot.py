@@ -1435,7 +1435,13 @@ class PendingSnapshot:
             try:
                 self._storage.sync_close()
             except Exception:
-                pass
+                # the commit outcome is already decided (self._exc);
+                # a teardown failure must not overwrite it — but a
+                # leaked executor/fd is worth a visible warning
+                logger.warning(
+                    "storage close after async commit failed",
+                    exc_info=True,
+                )
 
     def wait(self) -> Snapshot:
         """Block until the background commit finishes; re-raise any error

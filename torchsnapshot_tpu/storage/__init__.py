@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from .. import obs
 from ..io_types import StoragePlugin
 
 _ENTRY_POINT_GROUP = "torchsnapshot_tpu.storage_plugins"
@@ -73,8 +74,8 @@ def url_to_storage_plugin(
             if hasattr(eps, "select")
             else eps.get(_ENTRY_POINT_GROUP, [])
         )
-    except Exception:
-        pass
+    except Exception as e:
+        obs.swallowed_exception("storage.entry_point_discovery", e)
     for ep in group:
         if ep.name == scheme:
             return ep.load()(path, **opts)

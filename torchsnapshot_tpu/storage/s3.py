@@ -12,7 +12,7 @@ import asyncio
 import functools
 from concurrent.futures import ThreadPoolExecutor
 
-from .. import obs
+from .. import knobs, obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 
@@ -37,17 +37,15 @@ class S3StoragePlugin(StoragePlugin):
         num_threads: int = 16,
         endpoint_url: str = None,
     ) -> None:
-        import os
-
         self.bucket, _, self.prefix = path.partition("/")
         self._backend = None
         # emulator/alternate-endpoint support (minio, localstack, any
-        # S3-compatible store): explicit arg wins, else the env var —
-        # env-based so snapshot-level s3:// URLs resolve against the
-        # emulator too (url_to_storage_plugin has no options channel)
-        endpoint_url = endpoint_url or os.environ.get(
-            "TSNP_S3_ENDPOINT_URL"
-        ) or None
+        # S3-compatible store): explicit arg wins, else the knob —
+        # knob-based (TORCHSNAPSHOT_TPU_S3_ENDPOINT_URL, legacy
+        # TSNP_S3_ENDPOINT_URL) so snapshot-level s3:// URLs resolve
+        # against the emulator too (url_to_storage_plugin has no
+        # options channel) and tests get knobs.override_s3_endpoint_url
+        endpoint_url = endpoint_url or knobs.get_s3_endpoint_url()
         client_extra = {"endpoint_url": endpoint_url} if endpoint_url else {}
         try:
             import boto3

@@ -390,8 +390,11 @@ class TieredStoragePlugin(StoragePlugin):
         ):
             try:
                 await plugin.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                # best-effort teardown must not mask the take/restore
+                # outcome, but a close failure (leaked fd, wedged
+                # executor) should still be attributable
+                obs.swallowed_exception("tier.plugin_close", e)
         self._peer_plugins.clear()
 
     # ----------------------------------------------------- take lifecycle

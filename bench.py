@@ -290,6 +290,42 @@ def _lint_probe() -> dict:
     return repo_summary(repo)
 
 
+def _resilience_rollup() -> dict:
+    """Retry/abort/breaker counters for the BENCH record: a perf number
+    earned while the retry engine was quietly eating SlowDowns (or a
+    breaker was open) is a different datum than the same number on a
+    healthy backend — the rollup makes that visible next to the
+    headline.  Reads the live metrics registry; no I/O."""
+    from torchsnapshot_tpu import obs
+
+    snap = obs.metrics_snapshot()
+    counters = snap.get("counters", {})
+    out = {
+        "retries": counters.get(obs.RESILIENCE_RETRIES, 0),
+        "aborts": counters.get(obs.RESILIENCE_ABORTS, 0),
+        "failpoints_fired": counters.get(obs.RESILIENCE_FAILPOINTS_FIRED, 0),
+        "breaker_trips": counters.get(obs.RESILIENCE_BREAKER_TRIPS, 0),
+        "retries_by_backend": {
+            name.split(".")[1]: v
+            for name, v in counters.items()
+            if name.startswith("resilience.")
+            and name.endswith(".retries")
+            and name.count(".") == 2  # not the total "resilience.retries"
+        },
+        "breaker_state": {
+            name.split("resilience.breaker_state.", 1)[1]: g["value"]
+            for name, g in snap.get("gauges", {}).items()
+            if name.startswith("resilience.breaker_state.")
+        },
+    }
+    hist = snap.get("histograms", {}).get(obs.RESILIENCE_BACKOFF_DELAY_S)
+    if hist and hist.get("count"):
+        out["backoff_delay_s"] = {
+            k: hist[k] for k in ("count", "sum", "min", "max")
+        }
+    return out
+
+
 def _tier_probe(payload_mb: int = 32) -> dict:
     """Small write-back tiered roundtrip on local dirs (host arrays
     only — never touches the device mid-bench): records fast-tier
@@ -616,6 +652,13 @@ def run_child() -> None:
             result["lint"] = _lint_probe()
         except Exception as e:  # repo tooling absent (installed pkg)
             result["lint"] = {"error": f"{e!r}"[:200]}
+        # resilience rollup: retries/aborts/breaker activity during the
+        # measured phases (and the tier probe above) — a throughput
+        # number earned through a retry storm must say so
+        try:
+            result["resilience"] = _resilience_rollup()
+        except Exception as e:
+            result["resilience"] = {"error": f"{e!r}"[:200]}
         print(json.dumps(result), flush=True)
         # spot-check one leaf round-tripped
         import ml_dtypes

@@ -26,6 +26,17 @@ except Exception:
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _close_resilience_breakers():
+    """Circuit breakers are process-global per backend: a test that
+    deliberately exhausts retries (chaos schedules) must not leave the
+    's3'/'fs' breaker open for every later test in the worker."""
+    yield
+    from torchsnapshot_tpu.resilience import reset_breakers
+
+    reset_breakers()
+
+
 @pytest.fixture(params=[True, False], ids=["batching_on", "batching_off"])
 def toggle_batching(request):
     """Run snapshot tests with batching on and off (reference

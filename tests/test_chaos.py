@@ -1,0 +1,449 @@
+"""Chaos suite: seeded failpoint schedules driven through real
+take/restore/promotion stacks across fs, s3 (stubbed client), gcs
+(fake bucket) and tiered storage.
+
+THE invariant, asserted by every scenario: a run either **completes
+correctly after observed retries** (committed snapshot, round-trip
+equality, `resilience.retries` advanced) or **aborts cleanly** — the
+error surfaces on every rank (typed `SnapshotAbortedError` on peers),
+no `.snapshot_metadata` is ever committed, no partial/temp files leak,
+and nothing wedges to a barrier timeout (every scenario is wall-clock
+bounded).
+
+All schedules are deterministic: probability-1 specs with fire counts,
+or probabilistic specs pinned by TORCHSNAPSHOT_TPU_FAILPOINT_SEED.
+Backoff is capped to milliseconds so the whole suite stays inside the
+tier-1 budget."""
+
+import asyncio
+import glob
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.resilience import reset_breakers
+
+pytestmark = pytest.mark.chaos
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff():
+    """Milliseconds of backoff instead of seconds, and closed breakers
+    on entry — chaos schedules stay deterministic and fast."""
+    reset_breakers()
+    with knobs.override_retry_backoff_cap_s(0.01):
+        yield
+    reset_breakers()
+
+
+def _retries() -> int:
+    return obs.counter(obs.RESILIENCE_RETRIES).value
+
+
+def _state(n=512, seed=0):
+    return {
+        "app": StateDict(
+            w=np.arange(n, dtype=np.float32) + seed,
+            step=seed,
+        )
+    }
+
+
+def _assert_roundtrip(snap_path, n=512, seed=0, storage_options=None):
+    dest = {"app": StateDict(w=np.zeros(n, np.float32), step=-1)}
+    Snapshot(snap_path, storage_options=storage_options).restore(dest)
+    np.testing.assert_array_equal(
+        dest["app"]["w"], np.arange(n, dtype=np.float32) + seed
+    )
+    assert dest["app"]["step"] == seed
+
+
+# ======================================================== fs scenarios
+
+
+def test_chaos_fs_take_transient_writes_complete_after_retries(tmp_path):
+    path = str(tmp_path / "s")
+    r0 = _retries()
+    with knobs.override_failpoints("storage.fs.write=eintr:1:3"):
+        Snapshot.take(path, _state())
+    assert _retries() - r0 >= 3  # every injected fault was retried
+    assert os.path.exists(os.path.join(path, ".snapshot_metadata"))
+    _assert_roundtrip(path)
+
+
+def test_chaos_fs_take_enospc_aborts_clean_no_partials(tmp_path):
+    path = str(tmp_path / "s")
+    with knobs.override_failpoints("storage.fs.write.sync=enospc"):
+        with pytest.raises(OSError):
+            Snapshot.take(path, _state())
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+    assert glob.glob(os.path.join(path, "**", "*tsnp-tmp*"), recursive=True) == []
+    with pytest.raises(FileNotFoundError, match="not a committed snapshot"):
+        _ = Snapshot(path).metadata
+    # the aborted directory is reusable once the fault clears
+    Snapshot.take(path, _state(seed=7))
+    _assert_roundtrip(path, seed=7)
+
+
+def test_chaos_fs_restore_transient_reads_recover(tmp_path):
+    path = str(tmp_path / "s")
+    Snapshot.take(path, _state(seed=3))
+    r0 = _retries()
+    with knobs.override_failpoints("storage.fs.read=eagain:1:2"):
+        _assert_roundtrip(path, seed=3)
+    assert _retries() - r0 >= 2
+
+
+def test_chaos_fs_restore_fatal_read_aborts_not_wedges(tmp_path):
+    path = str(tmp_path / "s")
+    Snapshot.take(path, _state())
+    t0 = time.monotonic()
+    with knobs.override_failpoints("storage.fs.read=io"):
+        dest = {"app": StateDict(w=np.zeros(512, np.float32), step=-1)}
+        # the first failing read is the metadata fetch, which the
+        # metadata property wraps as "incomplete or aborted"
+        with pytest.raises((OSError, RuntimeError)):
+            Snapshot(path).restore(dest)
+    assert time.monotonic() - t0 < 30
+    # the committed snapshot itself is untouched and restorable
+    _assert_roundtrip(path)
+
+
+def test_chaos_fs_probabilistic_schedule_completes_or_aborts_clean(tmp_path):
+    """Seeded probabilistic faults: whatever the (deterministic) draw
+    sequence produces, the run must end in one of the two legal states."""
+    path = str(tmp_path / "s")
+    with knobs.override_failpoint_seed(42):
+        with knobs.override_failpoints("storage.fs.write=eintr:0.3"):
+            try:
+                Snapshot.take(path, _state(seed=5))
+                committed = True
+            except OSError:
+                committed = False
+    if committed:
+        _assert_roundtrip(path, seed=5)
+    else:
+        assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+
+
+# ============================================ s3 (stubbed client)
+
+
+@pytest.fixture
+def s3_stub(monkeypatch):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_s3_storage import FakeBoto3Client
+
+    import torchsnapshot_tpu.snapshot as snap_mod
+    import torchsnapshot_tpu.storage as storage_mod
+    from torchsnapshot_tpu.storage.s3 import S3StoragePlugin
+
+    fake = FakeBoto3Client()
+    real_resolver = storage_mod.url_to_storage_plugin
+
+    def factory(path, *a, **kw):
+        if path.startswith("s3://"):
+            from concurrent.futures import ThreadPoolExecutor
+
+            p = S3StoragePlugin.__new__(S3StoragePlugin)
+            p.bucket, _, p.prefix = path[len("s3://"):].partition("/")
+            p._backend = fake
+            p._is_fs = False
+            p._executor = ThreadPoolExecutor(max_workers=4)
+            return p
+        return real_resolver(path, *a, **kw)
+
+    monkeypatch.setattr(storage_mod, "url_to_storage_plugin", factory)
+    monkeypatch.setattr(snap_mod, "url_to_storage_plugin", factory)
+    return fake
+
+
+def test_chaos_s3_take_slowdown_storm_commits_after_retries(s3_stub):
+    r0 = _retries()
+    with knobs.override_failpoints("storage.s3.write=slowdown:1:4"):
+        Snapshot.take("s3://bkt/ck", _state(seed=2))
+    assert _retries() - r0 >= 4
+    assert ("bkt", "ck/.snapshot_metadata") in s3_stub.objects
+    _assert_roundtrip("s3://bkt/ck", seed=2)
+
+
+def test_chaos_s3_take_persistent_500_aborts_without_commit(s3_stub):
+    with knobs.override_retry_max_attempts(2):
+        with knobs.override_failpoints("storage.s3.write=http500"):
+            with pytest.raises(Exception) as ei:
+                Snapshot.take("s3://bkt/ck2", _state())
+    # surfaces as the injected 500 (original context), never FNF
+    assert getattr(ei.value, "response", {}).get("Error", {}).get(
+        "Code"
+    ) == "InternalError"
+    assert ("bkt", "ck2/.snapshot_metadata") not in s3_stub.objects
+
+
+def test_chaos_s3_restore_transient_reads_recover(s3_stub):
+    Snapshot.take("s3://bkt/ck3", _state(seed=9))
+    r0 = _retries()
+    with knobs.override_failpoints("storage.s3.read=slowdown:1:2"):
+        _assert_roundtrip("s3://bkt/ck3", seed=9)
+    assert _retries() - r0 >= 2
+
+
+# ============================================ gcs (fake bucket)
+
+
+def _gcs_plugin(chunk_bytes=1 << 20):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from concurrent.futures import ThreadPoolExecutor
+
+    from test_gcs_chunked import FakeBucket
+
+    from torchsnapshot_tpu.resilience import SharedProgress
+    from torchsnapshot_tpu.storage.gcs import GCSStoragePlugin
+
+    p = GCSStoragePlugin.__new__(GCSStoragePlugin)
+    p.prefix = "run"
+    p._bucket = FakeBucket()
+    p._executor = ThreadPoolExecutor(max_workers=8)
+    p._retry = SharedProgress(window_s=60.0, label="gcs-chaos")
+    p._chunk_bytes = chunk_bytes
+    return p
+
+
+def test_chaos_gcs_write_transient_conn_recovers():
+    p = _gcs_plugin()
+    r0 = _retries()
+    with knobs.override_failpoints("storage.gcs.write=conn:1:2"):
+        run(p.write(WriteIO(path="obj", buf=b"gcs payload")))
+    assert _retries() - r0 >= 2
+    assert p._bucket.data["run/obj"] == b"gcs payload"
+
+
+def test_chaos_gcs_read_transient_timeout_recovers():
+    p = _gcs_plugin()
+    run(p.write(WriteIO(path="obj", buf=b"37 bytes of definitely real payload")))
+    r0 = _retries()
+    with knobs.override_failpoints("storage.gcs.read=timeout:1:2"):
+        io_ = ReadIO(path="obj")
+        run(p.read(io_))
+    assert bytes(io_.buf) == b"37 bytes of definitely real payload"
+    assert _retries() - r0 >= 2
+
+
+def test_chaos_gcs_chunked_write_survives_part_faults():
+    """Composite upload: faults land on individual part uploads; each
+    part retries independently and the stitched object is intact."""
+    p = _gcs_plugin(chunk_bytes=64)
+    payload = bytes(range(256)) * 2  # 8 parts
+    r0 = _retries()
+    with knobs.override_failpoints("storage.gcs.write=conn:1:3"):
+        run(p.write(WriteIO(path="big", buf=payload)))
+    assert _retries() - r0 >= 3
+    assert p._bucket.data["run/big"] == payload
+
+
+def test_chaos_gcs_write_exhaustion_raises_original():
+    p = _gcs_plugin()
+    p._retry.max_attempts = 2
+    with knobs.override_failpoints("storage.gcs.write=conn"):
+        with pytest.raises(ConnectionError):
+            run(p.write(WriteIO(path="doomed", buf=b"x")))
+    assert "run/doomed" not in p._bucket.data
+
+
+# ================================================= tier scenarios
+
+
+def test_chaos_tier_promotion_data_failure_withholds_durable_commit(tmp_path):
+    from torchsnapshot_tpu.tier.promoter import drain_promotions
+
+    fast = str(tmp_path / "fast")
+    durable = str(tmp_path / "durable")
+    opts = {"tier": {"fast_url": fast, "policy": "write_back"}}
+    with knobs.override_failpoints("tier.promote.data=runtime"):
+        Snapshot.take(durable, _state(seed=4), storage_options=opts)
+        with pytest.raises(RuntimeError):
+            drain_promotions()
+    # fast tier committed (the write_back ack point) ...
+    assert os.path.exists(os.path.join(fast, ".snapshot_metadata"))
+    # ... but the durable commit marker was withheld: an interrupted
+    # promotion is an ABORTED durable snapshot, never a partial one
+    assert not os.path.exists(os.path.join(durable, ".snapshot_metadata"))
+    # fast-first restore still serves the committed step
+    _assert_roundtrip(durable, seed=4, storage_options=opts)
+
+
+def test_chaos_tier_commit_failure_withholds_durable_commit(tmp_path):
+    from torchsnapshot_tpu.tier.promoter import drain_promotions
+
+    fast = str(tmp_path / "fast")
+    durable = str(tmp_path / "durable")
+    opts = {"tier": {"fast_url": fast, "policy": "write_back"}}
+    with knobs.override_failpoints("tier.promote.commit=io"):
+        Snapshot.take(durable, _state(seed=6), storage_options=opts)
+        with pytest.raises(RuntimeError):
+            drain_promotions()
+    assert not os.path.exists(os.path.join(durable, ".snapshot_metadata"))
+    # data objects may exist durably — without the marker they are
+    # restore-invisible by contract
+    with pytest.raises(FileNotFoundError):
+        _ = Snapshot(durable).metadata
+
+
+def test_chaos_tier_dead_fast_tier_trips_breaker_restore_from_durable(
+    tmp_path,
+):
+    """Persistent fast-tier read faults: the per-backend breaker trips
+    open mid-restore and the remaining reads route straight to the
+    durable tier — the restore SUCCEEDS against a dead local disk."""
+    fast_ns = f"chaosfast_{os.getpid()}"
+    durable = str(tmp_path / "durable")
+    opts = {
+        "tier": {"fast_url": f"memory://{fast_ns}", "policy": "write_through"}
+    }
+    Snapshot.take(durable, _state(seed=8), storage_options=opts)
+    trips0 = obs.counter(obs.RESILIENCE_BREAKER_TRIPS).value
+    with knobs.override_breaker_threshold(2):
+        with knobs.override_failpoints("storage.memory.read=io"):
+            _assert_roundtrip(durable, seed=8, storage_options=opts)
+    assert obs.counter(obs.RESILIENCE_BREAKER_TRIPS).value > trips0
+    assert (
+        obs.gauge(
+            f"resilience.breaker_state.tier.fast:memory://{fast_ns}"
+        ).value
+        == 2  # open
+    )
+
+
+# ====================================== multi-rank abort scenarios
+
+
+def _launch_chaos_workers(tmp_path, body, env_per_rank, world=2, timeout_s=90):
+    script = os.path.join(str(tmp_path), "chaos_worker.py")
+    with open(script, "w") as f:
+        f.write(
+            textwrap.dedent(
+                f"""
+                import os, sys
+                sys.path.insert(0, {_REPO!r})
+                import numpy as np
+                from torchsnapshot_tpu import FileCoordinator, Snapshot, StateDict
+                from torchsnapshot_tpu.resilience import SnapshotAbortedError
+
+                rank = int(sys.argv[1])
+                world = int(sys.argv[2])
+                coord = FileCoordinator({os.path.join(str(tmp_path), "kv")!r}, rank, world)
+                snap_dir = {os.path.join(str(tmp_path), "snap")!r}
+                """
+            )
+            + textwrap.dedent(body)
+        )
+    base_env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, str(r), str(world)],
+            env={**base_env, **env_per_rank[r]},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout_s)[0].decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise AssertionError(
+            "chaos worker wedged past the wall-clock bound — the abort "
+            "protocol failed to release a blocked rank"
+        )
+    return [(p.returncode, out) for p, out in zip(procs, outs)]
+
+
+def test_chaos_multirank_take_peer_fault_aborts_all_ranks(tmp_path):
+    """Rank 1's persistent ENOSPC mid-take: rank 1 re-raises its own
+    OSError, rank 0 raises SnapshotAbortedError NAMING rank 1 within
+    seconds (not the 600s barrier timeout), and no metadata exists."""
+    body = r"""
+    state = {"app": StateDict(w=np.arange(256, dtype=np.float32) + rank)}
+    try:
+        Snapshot.take(snap_dir, state, coordinator=coord)
+        raise SystemExit(f"rank {rank}: take unexpectedly committed")
+    except SnapshotAbortedError as e:
+        assert rank == 0, f"origin rank must re-raise its own error: {e}"
+        assert e.info.origin_rank == 1, e
+        print(f"rank {rank} PEER-ABORT origin={e.info.origin_rank}")
+    except OSError:
+        assert rank == 1
+        print(f"rank {rank} ORIGIN-RAISED")
+    assert not os.path.exists(os.path.join(snap_dir, ".snapshot_metadata"))
+    print(f"rank {rank} CHAOS-OK")
+    """
+    t0 = time.monotonic()
+    results = _launch_chaos_workers(
+        tmp_path,
+        body,
+        env_per_rank=[
+            {},
+            {
+                "TORCHSNAPSHOT_TPU_FAILPOINTS": (
+                    "storage.fs.write.sync=enospc"
+                )
+            },
+        ],
+    )
+    assert time.monotonic() - t0 < 60, "must abort well before timeouts"
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} CHAOS-OK" in out
+    assert "rank 0 PEER-ABORT origin=1" in results[0][1]
+    assert "rank 1 ORIGIN-RAISED" in results[1][1]
+
+
+def test_chaos_multirank_restore_peer_fault_aborts_all_ranks(tmp_path):
+    body = r"""
+    state = {"app": StateDict(w=np.arange(128, dtype=np.float32))}
+    snap = Snapshot.take(snap_dir, state, coordinator=coord)
+    dest = {"app": StateDict(w=np.zeros(128, np.float32))}
+    import torchsnapshot_tpu.resilience.failpoints as fps
+    from torchsnapshot_tpu import knobs
+    if rank == 1:
+        ctx = knobs.override_failpoints("storage.fs.read=io")
+    else:
+        import contextlib
+        ctx = contextlib.nullcontext()
+    with ctx:
+        try:
+            Snapshot(snap_dir, coordinator=coord).restore(dest)
+            raise SystemExit(f"rank {rank}: restore unexpectedly succeeded")
+        except SnapshotAbortedError as e:
+            assert rank == 0 and e.info.origin_rank == 1, e
+            print(f"rank {rank} PEER-ABORT")
+        except Exception:
+            # rank 1's own failure (the metadata-read wrap or a raw
+            # OSError deeper in the loop) — never a peer-abort shape
+            assert rank == 1
+            print(f"rank {rank} ORIGIN-RAISED")
+    print(f"rank {rank} CHAOS-OK")
+    """
+    t0 = time.monotonic()
+    results = _launch_chaos_workers(tmp_path, body, env_per_rank=[{}, {}])
+    assert time.monotonic() - t0 < 60
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} CHAOS-OK" in out
+    assert "rank 0 PEER-ABORT" in results[0][1]
+    assert "rank 1 ORIGIN-RAISED" in results[1][1]

@@ -1,0 +1,183 @@
+"""Abort-aware barrier/kv_get for all three coordinators: poison set
+mid-wait must raise SnapshotAbortedError promptly (well under the
+default 600s timeout), naming the origin rank and cause.
+
+LocalCoordinator and FileCoordinator run real instances; JaxCoordinator
+runs against a fake coordination-service KV client (the same
+__new__-plus-attributes pattern the storage-plugin contract tests use)
+whose blocking get raises a DEADLINE_EXCEEDED-shaped error like the
+real jaxlib client, so the abort-aware chunked wait is exercised
+end-to-end without a jax.distributed service."""
+
+import threading
+import time
+
+import pytest
+
+from torchsnapshot_tpu.coordination import (
+    FileCoordinator,
+    JaxCoordinator,
+    LocalCoordinator,
+)
+from torchsnapshot_tpu.resilience import SnapshotAbortedError
+
+# generous wall-clock bound for "promptly": the abort poll interval is
+# 0.5s, the default wait timeout 600s
+_PROMPT_S = 10.0
+
+
+class _FakeXlaError(Exception):
+    """repr carries DEADLINE_EXCEEDED like jaxlib's XlaRuntimeError."""
+
+    def __init__(self, key):
+        super().__init__(f"DEADLINE_EXCEEDED: key {key!r} not found")
+
+
+class _FakeKVClient:
+    """The jax.distributed coordination-client surface JaxCoordinator
+    drives: a process-shared dict with real blocking semantics."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def key_value_set(self, key, value):
+        self._store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while time.monotonic() < deadline:
+            if key in self._store:
+                return self._store[key]
+            time.sleep(0.005)
+        raise _FakeXlaError(key)
+
+    def key_value_try_get(self, key):
+        if key not in self._store:
+            raise KeyError(key)
+        return self._store[key]
+
+    def wait_at_barrier(self, key, timeout_ms):  # pragma: no cover
+        raise AssertionError(
+            "abort-aware barriers must not reach the opaque native wait"
+        )
+
+
+def _fake_jax_coordinator(store, rank, world):
+    c = JaxCoordinator.__new__(JaxCoordinator)
+    c._client = _FakeKVClient(store)
+    c._rank = rank
+    c._world = world
+    c._ns = "t"
+    return c
+
+
+def _coordinator_pair(kind, tmp_path):
+    if kind == "file":
+        root = str(tmp_path / "kv")
+        return (
+            FileCoordinator(root, 0, 2),
+            FileCoordinator(root, 1, 2),
+        )
+    store = {}
+    return (
+        _fake_jax_coordinator(store, 0, 2),
+        _fake_jax_coordinator(store, 1, 2),
+    )
+
+
+def _poison_after(coord, scope, delay_s=0.3, cause="peer blew up"):
+    t = threading.Thread(
+        target=lambda: (time.sleep(delay_s), coord.poison(scope, cause)),
+        daemon=True,
+    )
+    t.start()
+    return t
+
+
+@pytest.mark.parametrize("kind", ["file", "jax"])
+def test_kv_get_aborts_promptly_on_poison(tmp_path, kind):
+    c0, c1 = _coordinator_pair(kind, tmp_path)
+    _poison_after(c1, "scope-kv")
+    t0 = time.monotonic()
+    with pytest.raises(SnapshotAbortedError) as ei:
+        with c0.abort_scope("scope-kv"):
+            c0.kv_get("never-written")  # default 600s timeout
+    assert time.monotonic() - t0 < _PROMPT_S
+    assert ei.value.info.origin_rank == 1
+    assert "peer blew up" in str(ei.value)
+
+
+@pytest.mark.parametrize("kind", ["file", "jax"])
+def test_barrier_aborts_promptly_on_poison(tmp_path, kind):
+    c0, c1 = _coordinator_pair(kind, tmp_path)
+    _poison_after(c1, "scope-bar")
+    t0 = time.monotonic()
+    with pytest.raises(SnapshotAbortedError):
+        with c0.abort_scope("scope-bar"):
+            c0.barrier("b-abort")  # rank 1 never arrives
+    assert time.monotonic() - t0 < _PROMPT_S
+
+
+@pytest.mark.parametrize("kind", ["file", "jax"])
+def test_waits_complete_normally_without_poison(tmp_path, kind):
+    c0, c1 = _coordinator_pair(kind, tmp_path)
+    c1.kv_set("present", "v")
+    with c0.abort_scope("scope-ok"):
+        assert c0.kv_get("present", timeout_s=10) == "v"
+
+    # a barrier both ranks reach releases both (rank 1 on a thread)
+    def rank1():
+        with c1.abort_scope("scope-ok"):
+            c1.barrier("b-ok", timeout_s=30)
+
+    t = threading.Thread(target=rank1, daemon=True)
+    t.start()
+    with c0.abort_scope("scope-ok"):
+        c0.barrier("b-ok", timeout_s=30)
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_local_coordinator_abort_surface():
+    lc = LocalCoordinator()
+    lc.poison("s", "local failure", site="unit")
+    with pytest.raises(SnapshotAbortedError, match="local failure"):
+        with lc.abort_scope("s"):
+            lc.barrier()
+    # un-poisoned scope stays a no-op
+    with lc.abort_scope("other"):
+        lc.barrier()
+
+
+@pytest.mark.parametrize("kind", ["file", "jax"])
+def test_timeout_preserved_when_not_poisoned(tmp_path, kind):
+    """The abort-aware wait still times out (as TimeoutError) when no
+    poison ever appears — aborting must not eat real timeouts."""
+    c0, _ = _coordinator_pair(kind, tmp_path)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        with c0.abort_scope("scope-timeout"):
+            c0.kv_get("never", timeout_s=1.2)
+    assert 1.0 < time.monotonic() - t0 < _PROMPT_S
+
+
+def test_abort_scope_is_per_thread(tmp_path):
+    """A background thread's abort scope must not make the foreground
+    thread's waits abort-aware (the promoter/async-commit threads scope
+    only their own waits)."""
+    root = str(tmp_path / "kv")
+    c = FileCoordinator(root, 0, 1)
+    seen = {}
+
+    def bg():
+        with c.abort_scope("bg-scope"):
+            seen["bg"] = c._current_abort_scope()
+            time.sleep(0.3)
+
+    t = threading.Thread(target=bg)
+    t.start()
+    time.sleep(0.1)
+    seen["fg"] = c._current_abort_scope()
+    t.join()
+    assert seen["bg"] == "bg-scope"
+    assert seen["fg"] is None

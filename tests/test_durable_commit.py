@@ -32,8 +32,14 @@ def test_native_write_passes_fsync_mode(tmp_path, monkeypatch):
     loop.run_until_complete(
         plugin.write(WriteIO(path="meta", buf=b"m", durable=True))
     )
-    modes = {os.path.basename(p): m for p, m in calls}
+    # the native write lands on a sibling temp name first (partial-write
+    # safety) — strip the temp suffix to recover the logical name
+    modes = {
+        os.path.basename(p).split(".tsnp-tmp", 1)[0]: m for p, m in calls
+    }
     assert modes == {"data": 0, "meta": 1}
+    # ... and the temp files were renamed onto the final names
+    assert sorted(os.listdir(tmp_path)) == ["data", "meta"]
 
 
 def test_fallback_durable_write_fsyncs(tmp_path, monkeypatch):

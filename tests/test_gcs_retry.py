@@ -1,6 +1,9 @@
 """GCS collective-progress retry semantics with a fake client — no
 network (reference gcs.py:221-277 behavior, tested like reference
-tests/test_gcs_storage_plugin.py but headless)."""
+tests/test_gcs_storage_plugin.py but headless).  The strategy class now
+lives in resilience/retry.py (SharedProgress) as the package-wide
+policy; GCS keeps the historical name as an alias and identical
+semantics — which is exactly what this suite pins."""
 
 import asyncio
 
@@ -13,7 +16,7 @@ def test_retry_allows_while_pipeline_progresses(monkeypatch):
     r = _CollectiveProgressRetry(window_s=100.0)
     now = [1000.0]
     monkeypatch.setattr(
-        "torchsnapshot_tpu.storage.gcs.time",
+        "torchsnapshot_tpu.resilience.retry.time",
         type("T", (), {"monotonic": staticmethod(lambda: now[0])}),
     )
     r.record_progress()

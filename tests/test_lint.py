@@ -650,6 +650,127 @@ def test_unrelated_env_read_clean():
     assert findings == []
 
 
+# ----------------------------------------------------- retry-discipline
+
+
+def test_retry_sleep_loop_around_storage_op_flagged():
+    findings = _run(
+        "retry-discipline",
+        """
+        import time
+
+        def pull(storage, path):
+            while True:
+                try:
+                    return storage.sync_read(path)
+                except OSError:
+                    time.sleep(2)
+        """,
+    )
+    assert len(findings) == 1
+    assert "resilience.retry_call" in findings[0].message
+
+
+def test_retry_async_sleep_loop_around_kv_op_flagged():
+    findings = _run(
+        "retry-discipline",
+        """
+        import asyncio
+
+        async def wait_peer(coord, key):
+            for _ in range(10):
+                v = coord.kv_try_get(key)
+                if v is not None:
+                    return v
+                await asyncio.sleep(0.5)
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_retry_sleep_loop_without_storage_op_clean():
+    findings = _run(
+        "retry-discipline",
+        """
+        import time
+
+        def wait_flag(flags):
+            while not flags.get("done"):
+                time.sleep(0.1)
+        """,
+    )
+    assert findings == []
+
+
+def test_retry_storage_loop_without_sleep_clean():
+    findings = _run(
+        "retry-discipline",
+        """
+        def drain(storage, paths):
+            for p in paths:
+                storage.sync_delete(p)
+        """,
+    )
+    assert findings == []
+
+
+def test_retry_discipline_exempts_resilience_module_and_non_package():
+    src = """
+    import time
+
+    def loop(storage, path):
+        while True:
+            try:
+                return storage.sync_read(path)
+            except OSError:
+                time.sleep(1)
+    """
+    assert _run(
+        "retry-discipline", src,
+        filename="torchsnapshot_tpu/resilience/retry.py",
+    ) == []
+    assert _run(
+        "retry-discipline", src, filename="tools/bench_watch.py"
+    ) == []
+    assert len(_run("retry-discipline", src)) == 1  # package default
+
+
+def test_retry_sleep_in_nested_def_not_attributed_to_loop():
+    findings = _run(
+        "retry-discipline",
+        """
+        import time
+
+        def schedule(storage, paths):
+            for p in paths:
+                def backoff():
+                    time.sleep(1)
+                storage.sync_write(p)
+        """,
+    )
+    assert findings == []
+
+
+def test_retry_nested_qualifying_loops_report_innermost_only():
+    findings = _run(
+        "retry-discipline",
+        """
+        import time
+
+        def pump(storage, batches):
+            for batch in batches:
+                while True:
+                    try:
+                        storage.sync_write(batch)
+                        break
+                    except OSError:
+                        time.sleep(1)
+        """,
+    )
+    assert len(findings) == 1
+    assert findings[0].line == 6  # the while, not the for
+
+
 # ------------------------------------------------------ instrumentation
 
 

@@ -1,0 +1,449 @@
+"""Resilience-layer units: failpoint registry semantics, the shared
+retry engine's classification/backoff behavior, circuit-breaker state
+transitions, and the two storage-plugin satellites (fs partial-write
+cleanup, s3 transient-vs-missing-vs-fatal classification)."""
+
+import asyncio
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import knobs, obs
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.resilience import (
+    FATAL,
+    MISSING,
+    TRANSIENT,
+    CircuitBreaker,
+    CircuitOpenError,
+    InjectedClientError,
+    SharedProgress,
+    SnapshotAbortedError,
+    classify_fs,
+    classify_s3,
+    parse_failpoints,
+    retry_call,
+)
+from torchsnapshot_tpu.resilience import failpoints as fp_mod
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ------------------------------------------------------------ failpoints
+
+
+def test_failpoint_disarmed_is_noop():
+    assert not fp_mod.active()
+    fp_mod.failpoint("storage.fs.write")  # must not raise
+
+
+def test_failpoint_spec_parsing_and_validation():
+    specs = parse_failpoints("a.b=io:0.5:3, c.*=conn")
+    assert [(s.pattern, s.kind) for s in specs] == [
+        ("a.b", "io"), ("c.*", "conn")
+    ]
+    assert specs[0].probability == 0.5 and specs[0].remaining == 3
+    assert specs[1].probability == 1.0 and specs[1].remaining is None
+    for bad in ("x", "a=nope", "a=io:2.0", "a=io:0.5:-1", "a=io:1:1:1"):
+        with pytest.raises(ValueError):
+            parse_failpoints(bad)
+    with pytest.raises(ValueError):
+        with knobs.override_failpoints("malformed-spec"):
+            pass
+
+
+def test_failpoint_count_and_glob_and_counter():
+    fired_before = obs.counter(obs.RESILIENCE_FAILPOINTS_FIRED).value
+    with knobs.override_failpoints("storage.fs.*=eagain::2"):
+        with pytest.raises(OSError):
+            fp_mod.failpoint("storage.fs.write")
+        with pytest.raises(OSError):
+            fp_mod.failpoint("storage.fs.read")
+        fp_mod.failpoint("storage.fs.write")  # count exhausted
+        fp_mod.failpoint("storage.gcs.write")  # no match
+    assert (
+        obs.counter(obs.RESILIENCE_FAILPOINTS_FIRED).value - fired_before
+        == 2
+    )
+
+
+def test_failpoint_probability_deterministic_per_seed():
+    def draw_schedule():
+        hits = []
+        with knobs.override_failpoints("site.p=io:0.5"):
+            for i in range(32):
+                try:
+                    fp_mod.failpoint("site.p")
+                    hits.append(0)
+                except OSError:
+                    hits.append(1)
+        return hits
+
+    a = draw_schedule()
+    b = draw_schedule()
+    assert a == b  # same seed + spec -> identical schedule
+    assert 0 < sum(a) < 32  # actually probabilistic
+    with knobs.override_failpoint_seed(1234):
+        c = draw_schedule()
+    assert c != a  # a different seed moves the schedule
+
+
+# ---------------------------------------------------------- retry engine
+
+
+def test_retry_transient_then_success_counts_retries():
+    progress = SharedProgress(window_s=60.0, label="t1")
+
+    async def no_sleep(attempt):
+        return None
+
+    progress.backoff = no_sleep
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    before = obs.counter(obs.RESILIENCE_RETRIES).value
+
+    async def go():
+        return await retry_call(
+            flaky,
+            op_name="op",
+            backend="testbk",
+            classify=lambda e: TRANSIENT,
+            progress=progress,
+        )
+
+    assert run(go()) == "ok"
+    assert calls["n"] == 3
+    assert obs.counter(obs.RESILIENCE_RETRIES).value - before == 2
+    assert obs.counter("resilience.testbk.retries").value >= 2
+
+
+def test_retry_fatal_raises_original_immediately():
+    progress = SharedProgress(window_s=60.0, label="t2")
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise ValueError("fatal thing")
+
+    async def go():
+        await retry_call(
+            boom,
+            op_name="op",
+            backend="testbk",
+            classify=lambda e: FATAL,
+            progress=progress,
+        )
+
+    with pytest.raises(ValueError, match="fatal thing"):
+        run(go())
+    assert calls["n"] == 1
+
+
+def test_retry_missing_maps_to_fnf_with_cause():
+    progress = SharedProgress(window_s=60.0, label="t3")
+
+    class Gone(Exception):
+        pass
+
+    def missing():
+        raise Gone("object vanished")
+
+    async def go():
+        await retry_call(
+            missing,
+            op_name="read x",
+            backend="testbk",
+            classify=lambda e: MISSING,
+            progress=progress,
+        )
+
+    with pytest.raises(FileNotFoundError, match="read x") as ei:
+        run(go())
+    assert isinstance(ei.value.__cause__, Gone)  # original context kept
+
+
+def test_retry_exhaustion_raises_original_error():
+    progress = SharedProgress(window_s=60.0, max_attempts=2, label="t4")
+
+    async def no_sleep(attempt):
+        return None
+
+    progress.backoff = no_sleep
+
+    def always():
+        raise ConnectionError("still down")
+
+    async def go():
+        await retry_call(
+            always,
+            op_name="op",
+            backend="testbk",
+            classify=lambda e: TRANSIENT,
+            progress=progress,
+        )
+
+    with pytest.raises(ConnectionError, match="still down"):
+        run(go())
+
+
+def test_shared_progress_deterministic_jitter():
+    a = SharedProgress(label="same")
+    b = SharedProgress(label="same")
+    assert [a.backoff_delay(i) for i in range(4)] == [
+        b.backoff_delay(i) for i in range(4)
+    ]
+    c = SharedProgress(label="other")
+    assert [a.backoff_delay(i) for i in range(4)] != [
+        c.backoff_delay(i) for i in range(4)
+    ]
+
+
+# ---------------------------------------------------------- classifiers
+
+
+def test_classify_fs_eintr_eagain_transient_rest_fatal():
+    import errno
+
+    assert classify_fs(OSError(errno.EINTR, "x")) == TRANSIENT
+    assert classify_fs(OSError(errno.EAGAIN, "x")) == TRANSIENT
+    assert classify_fs(OSError(errno.ENOSPC, "x")) == FATAL
+    assert classify_fs(ValueError("x")) == FATAL
+
+
+def test_classify_s3_explicit_categories():
+    assert classify_s3(InjectedClientError("SlowDown", 503, "s")) == TRANSIENT
+    assert classify_s3(InjectedClientError("InternalError", 500, "s")) == (
+        TRANSIENT
+    )
+    assert classify_s3(ConnectionError()) == TRANSIENT
+    assert classify_s3(TimeoutError()) == TRANSIENT
+
+    class NoSuchKey(Exception):
+        response = {"Error": {"Code": "NoSuchKey"}}
+
+    assert classify_s3(NoSuchKey()) == MISSING
+
+    class AccessDenied(Exception):
+        response = {
+            "Error": {"Code": "AccessDenied"},
+            "ResponseMetadata": {"HTTPStatusCode": 403},
+        }
+
+    assert classify_s3(AccessDenied()) == FATAL
+
+
+# -------------------------------------------------------------- breaker
+
+
+def test_breaker_trips_half_opens_and_recloses():
+    b = CircuitBreaker("unit-test", threshold=3, cooldown_s=0.1)
+    assert b.state == "closed"
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == "closed"  # under threshold
+    b.record_success()  # success resets the streak
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"
+    with pytest.raises(CircuitOpenError):
+        b.check("write x")
+    import time
+
+    time.sleep(0.15)
+    assert b.state == "half_open"
+    assert b.allow() is True  # one probe
+    assert b.allow() is False  # second concurrent probe refused
+    b.record_failure()  # probe failed -> re-open
+    assert b.state == "open"
+    time.sleep(0.15)
+    assert b.allow() is True
+    b.record_success()
+    assert b.state == "closed"
+    assert b.allow() is True
+
+
+def test_breaker_trip_counts_and_gauge():
+    trips_before = obs.counter(obs.RESILIENCE_BREAKER_TRIPS).value
+    b = CircuitBreaker("unit-gauge", threshold=1, cooldown_s=30.0)
+    b.record_failure()
+    assert obs.counter(obs.RESILIENCE_BREAKER_TRIPS).value == trips_before + 1
+    assert obs.gauge("resilience.breaker_state.unit-gauge").value == 2
+
+
+# ------------------------------------- satellite: fs partial-write fix
+
+
+def test_fs_mid_write_failure_leaves_no_partial_file(tmp_path):
+    """ENOSPC firing after bytes hit the temp file must leave neither a
+    partial object at the final name nor a leaked temp file."""
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+    plugin = FSStoragePlugin(str(tmp_path))
+    run(plugin.write(WriteIO(path="a/keep", buf=b"intact")))
+    with knobs.override_failpoints("storage.fs.write.sync=enospc"):
+        with pytest.raises(OSError):
+            run(plugin.write(WriteIO(path="a/torn", buf=b"x" * 4096)))
+    assert not os.path.exists(tmp_path / "a" / "torn")
+    assert glob.glob(str(tmp_path / "a" / "*tsnp-tmp*")) == []
+    # the failure didn't corrupt the neighbor, and the path is reusable
+    run(plugin.write(WriteIO(path="a/torn", buf=b"second try")))
+    io_ = ReadIO(path="a/torn")
+    run(plugin.read(io_))
+    assert bytes(io_.buf) == b"second try"
+    io_ = ReadIO(path="a/keep")
+    run(plugin.read(io_))
+    assert bytes(io_.buf) == b"intact"
+
+
+def test_fs_write_transient_eintr_retries_to_success(tmp_path):
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+    plugin = FSStoragePlugin(str(tmp_path))
+    before = obs.counter("resilience.fs.retries").value
+    with knobs.override_failpoints("storage.fs.write=eintr::2"), \
+            knobs.override_retry_backoff_cap_s(0.01):
+        run(plugin.write(WriteIO(path="obj", buf=b"payload")))
+    assert obs.counter("resilience.fs.retries").value - before == 2
+    io_ = ReadIO(path="obj")
+    run(plugin.read(io_))
+    assert bytes(io_.buf) == b"payload"
+
+
+# --------------------------- satellite: s3 transient classification
+
+
+def _make_s3_plugin(client):
+    from concurrent.futures import ThreadPoolExecutor
+
+    from torchsnapshot_tpu.storage.s3 import S3StoragePlugin
+
+    p = S3StoragePlugin.__new__(S3StoragePlugin)
+    p.bucket = "bkt"
+    p.prefix = "run"
+    p._backend = client
+    p._is_fs = False
+    p._executor = ThreadPoolExecutor(max_workers=2)
+    p._progress = SharedProgress(window_s=60.0, label="s3test")
+
+    async def no_sleep(attempt):
+        return None
+
+    p._progress.backoff = no_sleep
+    return p
+
+
+class _SlowDown(Exception):
+    """ClientError-shaped transient throttle."""
+
+    response = {"Error": {"Code": "SlowDown"}}
+
+
+class _Http500(Exception):
+    response = {
+        "Error": {"Code": "InternalError"},
+        "ResponseMetadata": {"HTTPStatusCode": 500},
+    }
+
+
+class _FlakyThenOkClient:
+    """get_object raises SlowDown twice, then serves."""
+
+    def __init__(self, fail_times=2, exc_cls=_SlowDown):
+        self.gets = 0
+        self.fail_times = fail_times
+        self.exc_cls = exc_cls
+
+    def get_object(self, Bucket, Key):
+        self.gets += 1
+        if self.gets <= self.fail_times:
+            raise self.exc_cls(f"throttled {Key}")
+
+        class Body:
+            @staticmethod
+            def read():
+                return b"recovered"
+
+        return {"Body": Body}
+
+
+def test_s3_read_retries_slowdown_then_succeeds():
+    client = _FlakyThenOkClient(fail_times=2)
+    p = _make_s3_plugin(client)
+    before = obs.counter("resilience.s3.retries").value
+    io_ = ReadIO(path="obj")
+    run(p.read(io_))
+    assert bytes(io_.buf) == b"recovered"
+    assert client.gets == 3
+    assert obs.counter("resilience.s3.retries").value - before == 2
+
+
+def test_s3_read_transient_500_exhausts_as_itself_not_fnf():
+    """A persistent 500 must surface as the ORIGINAL error after the
+    retry budget — never as a FileNotFoundError with the context lost
+    (the pre-fix behavior of _raise_missing_as_fnf)."""
+    client = _FlakyThenOkClient(fail_times=10**9, exc_cls=_Http500)
+    p = _make_s3_plugin(client)
+    p._progress.max_attempts = 2
+    with pytest.raises(_Http500):
+        run(p.read(ReadIO(path="obj")))
+    assert client.gets > 1  # it DID retry before surfacing
+
+
+def test_s3_read_missing_still_maps_to_fnf():
+    class _Client:
+        def get_object(self, Bucket, Key):
+            raise type(
+                "NoSuchKey", (Exception,),
+                {"response": {"Error": {"Code": "NoSuchKey"}}},
+            )(Key)
+
+    p = _make_s3_plugin(_Client())
+    with pytest.raises(FileNotFoundError, match="s3://bkt/run/nope"):
+        run(p.read(ReadIO(path="nope")))
+
+
+def test_s3_write_fatal_error_raises_original():
+    class _Denied(Exception):
+        response = {
+            "Error": {"Code": "AccessDenied"},
+            "ResponseMetadata": {"HTTPStatusCode": 403},
+        }
+
+    class _Client:
+        def __init__(self):
+            self.puts = 0
+
+        def put_object(self, Bucket, Key, Body):
+            self.puts += 1
+            raise _Denied(Key)
+
+    client = _Client()
+    p = _make_s3_plugin(client)
+    with pytest.raises(_Denied):
+        run(p.write(WriteIO(path="obj", buf=b"x")))
+    assert client.puts == 1  # fatal: no retry burned
+
+
+# ------------------------------------------------ abort error surface
+
+
+def test_snapshot_aborted_error_names_origin_and_cause():
+    from torchsnapshot_tpu.resilience import AbortInfo, decode_poison, encode_poison
+
+    info = AbortInfo(origin_rank=3, cause="OSError('disk')", site="take/rank3")
+    err = SnapshotAbortedError(info, scope="commit/7")
+    msg = str(err)
+    assert "rank 3" in msg and "OSError('disk')" in msg and "commit/7" in msg
+    assert decode_poison(encode_poison(info)) == info
+    # garbled poison still aborts, with an opaque cause
+    assert decode_poison("{not json").origin_rank == -1

@@ -1,7 +1,8 @@
 """snaplint — pass-based AST static analysis for this repo.
 
-``python -m tools.lint`` runs five passes repo-wide (collective-safety,
-lock-discipline, exception-hygiene, knob-registry, instrumentation)
+``python -m tools.lint`` runs six passes repo-wide (collective-safety,
+lock-discipline, exception-hygiene, knob-registry, retry-discipline,
+instrumentation)
 with a per-pass allowlist requiring written justifications and a
 ``baseline.json`` ratchet (legacy finding counts may only decrease).
 See docs/static_analysis.md and tools/lint/core.py.

@@ -32,6 +32,19 @@ ALLOWLIST: Tuple[Allow, ...] = (
         ),
     ),
     Allow(
+        pass_id="retry-discipline",
+        file="torchsnapshot_tpu/coordination.py",
+        context="FileCoordinator._kv_get_impl",
+        justification=(
+            "This loop IS the blocking-get KV primitive itself — a "
+            "fixed-interval existence poll of a shared-filesystem key, "
+            "not a backoff retry of a fallible op.  resilience.retry "
+            "wraps ops that FAIL transiently; a not-yet-written key is "
+            "the wait's normal pending state, and abort-awareness for "
+            "this wait is layered above it in Coordinator.kv_get."
+        ),
+    ),
+    Allow(
         pass_id="exception-hygiene",
         file="bench.py",
         context="run_child",

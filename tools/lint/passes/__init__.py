@@ -11,11 +11,13 @@ from .exception_hygiene import ExceptionHygienePass
 from .instrumentation import InstrumentationPass
 from .knob_registry import KnobRegistryPass
 from .lock_discipline import LockDisciplinePass
+from .retry_discipline import RetryDisciplinePass
 
 ALL_PASSES: Tuple[LintPass, ...] = (
     CollectiveSafetyPass(),
     LockDisciplinePass(),
     ExceptionHygienePass(),
     KnobRegistryPass(),
+    RetryDisciplinePass(),
     InstrumentationPass(),
 )

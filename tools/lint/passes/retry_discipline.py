@@ -1,0 +1,109 @@
+"""retry-discipline: storage/KV retry loops go through resilience.retry.
+
+The package has exactly one sanctioned retry/backoff implementation —
+``torchsnapshot_tpu/resilience/retry.py`` (shared-progress window,
+deterministic jitter, retry metrics, circuit-breaker feed).  A
+hand-rolled ``while ...: op(); time.sleep(...)`` loop elsewhere forks
+that policy: its backoff is invisible to the ``resilience.retries``
+counters and the backoff-delay histogram, ignores the collective-
+progress window, never trips the breaker, and silently diverges from
+the documented knobs.
+
+Flagged shape: a ``while``/``for`` loop (sync or async) whose own body
+— nested def/class/lambda scopes excluded — contains BOTH a ``sleep``
+call (``time.sleep``, ``asyncio.sleep``) and a storage/KV-flavored call
+(``kv_get``/``kv_set``/``barrier``, plugin ``write``/``read``/``stat``/
+``delete``/``sync_*``, raw client verbs like ``put_object``/
+``download_as_bytes``, or ``open``).  Scoped to the
+``torchsnapshot_tpu`` package; ``resilience/`` itself is exempt (it IS
+the retry module).  When loops nest, only the innermost qualifying loop
+is reported.
+
+Ships with an empty baseline: fix by routing through
+``resilience.retry_call`` (or allowlist with a written justification
+when the loop IS a sanctioned primitive, e.g. a coordinator's own KV
+poll)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileUnit, Finding, LintPass, call_name, calls_in_body
+
+_PKG_PREFIX = "torchsnapshot_tpu/"
+_EXEMPT_PREFIX = "torchsnapshot_tpu/resilience/"
+
+_LOOP_NODES = (ast.While, ast.For, ast.AsyncFor)
+
+# Trailing call names that read as storage/KV traffic.  Generic verbs
+# (write/read/open/...) are deliberately included: the co-occurrence
+# with a sleep inside one loop body is the narrowing filter, and a
+# sleep-polling loop over ANY I/O belongs in the retry module.
+_OP_NAMES = frozenset(
+    {
+        # coordinator KV surface
+        "kv_get", "kv_set", "kv_try_get", "kv_exchange", "barrier",
+        "blocking_key_value_get", "key_value_set", "wait_at_barrier",
+        # StoragePlugin surface (async + sync wrappers)
+        "write", "read", "stat", "delete", "link_from",
+        "sync_write", "sync_read", "sync_stat", "sync_delete",
+        # raw client verbs the plugins drive
+        "put_object", "get_object", "head_object", "delete_object",
+        "upload_from_file", "download_as_bytes", "compose",
+        "copy_object", "copy_blob", "cat_file", "pipe", "rm_file",
+        # local filesystem
+        "open",
+    }
+)
+
+
+class RetryDisciplinePass(LintPass):
+    pass_id = "retry-discipline"
+    description = (
+        "sleep-backoff retry loops around storage/KV ops must route "
+        "through resilience.retry"
+    )
+
+    def run(self, unit: FileUnit) -> Iterable[Finding]:
+        if not unit.relpath.startswith(_PKG_PREFIX):
+            return []
+        if unit.relpath.startswith(_EXEMPT_PREFIX):
+            return []
+        flagged: List[ast.AST] = []
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, _LOOP_NODES):
+                continue
+            has_sleep = False
+            op_name = None
+            for call in calls_in_body(node):
+                name = call_name(call)
+                if name == "sleep":
+                    has_sleep = True
+                elif op_name is None and name in _OP_NAMES:
+                    op_name = name
+            if has_sleep and op_name is not None:
+                flagged.append((node, op_name))
+        # innermost-only: a loop whose descendant loop already reports
+        # would double-count one retry site
+        inner_nodes = [n for n, _ in flagged]
+        out: List[Finding] = []
+        for node, op_name in flagged:
+            has_flagged_descendant = any(
+                other is not node and node in set(unit.ancestors(other))
+                for other in inner_nodes
+            )
+            if has_flagged_descendant:
+                continue
+            out.append(
+                self.finding(
+                    unit,
+                    node,
+                    f"retry/poll loop sleeps around storage/KV op "
+                    f"{op_name!r} — route it through "
+                    f"resilience.retry_call (shared backoff window, "
+                    f"retry metrics, circuit breaker) instead of a "
+                    f"hand-rolled sleep loop",
+                )
+            )
+        return out

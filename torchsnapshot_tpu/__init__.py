@@ -15,7 +15,7 @@ facebookresearch/torchsnapshot, re-designed for TPU/XLA:
 - random access to individual snapshot objects under a memory budget.
 """
 
-from . import knobs, obs  # noqa: F401
+from . import knobs, obs, resilience  # noqa: F401
 from .coordination import (  # noqa: F401
     Coordinator,
     FileCoordinator,
@@ -31,6 +31,7 @@ from .tier import (  # noqa: F401
     TieredStoragePlugin,
     drain_promotions,
 )
+from .resilience import SnapshotAbortedError  # noqa: F401
 from .verify import VerifyResult, verify_snapshot  # noqa: F401
 from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
 from .stateful import (  # noqa: F401
@@ -51,8 +52,10 @@ __all__ = [
     "TierConfig",
     "TieredStoragePlugin",
     "drain_promotions",
+    "SnapshotAbortedError",
     "VerifyResult",
     "verify_snapshot",
+    "resilience",
     "Stateful",
     "StateDict",
     "PyTreeState",

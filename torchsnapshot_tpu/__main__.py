@@ -477,7 +477,8 @@ def main(argv=None) -> int:
         "lint",
         help="run the snaplint static-analysis suite over this repo "
         "checkout (collective-safety, lock-discipline, "
-        "exception-hygiene, knob-registry, instrumentation); all "
+        "exception-hygiene, knob-registry, retry-discipline, "
+        "instrumentation); all "
         "arguments are forwarded to `python -m tools.lint` "
         "(e.g. --json, --list-passes, --pass exception-hygiene)",
     )

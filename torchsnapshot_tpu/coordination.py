@@ -23,21 +23,51 @@ barrier), so the three backends share the same semantics by construction.
 from __future__ import annotations
 
 import abc
+import contextlib
+import logging
 import os
+import threading
 import time
 import uuid
 from base64 import b64decode, b64encode
-from typing import Any, List, Optional
+from typing import Any, Iterator, List, Optional
 
 from . import obs
+from .resilience import abort as _abort
+from .resilience.failpoints import failpoint
 from .serialization import deserialize_object, serialize_object
 
+logger = logging.getLogger(__name__)
+
 _DEFAULT_TIMEOUT_S = 600.0
+# abort-aware waits poll the poison key at this cadence: a peer's abort
+# surfaces within ~this interval instead of the full wait timeout
+_ABORT_POLL_S = 0.5
+
+
+def _is_timeoutish(e: BaseException) -> bool:
+    """Did a bounded KV wait merely time out (vs. fail)?  Covers the
+    builtin TimeoutError (FileCoordinator) and the jax coordination
+    client's DEADLINE_EXCEEDED XlaRuntimeError."""
+    if isinstance(e, TimeoutError):
+        return True
+    name = type(e).__name__
+    r = repr(e).upper()
+    return "Timeout" in name or "DEADLINE_EXCEEDED" in r or "DEADLINE" in r
 
 
 class Coordinator(abc.ABC):
     """Uniform control-plane interface (reference PGWrapper,
-    pg_wrapper.py:17-91)."""
+    pg_wrapper.py:17-91).
+
+    Beyond the KV/barrier primitives, the base class carries the
+    cross-rank ABORT protocol (resilience/abort.py): ``poison(scope,
+    cause)`` broadcasts an abort under one KV key, and inside an
+    ``abort_scope(scope)`` every ``kv_get``/``barrier`` wait polls that
+    key — a peer's unrecoverable failure surfaces as a typed
+    ``SnapshotAbortedError`` within seconds instead of wedging the rank
+    until the wait timeout.  The scope is per-thread (a background
+    promotion thread's scope never leaks onto the foreground take)."""
 
     @property
     @abc.abstractmethod
@@ -48,11 +78,10 @@ class Coordinator(abc.ABC):
     def world_size(self) -> int: ...
 
     @abc.abstractmethod
-    def kv_set(self, key: str, value: str) -> None: ...
+    def _kv_set_impl(self, key: str, value: str) -> None: ...
 
     @abc.abstractmethod
-    def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
-        """Blocking get: waits until the key exists."""
+    def _kv_get_impl(self, key: str, timeout_s: float) -> str: ...
 
     @abc.abstractmethod
     def kv_try_get(self, key: str) -> Optional[str]: ...
@@ -60,14 +89,124 @@ class Coordinator(abc.ABC):
     @abc.abstractmethod
     def _barrier_impl(self, name: str, timeout_s: float) -> None: ...
 
+    def kv_set(self, key: str, value: str) -> None:
+        failpoint("coord.kv_set", key=key)
+        self._kv_set_impl(key, value)
+
+    def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
+        """Blocking get: waits until the key exists.  Abort-aware inside
+        an ``abort_scope``."""
+        failpoint("coord.kv_get", key=key)
+        scope = self._current_abort_scope()
+        if scope is None:
+            return self._kv_get_impl(key, timeout_s)
+        return self._abortable_kv_get(key, timeout_s, scope)
+
     def barrier(
         self, name: Optional[str] = None, timeout_s: float = _DEFAULT_TIMEOUT_S
     ) -> None:
         """Barrier; auto-names from the per-instance op counter when no name
         is given (coordination calls happen in identical program order on
         every rank).  Explicit names must be globally unique per use — JAX
-        barrier ids are single-use."""
-        self._barrier_impl(name or self._next_uid("bar"), timeout_s)
+        barrier ids are single-use.  Abort-aware inside an ``abort_scope``:
+        runs as a two-phase KV barrier over the abort-aware ``kv_get``
+        (the native barrier wait is opaque and can't poll poison)."""
+        name = name or self._next_uid("bar")
+        failpoint("coord.barrier", name=name)
+        scope = self._current_abort_scope()
+        if scope is None:
+            self._barrier_impl(name, timeout_s)
+            return
+        self.raise_if_poisoned(scope)
+        if self.world_size == 1:
+            return
+        # one deadline for the WHOLE barrier (matching the native
+        # implementation's bound) — not timeout_s per arrive key
+        deadline = time.monotonic() + timeout_s
+        self._kv_set_impl(f"{name}/aa/arrive/{self.rank}", "1")
+        if self.rank == 0:
+            for r in range(self.world_size):
+                self.kv_get(
+                    f"{name}/aa/arrive/{r}",
+                    max(0.0, deadline - time.monotonic()),
+                )
+            self._kv_set_impl(f"{name}/aa/depart", "1")
+        else:
+            self.kv_get(
+                f"{name}/aa/depart", max(0.0, deadline - time.monotonic())
+            )
+
+    # ---- cross-rank abort (resilience/abort.py) ------------------------
+
+    def poison(
+        self, scope: str, cause: str, site: str = ""
+    ) -> _abort.AbortInfo:
+        """Broadcast an abort of ``scope``: peers blocked in abort-aware
+        waits raise ``SnapshotAbortedError`` naming this rank and
+        ``cause``.  Never raises — poisoning runs on failure paths and
+        must not mask the original error."""
+        info = _abort.AbortInfo(
+            origin_rank=self.rank, cause=cause, site=site
+        )
+        obs.counter(obs.RESILIENCE_ABORTS).inc()
+        logger.warning(
+            "rank %d poisoning scope %r at %s: %s",
+            self.rank, scope, site or "?", cause,
+        )
+        try:
+            self._kv_set_impl(
+                _abort.poison_key(scope), _abort.encode_poison(info)
+            )
+        except Exception as e:  # noqa: BLE001 — best-effort broadcast
+            obs.swallowed_exception("coordination.poison", e)
+        return info
+
+    def check_poison(self, scope: str) -> Optional[_abort.AbortInfo]:
+        raw = self.kv_try_get(_abort.poison_key(scope))
+        return _abort.decode_poison(raw) if raw else None
+
+    def raise_if_poisoned(self, scope: str) -> None:
+        info = self.check_poison(scope)
+        if info is not None:
+            raise _abort.SnapshotAbortedError(info, scope=scope)
+
+    def _current_abort_scope(self) -> Optional[str]:
+        tls = self.__dict__.get("_abort_tls")
+        return getattr(tls, "scope", None) if tls is not None else None
+
+    @contextlib.contextmanager
+    def abort_scope(self, scope: str) -> Iterator[None]:
+        """While active, this THREAD's kv_get/barrier waits poll
+        ``scope``'s poison key (per-thread on purpose: the async-commit
+        and tier-promotion threads scope their own waits without
+        touching the foreground program order)."""
+        tls = self.__dict__.setdefault("_abort_tls", threading.local())
+        prev = getattr(tls, "scope", None)
+        tls.scope = scope
+        try:
+            yield
+        finally:
+            tls.scope = prev
+
+    def _abortable_kv_get(
+        self, key: str, timeout_s: float, scope: str
+    ) -> str:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self.raise_if_poisoned(scope)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"kv_get timed out waiting for {key!r} "
+                    f"(abort-aware, scope {scope!r})"
+                )
+            try:
+                return self._kv_get_impl(
+                    key, min(_ABORT_POLL_S, remaining)
+                )
+            except Exception as e:  # noqa: BLE001 — timeouts poll on
+                if not _is_timeoutish(e):
+                    raise
 
     # ---- derived object-level ops --------------------------------------
 
@@ -148,10 +287,10 @@ class LocalCoordinator(Coordinator):
     def world_size(self) -> int:
         return 1
 
-    def kv_set(self, key: str, value: str) -> None:
+    def _kv_set_impl(self, key: str, value: str) -> None:
         self._kv[key] = value
 
-    def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
+    def _kv_get_impl(self, key: str, timeout_s: float) -> str:
         return self._kv[key]
 
     def kv_try_get(self, key: str) -> Optional[str]:
@@ -195,12 +334,12 @@ class JaxCoordinator(Coordinator):
     def _k(self, key: str) -> str:
         return f"{self._ns}/{key}"
 
-    def kv_set(self, key: str, value: str) -> None:
+    def _kv_set_impl(self, key: str, value: str) -> None:
         self._client.key_value_set(self._k(key), value)
 
-    def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
+    def _kv_get_impl(self, key: str, timeout_s: float) -> str:
         return self._client.blocking_key_value_get(
-            self._k(key), int(timeout_s * 1000)
+            self._k(key), max(1, int(timeout_s * 1000))
         )
 
     def kv_try_get(self, key: str) -> Optional[str]:
@@ -234,14 +373,14 @@ class FileCoordinator(Coordinator):
     def _path(self, key: str) -> str:
         return os.path.join(self.root, key.replace("/", "%2F"))
 
-    def kv_set(self, key: str, value: str) -> None:
+    def _kv_set_impl(self, key: str, value: str) -> None:
         path = self._path(key)
         tmp = path + f".tmp.{uuid.uuid4().hex}"
         with open(tmp, "w") as f:
             f.write(value)
         os.replace(tmp, path)
 
-    def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
+    def _kv_get_impl(self, key: str, timeout_s: float) -> str:
         deadline = time.monotonic() + timeout_s
         path = self._path(key)
         while True:

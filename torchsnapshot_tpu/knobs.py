@@ -38,6 +38,13 @@ _VERIFY_ON_RESTORE = "VERIFY_ON_RESTORE"
 _DEVICE_UNPACK = "DEVICE_UNPACK"
 _RESTORE_DONATE = "RESTORE_DONATE"
 _TRACE = "TRACE"
+_FAILPOINTS = "FAILPOINTS"
+_FAILPOINT_SEED = "FAILPOINT_SEED"
+_RETRY_MAX_ATTEMPTS = "RETRY_MAX_ATTEMPTS"
+_RETRY_PROGRESS_WINDOW_S = "RETRY_PROGRESS_WINDOW_S"
+_RETRY_BACKOFF_CAP_S = "RETRY_BACKOFF_CAP_S"
+_BREAKER_THRESHOLD = "BREAKER_THRESHOLD"
+_BREAKER_COOLDOWN_S = "BREAKER_COOLDOWN_S"
 _S3_ENDPOINT_URL = "S3_ENDPOINT_URL"
 _TIER_POLICY = "TIER_POLICY"
 _TIER_FAST_KEEP_LAST_N = "TIER_FAST_KEEP_LAST_N"
@@ -151,6 +158,33 @@ _DEFAULTS = {
     # obs.refresh_enabled() after mutating it); gate runtime decisions
     # on obs.tracing_enabled(), which reports what is actually recorded.
     _TRACE: 0,
+    # Deterministic fault injection (resilience/failpoints.py):
+    # "site=error[:prob[:count]],..." specs, e.g.
+    # "storage.s3.write=slowdown:1:2".  Empty = disarmed (the default;
+    # the armed check is one module-global load).  Like TRACE, this is
+    # resolved into the failpoint module's armed set at import and by
+    # override_failpoints — set the env var BEFORE importing.
+    _FAILPOINTS: "",
+    # Seed for the per-spec RNG streams probabilistic failpoints draw
+    # from — the same spec + seed replays the same schedule.
+    _FAILPOINT_SEED: 0,
+    # Shared retry policy (resilience/retry.py): per-op attempt cap and
+    # the collective-progress window — an op only gives up when the
+    # WHOLE pipeline has made no progress for the window (any completion
+    # anywhere refreshes the shared clock).  Values match the GCS
+    # plugin's historical constants; all retrying backends (fs, s3,
+    # gcs, memory) now share them.
+    _RETRY_MAX_ATTEMPTS: 6,
+    _RETRY_PROGRESS_WINDOW_S: 120.0,
+    # Exponential backoff cap: delay = min(2**attempt, cap) * jitter.
+    _RETRY_BACKOFF_CAP_S: 32.0,
+    # Circuit breaker (resilience/breaker.py): consecutive COMPLETED
+    # failures (retries exhausted) before a backend trips open, and how
+    # long it stays open before a half-open probe is allowed.  Tripped
+    # writes fail fast (CircuitOpenError); tiered reads route straight
+    # to the replica/durable fallback.
+    _BREAKER_THRESHOLD: 5,
+    _BREAKER_COOLDOWN_S: 30.0,
     # Alternate S3 endpoint (minio, localstack, any S3-compatible
     # store) for the s3:// plugin.  None/"" = AWS default.  Env-based
     # so snapshot-level s3:// URLs resolve against the emulator too
@@ -347,6 +381,34 @@ def is_trace_enabled() -> bool:
     return bool(_get_int(_TRACE))
 
 
+def get_failpoints() -> str:
+    return str(_get_raw(_FAILPOINTS) or "")
+
+
+def get_failpoint_seed() -> int:
+    return _get_int(_FAILPOINT_SEED)
+
+
+def get_retry_max_attempts() -> int:
+    return max(1, _get_int(_RETRY_MAX_ATTEMPTS))
+
+
+def get_retry_progress_window_s() -> float:
+    return float(_get_raw(_RETRY_PROGRESS_WINDOW_S))
+
+
+def get_retry_backoff_cap_s() -> float:
+    return float(_get_raw(_RETRY_BACKOFF_CAP_S))
+
+
+def get_breaker_threshold() -> int:
+    return max(1, _get_int(_BREAKER_THRESHOLD))
+
+
+def get_breaker_cooldown_s() -> float:
+    return float(_get_raw(_BREAKER_COOLDOWN_S))
+
+
 def get_s3_endpoint_url() -> Optional[str]:
     """Alternate S3 endpoint, or None for the AWS default.  Resolution:
     override → TORCHSNAPSHOT_TPU_S3_ENDPOINT_URL → the pre-knob legacy
@@ -530,6 +592,47 @@ def override_tier_fast_keep_last_n(value: int):
 
 def override_tier_verify_fast_reads(value: bool):
     return _override(_TIER_VERIFY_FAST_READS, int(value))
+
+
+def override_failpoint_seed(value: int):
+    return _override(_FAILPOINT_SEED, value)
+
+
+def override_retry_max_attempts(value: int):
+    return _override(_RETRY_MAX_ATTEMPTS, value)
+
+
+def override_retry_progress_window_s(value: float):
+    return _override(_RETRY_PROGRESS_WINDOW_S, value)
+
+
+def override_retry_backoff_cap_s(value: float):
+    return _override(_RETRY_BACKOFF_CAP_S, value)
+
+
+def override_breaker_threshold(value: int):
+    return _override(_BREAKER_THRESHOLD, value)
+
+
+def override_breaker_cooldown_s(value: float):
+    return _override(_BREAKER_COOLDOWN_S, value)
+
+
+@contextlib.contextmanager
+def override_failpoints(value: str) -> Iterator[None]:
+    """Override FAILPOINTS and re-arm the failpoint module on entry AND
+    exit (the armed set is the zero-cost disarmed-path check, so it must
+    track the knob rather than re-resolve per call site).  Malformed
+    specs raise here — a test's typo'd schedule must fail loudly, not
+    silently run fault-free."""
+    from .resilience import failpoints as _failpoints
+
+    try:
+        with _override(_FAILPOINTS, value or ""):
+            _failpoints.refresh_from_knobs(strict=True)
+            yield
+    finally:
+        _failpoints.refresh_from_knobs(strict=False)
 
 
 @contextlib.contextmanager

@@ -40,6 +40,11 @@ from .metrics import (  # noqa: F401
     LATENCY_BUCKETS_S,
     PROMOTION_LAG_S,
     REGISTRY,
+    RESILIENCE_ABORTS,
+    RESILIENCE_BACKOFF_DELAY_S,
+    RESILIENCE_BREAKER_TRIPS,
+    RESILIENCE_FAILPOINTS_FIRED,
+    RESILIENCE_RETRIES,
     RSS_PEAK_DELTA_BYTES,
     SLABS_PACKED,
     TIER_FAST_CORRUPT,

@@ -68,6 +68,17 @@ BYTES_REPLICATED = "tier.bytes_replicated"
 PROMOTION_LAG_S = "tier.promotion_lag_s"
 # GC/retention: bytes of storage objects reclaimed by delete_snapshot
 GC_BYTES_RECLAIMED = "snapshot.gc.bytes_reclaimed"
+# Resilience (resilience/): transient-error retries (total, plus
+# per-backend twins named resilience.<backend>.retries), cross-rank
+# aborts initiated via the poison protocol, deterministic failpoint
+# fires, circuit-breaker trips (closed->open transitions; per-backend
+# state gauges are named resilience.breaker_state.<backend>: 0 closed,
+# 1 half-open, 2 open), and the backoff-delay histogram.
+RESILIENCE_RETRIES = "resilience.retries"
+RESILIENCE_ABORTS = "resilience.aborts"
+RESILIENCE_FAILPOINTS_FIRED = "resilience.failpoints_fired"
+RESILIENCE_BREAKER_TRIPS = "resilience.breaker_trips"
+RESILIENCE_BACKOFF_DELAY_S = "resilience.backoff_delay_s"
 # Exception hygiene (tools/lint exception-hygiene pass): every
 # deliberate broad-except swallow on a fallback path increments this
 # via obs.swallowed_exception, so "how often are we falling back" is a

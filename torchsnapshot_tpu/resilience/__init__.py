@@ -1,0 +1,87 @@
+"""Resilience layer: failure injection, unified retry, cross-rank
+abort, and per-backend circuit breaking.
+
+Four cooperating pieces (docs/resilience.md):
+
+- **failpoints** — a deterministic, seedable fault-injection registry
+  threaded through the storage plugins, the scheduler's pipelines, the
+  coordinator KV/barrier ops and the tier promoter; armed via the
+  ``TORCHSNAPSHOT_TPU_FAILPOINTS`` knob or
+  ``knobs.override_failpoints``, zero-cost when off.
+- **retry** — one shared retry/backoff policy (shared-progress
+  deadline, exponential backoff with deterministic jitter, per-op
+  attempt caps) with per-backend transient classifiers; extracted from
+  the GCS plugin and now also carrying S3, fs and memory transients.
+- **abort** — the KV poison protocol: a rank hitting an unrecoverable
+  error broadcasts an abort, abort-aware barriers/kv waits raise a
+  typed ``SnapshotAbortedError`` on every rank within seconds, and the
+  durable commit point is never written after poison.
+- **breaker** — per-backend consecutive-failure circuit breakers:
+  tripped writes fail fast (``CircuitOpenError``), tiered reads route
+  to the replica/durable fallback, half-open probes re-close.
+
+Everything emits obs metrics (``resilience.retries``,
+``resilience.aborts``, ``resilience.failpoints_fired``,
+``resilience.breaker_trips``, per-backend breaker-state gauges and a
+backoff-delay histogram) and rides the existing span tracer.
+"""
+
+from __future__ import annotations
+
+from .abort import (  # noqa: F401
+    AbortInfo,
+    SnapshotAbortedError,
+    decode_poison,
+    encode_poison,
+    poison_key,
+)
+from .breaker import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+    get_breaker,
+    reset_breakers,
+)
+from .failpoints import (  # noqa: F401
+    InjectedClientError,
+    failpoint,
+    parse_failpoints,
+    refresh_from_knobs as refresh_failpoints,
+)
+from .retry import (  # noqa: F401
+    FATAL,
+    MISSING,
+    RAISE,
+    SUCCESS_NONE,
+    TRANSIENT,
+    SharedProgress,
+    classify_fs,
+    classify_generic,
+    classify_s3,
+    retry_call,
+)
+
+__all__ = [
+    "AbortInfo",
+    "SnapshotAbortedError",
+    "poison_key",
+    "encode_poison",
+    "decode_poison",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "get_breaker",
+    "reset_breakers",
+    "InjectedClientError",
+    "failpoint",
+    "parse_failpoints",
+    "refresh_failpoints",
+    "SharedProgress",
+    "retry_call",
+    "classify_fs",
+    "classify_s3",
+    "classify_generic",
+    "TRANSIENT",
+    "MISSING",
+    "FATAL",
+    "RAISE",
+    "SUCCESS_NONE",
+]

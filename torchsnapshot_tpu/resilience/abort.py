@@ -1,0 +1,79 @@
+"""Cross-rank abort: the KV "poison" protocol's types and encoding.
+
+When a rank hits an unrecoverable error mid-take/restore/promotion it
+*poisons* the operation's scope — one KV key every peer can see.
+Abort-aware waits (``Coordinator.kv_get``/``barrier`` inside an
+``abort_scope``) poll that key while blocking, so every rank raises a
+typed ``SnapshotAbortedError`` naming the origin rank and cause within
+seconds instead of hanging to the barrier timeout.  The durable-commit
+invariant rides on top: rank 0 re-checks the poison key immediately
+before writing ``.snapshot_metadata``, so a poisoned operation can
+never commit.
+
+This module is deliberately coordination-free (plain types + JSON
+encoding); the protocol itself lives on ``Coordinator``
+(coordination.py) so all three backends share it by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+# poison keys live outside every uid namespace callers generate
+# (commit/N, bar/N, ...): the prefix cannot collide with _next_uid ops
+POISON_PREFIX = "__poison__"
+
+
+def poison_key(scope: str) -> str:
+    return f"{POISON_PREFIX}/{scope}"
+
+
+@dataclasses.dataclass(frozen=True)
+class AbortInfo:
+    """What a poison key carries: who aborted, where, and why."""
+
+    origin_rank: int
+    cause: str
+    site: str = ""
+
+
+class SnapshotAbortedError(RuntimeError):
+    """A distributed snapshot operation was aborted — by this rank (the
+    original error is chained as ``__cause__``) or by a peer (the
+    origin rank and its cause are named here)."""
+
+    def __init__(self, info: AbortInfo, scope: str = "") -> None:
+        self.info = info
+        self.scope = scope
+        super().__init__(
+            f"snapshot operation aborted by rank {info.origin_rank}"
+            + (f" at {info.site}" if info.site else "")
+            + (f" (scope {scope})" if scope else "")
+            + f": {info.cause}"
+        )
+
+
+def encode_poison(info: AbortInfo) -> str:
+    return json.dumps(
+        {
+            "origin_rank": info.origin_rank,
+            "cause": info.cause,
+            "site": info.site,
+        }
+    )
+
+
+def decode_poison(raw: str) -> Optional[AbortInfo]:
+    """Best-effort decode: a torn/garbled poison value still aborts
+    (with an opaque cause) rather than wedging the waiter."""
+    try:
+        d = json.loads(raw)
+        return AbortInfo(
+            origin_rank=int(d.get("origin_rank", -1)),
+            cause=str(d.get("cause", "")),
+            site=str(d.get("site", "")),
+        )
+    except (ValueError, TypeError, AttributeError):
+        return AbortInfo(origin_rank=-1, cause=f"unparseable poison: {raw!r}")

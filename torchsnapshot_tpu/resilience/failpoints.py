@@ -1,0 +1,215 @@
+"""Deterministic, seedable fault injection: the ``failpoint`` registry.
+
+Instrumented sites across the package call ``failpoint("site.name")``;
+when armed, a matching spec raises the configured exception there.  The
+disarmed path is one module-global ``None`` check — the same
+zero-cost-when-off discipline as the obs tracer — so production code
+keeps the calls unconditionally.
+
+Spec grammar (the ``TORCHSNAPSHOT_TPU_FAILPOINTS`` knob, or
+``knobs.override_failpoints`` in tests)::
+
+    site=error[:probability[:count]][,site=error...]
+
+- **site** — an instrumented site name, or an ``fnmatch`` glob over
+  them (``storage.s3.*``).  Sites are listed in docs/resilience.md.
+- **error** — one of the registered kinds below (``eintr``, ``enospc``,
+  ``conn``, ``slowdown``, ...).
+- **probability** — per-evaluation fire chance in (0, 1]; default 1.
+- **count** — maximum number of fires before the spec disarms itself;
+  default unlimited.
+
+Determinism: every spec draws from its own ``random.Random`` seeded
+from ``TORCHSNAPSHOT_TPU_FAILPOINT_SEED`` and the spec text, so a
+probabilistic schedule replays identically regardless of how OTHER
+sites interleave across threads (per-spec streams never share draws).
+Fire counts are lock-guarded — concurrent evaluations can never
+over-fire a bounded spec.
+
+Instrumented sites (kept in sync with docs/resilience.md):
+``storage.{fs,s3,gcs,memory}.{write,read}``, ``storage.fs.write.sync``,
+``scheduler.{stage,write,read}``, ``coord.{kv_set,kv_get,barrier}``,
+``tier.promote.{data,commit}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno as _errno
+import fnmatch
+import logging
+import random
+import threading
+import zlib
+from typing import List, Optional
+
+from .. import knobs, obs
+
+logger = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+# None == disarmed (the zero-cost check in failpoint()); a list of
+# _Armed specs otherwise.
+_ARMED: Optional[List["_Armed"]] = None
+
+
+class InjectedClientError(Exception):
+    """A botocore ClientError-shaped injected failure: carries
+    ``response["Error"]["Code"]`` (and an HTTP status) so the storage
+    plugins' real classification logic runs against it unchanged."""
+
+    def __init__(self, code: str, status: int, site: str) -> None:
+        super().__init__(f"injected {code} at {site}")
+        self.response = {
+            "Error": {"Code": code},
+            "ResponseMetadata": {"HTTPStatusCode": status},
+        }
+
+
+def _oserror(code: int, site: str) -> OSError:
+    # OSError(errno, ...) resolves to the right subclass (ENOENT ->
+    # FileNotFoundError), matching what real syscalls raise
+    return OSError(code, f"injected {_errno.errorcode.get(code, code)}", site)
+
+
+# error kind -> factory(site) -> BaseException
+_ERROR_KINDS = {
+    "io": lambda s: _oserror(_errno.EIO, s),
+    "enospc": lambda s: _oserror(_errno.ENOSPC, s),
+    "eintr": lambda s: _oserror(_errno.EINTR, s),
+    "eagain": lambda s: _oserror(_errno.EAGAIN, s),
+    "fnf": lambda s: _oserror(_errno.ENOENT, s),
+    "conn": lambda s: ConnectionError(f"injected connection error at {s}"),
+    "timeout": lambda s: TimeoutError(f"injected timeout at {s}"),
+    "slowdown": lambda s: InjectedClientError("SlowDown", 503, s),
+    "http500": lambda s: InjectedClientError("InternalError", 500, s),
+    "runtime": lambda s: RuntimeError(f"injected failure at {s}"),
+}
+
+
+@dataclasses.dataclass
+class _Armed:
+    pattern: str
+    kind: str
+    probability: float
+    remaining: Optional[int]  # None == unlimited
+    rng: random.Random
+
+    def matches(self, site: str) -> bool:
+        return site == self.pattern or fnmatch.fnmatchcase(
+            site, self.pattern
+        )
+
+
+def parse_failpoints(spec: str, seed: int = 0) -> List[_Armed]:
+    """Parse a spec string into armed failpoints; raises ``ValueError``
+    on malformed specs (the override path surfaces typos loudly)."""
+    armed: List[_Armed] = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if "=" not in raw:
+            raise ValueError(
+                f"failpoint spec {raw!r} is not site=error[:prob[:count]]"
+            )
+        site, _, rhs = raw.partition("=")
+        parts = rhs.split(":")
+        kind = parts[0].strip().lower()
+        if kind not in _ERROR_KINDS:
+            raise ValueError(
+                f"failpoint spec {raw!r}: unknown error kind {kind!r} "
+                f"(known: {sorted(_ERROR_KINDS)})"
+            )
+        probability = 1.0
+        if len(parts) > 1 and parts[1].strip():
+            probability = float(parts[1])
+            if not 0.0 < probability <= 1.0:
+                raise ValueError(
+                    f"failpoint spec {raw!r}: probability must be in "
+                    f"(0, 1], got {probability}"
+                )
+        remaining: Optional[int] = None
+        if len(parts) > 2 and parts[2].strip() not in ("", "*"):
+            remaining = int(parts[2])
+            if remaining < 0:
+                raise ValueError(
+                    f"failpoint spec {raw!r}: count must be >= 0"
+                )
+        if len(parts) > 3:
+            raise ValueError(f"failpoint spec {raw!r}: too many fields")
+        armed.append(
+            _Armed(
+                pattern=site.strip(),
+                kind=kind,
+                probability=probability,
+                remaining=remaining,
+                # per-spec private stream: deterministic under any
+                # cross-site/thread interleaving, and never touches the
+                # global random state the take-path RNG invariant guards
+                rng=random.Random(seed ^ zlib.crc32(raw.encode())),
+            )
+        )
+    return armed
+
+
+def refresh_from_knobs(strict: bool = False) -> None:
+    """Re-resolve the FAILPOINTS knob into the armed set.  ``strict``
+    (the override path) raises on malformed specs; the import-time call
+    logs and stays disarmed instead — a typo'd env var must not break
+    ``import torchsnapshot_tpu``."""
+    global _ARMED
+    spec = knobs.get_failpoints()
+    if not spec:
+        _ARMED = None
+        return
+    try:
+        armed = parse_failpoints(spec, seed=knobs.get_failpoint_seed())
+    except ValueError:
+        if strict:
+            raise
+        logger.warning(
+            "ignoring malformed TORCHSNAPSHOT_TPU_FAILPOINTS=%r",
+            spec, exc_info=True,
+        )
+        _ARMED = None
+        return
+    _ARMED = armed or None
+
+
+def active() -> bool:
+    return _ARMED is not None
+
+
+def failpoint(site: str, **attrs) -> None:
+    """Evaluate the armed specs at ``site``; raises the configured
+    exception when one fires.  One global ``None`` check when disarmed."""
+    armed = _ARMED
+    if armed is None:
+        return
+    for fp in armed:
+        if not fp.matches(site):
+            continue
+        with _LOCK:
+            if fp.remaining == 0:
+                continue
+            if fp.probability < 1.0 and fp.rng.random() >= fp.probability:
+                continue
+            if fp.remaining is not None:
+                fp.remaining -= 1
+        obs.counter(obs.RESILIENCE_FAILPOINTS_FIRED).inc()
+        exc = _ERROR_KINDS[fp.kind](site)
+        logger.info(
+            "failpoint %s fired at %s (%s): %r", fp.pattern, site, attrs, exc
+        )
+        raise exc
+
+
+def fired_count() -> int:
+    """Total fires since process start (the obs counter's value)."""
+    return obs.counter(obs.RESILIENCE_FAILPOINTS_FIRED).value
+
+
+# arm from the environment at import, mirroring the tracer's ENABLED
+# resolution: the knob is read once here and by override_failpoints
+refresh_from_knobs(strict=False)

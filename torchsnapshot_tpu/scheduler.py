@@ -53,6 +53,7 @@ from .io_types import (
 from .obs import buf_nbytes as _buf_nbytes
 from .obs import metrics as obs_metrics
 from .obs import tracer as obs_tracer
+from .resilience.failpoints import failpoint
 
 logger = logging.getLogger(__name__)
 
@@ -338,6 +339,7 @@ async def _execute_write_pipelines(
         return p
 
     async def _stage_one_inner(p: _WritePipeline) -> _WritePipeline:
+        failpoint("scheduler.stage", path=p.write_req.path)
         p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
         p.buf_size = _buf_nbytes(p.buf)
         wr = p.write_req
@@ -387,6 +389,7 @@ async def _execute_write_pipelines(
             return await _write_one_inner(p)
 
     async def _write_one_inner(p: _WritePipeline) -> _WritePipeline:
+        failpoint("scheduler.write", path=p.write_req.path)
         wr = p.write_req
         if wr.dedup is not None and wr.object_digest == wr.dedup[1]:
             # content unchanged vs the base snapshot: link/server-side
@@ -716,6 +719,7 @@ async def _execute_read_pipelines(
             cost=p.consuming_cost,
             op="read",
         ) as sp:
+            failpoint("scheduler.read", path=p.read_req.path)
             read_io = ReadIO(
                 path=p.read_req.path,
                 byte_range=p.read_req.byte_range,

@@ -54,6 +54,7 @@ from .preparers import (
     prepare_write,
 )
 from .preparers.sharded import is_multi_device_jax_array
+from .resilience import SnapshotAbortedError
 from .serialization import serialize_object
 from .scheduler import (
     PendingIOWork,
@@ -426,38 +427,62 @@ class Snapshot:
                 is_async=False, base=base, leaf_transform=leaf_transform,
                 storage_options=storage_options,
             )
-            pending_io.sync_complete()
-            # tiered storage: replicate fast-tier payloads to peers and
-            # enqueue write-back promotion, strictly after this rank's
-            # writes landed and strictly before the commit barrier (so
-            # the durable commit marker can only ever trail the data)
-            finalize = getattr(storage, "finalize_take", None)
-            if finalize is not None:
-                finalize(coordinator, commit_uid)
-            # content checksums became final when staging finished above;
-            # gather them (foreground path: collectives are fine) and
-            # merge into every rank's metadata copy
-            local_crcs = _crc_payload(local_entries, object_crcs)
-            if coordinator.world_size > 1:
-                crc_maps = coordinator.all_gather_object(local_crcs)
-            else:
-                crc_maps = [local_crcs]
-            _merge_crc_payloads(metadata, crc_maps)
-            # commit: all ranks done writing → rank 0 writes metadata
-            # (reference snapshot.py:202-209)
-            coordinator.barrier()
-            if coordinator.rank == 0:
-                # durable: the commit point must survive a host crash —
-                # a synced metadata file is the definition of "committed"
-                storage.sync_write(
-                    WriteIO(
-                        path=SNAPSHOT_METADATA_FNAME,
-                        buf=metadata.to_yaml().encode(),
-                        durable=True,
-                    )
+            # Abort-aware commit (resilience/abort.py): a rank hitting
+            # an unrecoverable error here poisons the commit scope and
+            # re-raises its ORIGINAL error; peers blocked in the gathers
+            # and barriers below raise a typed SnapshotAbortedError
+            # naming the origin rank within seconds instead of wedging
+            # to the barrier timeout.  Rank 0 re-checks the poison key
+            # immediately before the metadata write, so a poisoned take
+            # can never produce a committed snapshot.
+            try:
+                with coordinator.abort_scope(commit_uid):
+                    pending_io.sync_complete()
+                    # tiered storage: replicate fast-tier payloads to
+                    # peers and enqueue write-back promotion, strictly
+                    # after this rank's writes landed and strictly
+                    # before the commit barrier (so the durable commit
+                    # marker can only ever trail the data)
+                    finalize = getattr(storage, "finalize_take", None)
+                    if finalize is not None:
+                        finalize(coordinator, commit_uid)
+                    # content checksums became final when staging
+                    # finished above; gather them (foreground path:
+                    # collectives are fine) and merge into every rank's
+                    # metadata copy
+                    local_crcs = _crc_payload(local_entries, object_crcs)
+                    if coordinator.world_size > 1:
+                        crc_maps = coordinator.all_gather_object(local_crcs)
+                    else:
+                        crc_maps = [local_crcs]
+                    _merge_crc_payloads(metadata, crc_maps)
+                    # commit: all ranks done writing → rank 0 writes
+                    # metadata (reference snapshot.py:202-209)
+                    coordinator.barrier()
+                    if coordinator.rank == 0:
+                        coordinator.raise_if_poisoned(commit_uid)
+                        # durable: the commit point must survive a host
+                        # crash — a synced metadata file is the
+                        # definition of "committed"
+                        storage.sync_write(
+                            WriteIO(
+                                path=SNAPSHOT_METADATA_FNAME,
+                                buf=metadata.to_yaml().encode(),
+                                durable=True,
+                            )
+                        )
+                    coordinator.barrier()
+            except SnapshotAbortedError:
+                raise
+            except BaseException as e:
+                coordinator.poison(
+                    commit_uid,
+                    cause=repr(e),
+                    site=f"take/rank{coordinator.rank}",
                 )
-            coordinator.barrier()
-            storage.sync_close()
+                raise
+            finally:
+                storage.sync_close()
         snapshot = cls(path, coordinator, storage_options=storage_options)
         snapshot._metadata_cache = metadata
         return snapshot
@@ -539,13 +564,27 @@ class Snapshot:
             for k, v in app_state.items()
             if isinstance(v, RNGState)
         }
+        # The commit uid doubles as the abort scope and is minted BEFORE
+        # planning (same per-instance counter position on every rank),
+        # so even a rank dying in the planning gathers — storage
+        # construction, glob/key/manifest exchanges — poisons a scope
+        # its peers are already watching instead of wedging them.
+        commit_uid = coordinator._next_uid("commit")
         try:
-            return cls._take_impl_inner(
-                path, app_state, replicated, coordinator, is_async,
-                rank, world, rng_states_at_entry, base,
-                leaf_transform=leaf_transform,
-                storage_options=storage_options,
+            with coordinator.abort_scope(commit_uid):
+                return cls._take_impl_inner(
+                    path, app_state, replicated, coordinator, is_async,
+                    rank, world, rng_states_at_entry, commit_uid, base,
+                    leaf_transform=leaf_transform,
+                    storage_options=storage_options,
+                )
+        except SnapshotAbortedError:
+            raise
+        except BaseException as e:
+            coordinator.poison(
+                commit_uid, cause=repr(e), site=f"take_plan/rank{rank}"
             )
+            raise
         finally:
             for k, v in app_state.items():
                 if isinstance(v, RNGState):
@@ -563,6 +602,7 @@ class Snapshot:
         rank: int,
         world: int,
         rng_states_at_entry: Dict[str, Dict[str, Any]],
+        commit_uid: str,
         base: Optional[str] = None,
         leaf_transform: Optional[Callable[[str, Any], Any]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
@@ -868,7 +908,6 @@ class Snapshot:
             version=MANIFEST_VERSION, world_size=world, manifest=global_manifest
         )
 
-        commit_uid = coordinator._next_uid("commit")
         budget = get_process_memory_budget_bytes()
 
         # TPU-native unblock-early point: one batched device→pinned_host
@@ -965,31 +1004,53 @@ class Snapshot:
         rank, world = coordinator.rank, coordinator.world_size
         _validate_app_state(app_state)
         with log_event(Event("restore", {"path": self.path, "rank": rank})):
-            metadata = self.metadata
-            manifest_for_rank = get_manifest_for_rank(metadata, rank)
-            storage = _storage_for(self.path, self._storage_options)
-            self._prime_tier_digests(storage)
-            local_keys = sorted(app_state.keys())
-            if world > 1:
-                global_keys = sorted(
-                    set().union(*coordinator.all_gather_object(local_keys))
-                )
-            else:
-                global_keys = local_keys
-            # RNG state is restored last so earlier restores cannot perturb
-            # it (reference snapshot.py:371-381)
-            global_keys.sort(key=lambda k: isinstance(app_state.get(k), RNGState))
+            # abort-aware restore: the scope uid is agreed up front (the
+            # per-instance uid counter runs in the same program order on
+            # every rank), and covers EVERYTHING that can fail — even a
+            # rank dying on the metadata read poisons before its peers
+            # enter the key gather, so nobody wedges to a wait timeout.
+            # The failing rank re-raises its own error; peers raise a
+            # typed SnapshotAbortedError naming it.
+            abort_uid = coordinator._next_uid("restore")
+            storage = None
             try:
-                for key in global_keys:
-                    if key in app_state:
-                        self._load_stateful(
-                            key, app_state[key], manifest_for_rank, storage,
-                            strict, rank, paths=paths,
-                        )
+                with coordinator.abort_scope(abort_uid):
+                    metadata = self.metadata
+                    manifest_for_rank = get_manifest_for_rank(metadata, rank)
+                    storage = _storage_for(self.path, self._storage_options)
+                    self._prime_tier_digests(storage)
+                    local_keys = sorted(app_state.keys())
                     if world > 1:
-                        coordinator.barrier()
+                        global_keys = sorted(
+                            set().union(
+                                *coordinator.all_gather_object(local_keys)
+                            )
+                        )
+                    else:
+                        global_keys = local_keys
+                    # RNG state is restored last so earlier restores
+                    # cannot perturb it (reference snapshot.py:371-381)
+                    global_keys.sort(
+                        key=lambda k: isinstance(app_state.get(k), RNGState)
+                    )
+                    for key in global_keys:
+                        if key in app_state:
+                            self._load_stateful(
+                                key, app_state[key], manifest_for_rank,
+                                storage, strict, rank, paths=paths,
+                            )
+                        if world > 1:
+                            coordinator.barrier()
+            except SnapshotAbortedError:
+                raise
+            except BaseException as e:
+                coordinator.poison(
+                    abort_uid, cause=repr(e), site=f"restore/rank{rank}"
+                )
+                raise
             finally:
-                storage.sync_close()
+                if storage is not None:
+                    storage.sync_close()
 
     def _load_stateful(
         self,
@@ -1357,6 +1418,16 @@ class PendingSnapshot:
         except BaseException as e:  # noqa: BLE001
             self._exc = e
             status = f"err:{e!r}"
+            # poison FIRST: peers blocked in the abort-aware waits below
+            # learn of this failure in one poll interval even before the
+            # arrive/depart protocol rounds complete
+            coord.poison(uid, cause=repr(e), site=f"async_commit/rank{rank}")
+        with coord.abort_scope(uid):
+            self._complete_snapshot_protocol(coord, uid, rank, world, status)
+
+    def _complete_snapshot_protocol(
+        self, coord: Coordinator, uid: str, rank: int, world: int, status: str
+    ) -> None:
         try:
             # content checksums finalized during background staging ride
             # the KV channel (collectives are forbidden here); set BEFORE
@@ -1403,6 +1474,9 @@ class PendingSnapshot:
                                 "crc merge failed; committing without "
                                 "checksums", exc_info=True,
                             )
+                        # durable-commit invariant: never write the
+                        # commit marker after the scope was poisoned
+                        coord.raise_if_poisoned(uid)
                         self._storage.sync_write(
                             WriteIO(
                                 path=SNAPSHOT_METADATA_FNAME,

@@ -19,11 +19,30 @@ from __future__ import annotations
 import asyncio
 import functools
 import os
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from .. import knobs, obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..resilience import classify_fs, get_breaker, retry_call
+from ..resilience.retry import lazy_shared_progress
+from ..resilience.failpoints import failpoint
+
+
+def _tmp_name(full: str) -> str:
+    """Unique sibling temp name: data lands here first and is
+    ``os.replace``d onto the final name, so a mid-write failure (ENOSPC,
+    crash) can never leave a partial file where a reader — or a later
+    recovery sweep — would trust it."""
+    return f"{full}.tsnp-tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
 
 
 def _fsync_dir(path: str) -> None:
@@ -82,25 +101,40 @@ class FSStoragePlugin(StoragePlugin):
             os.makedirs(d, exist_ok=True)
             self._dirs_created.add(d)
 
+    async def _retry(self, fn, op_name: str, executor=None, breaker=None):
+        return await retry_call(
+            fn,
+            op_name=op_name,
+            backend="fs",
+            classify=classify_fs,
+            progress=lazy_shared_progress(self, "fs"),
+            executor=executor,
+            breaker=breaker,
+        )
+
     async def write(self, write_io: WriteIO) -> None:
+        # All paths write a sibling temp file and os.replace it onto the
+        # final name: a mid-write OSError (ENOSPC, EIO) leaves NO
+        # partial file behind, and replacing the dirent (instead of
+        # truncating in place) means incremental-dedup hardlinks shared
+        # with other snapshots are never rewritten through.  Transient
+        # EINTR/EAGAIN retries via the shared policy.
         full = self._full(write_io.path)
         self._ensure_dir(full)
-        # break hardlinks before writing: incremental dedup shares inodes
-        # across snapshots, so truncating in place would rewrite an
-        # object some OTHER snapshot's metadata still describes
-        try:
-            if os.stat(full).st_nlink > 1:
-                os.remove(full)
-        except OSError:
-            pass
+        breaker = get_breaker("fs")
         if self._lib is not None:
-            write_io.digests = await asyncio.get_running_loop().run_in_executor(
-                self._executor,
-                self._native_write,
-                full,
-                write_io.buf,
-                write_io.durable,
-                write_io.want_digest,
+
+            def native_attempt():
+                failpoint("storage.fs.write", path=write_io.path)
+                return self._native_write(
+                    full, write_io.buf, write_io.durable, write_io.want_digest
+                )
+
+            write_io.digests = await self._retry(
+                native_attempt,
+                f"write {write_io.path}",
+                executor=self._executor,
+                breaker=breaker,
             )
             return
         if write_io.durable or knobs.is_fs_sync_data():
@@ -108,24 +142,51 @@ class FSStoragePlugin(StoragePlugin):
             # write+fdatasync in a thread.  Only the commit-point write
             # syncs the directory chain (data files' dirents become
             # durable with the metadata's chain sync that follows them).
-            await asyncio.get_running_loop().run_in_executor(
-                None,
-                self._durable_fallback_write,
-                full,
-                write_io.buf,
-                write_io.durable,
+            def sync_work():
+                failpoint("storage.fs.write", path=write_io.path)
+                self._durable_fallback_write(
+                    full, write_io.buf, write_io.durable
+                )
+
+            async def sync_attempt():
+                await asyncio.get_running_loop().run_in_executor(
+                    None, sync_work
+                )
+
+            await self._retry(
+                sync_attempt, f"write {write_io.path}", breaker=breaker
             )
             return
         import aiofiles
 
-        async with aiofiles.open(full, "wb") as f:
-            await f.write(write_io.buf)
+        async def aio_attempt():
+            failpoint("storage.fs.write", path=write_io.path)
+            tmp = _tmp_name(full)
+            try:
+                async with aiofiles.open(tmp, "wb") as f:
+                    await f.write(write_io.buf)
+                failpoint("storage.fs.write.sync", path=write_io.path)
+                os.replace(tmp, full)
+            except BaseException:
+                _unlink_quiet(tmp)
+                raise
+
+        await self._retry(
+            aio_attempt, f"write {write_io.path}", breaker=breaker
+        )
 
     def _durable_fallback_write(self, full: str, buf, chain: bool = True) -> None:
-        with open(full, "wb") as f:
-            f.write(buf)
-            f.flush()
-            os.fdatasync(f.fileno())
+        tmp = _tmp_name(full)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(buf)
+                f.flush()
+                os.fdatasync(f.fileno())
+            failpoint("storage.fs.write.sync", path=full)
+            os.replace(tmp, full)
+        except BaseException:
+            _unlink_quiet(tmp)
+            raise
         if chain:
             _fsync_dir_chain(os.path.dirname(full), self.root)
 
@@ -140,19 +201,26 @@ class FSStoragePlugin(StoragePlugin):
         view = memoryview(buf).cast("B")
         addr = _buffer_address(view) if view.nbytes else None
         digests = None
-        if want_digest and hasattr(self._lib, "tsnp_write_file_digest"):
-            out = (ctypes.c_uint32 * 2)()
-            rc = self._lib.tsnp_write_file_digest(
-                full.encode(), addr, view.nbytes, 1 if sync_file else 0, out
-            )
-            if rc == 0:
-                digests = (int(out[0]), int(out[1]))
-        else:
-            rc = self._lib.tsnp_write_file(
-                full.encode(), addr, view.nbytes, 1 if sync_file else 0
-            )
-        if rc != 0:
-            raise OSError(-rc, os.strerror(-rc), full)
+        tmp = _tmp_name(full)
+        try:
+            if want_digest and hasattr(self._lib, "tsnp_write_file_digest"):
+                out = (ctypes.c_uint32 * 2)()
+                rc = self._lib.tsnp_write_file_digest(
+                    tmp.encode(), addr, view.nbytes, 1 if sync_file else 0, out
+                )
+                if rc == 0:
+                    digests = (int(out[0]), int(out[1]))
+            else:
+                rc = self._lib.tsnp_write_file(
+                    tmp.encode(), addr, view.nbytes, 1 if sync_file else 0
+                )
+            if rc != 0:
+                raise OSError(-rc, os.strerror(-rc), full)
+            failpoint("storage.fs.write.sync", path=full)
+            os.replace(tmp, full)
+        except BaseException:
+            _unlink_quiet(tmp)
+            raise
         if durable:
             # fdatasync covers the file CONTENT; the file's existence
             # needs every (possibly just-created) directory up the chain
@@ -176,23 +244,31 @@ class FSStoragePlugin(StoragePlugin):
     async def read(self, read_io: ReadIO) -> None:
         full = self._full(read_io.path)
         if self._lib is not None:
-            read_io.buf = await asyncio.get_running_loop().run_in_executor(
-                self._executor,
-                self._native_read,
-                full,
-                read_io.byte_range,
-                read_io.into,
+
+            def native_attempt():
+                failpoint("storage.fs.read", path=read_io.path)
+                return self._native_read(
+                    full, read_io.byte_range, read_io.into
+                )
+
+            read_io.buf = await self._retry(
+                native_attempt,
+                f"read {read_io.path}",
+                executor=self._executor,
             )
             return
         import aiofiles
 
-        async with aiofiles.open(full, "rb") as f:
-            if read_io.byte_range is None:
-                read_io.buf = await f.read()
-            else:
+        async def aio_attempt():
+            failpoint("storage.fs.read", path=read_io.path)
+            async with aiofiles.open(full, "rb") as f:
+                if read_io.byte_range is None:
+                    return await f.read()
                 start, end = read_io.byte_range
                 await f.seek(start)
-                read_io.buf = await f.read(end - start)
+                return await f.read(end - start)
+
+        read_io.buf = await self._retry(aio_attempt, f"read {read_io.path}")
 
     def _native_read(self, full: str, byte_range, into=None):
         import numpy as np

@@ -26,20 +26,32 @@ from __future__ import annotations
 import asyncio
 import functools
 import logging
-import random
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from .. import obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..resilience import (
+    MISSING,
+    RAISE,
+    SUCCESS_NONE,
+    TRANSIENT,
+    SharedProgress,
+    get_breaker,
+    retry_call,
+)
+from ..resilience.failpoints import failpoint
 
 logger = logging.getLogger(__name__)
 
-_PROGRESS_WINDOW_S = 120.0
-_MAX_ATTEMPTS = 6
 _DEFAULT_CHUNK_BYTES = 100 * 1024 * 1024
 _MAX_COMPOSE_COMPONENTS = 32  # GCS compose limit per call
+
+# The collective-progress retry strategy was born here (reference
+# _RetryStrategy, gcs.py:221-277) and now lives in resilience/retry.py
+# as the package-wide policy; the old name remains for callers/tests
+# that grew up against this module.
+_CollectiveProgressRetry = SharedProgress
 
 
 def _is_not_found(e: BaseException) -> bool:
@@ -63,30 +75,6 @@ def _is_range_unsatisfiable(e: BaseException) -> bool:
     )
 
 
-class _CollectiveProgressRetry:
-    """Shared-deadline retry: any completion anywhere refreshes the clock
-    (reference _RetryStrategy, gcs.py:221-277)."""
-
-    def __init__(self, window_s: float = _PROGRESS_WINDOW_S) -> None:
-        self.window_s = window_s
-        self.last_progress = time.monotonic()
-        # private stream: backoff jitter (possibly on the async-commit
-        # background thread) must never perturb the global random state
-        # the take-path RNG invariant protects
-        self._rng = random.Random()
-
-    def record_progress(self) -> None:
-        self.last_progress = time.monotonic()
-
-    def should_retry(self, attempt: int) -> bool:
-        if attempt >= _MAX_ATTEMPTS:
-            return False
-        return (time.monotonic() - self.last_progress) < self.window_s
-
-    async def backoff(self, attempt: int) -> None:
-        await asyncio.sleep(min(2**attempt, 32) * (0.5 + self._rng.random()))
-
-
 @obs.instrument_storage("gcs")
 class GCSStoragePlugin(StoragePlugin):
     def __init__(
@@ -107,47 +95,49 @@ class GCSStoragePlugin(StoragePlugin):
         self._executor = ThreadPoolExecutor(
             max_workers=num_threads, thread_name_prefix="tsnp-gcs"
         )
-        self._retry = _CollectiveProgressRetry()
+        self._retry = SharedProgress(label="gcs")
         self._chunk_bytes = chunk_bytes
 
     def _blob_name(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
 
     async def _with_retry(self, fn, op_name: str):
-        loop = asyncio.get_running_loop()
-        attempt = 0
-        while True:
-            try:
-                result = await loop.run_in_executor(self._executor, fn)
-                self._retry.record_progress()
-                return result
-            except FileNotFoundError:
-                raise
-            except Exception as e:  # noqa: BLE001
-                # A 404 means the object is missing.  Reads map to the
-                # same FileNotFoundError contract as the fs/memory
-                # plugins instead of burning the retry deadline; deletes
-                # treat it as SUCCESS (idempotent cleanup — fs-style
-                # callers expect re-deleting to be a no-op).  WRITES keep
-                # retrying: a resumable-upload session GCS invalidated
-                # mid-upload also surfaces as 404, and a fresh attempt
-                # starts a new session and succeeds.
-                if _is_not_found(e):
-                    if op_name.startswith("delete "):
-                        self._retry.record_progress()
-                        return None
-                    if not op_name.startswith("write "):
-                        raise FileNotFoundError(f"{op_name}: {e}") from e
-                if _is_range_unsatisfiable(e) and op_name.startswith("read "):
-                    raise  # deterministic (zero-byte object); don't retry
-                attempt += 1
-                if not self._retry.should_retry(attempt):
-                    raise
-                logger.warning(
-                    "GCS %s failed (attempt %d, retrying): %r",
-                    op_name, attempt, e,
-                )
-                await self._retry.backoff(attempt)
+        kind = op_name.split(" ", 1)[0]
+
+        def attempt():
+            failpoint(f"storage.gcs.{kind}", op=op_name)
+            return fn()
+
+        def classify(e: BaseException) -> str:
+            # A 404 means the object is missing.  Reads map to the
+            # same FileNotFoundError contract as the fs/memory
+            # plugins instead of burning the retry deadline; deletes
+            # treat it as SUCCESS (idempotent cleanup — fs-style
+            # callers expect re-deleting to be a no-op).  WRITES keep
+            # retrying: a resumable-upload session GCS invalidated
+            # mid-upload also surfaces as 404, and a fresh attempt
+            # starts a new session and succeeds.
+            if _is_not_found(e):
+                if op_name.startswith("delete "):
+                    return SUCCESS_NONE
+                if not op_name.startswith("write "):
+                    return MISSING
+                return TRANSIENT
+            if _is_range_unsatisfiable(e) and op_name.startswith("read "):
+                return RAISE  # deterministic (zero-byte object)
+            return TRANSIENT
+
+        return await retry_call(
+            attempt,
+            op_name=op_name,
+            backend="gcs",
+            classify=classify,
+            progress=self._retry,
+            executor=self._executor,
+            breaker=(
+                get_breaker("gcs") if op_name.startswith("write ") else None
+            ),
+        )
 
     # ------------------------------------------------------------- write
 

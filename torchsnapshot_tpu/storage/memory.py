@@ -12,6 +12,10 @@ from typing import Dict
 
 from .. import obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..resilience import classify_generic, retry_call
+from ..resilience.failpoints import active as _failpoints_active
+from ..resilience.failpoints import failpoint
+from ..resilience.retry import lazy_shared_progress
 
 _NAMESPACES: Dict[str, Dict[str, bytes]] = {}
 _LOCK = threading.Lock()
@@ -40,6 +44,18 @@ class MemoryStoragePlugin(StoragePlugin):
         self.supports_fused_digest = _load_native() is not None
 
     async def write(self, write_io: WriteIO) -> None:
+        # the failpoint rides the shared retry policy so chaos tests
+        # drive transient-then-recover schedules through the full
+        # snapshot stack without touching a real backend; gated on the
+        # armed check so the disarmed hot path pays one module load
+        if _failpoints_active():
+            await retry_call(
+                lambda: failpoint("storage.memory.write", path=write_io.path),
+                op_name=f"write {write_io.path}",
+                backend="memory",
+                classify=classify_generic,
+                progress=lazy_shared_progress(self, "memory"),
+            )
         if write_io.want_digest and self.supports_fused_digest:
             from .._csrc import copy_digest
 
@@ -53,6 +69,14 @@ class MemoryStoragePlugin(StoragePlugin):
         self._store[write_io.path] = bytes(write_io.buf)
 
     async def read(self, read_io: ReadIO) -> None:
+        if _failpoints_active():
+            await retry_call(
+                lambda: failpoint("storage.memory.read", path=read_io.path),
+                op_name=f"read {read_io.path}",
+                backend="memory",
+                classify=classify_generic,
+                progress=lazy_shared_progress(self, "memory"),
+            )
         try:
             data = self._store[read_io.path]
         except KeyError:

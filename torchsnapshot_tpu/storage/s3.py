@@ -4,29 +4,34 @@ Reference: torchsnapshot/storage_plugins/s3.py:18-79 (aiobotocore with HTTP
 Range reads).  This environment ships no S3 client library; the plugin
 lazily binds to whichever of ``aiobotocore`` / ``boto3`` / ``s3fs`` is
 installed and raises a clear error otherwise.
+
+Every op runs under the shared retry policy (resilience/retry.py) with
+EXPLICIT error classification: throttles (SlowDown), 5xx and
+connection/timeout shapes retry with backoff under the collective-
+progress window; NoSuchKey/404 maps to the cross-plugin
+FileNotFoundError contract (reads/stats) or idempotent success
+(deletes); anything else is fatal and surfaces AS ITSELF with its
+original context — a transient 500 can no longer masquerade as a
+confusing non-FNF re-raise with the cause lost.
 """
 
 from __future__ import annotations
 
-import asyncio
 import functools
 from concurrent.futures import ThreadPoolExecutor
 
 from .. import knobs, obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
-
-
-def _raise_missing_as_fnf(e: Exception, uri: str) -> None:
-    """Map botocore NoSuchKey/404 to the cross-plugin FileNotFoundError
-    contract (fs/memory/gcs behave the same); re-raise anything else."""
-    if isinstance(e, FileNotFoundError):
-        raise e
-    code = str(
-        getattr(e, "response", {}).get("Error", {}).get("Code", "")
-    )
-    if code in ("NoSuchKey", "404") or type(e).__name__ in ("NoSuchKey",):
-        raise FileNotFoundError(uri) from e
-    raise e
+from ..resilience import (
+    FATAL,
+    MISSING,
+    SUCCESS_NONE,
+    classify_s3,
+    get_breaker,
+    retry_call,
+)
+from ..resilience.failpoints import failpoint
+from ..resilience.retry import lazy_shared_progress
 
 
 @obs.instrument_storage("s3")
@@ -72,116 +77,165 @@ class S3StoragePlugin(StoragePlugin):
     def _key(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
 
-    async def _run(self, fn):
-        return await asyncio.get_running_loop().run_in_executor(
-            self._executor, fn
+    def _uri(self, key: str) -> str:
+        return f"s3://{self.bucket}/{key}"
+
+    async def _run(
+        self, fn, op_name: str, on_missing: str = "raise", breaker=None
+    ):
+        """Execute one client call on the executor under the shared
+        retry policy.  ``on_missing``: what a NoSuchKey/404 means for
+        this op — "fnf" (reads/stats: the cross-plugin cold-start
+        contract), "ok" (deletes: idempotent cleanup), or "raise"
+        (writes: a missing-bucket-style failure is fatal)."""
+
+        def classify(e: BaseException) -> str:
+            verdict = classify_s3(e)
+            if verdict == MISSING:
+                if on_missing == "ok":
+                    return SUCCESS_NONE
+                if on_missing == "raise":
+                    return FATAL
+            return verdict
+
+        return await retry_call(
+            fn,
+            op_name=op_name,
+            backend="s3",
+            classify=classify,
+            progress=lazy_shared_progress(self, "s3"),
+            executor=self._executor,
+            breaker=breaker,
         )
 
     async def write(self, write_io: WriteIO) -> None:
         data = bytes(write_io.buf)
+        key = self._key(write_io.path)
         if self._is_fs:
-            full = f"{self.bucket}/{self._key(write_io.path)}"
-            await self._run(functools.partial(self._backend.pipe, full, data))
-        else:
+            full = f"{self.bucket}/{key}"
+
+            def fs_put() -> None:
+                failpoint("storage.s3.write", path=write_io.path)
+                self._backend.pipe(full, data)
+
             await self._run(
-                functools.partial(
-                    self._backend.put_object,
-                    Bucket=self.bucket,
-                    Key=self._key(write_io.path),
-                    Body=data,
-                )
+                fs_put,
+                f"write {self._uri(key)}",
+                breaker=get_breaker("s3"),
             )
+            return
+
+        def put() -> None:
+            failpoint("storage.s3.write", path=write_io.path)
+            self._backend.put_object(
+                Bucket=self.bucket, Key=key, Body=data
+            )
+
+        await self._run(
+            put, f"write {self._uri(key)}", breaker=get_breaker("s3")
+        )
 
     async def read(self, read_io: ReadIO) -> None:
         key = self._key(read_io.path)
         if self._is_fs:
             full = f"{self.bucket}/{key}"
             if read_io.byte_range is None:
-                read_io.buf = await self._run(
-                    functools.partial(self._backend.cat_file, full)
-                )
+                fetch = functools.partial(self._backend.cat_file, full)
             else:
                 start, end = read_io.byte_range
-                read_io.buf = await self._run(
-                    functools.partial(
-                        self._backend.cat_file, full, start=start, end=end
-                    )
+                fetch = functools.partial(
+                    self._backend.cat_file, full, start=start, end=end
                 )
-        else:
-            kwargs = {"Bucket": self.bucket, "Key": key}
-            if read_io.byte_range is not None:
-                start, end = read_io.byte_range
-                kwargs["Range"] = f"bytes={start}-{end - 1}"
-            try:
-                resp = await self._run(
-                    functools.partial(self._backend.get_object, **kwargs)
-                )
-            except Exception as e:
-                # Map missing keys to the same cold-start contract as the
-                # fs/memory/gcs plugins so `except FileNotFoundError`
-                # works for s3:// too.
-                _raise_missing_as_fnf(e, f"s3://{self.bucket}/{key}")
-            read_io.buf = await self._run(resp["Body"].read)
+
+            def fs_get():
+                failpoint("storage.s3.read", path=read_io.path)
+                return fetch()
+
+            read_io.buf = await self._run(
+                fs_get, f"read {self._uri(key)}", on_missing="fnf"
+            )
+            return
+        kwargs = {"Bucket": self.bucket, "Key": key}
+        if read_io.byte_range is not None:
+            start, end = read_io.byte_range
+            kwargs["Range"] = f"bytes={start}-{end - 1}"
+
+        def get() -> bytes:
+            failpoint("storage.s3.read", path=read_io.path)
+            # the body stream belongs to THIS attempt's response: read
+            # it inside the retried call so a connection dropped
+            # mid-stream retries the whole GET, not a half-read stream
+            resp = self._backend.get_object(**kwargs)
+            return resp["Body"].read()
+
+        read_io.buf = await self._run(
+            get, f"read {self._uri(key)}", on_missing="fnf"
+        )
 
     async def link_from(self, base_url: str, path: str) -> None:
         base = base_url.split("://", 1)[-1]
         src_bucket, _, src_prefix = base.partition("/")
         src_key = f"{src_prefix}/{path}" if src_prefix else path
-        try:
-            if self._is_fs:
-                await self._run(
-                    functools.partial(
-                        self._backend.copy,
-                        f"{src_bucket}/{src_key}",
-                        f"{self.bucket}/{self._key(path)}",
-                    )
-                )
-            else:
-                await self._run(
-                    functools.partial(
-                        self._backend.copy_object,
-                        Bucket=self.bucket,
-                        Key=self._key(path),
-                        CopySource={"Bucket": src_bucket, "Key": src_key},
-                    )
-                )
-        except Exception as e:
-            # same missing-key contract as read/stat (and gs:// link_from)
-            _raise_missing_as_fnf(e, f"s3://{src_bucket}/{src_key}")
+        if self._is_fs:
+            copy = functools.partial(
+                self._backend.copy,
+                f"{src_bucket}/{src_key}",
+                f"{self.bucket}/{self._key(path)}",
+            )
+        else:
+            copy = functools.partial(
+                self._backend.copy_object,
+                Bucket=self.bucket,
+                Key=self._key(path),
+                CopySource={"Bucket": src_bucket, "Key": src_key},
+            )
+        # missing base object -> FileNotFoundError (same contract as
+        # read/stat and gs:// link_from); the caller degrades to a
+        # normal write
+        await self._run(
+            copy,
+            f"copy s3://{src_bucket}/{src_key}",
+            on_missing="fnf",
+        )
 
     async def stat(self, path: str) -> int:
         key = self._key(path)
-        try:
-            if self._is_fs:
-                info = await self._run(
-                    functools.partial(
-                        self._backend.info, f"{self.bucket}/{key}"
-                    )
-                )
+        if self._is_fs:
+
+            def fs_head() -> int:
+                info = self._backend.info(f"{self.bucket}/{key}")
                 return int(info["size"])
-            resp = await self._run(
-                functools.partial(
-                    self._backend.head_object, Bucket=self.bucket, Key=key
-                )
+
+            return await self._run(
+                fs_head, f"stat {self._uri(key)}", on_missing="fnf"
             )
+
+        def head() -> int:
+            resp = self._backend.head_object(Bucket=self.bucket, Key=key)
             return int(resp["ContentLength"])
-        except Exception as e:
-            _raise_missing_as_fnf(e, f"s3://{self.bucket}/{key}")
+
+        return await self._run(
+            head, f"stat {self._uri(key)}", on_missing="fnf"
+        )
 
     async def delete(self, path: str) -> None:
         key = self._key(path)
         if self._is_fs:
-            await self._run(
-                functools.partial(
-                    self._backend.rm_file, f"{self.bucket}/{key}"
-                )
-            )
+            # s3fs raises FileNotFoundError directly (which the retry
+            # engine passes through untouched), so the idempotence
+            # mapping must happen here, not in the classifier
+            def rm() -> None:
+                try:
+                    self._backend.rm_file(f"{self.bucket}/{key}")
+                except FileNotFoundError:
+                    pass
         else:
-            await self._run(
-                functools.partial(
-                    self._backend.delete_object, Bucket=self.bucket, Key=key
-                )
+            rm = functools.partial(
+                self._backend.delete_object, Bucket=self.bucket, Key=key
             )
+        # S3 deletes are idempotent; map a 404 to success so re-deleting
+        # (GC sweeps, aborted-upload cleanup) is a no-op like fs/gcs
+        await self._run(rm, f"delete {self._uri(key)}", on_missing="ok")
 
     async def close(self) -> None:
         self._executor.shutdown(wait=False)

@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional
 
 from .. import knobs, obs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..resilience import get_breaker
 from .promoter import PromotionGroup, get_promoter
 
 logger = logging.getLogger(__name__)
@@ -116,6 +117,13 @@ class TieredStoragePlugin(StoragePlugin):
         self._verified: set = set()
         self._bad_fast: set = set()
         self._group = PromotionGroup(self.fast_url, self.durable_url)
+        # fast-tier circuit breaker (resilience/breaker.py): consecutive
+        # fast-read failures (corrupt copies, a dying local disk) trip
+        # reads straight onto the replica/durable fallback path without
+        # paying a doomed local attempt each; a half-open probe after
+        # the cooldown re-admits a recovered disk.  Keyed by fast root —
+        # every plugin instance over the same local tier shares it.
+        self._fast_breaker = get_breaker(f"tier.fast:{self.fast_url}")
         self._replica_target_urls: List[str] = []
         self._peer_plugins: Dict[str, StoragePlugin] = {}
         m = obs.REGISTRY
@@ -217,14 +225,23 @@ class TieredStoragePlugin(StoragePlugin):
 
     async def read(self, read_io: ReadIO) -> None:
         path = read_io.path
-        if path not in self._bad_fast:
+        # breaker first: with the fast tier tripped open, reads route
+        # straight to the replica/durable fallback (allow() also admits
+        # the half-open probe after the cooldown)
+        if path not in self._bad_fast and self._fast_breaker.allow():
             try:
                 await self._read_fast_checked(read_io)
                 self._m_hits.inc()
+                self._fast_breaker.record_success()
                 return
             except FileNotFoundError:
-                pass
+                # a genuine miss (promotion-only object, evicted step)
+                # says nothing about the disk's health: neither success
+                # nor failure, but the half-open probe slot must be
+                # released or the breaker wedges half-open
+                self._fast_breaker.release_probe()
             except _FastTierCorrupt:
+                self._fast_breaker.record_failure()
                 self._m_corrupt.inc()
                 logger.warning(
                     "fast-tier copy of %r failed its integrity check; "
@@ -235,10 +252,16 @@ class TieredStoragePlugin(StoragePlugin):
                 # as likely as a bit flip — treat it as a miss and fall
                 # back rather than aborting a restore the durable tier
                 # can still serve
+                self._fast_breaker.record_failure()
                 logger.warning(
                     "fast-tier read of %r failed (%r); falling back",
                     path, e,
                 )
+            except BaseException:
+                # cancellation (or any unclassified error) propagates —
+                # but never with the half-open probe slot still claimed
+                self._fast_breaker.release_probe()
+                raise
             self._bad_fast.add(path)
         self._m_misses.inc()
         await self._fallback_read(read_io)

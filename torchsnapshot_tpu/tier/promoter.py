@@ -130,6 +130,18 @@ class Promoter:
                     "tier promotion %s job for %r failed", kind,
                     group.durable_url,
                 )
+                # cross-rank abort: rank 0's commit job is (or will be)
+                # blocked waiting for every rank's done-key — poison the
+                # promotion scope so it withholds the durable commit
+                # marker within one poll interval instead of stalling
+                # the FIFO for the full done-key timeout
+                coord = group.coordinator
+                if coord is not None and group.uid is not None:
+                    coord.poison(
+                        f"{group.uid}/tier",
+                        cause=repr(e),
+                        site=f"tier.promote.{kind}/rank{coord.rank}",
+                    )
                 with self._lock:
                     self._errors.append((kind, e))
             finally:
@@ -143,10 +155,13 @@ class Promoter:
         )
         from ..storage import url_to_storage_plugin
 
+        from ..resilience.failpoints import failpoint
+
         src = url_to_storage_plugin(group.fast_url)
         dst = url_to_storage_plugin(group.durable_url)
         try:
             if kind == "data":
+                failpoint("tier.promote.data", durable=group.durable_url)
                 paths = sorted(group.paths - group.linked)
                 if group.recovery:
                     # this host's fast root holds only its own share of
@@ -172,6 +187,7 @@ class Promoter:
             with obs.span(
                 "tier/promote_commit", durable=group.durable_url
             ):
+                failpoint("tier.promote.commit", durable=group.durable_url)
                 if group.failed:
                     raise RuntimeError(
                         f"durable commit for {group.durable_url!r} "
@@ -179,10 +195,15 @@ class Promoter:
                     )
                 coord = group.coordinator
                 if coord is not None and group.uid is not None:
-                    for r in range(coord.world_size):
-                        coord.kv_get(
-                            f"{group.uid}/tierdone/{r}", _DONE_TIMEOUT_S
-                        )
+                    # abort-aware done-key wait: a peer whose data
+                    # promotion failed poisons {uid}/tier, and this wait
+                    # raises SnapshotAbortedError promptly — the durable
+                    # commit marker is withheld either way
+                    with coord.abort_scope(f"{group.uid}/tier"):
+                        for r in range(coord.world_size):
+                            coord.kv_get(
+                                f"{group.uid}/tierdone/{r}", _DONE_TIMEOUT_S
+                            )
                 if group.recovery:
                     # no cross-rank handshake in recovery mode: gate the
                     # commit marker on every manifest location actually

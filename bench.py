@@ -384,6 +384,148 @@ def _tier_probe(payload_mb: int = 32) -> dict:
     return out
 
 
+def _stripe_probe(payload_mb: int = 256, part_mb: int = 32) -> dict:
+    """Per-backend storage-throughput microbench: write/read GB/s for a
+    SINGLE large object, striped vs unstriped, memory + fs backends —
+    the single-stream 0.022 GB/s axis from BENCH r05, tracked from this
+    PR on.  Both writes measure the REAL checksummed save path: the
+    unstriped leg is the pre-stripe fused copy+digest write, the
+    striped leg is the scheduler's stage→write part stream (per-part
+    fused digests, folded and cross-checked against the unstriped
+    digest so the bench doubles as an equivalence assert).  Best of 3
+    trials per leg (microbench convention — the box's page-cache and
+    scheduler noise lands on single trials).  Host-only: numpy buffers,
+    RAM and a local dir; cannot perturb the device."""
+    import asyncio
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+    from torchsnapshot_tpu.preparers.array import HostArrayBufferStager
+    from torchsnapshot_tpu.storage import stripe
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+    from torchsnapshot_tpu.storage.memory import (
+        MemoryStoragePlugin,
+        reset_namespace,
+    )
+    from torchsnapshot_tpu.utils.checksums import combine_piece_digests
+
+    loop = asyncio.new_event_loop()
+
+    def run(coro):
+        return loop.run_until_complete(coro)
+
+    nbytes = payload_mb << 20
+    part = part_mb << 20
+    gb = nbytes / 1e9
+    data = np.random.default_rng(0).integers(
+        0, 256, size=nbytes, dtype=np.uint8
+    )
+    executor = ThreadPoolExecutor(max_workers=4, thread_name_prefix="stripe-bench")
+    root = tempfile.mkdtemp(prefix="tsnp_bench_stripe_")
+    ns = f"stripe_bench_{os.getpid()}"
+    out: dict = {
+        "payload_mb": payload_mb,
+        "part_mb": part_mb,
+        "trials": 3,
+    }
+
+    def best(*fns):
+        # interleave the legs' trials so page-cache / memory-pressure
+        # drift across the probe penalizes both paths equally instead
+        # of whichever leg happened to run second
+        times = [[] for _ in fns]
+        for _ in range(3):
+            for i, fn in enumerate(fns):
+                times[i].append(fn())
+        return [round(gb / min(ts), 3) for ts in times]
+
+    try:
+        for name, plugin in (
+            ("memory", MemoryStoragePlugin(ns)),
+            ("fs", FSStoragePlugin(os.path.join(root, "fs"))),
+        ):
+            b: dict = {}
+
+            def timed_unstriped_write() -> float:
+                wio = WriteIO(path="u", buf=memoryview(data), want_digest=True)
+                t0 = time.perf_counter()
+                run(plugin.write(wio))
+                dt = time.perf_counter() - t0
+                b["unstriped_digests"] = wio.digests
+                return dt
+
+            def timed_striped_write() -> float:
+                stager = HostArrayBufferStager(data, defensive_copy=False)
+                spans = stager.part_plan(part)
+                t0 = time.perf_counter()
+                d = run(
+                    stripe.streamed_part_write(
+                        plugin, "s", stager, spans, executor,
+                        window_parts=4, want_digests=True,
+                    )
+                )
+                dt = time.perf_counter() - t0
+                crc, adler, total = combine_piece_digests(d)
+                b["striped_digests"] = (crc, adler)
+                assert total == nbytes
+                return dt
+
+            def timed_unstriped_read() -> float:
+                rio = ReadIO(path="u", into=np.empty(nbytes, np.uint8))
+                t0 = time.perf_counter()
+                run(plugin.read(rio))
+                return time.perf_counter() - t0
+
+            def timed_striped_read() -> float:
+                dst = np.empty(nbytes, np.uint8)
+                t0 = time.perf_counter()
+                run(
+                    stripe.striped_read(
+                        plugin, "s", offset=0, length=nbytes, into=dst
+                    )
+                )
+                return time.perf_counter() - t0
+
+            with knobs.override_stripe_part_size_bytes(part), (
+                knobs.override_stripe_min_object_size_bytes(1 << 20)
+            ):
+                (
+                    b["write_unstriped_gbps"],
+                    b["write_striped_gbps"],
+                ) = best(timed_unstriped_write, timed_striped_write)
+                (
+                    b["read_unstriped_gbps"],
+                    b["read_striped_gbps"],
+                ) = best(timed_unstriped_read, timed_striped_read)
+            # bitwise equivalence of the two write paths, for free: the
+            # fused whole-object digest must equal the folded part digests
+            if b.get("unstriped_digests") and b.get("striped_digests"):
+                assert tuple(b.pop("unstriped_digests")) == tuple(
+                    b.pop("striped_digests")
+                ), f"{name}: striped/unstriped digests diverged"
+            else:
+                b.pop("unstriped_digests", None)
+                b.pop("striped_digests", None)
+            b["write_speedup"] = round(
+                b["write_striped_gbps"] / max(b["write_unstriped_gbps"], 1e-9),
+                2,
+            )
+            b["read_speedup"] = round(
+                b["read_striped_gbps"] / max(b["read_unstriped_gbps"], 1e-9),
+                2,
+            )
+            out[name] = b
+    finally:
+        loop.close()
+        executor.shutdown(wait=False)
+        reset_namespace(ns)
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def run_child() -> None:
     import jax
     import jax.numpy as jnp
@@ -659,6 +801,14 @@ def run_child() -> None:
             result["resilience"] = _resilience_rollup()
         except Exception as e:
             result["resilience"] = {"error": f"{e!r}"[:200]}
+        # storage-striping microbench: single-object write/read GB/s,
+        # striped vs unstriped, memory + fs (the intra-object
+        # parallelism axis this PR adds; host-only, after the metrics
+        # snapshot for the same reason as the tier probe)
+        try:
+            result["stripe"] = _stripe_probe()
+        except Exception as e:
+            result["stripe"] = {"error": f"{e!r}"[:200]}
         print(json.dumps(result), flush=True)
         # spot-check one leaf round-tripped
         import ml_dtypes

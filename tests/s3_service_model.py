@@ -31,6 +31,10 @@ PY_TO_OP = {
     "head_object": "HeadObject",
     "copy_object": "CopyObject",
     "delete_object": "DeleteObject",
+    "create_multipart_upload": "CreateMultipartUpload",
+    "upload_part": "UploadPart",
+    "complete_multipart_upload": "CompleteMultipartUpload",
+    "abort_multipart_upload": "AbortMultipartUpload",
 }
 
 # member name -> type tag checked by validate_call (None = name-only)
@@ -135,6 +139,73 @@ S3_MODEL: Dict[str, Dict[str, Any]] = {
         },
         "output": ["DeleteMarker", "VersionId"],
         "errors": [],
+    },
+    # Multipart lifecycle (storage/stripe.py striped writes):
+    # CreateMultipartUpload → N× UploadPart (1-based part numbers) →
+    # CompleteMultipartUpload, with AbortMultipartUpload on any failure.
+    "CreateMultipartUpload": {
+        "required": ["Bucket", "Key"],
+        "members": {
+            "ACL": None, "Bucket": "string", "CacheControl": None,
+            "ContentDisposition": None, "ContentEncoding": None,
+            "ContentLanguage": None, "ContentType": None,
+            "ChecksumAlgorithm": None, "Expires": None,
+            "GrantFullControl": None, "GrantRead": None,
+            "GrantReadACP": None, "GrantWriteACP": None, "Key": "string",
+            "Metadata": "map", "ServerSideEncryption": None,
+            "StorageClass": None, "WebsiteRedirectLocation": None,
+            "SSECustomerAlgorithm": None, "SSECustomerKey": None,
+            "SSECustomerKeyMD5": None, "SSEKMSKeyId": None,
+            "SSEKMSEncryptionContext": None, "BucketKeyEnabled": None,
+            "RequestPayer": None, "Tagging": None, "ObjectLockMode": None,
+            "ObjectLockRetainUntilDate": None,
+            "ObjectLockLegalHoldStatus": None, "ExpectedBucketOwner": None,
+        },
+        # the plugin consumes UploadId; Abort* are lifecycle hints
+        "output": ["AbortDate", "AbortRuleId", "Bucket", "Key", "UploadId"],
+        "errors": [],
+    },
+    "UploadPart": {
+        "required": ["Bucket", "Key", "PartNumber", "UploadId"],
+        "members": {
+            "Body": "blob", "Bucket": "string", "ContentLength": "long",
+            "ContentMD5": None, "ChecksumAlgorithm": None,
+            "ChecksumCRC32": None, "ChecksumCRC32C": None,
+            "ChecksumSHA1": None, "ChecksumSHA256": None, "Key": "string",
+            "PartNumber": "integer", "UploadId": "string",
+            "SSECustomerAlgorithm": None, "SSECustomerKey": None,
+            "SSECustomerKeyMD5": None, "RequestPayer": None,
+            "ExpectedBucketOwner": None,
+        },
+        # NoSuchUpload is reachable via COMMON_ERRORS — the raw model
+        # lists no per-op error shapes for UploadPart
+        "output": ["ETag"],
+        "errors": [],
+    },
+    "CompleteMultipartUpload": {
+        "required": ["Bucket", "Key", "UploadId"],
+        "members": {
+            "Bucket": "string", "Key": "string",
+            "MultipartUpload": "completed_parts", "UploadId": "string",
+            "ChecksumCRC32": None, "ChecksumCRC32C": None,
+            "ChecksumSHA1": None, "ChecksumSHA256": None,
+            "RequestPayer": None, "ExpectedBucketOwner": None,
+            "SSECustomerAlgorithm": None, "SSECustomerKey": None,
+            "SSECustomerKeyMD5": None,
+        },
+        "output": [
+            "Location", "Bucket", "Key", "ETag", "Expiration", "VersionId",
+        ],
+        "errors": [],
+    },
+    "AbortMultipartUpload": {
+        "required": ["Bucket", "Key", "UploadId"],
+        "members": {
+            "Bucket": "string", "Key": "string", "UploadId": "string",
+            "RequestPayer": None, "ExpectedBucketOwner": None,
+        },
+        "output": ["RequestCharged"],
+        "errors": ["NoSuchUpload"],
     },
 }
 
@@ -293,6 +364,16 @@ def validate_response(
             raise S3ResponseShapeError(
                 "CopyObject: CopyObjectResult must be a dict"
             )
+    elif op == "CreateMultipartUpload":
+        if not isinstance(resp.get("UploadId"), str) or not resp["UploadId"]:
+            raise S3ResponseShapeError(
+                "CreateMultipartUpload: UploadId must be a non-empty str"
+            )
+    elif op == "UploadPart":
+        if not isinstance(resp.get("ETag"), str) or not resp["ETag"]:
+            raise S3ResponseShapeError(
+                "UploadPart: ETag must be a non-empty str"
+            )
 
 
 # S3's documented COMMON errors are raisable on any object operation
@@ -303,7 +384,14 @@ def validate_response(
 # InvalidRange (HTTP 416) is what the service returns for a Range whose
 # start is at or past the object size (including ANY range on an empty
 # object) — not in the per-op model error lists either.
-COMMON_ERRORS = {"NoSuchKey", "NoSuchBucket", "AccessDenied", "InvalidRange"}
+# NoSuchUpload / InvalidPart / InvalidPartOrder are the multipart
+# lifecycle's documented failure codes (abort-after-abort, completing
+# with a bad/misordered part list) — raisable beyond the per-op lists
+# like the rest of this set.
+COMMON_ERRORS = {
+    "NoSuchKey", "NoSuchBucket", "AccessDenied", "InvalidRange",
+    "NoSuchUpload", "InvalidPart", "InvalidPartOrder",
+}
 
 
 def validate_error(python_name: str, code: str) -> None:
@@ -372,6 +460,30 @@ def validate_call(python_name: str, kwargs: Dict[str, Any]) -> str:
             raise S3ParamValidationError(
                 f"{op}.{name}: expected dict, got {type(value).__name__}"
             )
+        elif tag == "integer" and not isinstance(value, int):
+            raise S3ParamValidationError(
+                f"{op}.{name}: expected int, got {type(value).__name__}"
+            )
+        elif tag == "completed_parts":
+            # CompletedMultipartUpload structure: {"Parts": [{"ETag":
+            # str, "PartNumber": int, optional Checksum*}, ...]}
+            if not isinstance(value, dict) or set(value) - {"Parts"}:
+                raise S3ParamValidationError(
+                    f"{op}.{name}: expected {{'Parts': [...]}} structure"
+                )
+            for part in value.get("Parts", ()):
+                if not isinstance(part, dict) or not {
+                    "ETag", "PartNumber"
+                } <= set(part):
+                    raise S3ParamValidationError(
+                        f"{op}.{name}: each part needs ETag + PartNumber"
+                    )
+                if not isinstance(part["PartNumber"], int) or not isinstance(
+                    part["ETag"], str
+                ):
+                    raise S3ParamValidationError(
+                        f"{op}.{name}: part member types invalid"
+                    )
         elif tag == "copysource":
             # boto3 customization: str "bucket/key[?versionId=...]" or
             # dict with required Bucket+Key, optional VersionId.  A str

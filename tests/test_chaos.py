@@ -139,6 +139,82 @@ def test_chaos_fs_probabilistic_schedule_completes_or_aborts_clean(tmp_path):
         assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
 
 
+# ================================== striped (mid-multipart) faults
+
+
+def _stripe_ctx():
+    """Small part/threshold knobs so a ~1MB array stripes into ~16
+    parts through the REAL take/stream path."""
+    import contextlib
+
+    ctx = contextlib.ExitStack()
+    ctx.enter_context(knobs.override_stripe_part_size_bytes(1 << 16))
+    ctx.enter_context(knobs.override_stripe_min_object_size_bytes(1 << 16))
+    return ctx
+
+
+def _big_state(seed=0, n=1 << 18):
+    return {"app": StateDict(w=np.arange(n, dtype=np.float32) + seed, step=seed)}
+
+
+def _assert_big_roundtrip(path, seed=0, n=1 << 18):
+    dest = {"app": StateDict(w=np.zeros(n, np.float32), step=-1)}
+    Snapshot(path).restore(dest)
+    np.testing.assert_array_equal(
+        dest["app"]["w"], np.arange(n, dtype=np.float32) + seed
+    )
+
+
+def test_chaos_fs_striped_take_transient_part_faults_complete(tmp_path):
+    """Transient EINTR on individual part pwrites: each part retries
+    independently, the take commits, and the striped object restores
+    bitwise-equal."""
+    path = str(tmp_path / "s")
+    r0 = _retries()
+    parts0 = obs.counter(obs.STRIPE_PARTS_WRITTEN).value
+    with _stripe_ctx(), knobs.override_failpoints(
+        "storage.fs.part.write=eintr:1:3"
+    ):
+        Snapshot.take(path, _big_state(seed=2))
+    assert _retries() - r0 >= 3
+    assert obs.counter(obs.STRIPE_PARTS_WRITTEN).value - parts0 >= 2
+    with _stripe_ctx():
+        _assert_big_roundtrip(path, seed=2)
+    assert glob.glob(os.path.join(path, "**", "*tsnp-tmp*"), recursive=True) == []
+
+
+def test_chaos_fs_striped_take_fatal_part_fault_aborts_clean(tmp_path):
+    """A fatal mid-stripe failure: the handle aborts, leaving NO
+    .tsnp-tmp-* files and no commit marker — a failed multipart write
+    is indistinguishable from one that never started."""
+    path = str(tmp_path / "s")
+    with _stripe_ctx(), knobs.override_failpoints(
+        "storage.fs.part.write=io"
+    ):
+        with pytest.raises(OSError):
+            Snapshot.take(path, _big_state())
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+    assert glob.glob(os.path.join(path, "**", "*tsnp-tmp*"), recursive=True) == []
+    # the directory is reusable once the fault clears (16 fatal part
+    # failures legitimately tripped the fs breaker — close it first)
+    reset_breakers()
+    with _stripe_ctx():
+        Snapshot.take(path, _big_state(seed=5))
+        _assert_big_roundtrip(path, seed=5)
+
+
+def test_chaos_fs_striped_restore_transient_part_reads_recover(tmp_path):
+    path = str(tmp_path / "s")
+    with _stripe_ctx():
+        Snapshot.take(path, _big_state(seed=4))
+    r0 = _retries()
+    with _stripe_ctx(), knobs.override_failpoints(
+        "storage.fs.read=eagain:1:2"
+    ):
+        _assert_big_roundtrip(path, seed=4)
+    assert _retries() - r0 >= 2
+
+
 # ============================================ s3 (stubbed client)
 
 
@@ -198,6 +274,46 @@ def test_chaos_s3_restore_transient_reads_recover(s3_stub):
     with knobs.override_failpoints("storage.s3.read=slowdown:1:2"):
         _assert_roundtrip("s3://bkt/ck3", seed=9)
     assert _retries() - r0 >= 2
+
+
+def test_chaos_s3_striped_take_mid_multipart_transients_commit(s3_stub):
+    """SlowDown storms on individual UploadPart calls: parts retry
+    independently, the multipart completes, and nothing is left in
+    progress on the bucket."""
+    r0 = _retries()
+    with _stripe_ctx(), knobs.override_failpoints(
+        "storage.s3.part.write=slowdown:1:4"
+    ):
+        Snapshot.take("s3://bkt/mp", _big_state(seed=6))
+    assert _retries() - r0 >= 4
+    assert ("bkt", "mp/.snapshot_metadata") in s3_stub.objects
+    assert s3_stub.multipart_uploads == {}, "orphaned multipart upload"
+    with _stripe_ctx():
+        dest = {"app": StateDict(w=np.zeros(1 << 18, np.float32), step=-1)}
+        Snapshot("s3://bkt/mp").restore(dest)
+        np.testing.assert_array_equal(
+            dest["app"]["w"], np.arange(1 << 18, dtype=np.float32) + 6
+        )
+
+
+def test_chaos_s3_striped_take_persistent_part_fault_aborts_no_orphans(
+    s3_stub,
+):
+    """Exhausted part retries: AbortMultipartUpload runs, so the fake's
+    in-progress table drains to empty — on real S3 an orphaned upload
+    bills storage forever."""
+    with _stripe_ctx(), knobs.override_retry_max_attempts(2), (
+        knobs.override_failpoints("storage.s3.part.write=http500")
+    ):
+        with pytest.raises(Exception) as ei:
+            Snapshot.take("s3://bkt/mp2", _big_state())
+    assert getattr(ei.value, "response", {}).get("Error", {}).get(
+        "Code"
+    ) == "InternalError"
+    assert ("bkt", "mp2/.snapshot_metadata") not in s3_stub.objects
+    assert s3_stub.multipart_uploads == {}, "orphaned multipart upload"
+    # the aborted striped object itself was never published
+    assert "abort_multipart" in [c[0] for c in s3_stub.calls]
 
 
 # ============================================ gcs (fake bucket)

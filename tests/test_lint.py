@@ -735,6 +735,44 @@ def test_retry_discipline_exempts_resilience_module_and_non_package():
     assert len(_run("retry-discipline", src)) == 1  # package default
 
 
+def test_retry_sleep_loop_around_part_write_flagged():
+    """Part-level entry points (StripedWriteHandle.write_part, the raw
+    multipart client verbs, pwrite) carry the same retry obligation as
+    whole-object ops — striping must not open a policy bypass."""
+    for op in (
+        "handle.write_part(0, 0, buf)",
+        "client.upload_part(Bucket=b, Key=k, PartNumber=1, UploadId=u, Body=buf)",
+        "os.pwrite(fd, buf, off)",
+        "client.abort_multipart_upload(Bucket=b, Key=k, UploadId=u)",
+    ):
+        findings = _run(
+            "retry-discipline",
+            f"""
+            import os, time
+
+            def pump(handle, client, fd, b, k, u, off, buf):
+                while True:
+                    try:
+                        return {op}
+                    except OSError:
+                        time.sleep(1)
+            """,
+        )
+        assert len(findings) == 1, op
+
+
+def test_retry_part_write_without_sleep_clean():
+    findings = _run(
+        "retry-discipline",
+        """
+        async def drive(handle, spans):
+            for i, (lo, hi) in enumerate(spans):
+                await handle.write_part(i, lo, memoryview(b"x"))
+        """,
+    )
+    assert findings == []
+
+
 def test_retry_sleep_in_nested_def_not_attributed_to_loop():
     findings = _run(
         "retry-discipline",
@@ -831,6 +869,27 @@ def test_sibling_method_findings_have_distinct_fingerprints():
     assert {f.context for f in findings} == {
         "Snapshot.naked_a", "Snapshot.naked_b",
     }
+
+
+def test_instrumentation_covers_stripe_entry_points():
+    """The stripe engine's module-level entry points bypass the
+    instrument_storage wrappers, so they are covered directly — an
+    unbracketed striped_write must be flagged."""
+    findings = _run(
+        "instrumentation",
+        """
+        async def striped_write(storage, path, buf):
+            handle = await storage.begin_striped_write(path, len(buf))
+            await handle.complete()
+
+        async def striped_read(storage, path, *, offset, length, into=None):
+            with obs.span("stripe/read", path=path):
+                return None
+        """,
+        filename="torchsnapshot_tpu/storage/stripe.py",
+    )
+    assert len(findings) == 1
+    assert "striped_write" in findings[0].message
 
 
 def test_check_source_without_module_functions_ignores_global_coverage():

@@ -39,6 +39,12 @@ class FakeBoto3Client:
         self.objects = {}
         self.calls = []
         self.validated = []  # (operation, kwargs) after model validation
+        # in-progress multipart uploads: upload_id -> {"bucket", "key",
+        # "parts": {part_number: (etag, data)}}.  Anything left here at
+        # the end of a test is an ORPHANED upload (real S3 bills those
+        # forever) — the chaos suite asserts this dict drains
+        self.multipart_uploads = {}
+        self._upload_seq = 0
 
     def _validated(self, python_name, kwargs):
         from s3_service_model import validate_call
@@ -137,6 +143,89 @@ class FakeBoto3Client:
         # S3 delete is idempotent: deleting a missing key succeeds
         self.objects.pop((Bucket, Key), None)
         return self._respond("delete_object", kw, {})
+
+    # ------------------------------------------- multipart lifecycle
+
+    def create_multipart_upload(self, **kw):
+        self._validated("create_multipart_upload", kw)
+        Bucket, Key = kw["Bucket"], kw["Key"]
+        self.calls.append(("create_multipart", Bucket, Key))
+        self._upload_seq += 1
+        upload_id = f"upload-{self._upload_seq:04d}"
+        self.multipart_uploads[upload_id] = {
+            "bucket": Bucket, "key": Key, "parts": {},
+        }
+        return self._respond(
+            "create_multipart_upload",
+            kw,
+            {"Bucket": Bucket, "Key": Key, "UploadId": upload_id},
+        )
+
+    def upload_part(self, **kw):
+        self._validated("upload_part", kw)
+        upload_id = kw["UploadId"]
+        part_number = kw["PartNumber"]
+        self.calls.append(
+            ("upload_part", kw["Bucket"], kw["Key"], part_number)
+        )
+        up = self.multipart_uploads.get(upload_id)
+        if up is None:
+            raise FakeClientError("upload_part", "NoSuchUpload", kw["Key"])
+        body = kw.get("Body", b"")
+        data = body.encode() if isinstance(body, str) else bytes(body)
+        etag = self._etag(data)
+        up["parts"][part_number] = (etag, data)
+        return self._respond("upload_part", kw, {"ETag": etag})
+
+    def complete_multipart_upload(self, **kw):
+        self._validated("complete_multipart_upload", kw)
+        upload_id = kw["UploadId"]
+        Bucket, Key = kw["Bucket"], kw["Key"]
+        self.calls.append(("complete_multipart", Bucket, Key))
+        up = self.multipart_uploads.get(upload_id)
+        if up is None:
+            raise FakeClientError(
+                "complete_multipart_upload", "NoSuchUpload", Key
+            )
+        parts = kw.get("MultipartUpload", {}).get("Parts", [])
+        if not parts or [p["PartNumber"] for p in parts] != sorted(
+            p["PartNumber"] for p in parts
+        ):
+            raise FakeClientError(
+                "complete_multipart_upload", "InvalidPartOrder", Key
+            )
+        blob = b""
+        for p in parts:
+            stored = up["parts"].get(p["PartNumber"])
+            if stored is None or stored[0] != p["ETag"]:
+                raise FakeClientError(
+                    "complete_multipart_upload", "InvalidPart", Key
+                )
+            blob += stored[1]
+        del self.multipart_uploads[upload_id]
+        self.objects[(Bucket, Key)] = blob
+        return self._respond(
+            "complete_multipart_upload",
+            kw,
+            {
+                "Bucket": Bucket,
+                "Key": Key,
+                "ETag": self._etag(blob),
+                "Location": f"https://{Bucket}.s3.test/{Key}",
+            },
+        )
+
+    def abort_multipart_upload(self, **kw):
+        self._validated("abort_multipart_upload", kw)
+        upload_id = kw["UploadId"]
+        self.calls.append(("abort_multipart", kw["Bucket"], kw["Key"]))
+        if upload_id not in self.multipart_uploads:
+            # aborting an already-gone upload is NoSuchUpload on real S3
+            raise FakeClientError(
+                "abort_multipart_upload", "NoSuchUpload", kw["Key"]
+            )
+        del self.multipart_uploads[upload_id]
+        return self._respond("abort_multipart_upload", kw, {})
 
 
 def make_plugin():
@@ -400,3 +489,97 @@ def test_s3_endpoint_knob_resolution(monkeypatch):
     with knobs.override_s3_endpoint_url(None):
         assert knobs.get_s3_endpoint_url() is None
     assert knobs.get_s3_endpoint_url() == "http://new:9000"
+
+
+# ------------------------------------------------- multipart striping
+
+
+def _stripe_knobs():
+    import contextlib
+
+    from torchsnapshot_tpu import knobs
+
+    ctx = contextlib.ExitStack()
+    ctx.enter_context(knobs.override_stripe_part_size_bytes(1 << 10))
+    ctx.enter_context(knobs.override_stripe_min_object_size_bytes(1 << 10))
+    return ctx
+
+
+def test_multipart_striped_write_round_trips():
+    from torchsnapshot_tpu.storage import stripe
+
+    p = make_plugin()
+    payload = bytes(range(256)) * 17  # 4352B -> 5 parts of 1KB
+    with _stripe_knobs():
+        assert stripe.write_eligible(len(payload), p)
+        run(stripe.striped_write(p, "0/app/big", payload))
+    assert p._backend.objects[("bkt", "run/1/0/app/big")] == payload
+    # the upload completed: nothing left in progress to bill storage
+    assert p._backend.multipart_uploads == {}
+    ops = [c[0] for c in p._backend.calls]
+    assert ops.count("upload_part") == 5
+    assert "create_multipart" in ops and "complete_multipart" in ops
+    # a striped object reads back like any other (whole + ranged)
+    io_ = ReadIO(path="0/app/big")
+    run(p.read(io_))
+    assert bytes(io_.buf) == payload
+    io_ = ReadIO(path="0/app/big", byte_range=[1000, 3000])
+    run(p.read(io_))
+    assert bytes(io_.buf) == payload[1000:3000]
+
+
+def test_multipart_part_failure_aborts_with_zero_orphans():
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.storage import stripe
+
+    p = make_plugin()
+    payload = b"z" * 4096
+    with _stripe_knobs(), knobs.override_retry_max_attempts(2), (
+        knobs.override_retry_backoff_cap_s(0.01)
+    ), knobs.override_failpoints("storage.s3.part.write=http500"):
+        with pytest.raises(Exception) as ei:
+            run(stripe.striped_write(p, "0/app/doomed", payload))
+    # the injected 500 surfaces as itself (original context preserved)
+    assert getattr(ei.value, "response", {}).get("Error", {}).get(
+        "Code"
+    ) == "InternalError"
+    # abort swept the upload: no orphaned parts, no published object
+    assert p._backend.multipart_uploads == {}
+    assert ("bkt", "run/1/0/app/doomed") not in p._backend.objects
+    assert "abort_multipart" in [c[0] for c in p._backend.calls]
+
+
+def test_multipart_transient_part_faults_recover():
+    from torchsnapshot_tpu import knobs, obs
+    from torchsnapshot_tpu.storage import stripe
+
+    p = make_plugin()
+    payload = b"q" * 3000
+    r0 = obs.counter(obs.RESILIENCE_RETRIES).value
+    with _stripe_knobs(), knobs.override_retry_backoff_cap_s(0.01), (
+        knobs.override_failpoints("storage.s3.part.write=slowdown:1:2")
+    ):
+        run(stripe.striped_write(p, "0/app/flaky", payload))
+    assert obs.counter(obs.RESILIENCE_RETRIES).value - r0 >= 2
+    assert p._backend.objects[("bkt", "run/1/0/app/flaky")] == payload
+    assert p._backend.multipart_uploads == {}
+
+
+def test_s3fs_backend_declines_striped_writes():
+    p = make_plugin()
+    p._is_fs = True
+    assert not p.supports_striped_write
+
+
+def test_unstriped_write_streams_view_not_copy():
+    """The satellite fix: write() must hand the backend a VIEW of the
+    staged buffer, not a bytes() copy held across the retry loop."""
+    p = make_plugin()
+    src = bytearray(b"abcdef" * 100)
+    run(p.write(WriteIO(path="0/app/v", buf=src)))
+    put_kwargs = [
+        kw for op, kw in p._backend.validated if op == "PutObject"
+    ]
+    assert put_kwargs and isinstance(put_kwargs[-1]["Body"], memoryview)
+    assert put_kwargs[-1]["Body"].readonly
+    assert p._backend.objects[("bkt", "run/1/0/app/v")] == bytes(src)

@@ -1,0 +1,402 @@
+"""Stripe engine edge-case suite: part-boundary math, bitwise
+equivalence between striped and unstriped paths in BOTH directions
+(write striped → read whole, write whole → read ranged/striped),
+zero-length and exactly-one-part objects, dtype itemsizes straddling
+part boundaries, and streamed-write checksum folds.
+
+The fuzz legs reuse the corruption-fuzz tree generator so the same
+dtype/shape population that exercises integrity checking also
+exercises part tiling.
+"""
+
+import asyncio
+import contextlib
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage import stripe
+from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+from torchsnapshot_tpu.storage.memory import (
+    MemoryStoragePlugin,
+    reset_namespace,
+)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_corruption_fuzz import _tree  # noqa: E402  (shared fuzz population)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _knobs(part=1 << 12, min_bytes=1 << 12):
+    ctx = contextlib.ExitStack()
+    ctx.enter_context(knobs.override_stripe_part_size_bytes(part))
+    ctx.enter_context(knobs.override_stripe_min_object_size_bytes(min_bytes))
+    return ctx
+
+
+def _backends(tmp_path):
+    ns = f"stripe-{os.getpid()}-{tmp_path.name}"
+    reset_namespace(ns)
+    return [
+        MemoryStoragePlugin(ns),
+        FSStoragePlugin(str(tmp_path / "fs")),
+    ]
+
+
+# ------------------------------------------------------- plan math
+
+
+def test_plan_parts_tiles_exactly():
+    for total, part in [(1, 1), (10, 3), (4096, 4096), (4097, 4096),
+                        (3 * 4096, 4096), (5, 100)]:
+        spans = stripe.plan_parts(total, part)
+        assert spans[0][0] == 0 and spans[-1][1] == total
+        assert all(b[0] == a[1] for a, b in zip(spans, spans[1:]))
+        assert all(0 < hi - lo <= part for lo, hi in spans)
+
+
+def test_plan_parts_zero_length_is_empty():
+    assert stripe.plan_parts(0, 4096) == []
+
+
+def test_threshold_floors_above_one_part():
+    # a threshold at/below the part size would produce one-part
+    # "stripes" that pay multipart overhead for zero parallelism
+    with knobs.override_stripe_part_size_bytes(1 << 20), (
+        knobs.override_stripe_min_object_size_bytes(1)
+    ):
+        assert knobs.get_stripe_min_object_size_bytes() == (1 << 20) + 1
+    with knobs.override_stripe_min_object_size_bytes(0):
+        assert knobs.get_stripe_min_object_size_bytes() is None
+
+
+def test_exactly_one_part_object_is_not_striped(tmp_path):
+    with _knobs(part=4096, min_bytes=4096):
+        for plugin in _backends(tmp_path):
+            # exactly one part: below the floored threshold
+            assert not stripe.write_eligible(4096, plugin)
+            assert stripe.write_eligible(4097, plugin)
+
+
+# ------------------------------------------- engine-level equivalence
+
+
+@pytest.mark.parametrize(
+    "nbytes",
+    [
+        2 * 4096,          # exact multiple
+        2 * 4096 + 1,      # one byte over a boundary
+        3 * 4096 - 1,      # one byte short
+        4097,              # barely two parts
+        10 * 4096 + 137,   # ragged tail
+    ],
+)
+def test_striped_write_unstriped_read_bitwise(tmp_path, nbytes):
+    data = np.random.default_rng(nbytes).integers(
+        0, 256, size=nbytes, dtype=np.uint8
+    )
+    with _knobs():
+        for plugin in _backends(tmp_path):
+            run(stripe.striped_write(plugin, "obj", memoryview(data)))
+            rio = ReadIO(path="obj")
+            run(plugin.read(rio))
+            assert np.array_equal(
+                np.frombuffer(memoryview(rio.buf), np.uint8), data
+            ), type(plugin).__name__
+            assert run(plugin.stat("obj")) == nbytes
+
+
+@pytest.mark.parametrize("nbytes", [4097, 3 * 4096, 10 * 4096 + 137])
+def test_unstriped_write_striped_read_bitwise(tmp_path, nbytes):
+    data = np.random.default_rng(nbytes + 1).integers(
+        0, 256, size=nbytes, dtype=np.uint8
+    )
+    with _knobs():
+        for plugin in _backends(tmp_path):
+            run(plugin.write(WriteIO(path="obj", buf=memoryview(data))))
+            out = run(
+                stripe.striped_read(plugin, "obj", offset=0, length=nbytes)
+            )
+            assert np.array_equal(
+                np.frombuffer(memoryview(out), np.uint8), data
+            ), type(plugin).__name__
+            # interior ranged striped read (offset ≠ 0)
+            lo, hi = 1000, nbytes - 500
+            out = run(
+                stripe.striped_read(
+                    plugin, "obj", offset=lo, length=hi - lo
+                )
+            )
+            assert bytes(memoryview(out)) == data.tobytes()[lo:hi]
+
+
+def test_striped_read_honors_into(tmp_path):
+    nbytes = 3 * 4096 + 5
+    data = np.random.default_rng(7).integers(0, 256, nbytes, np.uint8)
+    with _knobs():
+        for plugin in _backends(tmp_path):
+            run(plugin.write(WriteIO(path="obj", buf=memoryview(data))))
+            dst = np.zeros(nbytes, np.uint8)
+            out = run(
+                stripe.striped_read(
+                    plugin, "obj", offset=0, length=nbytes, into=dst
+                )
+            )
+            assert out is dst
+            assert np.array_equal(dst, data)
+
+
+def test_zero_length_write_read(tmp_path):
+    # below any threshold, but the engine must still handle a direct
+    # call without dividing by zero or publishing garbage
+    with _knobs():
+        for plugin in _backends(tmp_path):
+            run(stripe.striped_write(plugin, "empty", memoryview(b"")))
+            rio = ReadIO(path="empty")
+            run(plugin.read(rio))
+            assert bytes(memoryview(rio.buf)) == b""
+
+
+# --------------------------------------- snapshot-level equivalence
+
+
+def _take_restore(path, state, template):
+    Snapshot.take(path, {"app": StateDict(**state)})
+    dest = {"app": StateDict(**template)}
+    Snapshot(path).restore(dest)
+    return dest["app"]
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.int16, np.float32])
+def test_itemsize_straddles_part_boundary(tmp_path, dtype):
+    """Part size deliberately NOT a multiple of the itemsize: element
+    bytes split across two parts must reassemble bitwise."""
+    part = 4096 + 3  # coprime with 2, 4 and 8
+    n = (40 * 4096) // np.dtype(dtype).itemsize
+    w = (np.random.default_rng(3).standard_normal(n) * 8).astype(dtype)
+    with _knobs(part=part, min_bytes=part):
+        got = _take_restore(
+            str(tmp_path / "s"), {"w": w}, {"w": np.zeros(n, dtype)}
+        )
+    np.testing.assert_array_equal(got["w"], w)
+
+
+def test_striped_take_unstriped_restore_and_back(tmp_path):
+    """Cross-path equivalence through the FULL stack: a snapshot taken
+    with striping on restores with striping off (and vice versa) —
+    striping must be invisible in the stored bytes."""
+    n = 1 << 16
+    w = np.arange(n, dtype=np.float32)
+    path = str(tmp_path / "a")
+    with _knobs():
+        Snapshot.take(path, {"app": StateDict(w=w)})
+        assert obs.counter(obs.STRIPE_WRITES).value > 0
+    # restore with striping disabled
+    with knobs.override_stripe_min_object_size_bytes(0):
+        dest = {"app": StateDict(w=np.zeros(n, np.float32))}
+        Snapshot(path).restore(dest)
+    np.testing.assert_array_equal(dest["app"]["w"], w)
+    # unstriped take, striped restore
+    path2 = str(tmp_path / "b")
+    with knobs.override_stripe_min_object_size_bytes(0):
+        Snapshot.take(path2, {"app": StateDict(w=w + 1)})
+    with _knobs():
+        dest = {"app": StateDict(w=np.zeros(n, np.float32))}
+        Snapshot(path2).restore(dest)
+    np.testing.assert_array_equal(dest["app"]["w"], w + 1)
+
+
+def test_streamed_write_checksums_fold_correctly(tmp_path):
+    """The streamed path folds per-part digests into the manifest crc;
+    deep verify re-reads everything and must agree."""
+    n = 1 << 16
+    path = str(tmp_path / "s")
+    with _knobs():
+        Snapshot.take(
+            path, {"app": StateDict(w=np.arange(n, dtype=np.float64))}
+        )
+        assert obs.counter(obs.STRIPE_STREAMED_WRITES).value > 0
+        result = Snapshot(path).verify(deep=True)
+    assert result.ok, result
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_striped_roundtrip_fuzz(tmp_path, seed):
+    """Corruption-fuzz tree population through striped take+restore:
+    mixed dtypes/sizes, ragged part tails, object and scalar leaves."""
+    rng = np.random.default_rng(seed)
+    tree = _tree(rng)
+    path = str(tmp_path / f"s{seed}")
+    with _knobs(part=4096 + 1, min_bytes=4096 + 1):
+        Snapshot.take(path, {"app": StateDict(**tree)})
+        dest = {
+            "app": StateDict(
+                **{
+                    k: (np.zeros_like(v) if isinstance(v, np.ndarray) else v)
+                    for k, v in tree.items()
+                }
+            )
+        }
+        Snapshot(path).restore(dest)
+    for k, v in tree.items():
+        if isinstance(v, np.ndarray):
+            np.testing.assert_array_equal(dest["app"][k], v)
+        else:
+            assert dest["app"][k] == v
+
+
+def test_stream_window_bounds_budget(tmp_path):
+    """A streamed object larger than the budget still moves: the
+    admission reservation is a window of parts, not the object."""
+    n = 1 << 16  # 256KB float32
+    w = np.arange(n, dtype=np.float32)
+    path = str(tmp_path / "s")
+    with _knobs(part=1 << 12, min_bytes=1 << 12), (
+        knobs.override_per_rank_memory_budget_bytes(64 * 1024)
+    ):
+        Snapshot.take(path, {"app": StateDict(w=w)})
+        dest = {"app": StateDict(w=np.zeros(n, np.float32))}
+        Snapshot(path).restore(dest)
+    np.testing.assert_array_equal(dest["app"]["w"], w)
+
+
+def test_abort_leaves_no_temp_files(tmp_path):
+    """Engine-level abort cleanliness on fs: a failing part write
+    sweeps the preallocated temp file."""
+    plugin = FSStoragePlugin(str(tmp_path / "fs"))
+    with _knobs(), knobs.override_retry_backoff_cap_s(0.01), (
+        knobs.override_failpoints("storage.fs.part.write=io")
+    ):
+        with pytest.raises(OSError):
+            run(
+                stripe.striped_write(
+                    plugin, "doomed", memoryview(b"x" * (3 * 4096))
+                )
+            )
+    leftovers = []
+    for dirpath, _dirs, files in os.walk(str(tmp_path / "fs")):
+        leftovers.extend(f for f in files)
+    assert leftovers == [], leftovers
+
+
+# ------------------------------------------- review-hardening cases
+
+
+def test_cancellation_aborts_handle():
+    """Outer cancellation (the scheduler tearing down sibling pipelines)
+    must still abort the handle — an unaborted S3 multipart upload
+    bills storage forever."""
+    from torchsnapshot_tpu.io_types import StoragePlugin, StripedWriteHandle
+
+    events = []
+
+    class Handle(StripedWriteHandle):
+        async def write_part(self, index, offset, buf, want_digest=False):
+            events.append(("part", index))
+            await asyncio.sleep(30)
+
+        async def complete(self):
+            events.append(("complete",))
+
+        async def abort(self):
+            events.append(("abort",))
+
+    class Plugin(StoragePlugin):
+        supports_striped_write = True
+        obs_backend = "fake"
+
+        async def begin_striped_write(self, path, total):
+            return Handle()
+
+        async def write(self, write_io):  # pragma: no cover
+            raise AssertionError
+
+        async def read(self, read_io):  # pragma: no cover
+            raise AssertionError
+
+        async def delete(self, path):  # pragma: no cover
+            raise AssertionError
+
+    async def main():
+        task = asyncio.ensure_future(
+            stripe.striped_write(Plugin(), "x", memoryview(b"a" * 8200))
+        )
+        await asyncio.sleep(0.05)
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        await asyncio.sleep(0.05)  # let the shielded abort settle
+
+    with _knobs():
+        asyncio.new_event_loop().run_until_complete(main())
+    assert ("abort",) in events
+    assert ("complete",) not in events
+
+
+def test_defensive_copy_stager_declines_streaming():
+    """An async take still holding its defensive-copy obligation must
+    stage whole: per-part copies would move the unblock point from one
+    memcpy to the whole upload (streams delay staging_done)."""
+    from torchsnapshot_tpu.preparers.array import HostArrayBufferStager
+
+    arr = np.zeros(1 << 20, np.uint8)
+    assert HostArrayBufferStager(arr, defensive_copy=True).part_plan(4096) is None
+    assert HostArrayBufferStager(arr, defensive_copy=False).part_plan(4096)
+
+
+def test_s3_lost_complete_response_verifies_published():
+    """A complete whose first attempt committed server-side but lost its
+    response must not fail the take: the retry's NoSuchUpload is
+    resolved by size verification against the published object."""
+    sys.path.pop(0) if False else None
+    from test_s3_storage import make_plugin
+
+    p = make_plugin()
+    real_complete = p._backend.complete_multipart_upload
+    dropped = []
+
+    def flaky_complete(**kw):
+        real_complete(**kw)  # commits server-side
+        if not dropped:
+            dropped.append(1)
+            raise ConnectionError("response lost after commit")
+
+    p._backend.complete_multipart_upload = flaky_complete
+    payload = b"p" * 4096 * 3
+    with _knobs(), knobs.override_retry_backoff_cap_s(0.01):
+        run(stripe.striped_write(p, "0/app/lost", payload))
+    assert p._backend.objects[("bkt", "run/1/0/app/lost")] == payload
+    assert p._backend.multipart_uploads == {}
+
+
+def test_gcs_zero_part_complete_publishes_empty(tmp_path):
+    """A zero-part striped handle must publish an empty object, not
+    hang composing an empty source list."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from concurrent.futures import ThreadPoolExecutor
+
+    from test_gcs_chunked import FakeBucket
+
+    from torchsnapshot_tpu.resilience import SharedProgress
+    from torchsnapshot_tpu.storage.gcs import GCSStoragePlugin
+
+    p = GCSStoragePlugin.__new__(GCSStoragePlugin)
+    p.prefix = "run"
+    p._bucket = FakeBucket()
+    p._executor = ThreadPoolExecutor(max_workers=2)
+    p._retry = SharedProgress(window_s=30.0, label="gcs-stripe")
+    p._chunk_bytes = 1 << 20
+
+    async def zero_parts():
+        handle = await p.begin_striped_write("empty", 0)
+        await handle.complete()
+
+    run(zero_parts())
+    assert p._bucket.data["run/empty"] == b""

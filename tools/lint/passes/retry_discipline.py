@@ -48,12 +48,21 @@ _OP_NAMES = frozenset(
         # StoragePlugin surface (async + sync wrappers)
         "write", "read", "stat", "delete", "link_from",
         "sync_write", "sync_read", "sync_stat", "sync_delete",
+        # striped-write part surface (io_types.StripedWriteHandle +
+        # storage/stripe.py): part-level entry points carry the SAME
+        # retry obligation as whole-object ops — a sleep loop around a
+        # part write would fork the policy at exactly the granularity
+        # the stripe engine moved it to
+        "write_part", "begin_striped_write", "striped_write",
+        "striped_read", "streamed_part_write",
         # raw client verbs the plugins drive
         "put_object", "get_object", "head_object", "delete_object",
         "upload_from_file", "download_as_bytes", "compose",
         "copy_object", "copy_blob", "cat_file", "pipe", "rm_file",
+        "create_multipart_upload", "upload_part",
+        "complete_multipart_upload", "abort_multipart_upload",
         # local filesystem
-        "open",
+        "open", "pwrite",
     }
 )
 

@@ -189,6 +189,16 @@ class BatchedBufferStager(BufferStager):
         self.stagers = []
         return memoryview(slab).cast("B")
 
+    def part_plan(self, part_size_bytes: int):
+        # Deliberately not part-streamable: members carry re-ranged
+        # checksum sinks over interior slab spans, the device pack is a
+        # single XLA op with no per-part completion signal, and the host
+        # fallback's fused copy+digest already records per-member piece
+        # digests.  A slab that clears the stripe threshold still gets
+        # intra-object write parallelism from the whole-staged striped
+        # path in scheduler._write_one_inner.
+        return None
+
     def get_staging_cost_bytes(self) -> int:
         # covers both paths: device pack holds just the slab (1x); the
         # sequential host fallback holds slab + one member at a time

@@ -60,6 +60,36 @@ class BufferStager(abc.ABC):
     def get_staging_cost_bytes(self) -> int:
         """Peak host memory consumed while the staged buffer is alive."""
 
+    # ------------------------------------------------- part streaming
+    # Optional capability consumed by the scheduler's stripe path: a
+    # stager that can produce its bytes one part at a time lets a large
+    # object's staging and storage I/O overlap WITHIN the object — a
+    # part stages, its write dispatches immediately, later parts are
+    # still staging — and the memory-budget reservation shrinks from
+    # the whole object to a window of parts.  Stagers that can only
+    # materialize whole (device packs, slabs with interior checksum
+    # ranges) keep the defaults and stage as before.
+
+    def part_plan(self, part_size_bytes: int) -> Optional[List[Tuple[int, int]]]:
+        """``[start, end)`` byte spans that exactly tile the staged
+        object (last span may be short), or None when this stager can
+        only stage whole.  Spans must be returnable BEFORE staging (the
+        exact-size property the buffer-protocol stagers already have)."""
+        return None
+
+    async def stage_part(
+        self, span: Tuple[int, int], executor: Optional[Executor] = None
+    ) -> Any:
+        """Produce exactly the bytes of ``span`` (a span from
+        ``part_plan``).  Each part buffer must be independent of the
+        others so it can be released as soon as its write completes."""
+        raise NotImplementedError
+
+    def release_source(self) -> None:
+        """Drop references to the staging source after the last
+        ``stage_part`` call (success or failure) — the part-streaming
+        twin of ``stage_buffer``'s drop-refs-early discipline."""
+
 
 class BufferConsumer(abc.ABC):
     """Read-side dual of BufferStager (reference io_types.py:41-56)."""
@@ -174,6 +204,46 @@ class ReadIO:
     into: Any = None
 
 
+class StripedWriteHandle(abc.ABC):
+    """One in-flight striped (multipart) write of a single object.
+
+    Obtained from ``StoragePlugin.begin_striped_write``; parts may be
+    written concurrently and in any order, then EXACTLY ONE of
+    ``complete``/``abort`` finishes the handle.  The object must never
+    be observable half-written: ``complete`` is the atomic publish (S3
+    CompleteMultipartUpload, GCS compose, fs temp→rename) and ``abort``
+    must leave zero orphaned parts/temp files behind — a poisoned or
+    failed take cleans up after itself (the chaos suite asserts this).
+
+    Retry/failpoint/breaker discipline lives INSIDE ``write_part`` (the
+    per-backend classifiers know what a transient looks like), so a
+    transient mid-object re-sends one part, not the object."""
+
+    # the part-level twin of StoragePlugin.supports_fused_digest: True
+    # when write_part honors ``want_digest`` by computing the part's
+    # (crc32, adler32) fused with its copy/upload — the stripe engine
+    # then skips its separate per-part digest pass
+    supports_fused_digest: bool = False
+
+    @abc.abstractmethod
+    async def write_part(
+        self, index: int, offset: int, buf: Any, want_digest: bool = False
+    ) -> Optional[Tuple[int, int]]:
+        """Write ``buf`` at byte ``offset`` as part ``index`` (0-based,
+        contiguous, exactly tiling the object).  Returns the part's
+        (crc32, adler32) when ``want_digest`` and the handle fuses
+        digests, else None."""
+
+    @abc.abstractmethod
+    async def complete(self) -> None:
+        """Atomically publish the assembled object."""
+
+    @abc.abstractmethod
+    async def abort(self) -> None:
+        """Tear down without publishing; idempotent and best-effort
+        (never raises over the original failure)."""
+
+
 class StoragePlugin(abc.ABC):
     """Async storage backend (reference io_types.py:80-120)."""
 
@@ -183,6 +253,19 @@ class StoragePlugin(abc.ABC):
     # such plugins — on anything else the pre-write digest path keeps
     # its staging-phase overlap.
     supports_fused_digest: bool = False
+
+    # True when begin_striped_write is implemented; the stripe engine
+    # (storage/stripe.py) checks this before splitting a write.  Ranged
+    # READS need no capability flag — every plugin already honors
+    # ReadIO.byte_range, so striped restore works against any backend.
+    supports_striped_write: bool = False
+
+    async def begin_striped_write(
+        self, path: str, total_size: int
+    ) -> StripedWriteHandle:
+        """Open a striped write of ``total_size`` bytes to ``path``.
+        Only called when ``supports_striped_write`` is True."""
+        raise NotImplementedError
 
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None: ...

@@ -46,6 +46,8 @@ _RETRY_BACKOFF_CAP_S = "RETRY_BACKOFF_CAP_S"
 _BREAKER_THRESHOLD = "BREAKER_THRESHOLD"
 _BREAKER_COOLDOWN_S = "BREAKER_COOLDOWN_S"
 _S3_ENDPOINT_URL = "S3_ENDPOINT_URL"
+_STRIPE_PART_SIZE_BYTES = "STRIPE_PART_SIZE_BYTES"
+_STRIPE_MIN_OBJECT_SIZE_BYTES = "STRIPE_MIN_OBJECT_SIZE_BYTES"
 _TIER_POLICY = "TIER_POLICY"
 _TIER_FAST_KEEP_LAST_N = "TIER_FAST_KEEP_LAST_N"
 _TIER_VERIFY_FAST_READS = "TIER_VERIFY_FAST_READS"
@@ -191,6 +193,16 @@ _DEFAULTS = {
     # (url_to_storage_plugin has no options channel); the legacy
     # TSNP_S3_ENDPOINT_URL spelling is still honored as a fallback.
     _S3_ENDPOINT_URL: None,
+    # Striped storage I/O (storage/stripe.py): objects at or above
+    # STRIPE_MIN_OBJECT_SIZE_BYTES are split into STRIPE_PART_SIZE_BYTES
+    # parts driven concurrently — S3 true multipart uploads, GCS
+    # parallel compose-part uploads, fs offset-parallel pwrite into the
+    # preallocated temp file, memory ranged writes — and restore reads
+    # fan out as parallel ranged GETs.  Retry/failpoint/breaker/metrics
+    # granularity moves to the part: a transient mid-object re-sends one
+    # part, not the object.  Set MIN to 0 to disable striping entirely.
+    _STRIPE_PART_SIZE_BYTES: 64 * 1024 * 1024,
+    _STRIPE_MIN_OBJECT_SIZE_BYTES: 128 * 1024 * 1024,
     # Default policy for tiered storage (tier/) when the tier options
     # don't name one: "write_back" acks a take when the FAST tier
     # commits and promotes to the durable tier in the background (the
@@ -427,6 +439,21 @@ def get_s3_endpoint_url() -> Optional[str]:
     return v or None
 
 
+def get_stripe_part_size_bytes() -> int:
+    return max(1, _get_int(_STRIPE_PART_SIZE_BYTES))
+
+
+def get_stripe_min_object_size_bytes() -> Optional[int]:
+    """Striping threshold, or None when striping is disabled (0).  The
+    floor of one part guards against a threshold below the part size
+    producing single-part "stripes" that pay the multipart overhead
+    (create/complete round-trips) for zero parallelism."""
+    v = _get_int(_STRIPE_MIN_OBJECT_SIZE_BYTES)
+    if v <= 0:
+        return None
+    return max(v, get_stripe_part_size_bytes() + 1)
+
+
 def get_tier_policy() -> str:
     v = str(_get_raw(_TIER_POLICY)).lower()
     if v not in ("write_back", "write_through"):
@@ -580,6 +607,14 @@ def override_restore_donate(value):
 
 def override_s3_endpoint_url(value):
     return _override(_S3_ENDPOINT_URL, value)
+
+
+def override_stripe_part_size_bytes(value: int):
+    return _override(_STRIPE_PART_SIZE_BYTES, value)
+
+
+def override_stripe_min_object_size_bytes(value: int):
+    return _override(_STRIPE_MIN_OBJECT_SIZE_BYTES, value)
 
 
 def override_tier_policy(value: str):

@@ -47,6 +47,16 @@ from .metrics import (  # noqa: F401
     RESILIENCE_RETRIES,
     RSS_PEAK_DELTA_BYTES,
     SLABS_PACKED,
+    STRIPE_ABORTS,
+    STRIPE_BYTES_READ,
+    STRIPE_BYTES_WRITTEN,
+    STRIPE_PART_READ_LATENCY_S,
+    STRIPE_PART_WRITE_LATENCY_S,
+    STRIPE_PARTS_READ,
+    STRIPE_PARTS_WRITTEN,
+    STRIPE_READS,
+    STRIPE_STREAMED_WRITES,
+    STRIPE_WRITES,
     TIER_FAST_CORRUPT,
     TIER_FAST_HITS,
     TIER_FAST_MISSES,
@@ -173,6 +183,9 @@ def instrument_storage(backend: str):
 
         cls.write = write
         cls.read = read
+        # the stripe engine bypasses write() (it drives write_part on a
+        # handle) but still labels its per-part metrics by backend
+        cls.obs_backend = backend
         return cls
 
     return deco

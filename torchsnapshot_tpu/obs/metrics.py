@@ -66,6 +66,23 @@ TIER_PEER_HITS = "tier.peer_hits"
 BYTES_PROMOTED = "tier.bytes_promoted"
 BYTES_REPLICATED = "tier.bytes_replicated"
 PROMOTION_LAG_S = "tier.promotion_lag_s"
+# Striped storage I/O (storage/stripe.py): whole-object writes/reads
+# that were split into parts, the parts themselves, bytes moved through
+# the striped paths, and aborted striped writes (failure/poison cleanup
+# that tore down a multipart upload).  Part-level latencies land in the
+# storage.stripe.part_write_latency_s / part_read_latency_s histograms;
+# per-backend byte/latency instruments keep recording per part via
+# record_storage_io, so backend dashboards see striped traffic too.
+STRIPE_WRITES = "storage.stripe.writes"
+STRIPE_READS = "storage.stripe.reads"
+STRIPE_PARTS_WRITTEN = "storage.stripe.parts_written"
+STRIPE_PARTS_READ = "storage.stripe.parts_read"
+STRIPE_BYTES_WRITTEN = "storage.stripe.bytes_written"
+STRIPE_BYTES_READ = "storage.stripe.bytes_read"
+STRIPE_ABORTS = "storage.stripe.aborts"
+STRIPE_STREAMED_WRITES = "storage.stripe.streamed_writes"
+STRIPE_PART_WRITE_LATENCY_S = "storage.stripe.part_write_latency_s"
+STRIPE_PART_READ_LATENCY_S = "storage.stripe.part_read_latency_s"
 # GC/retention: bytes of storage objects reclaimed by delete_snapshot
 GC_BYTES_RECLAIMED = "snapshot.gc.bytes_reclaimed"
 # Resilience (resilience/): transient-error retries (total, plus

@@ -285,6 +285,58 @@ class HostArrayBufferStager(BufferStager):
             self.arr = None
         return array_as_memoryview(arr)
 
+    # ------------------------------------------------- part streaming
+    # A host array is the one source whose bytes exist BEFORE staging,
+    # so it can stage per part for the scheduler's stripe stream path:
+    # each part is a view (sync take: zero copy) or a part-sized
+    # defensive copy (async take: the copy that used to be whole-object
+    # now peaks at the stream window), and the part's write dispatches
+    # while later parts are still copying.
+
+    def part_plan(self, part_size_bytes: int):
+        arr = self.arr
+        if self.defensive_copy:
+            # an async take that still needs its defensive copy must
+            # take it WHOLE at staging time: per-part copies would move
+            # the unblock point (staging_done, which streams delay to
+            # ~write completion) from one memcpy to the whole upload.
+            # Eager offload clears this flag once it owns a private
+            # copy, so offloaded async leaves still stream.
+            return None
+        if (
+            arr is None
+            or not arr.flags["C_CONTIGUOUS"]
+            or arr.dtype.byteorder == ">"
+        ):
+            # staging whole would copy/normalize anyway — per-part
+            # staging on top of that would re-copy the object per part
+            return None
+        from ..storage.stripe import plan_parts
+
+        return plan_parts(arr.nbytes, part_size_bytes)
+
+    async def stage_part(
+        self, span, executor: Optional[Executor] = None
+    ):
+        lo, hi = span
+        view = array_as_memoryview(self.arr)[lo:hi]
+        if not self.defensive_copy:
+            return view
+
+        def copy() -> np.ndarray:
+            dst = np.empty(hi - lo, dtype=np.uint8)
+            np.copyto(dst, np.frombuffer(view, dtype=np.uint8))
+            return dst
+
+        if executor is not None:
+            return await asyncio.get_running_loop().run_in_executor(
+                executor, copy
+            )
+        return copy()
+
+    def release_source(self) -> None:
+        self.arr = None
+
     def get_staging_cost_bytes(self) -> int:
         return self.arr.nbytes if self.arr is not None else 0
 

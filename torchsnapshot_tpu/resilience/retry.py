@@ -240,7 +240,10 @@ def _http_status(e: BaseException) -> Optional[int]:
     return status if isinstance(status, int) else None
 
 
-_S3_MISSING_CODES = frozenset(("NoSuchKey", "404"))
+# NoSuchUpload: the multipart-upload twin of NoSuchKey — an abort/part
+# op against an upload id that no longer exists (already aborted or
+# completed); maps to MISSING so abort-on-cleanup stays idempotent
+_S3_MISSING_CODES = frozenset(("NoSuchKey", "NoSuchUpload", "404"))
 _S3_TRANSIENT_CODES = frozenset(
     (
         "SlowDown",

@@ -54,6 +54,14 @@ from .obs import buf_nbytes as _buf_nbytes
 from .obs import metrics as obs_metrics
 from .obs import tracer as obs_tracer
 from .resilience.failpoints import failpoint
+from .storage import stripe
+
+# Parts of one streamed object in flight (staged-but-unwritten or
+# writing) at a time.  This bound IS the budget reservation for the
+# whole object: a streamed 8GB tensor reserves 4 parts' worth of host
+# memory instead of 8GB, which is what lets objects larger than the
+# budget move under it (the progress rule used to admit them alone).
+_STREAM_WINDOW_PARTS = 4
 
 logger = logging.getLogger(__name__)
 
@@ -180,6 +188,8 @@ class _WritePipeline:
     __slots__ = (
         "write_req",
         "staging_cost",
+        "admission_cost",
+        "stream_spans",
         "buf",
         "buf_size",
         "deduped",
@@ -189,6 +199,13 @@ class _WritePipeline:
     def __init__(self, write_req: WriteReq) -> None:
         self.write_req = write_req
         self.staging_cost = write_req.buffer_stager.get_staging_cost_bytes()
+        # what budget admission actually debits: the full staging cost,
+        # except for part-streamed striped writes, which reserve only a
+        # window of parts (set in _execute_write_pipelines)
+        self.admission_cost = self.staging_cost
+        # part spans when this pipeline stage→writes per part through
+        # the stripe engine instead of staging whole
+        self.stream_spans = None
         self.buf = None
         self.buf_size = 0
         self.deduped = False
@@ -287,10 +304,33 @@ async def _execute_write_pipelines(
     staging_done: threading.Event,
     stats: dict,
 ) -> None:
+    # Part-streaming eligibility: a stager that can produce parts, a
+    # plugin that can absorb them, an object over the stripe threshold,
+    # and no interior checksum ranges (slab member sinks need the whole
+    # buffer) or pending dedup decision (link-vs-write needs the object
+    # digest before any byte moves).  Eligible pipelines reserve only a
+    # window of parts from the budget and stage→write each part through
+    # the stripe engine.
+    part_size = knobs.get_stripe_part_size_bytes()
+    for p in pipelines:
+        wr = p.write_req
+        if (
+            wr.dedup is None
+            and stripe.write_eligible(p.staging_cost, storage)
+            and all(rng is None for _, rng in (wr.checksum_sinks or ()))
+        ):
+            spans = wr.buffer_stager.part_plan(part_size)
+            if spans and len(spans) > 1 and spans[-1][1] == p.staging_cost:
+                p.stream_spans = spans
+                p.admission_cost = min(
+                    p.staging_cost, _STREAM_WINDOW_PARTS * part_size
+                )
+
     ready_for_staging = deque(pipelines)
     ready_for_io: deque = deque()
     staging_tasks: set = set()
     io_tasks: set = set()
+    stream_tasks: set = set()
     io_concurrency = knobs.get_max_per_rank_io_concurrency()
     reporter = _WriteReporter(budget, stats)
     # observability: counters/gauges are always on (one locked arithmetic
@@ -321,10 +361,10 @@ async def _execute_write_pipelines(
         if sp is not None:
             tracer.end(sp, fire_event=True)
 
-    # smallest pending staging cost: lets a wake where nothing can fit
+    # smallest pending admission cost: lets a wake where nothing can fit
     # skip the admission scan in O(1) instead of rotating the whole
     # deque on every task completion (O(n^2) across a large take)
-    min_pending_cost = min((p.staging_cost for p in pipelines), default=0)
+    min_pending_cost = min((p.admission_cost for p in pipelines), default=0)
 
     async def stage_one(p: _WritePipeline) -> _WritePipeline:
         with obs_tracer.span(
@@ -351,6 +391,7 @@ async def _execute_write_pipelines(
                 getattr(storage, "supports_fused_digest", False)
                 and wr.dedup is None
                 and precomputed is None
+                and not stripe.write_eligible(p.buf_size, storage)
                 and all(
                     rng is None or (rng[0] == 0 and rng[1] == p.buf_size)
                     for _, rng in (wr.checksum_sinks or ())
@@ -409,6 +450,15 @@ async def _execute_write_pipelines(
                     "dedup link for %r failed (%r); writing normally",
                     wr.path, e,
                 )
+        if not p.defer_digest and stripe.write_eligible(p.buf_size, storage):
+            # whole-staged striped write: the buffer exists, so split it
+            # into concurrent parts (true multipart on s3, compose parts
+            # on gcs, offset-parallel pwrite on fs).  Checksums were
+            # applied at staging — defer_digest is disabled for
+            # stripe-eligible writes (_stage_one_inner), since part
+            # writes can't fuse a whole-object digest.
+            await stripe.striped_write(storage, wr.path, p.buf)
+            return p
         wio = WriteIO(path=wr.path, buf=p.buf, want_digest=p.defer_digest)
         await storage.write(wio)
         if p.defer_digest:
@@ -431,6 +481,60 @@ async def _execute_write_pipelines(
                     wr.digest_sink([d[0], d[1], p.buf_size])
         return p
 
+    async def stream_one(p: _WritePipeline) -> _WritePipeline:
+        """Per-part stage→write streaming through the stripe engine: a
+        part's copy completes → its write dispatches immediately while
+        later parts are still staging.  Budget debit/credit, retries,
+        failpoints, breaker accounting and spans/metrics all sit at
+        part granularity inside the engine."""
+        wr = p.write_req
+        want = bool(wr.checksum_sinks or wr.digest_sink) and (
+            knobs.write_checksums_enabled()
+        )
+
+        def on_part_staged(n: int) -> None:
+            m_staged.inc(n)
+
+        def on_part_done(n: int) -> None:
+            stats["bytes_written"] += n
+            m_written.inc(n)
+
+        with obs_tracer.span(
+            "pipeline/stream", path=wr.path, bytes=p.staging_cost,
+            parts=len(p.stream_spans),
+        ):
+            # both scheduler failpoints fire so existing stage/write
+            # chaos schedules keep covering streamed objects
+            failpoint("scheduler.stage", path=wr.path)
+            failpoint("scheduler.write", path=wr.path)
+            digests = await stripe.streamed_part_write(
+                storage,
+                wr.path,
+                wr.buffer_stager,
+                p.stream_spans,
+                executor,
+                window_parts=_STREAM_WINDOW_PARTS,
+                on_part_staged=on_part_staged,
+                on_part_done=on_part_done,
+                want_digests=want,
+            )
+        p.buf_size = p.staging_cost
+        if want and digests:
+            from .utils.checksums import combine_piece_digests
+
+            crc, adler, total = combine_piece_digests(digests)
+            for sink, _rng in wr.checksum_sinks or ():
+                sink(crc)
+            if wr.digest_sink is not None:
+                wr.digest_sink([crc, adler, total])
+        return p
+
+    def _launch(p: _WritePipeline) -> None:
+        if p.stream_spans is not None:
+            stream_tasks.add(asyncio.ensure_future(stream_one(p)))
+        else:
+            staging_tasks.add(asyncio.ensure_future(stage_one(p)))
+
     def dispatch_staging() -> None:
         # Scan ALL pending requests, admitting every one that fits the
         # remaining budget — the deque is largest-first, so breaking at
@@ -445,24 +549,29 @@ async def _execute_write_pipelines(
             new_min = None
             for _ in range(len(ready_for_staging)):
                 p = ready_for_staging.popleft()
-                if budget.fits(p.staging_cost):
-                    budget.debit(p.staging_cost)
+                if budget.fits(p.admission_cost):
+                    budget.debit(p.admission_cost)
                     _admitted(p)
-                    staging_tasks.add(asyncio.ensure_future(stage_one(p)))
+                    _launch(p)
                 else:
                     ready_for_staging.append(p)
-                    if new_min is None or p.staging_cost < new_min:
-                        new_min = p.staging_cost
+                    if new_min is None or p.admission_cost < new_min:
+                        new_min = p.admission_cost
             min_pending_cost = new_min or 0
             if not ready_for_staging:
                 return
-        if not staging_tasks and not io_tasks and not ready_for_io:
+        if (
+            not staging_tasks
+            and not stream_tasks
+            and not io_tasks
+            and not ready_for_io
+        ):
             # rotation preserves the largest-first order, so the head is
             # the largest pending item; admitting it leaves min unchanged
             p = ready_for_staging.popleft()
-            budget.debit(p.staging_cost)
+            budget.debit(p.admission_cost)
             _admitted(p)
-            staging_tasks.add(asyncio.ensure_future(stage_one(p)))
+            _launch(p)
             if not ready_for_staging:
                 min_pending_cost = 0
 
@@ -473,21 +582,27 @@ async def _execute_write_pipelines(
         m_ioq.set(len(ready_for_io))
 
     try:
-        while ready_for_staging or staging_tasks or ready_for_io or io_tasks:
+        while (
+            ready_for_staging
+            or staging_tasks
+            or ready_for_io
+            or io_tasks
+            or stream_tasks
+        ):
             dispatch_staging()
             dispatch_io()
             reporter.maybe_report(
                 len(ready_for_staging),
-                len(staging_tasks),
+                len(staging_tasks) + len(stream_tasks),
                 len(ready_for_io),
                 len(io_tasks),
             )
-            if not staging_tasks and not io_tasks:
+            if not staging_tasks and not io_tasks and not stream_tasks:
                 continue
             # timeout keeps the reporter ticking through long stalls (e.g.
             # one giant storage write in flight)
             done, _ = await asyncio.wait(
-                staging_tasks | io_tasks,
+                staging_tasks | io_tasks | stream_tasks,
                 return_when=asyncio.FIRST_COMPLETED,
                 timeout=_PROGRESS_INTERVAL_S,
             )
@@ -502,6 +617,13 @@ async def _execute_write_pipelines(
                     m_staged.inc(p.buf_size)
                     ready_for_io.append(p)
                     m_ioq.set(len(ready_for_io))
+                elif task in stream_tasks:
+                    # streamed pipelines account bytes per part inside
+                    # the engine; only the window reservation returns
+                    stream_tasks.discard(task)
+                    p = task.result()
+                    budget.credit(p.admission_cost)
+                    m_budget.set(budget.used)
                 else:
                     io_tasks.discard(task)
                     p = task.result()
@@ -513,13 +635,21 @@ async def _execute_write_pipelines(
                     budget.credit(p.buf_size)
                     m_budget.set(budget.used)
                     p.buf = None
-            if not ready_for_staging and not staging_tasks:
+            if (
+                not ready_for_staging
+                and not staging_tasks
+                and not stream_tasks
+            ):
+                # a streamed pipeline's source stays referenced until
+                # its LAST part stages, so "staging done" (the point the
+                # caller may mutate training state again) must wait for
+                # in-flight streams too
                 staging_done.set()
         stats["end_ts"] = time.monotonic()
         staging_done.set()
     except BaseException:
         staging_done.set()  # unblock the waiting caller; error surfaces via fut
-        for t in staging_tasks | io_tasks:
+        for t in staging_tasks | io_tasks | stream_tasks:
             t.cancel()
         raise
     finally:
@@ -712,6 +842,34 @@ async def _execute_read_pipelines(
     # on wakes where nothing can fit (see the write loop's twin)
     min_pending_cost = min((p.consuming_cost for p in pipelines), default=0)
 
+    # striped reads need the object's byte length up front; a whole-
+    # object read only knows its consuming-cost ESTIMATE, so resolve it
+    # with a stat — but never through the base-class default, which
+    # "stats" by reading the whole object (all shipped plugins override
+    # it with a cheap metadata call)
+    cheap_stat = type(storage).stat is not StoragePlugin.stat
+
+    async def _striped_read(p: _ReadPipeline, sp) -> bool:
+        """Fan a large read out as parallel ranged part GETs through the
+        stripe engine (storage/stripe.py).  Returns False when the read
+        turns out ineligible (size below threshold once known) so the
+        caller falls through to the single-stream path."""
+        rr = p.read_req
+        if rr.byte_range is not None:
+            offset, length = rr.byte_range[0], rr.byte_range[1] - rr.byte_range[0]
+        else:
+            if not cheap_stat:
+                return False
+            offset, length = 0, await storage.stat(rr.path)
+        if not stripe.read_eligible(length):
+            return False
+        if sp is not None:
+            sp.attrs["striped"] = True
+        p.buf = await stripe.striped_read(
+            storage, rr.path, offset=offset, length=length, into=rr.into
+        )
+        return True
+
     async def read_one(p: _ReadPipeline) -> _ReadPipeline:
         with obs_tracer.span(
             "pipeline/io",
@@ -720,10 +878,19 @@ async def _execute_read_pipelines(
             op="read",
         ) as sp:
             failpoint("scheduler.read", path=p.read_req.path)
+            rr = p.read_req
+            if stripe.read_eligible(
+                rr.byte_range[1] - rr.byte_range[0]
+                if rr.byte_range is not None
+                else p.consuming_cost
+            ) and await _striped_read(p, sp):
+                if sp is not None:
+                    sp.attrs["bytes"] = _buf_nbytes(p.buf)
+                return p
             read_io = ReadIO(
-                path=p.read_req.path,
-                byte_range=p.read_req.byte_range,
-                into=p.read_req.into,
+                path=rr.path,
+                byte_range=rr.byte_range,
+                into=rr.into,
             )
             await storage.read(read_io)
             p.buf = read_io.buf

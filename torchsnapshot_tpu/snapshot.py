@@ -355,6 +355,34 @@ def _merge_crc_payloads(
         metadata.objects.update(p.get("objects") or {})
 
 
+_STRIPE_EVENT_COUNTERS = (
+    obs.STRIPE_WRITES,
+    obs.STRIPE_READS,
+    obs.STRIPE_PARTS_WRITTEN,
+    obs.STRIPE_PARTS_READ,
+    obs.STRIPE_BYTES_WRITTEN,
+    obs.STRIPE_BYTES_READ,
+    obs.STRIPE_ABORTS,
+)
+
+
+def _stripe_event_stamp():
+    """Capture the stripe counters now; the returned stamp writes the
+    DELTAS into a take/restore event's metadata — how much of the
+    operation's I/O moved through striped paths (and whether any
+    multipart write had to abort) lands next to duration_s in the event
+    stream, where a throughput incident review will look first."""
+    before = {n: obs.counter(n).value for n in _STRIPE_EVENT_COUNTERS}
+
+    def stamp(event: "Event") -> None:
+        for n in _STRIPE_EVENT_COUNTERS:
+            delta = obs.counter(n).value - before[n]
+            if delta:
+                event.metadata[n] = delta
+
+    return stamp
+
+
 def _validate_app_state(app_state: Dict[str, Any]) -> None:
     # reference snapshot.py:672-690
     for key, value in app_state.items():
@@ -418,7 +446,8 @@ class Snapshot:
         coordinator = coordinator or get_default_coordinator()
         with log_event(
             Event("take", {"path": path, "rank": coordinator.rank})
-        ):
+        ) as take_event:
+            stamp_stripe = _stripe_event_stamp()
             (
                 metadata, pending_io, storage, commit_uid,
                 local_entries, object_crcs,
@@ -482,6 +511,7 @@ class Snapshot:
                 )
                 raise
             finally:
+                stamp_stripe(take_event)
                 storage.sync_close()
         snapshot = cls(path, coordinator, storage_options=storage_options)
         snapshot._metadata_cache = metadata
@@ -1003,7 +1033,10 @@ class Snapshot:
         coordinator = self._coordinator
         rank, world = coordinator.rank, coordinator.world_size
         _validate_app_state(app_state)
-        with log_event(Event("restore", {"path": self.path, "rank": rank})):
+        with log_event(
+            Event("restore", {"path": self.path, "rank": rank})
+        ) as restore_event:
+            stamp_stripe = _stripe_event_stamp()
             # abort-aware restore: the scope uid is agreed up front (the
             # per-instance uid counter runs in the same program order on
             # every rank), and covers EVERYTHING that can fail — even a
@@ -1049,6 +1082,7 @@ class Snapshot:
                 )
                 raise
             finally:
+                stamp_stripe(restore_event)
                 if storage is not None:
                     storage.sync_close()
 

@@ -24,7 +24,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from .. import knobs, obs
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import ReadIO, StoragePlugin, StripedWriteHandle, WriteIO
 from ..resilience import classify_fs, get_breaker, retry_call
 from ..resilience.retry import lazy_shared_progress
 from ..resilience.failpoints import failpoint
@@ -315,6 +315,37 @@ class FSStoragePlugin(StoragePlugin):
                 out = out[:n]
         return out
 
+    # ------------------------------------------------- striped writes
+
+    supports_striped_write = True
+
+    async def begin_striped_write(
+        self, path: str, total_size: int
+    ) -> "_FSStripedWriteHandle":
+        full = self._full(path)
+        self._ensure_dir(full)
+        tmp = _tmp_name(full)
+
+        def _open() -> int:
+            fd = os.open(tmp, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o644)
+            try:
+                os.ftruncate(fd, total_size)
+            except BaseException:
+                os.close(fd)
+                _unlink_quiet(tmp)
+                raise
+            return fd
+
+        fd = await self._off_loop(_open)
+        return _FSStripedWriteHandle(self, path, full, tmp, fd)
+
+    async def _off_loop(self, fn):
+        """Run a sync syscall off the event loop (the plugin's executor
+        when the native path owns one, the default pool otherwise)."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, fn
+        )
+
     async def delete(self, path: str) -> None:
         # keep the shared event loop responsive: remove() off-loop
         full = self._full(path)
@@ -370,3 +401,81 @@ class FSStoragePlugin(StoragePlugin):
     async def close(self) -> None:
         if self._executor is not None:
             self._executor.shutdown(wait=False)
+
+
+class _FSStripedWriteHandle(StripedWriteHandle):
+    """Offset-parallel ``pwrite`` into a preallocated sibling temp file.
+
+    Keeps the plugin's temp+rename commit discipline: parts land in the
+    ``.tsnp-tmp-*`` file (preallocated with ftruncate so concurrent
+    pwrites never race an append), ``complete`` optionally fdatasyncs
+    and ``os.replace``s onto the final name — a mid-stripe failure or
+    abort leaves NO partial file where a reader (or a recovery sweep)
+    would trust it.  Each part retries independently under the shared
+    fs policy (EINTR/EAGAIN transient, ENOSPC/EIO fatal) and feeds the
+    fs breaker."""
+
+    def __init__(self, plugin: FSStoragePlugin, path, full, tmp, fd) -> None:
+        self._plugin = plugin
+        self._path = path
+        self._final = full
+        self._tmp = tmp
+        self._fd = fd
+        self._closed = False
+
+    async def write_part(
+        self, index: int, offset: int, buf, want_digest: bool = False
+    ) -> None:
+        # no fused part digest: pwrite has no digesting variant in the
+        # native lib, so the engine computes part digests itself
+        view = memoryview(buf).cast("B")
+
+        def attempt() -> None:
+            failpoint(
+                "storage.fs.part.write", path=self._path, part=index
+            )
+            pos = 0
+            while pos < view.nbytes:
+                pos += os.pwrite(self._fd, view[pos:], offset + pos)
+
+        async def aio_attempt() -> None:
+            # off-loop even on the aiofiles fallback (plugin executor
+            # None -> the loop's default pool): a part-sized pwrite on
+            # the loop thread would stall every concurrent pipeline
+            await self._plugin._off_loop(attempt)
+
+        await self._plugin._retry(
+            aio_attempt,
+            f"write {self._path} [part {index}]",
+            breaker=get_breaker("fs"),
+        )
+
+    async def complete(self) -> None:
+        durable = knobs.is_fs_sync_data()
+
+        def commit() -> None:
+            failpoint("storage.fs.write.sync", path=self._path)
+            try:
+                if durable:
+                    os.fdatasync(self._fd)
+            finally:
+                self._close_fd()
+            os.replace(self._tmp, self._final)
+
+        try:
+            await self._plugin._off_loop(commit)
+        except BaseException:
+            await self.abort()
+            raise
+
+    def _close_fd(self) -> None:
+        if not self._closed:
+            self._closed = True
+            os.close(self._fd)
+
+    async def abort(self) -> None:
+        def cleanup() -> None:
+            self._close_fd()
+            _unlink_quiet(self._tmp)
+
+        await self._plugin._off_loop(cleanup)

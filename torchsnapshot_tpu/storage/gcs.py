@@ -30,7 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from .. import obs
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import ReadIO, StoragePlugin, StripedWriteHandle, WriteIO
 from ..resilience import (
     MISSING,
     RAISE,
@@ -200,43 +200,66 @@ class GCSStoragePlugin(StoragePlugin):
             errs = [r for r in results if isinstance(r, BaseException)]
             if errs:
                 raise errs[0]
-
-            sources, level = part_names, 0
-            while len(sources) > 1:
-                groups = [
-                    sources[j : j + _MAX_COMPOSE_COMPONENTS]
-                    for j in range(0, len(sources), _MAX_COMPOSE_COMPONENTS)
-                ]
-                nxt = []
-                for gi, grp in enumerate(groups):
-                    out = (
-                        name
-                        if len(groups) == 1
-                        else f"{name}.compose-{level}-{gi:05d}"
-                    )
-                    dest = self._bucket.blob(out)
-                    srcs = [self._bucket.blob(s) for s in grp]
-                    await self._with_retry(
-                        functools.partial(dest.compose, srcs),
-                        f"write {path} [compose L{level}.{gi}]",
-                    )
-                    nxt.append(out)
-                    if out != name:
-                        temps.append(out)
-                sources, level = nxt, level + 1
+            temps = await self._compose_parts(path, name, part_names)
         finally:
-            # ALWAYS sweep intermediates: an exhausted part retry must
-            # not leak manifest-invisible ~100MB orphans that bill
-            # storage forever (delete is idempotent; sweep errors are
-            # secondary to the write's own outcome)
-            for tmp in part_names + temps:
-                try:
-                    await self._delete_blob(tmp)
-                except Exception:  # noqa: BLE001
-                    logger.warning(
-                        "failed to sweep upload intermediate %s", tmp,
-                        exc_info=True,
-                    )
+            await self._sweep_blobs(part_names + temps)
+
+    async def _compose_parts(self, path, name, part_names) -> list:
+        """Stitch uploaded part blobs into ``name`` (hierarchical above
+        the 32-component compose limit); returns the intermediate blob
+        names the caller must sweep.  Shared by the whole-buffer chunked
+        write and the striped-write handle.  ``part_names`` must be
+        non-empty — an empty list would never converge on [name]."""
+        if not part_names:
+            raise ValueError(f"compose of {name}: no parts")
+        sources, level = list(part_names), 0
+        temps: list = []
+        while sources != [name]:
+            groups = [
+                sources[j : j + _MAX_COMPOSE_COMPONENTS]
+                for j in range(0, len(sources), _MAX_COMPOSE_COMPONENTS)
+            ]
+            nxt = []
+            for gi, grp in enumerate(groups):
+                out = (
+                    name
+                    if len(groups) == 1
+                    else f"{name}.compose-{level}-{gi:05d}"
+                )
+                dest = self._bucket.blob(out)
+                srcs = [self._bucket.blob(s) for s in grp]
+                await self._with_retry(
+                    functools.partial(dest.compose, srcs),
+                    f"write {path} [compose L{level}.{gi}]",
+                )
+                nxt.append(out)
+                if out != name:
+                    temps.append(out)
+            sources, level = nxt, level + 1
+        return temps
+
+    async def _sweep_blobs(self, blob_names) -> None:
+        """ALWAYS sweep upload intermediates: an exhausted part retry
+        must not leak manifest-invisible ~100MB orphans that bill
+        storage forever (delete is idempotent; sweep errors are
+        secondary to the write's own outcome)."""
+        for tmp in blob_names:
+            try:
+                await self._delete_blob(tmp)
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "failed to sweep upload intermediate %s", tmp,
+                    exc_info=True,
+                )
+
+    # ------------------------------------------------- striped writes
+
+    supports_striped_write = True
+
+    async def begin_striped_write(
+        self, path: str, total_size: int
+    ) -> "_GCSStripedWriteHandle":
+        return _GCSStripedWriteHandle(self, path)
 
     # -------------------------------------------------------------- read
 
@@ -368,3 +391,74 @@ class GCSStoragePlugin(StoragePlugin):
 
     async def close(self) -> None:
         self._executor.shutdown(wait=False)
+
+
+class _GCSStripedWriteHandle(StripedWriteHandle):
+    """Parallel compose-part upload driven part-by-part: each part is
+    its own blob (own retry domain, server-side crc32c), ``complete``
+    stitches them with hierarchical ``compose`` and sweeps the
+    intermediates, ``abort`` sweeps whatever parts landed.  This is the
+    plugin's existing parallel-composite pattern opened up to the
+    stripe engine so parts can dispatch AS THEY STAGE instead of after
+    the whole buffer exists."""
+
+    def __init__(self, plugin: GCSStoragePlugin, path: str) -> None:
+        self._plugin = plugin
+        self._path = path
+        self._name = plugin._blob_name(path)
+        # part index -> part blob name; filled on the plugin's event
+        # loop, so no lock
+        self._parts: dict = {}
+        self._finished = False
+
+    async def write_part(
+        self, index: int, offset: int, buf, want_digest: bool = False
+    ) -> None:
+        from ..utils.memoryview_stream import MemoryviewStream
+
+        view = memoryview(buf).cast("B")
+        part_name = f"{self._name}.part-{index:05d}"
+        blob = self._plugin._bucket.blob(part_name)
+
+        def upload() -> None:
+            failpoint(
+                "storage.gcs.part.write", path=self._path, part=index
+            )
+            blob.upload_from_file(
+                MemoryviewStream(view),
+                size=view.nbytes,
+                rewind=True,
+                checksum="crc32c",
+            )
+
+        await self._plugin._with_retry(
+            upload, f"write {self._path} [part {index}]"
+        )
+        self._parts[index] = part_name
+
+    async def complete(self) -> None:
+        part_names = [self._parts[i] for i in sorted(self._parts)]
+        if not part_names:
+            # zero-length object: nothing to compose — publish empty
+            # through the plugin's normal write path
+            from ..io_types import WriteIO
+
+            await self._plugin.write(WriteIO(path=self._path, buf=b""))
+            self._finished = True
+            return
+        temps: list = []
+        try:
+            temps = await self._plugin._compose_parts(
+                self._path, self._name, part_names
+            )
+        finally:
+            await self._plugin._sweep_blobs(part_names + temps)
+        self._finished = True
+
+    async def abort(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        await self._plugin._sweep_blobs(
+            [self._parts[i] for i in sorted(self._parts)]
+        )

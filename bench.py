@@ -379,8 +379,204 @@ def _tier_probe(payload_mb: int = 32) -> dict:
         )
         if lag and lag.get("count"):
             out["promotion_lag_max_s"] = round(lag["max"], 4)
+        # durable-tier bytes actually written (post-promotion du):
+        # the storage-cost axis the codec layer exists to shrink —
+        # tracked per BENCH round so compression regressions surface
+        durable_bytes = 0
+        for dirpath, _dirs, files in os.walk(durable):
+            for f in files:
+                try:
+                    durable_bytes += os.path.getsize(
+                        os.path.join(dirpath, f)
+                    )
+                except OSError:
+                    pass
+        out["durable_bytes_written"] = durable_bytes
     finally:
         shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def _codec_probe(payload_mb: int = 128, part_mb: int = 8) -> dict:
+    """Compression microbench on a REALISTIC bf16 payload (noisy
+    weights — zeros would flatter every codec): per-codec compression
+    ratio and encode throughput, byte-shuffled vs unshuffled, plus the
+    pipeline-level check that matters — effective write GB/s
+    (wall-clock over RAW bytes) through the real stage→write part
+    stream with the codec on vs off on the memory backend, where
+    encode overlap either hides the compute or doesn't.  The payload
+    sits at the production striping floor (STRIPE_MIN_OBJECT_SIZE,
+    128MB) — smaller payloads over-weight the pipeline's fixed costs
+    (ramp-up, the last part's un-overlapped wire time, complete())
+    that striping never pays at its real object sizes."""
+    import asyncio
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from torchsnapshot_tpu import codec, knobs
+    from torchsnapshot_tpu.preparers.array import HostArrayBufferStager
+    from torchsnapshot_tpu.storage import stripe
+    from torchsnapshot_tpu.storage.memory import (
+        MemoryStoragePlugin,
+        reset_namespace,
+    )
+
+    nbytes = payload_mb << 20
+    gb = nbytes / 1e9
+    rng = np.random.default_rng(0)
+    weights = (rng.standard_normal(nbytes // 2) * 0.02).astype(np.float32)
+    try:
+        import ml_dtypes
+
+        payload = weights.astype(ml_dtypes.bfloat16)
+        dtype_name, stride = "bfloat16", 2
+    except ImportError:  # honest fallback: f16 has the same byte planes
+        payload = weights.astype(np.float16)
+        dtype_name, stride = "float16", 2
+    data = payload.view(np.uint8)
+    out: dict = {
+        "payload_mb": payload_mb,
+        "part_mb": part_mb,
+        "dtype": dtype_name,
+        "codecs": {},
+    }
+
+    # --- per-codec ratio + encode speed, shuffled vs unshuffled ------
+    sample = memoryview(data[: 8 << 20])
+    for name in codec.available_codecs():
+        if name == "raw":
+            continue
+        spec = codec.WriteSpec(name, 0, 1.0)
+        legs = {}
+        for label, st in (("shuffled", stride), ("unshuffled", 0)):
+            t0 = time.perf_counter()
+            frame = codec.encode_frame(sample, spec, st)
+            dt = time.perf_counter() - t0
+            legs[label] = {
+                "ratio": round(sample.nbytes / len(frame), 3),
+                "encode_gbps": round(sample.nbytes / 1e9 / dt, 3),
+            }
+        out["codecs"][name] = legs
+
+    # --- pipeline: effective write GB/s over RAW bytes, codec on vs
+    # off, through the real stage→write part stream.  Two sinks:
+    #  - cloud model (HEADLINE): memory sink throttled to a documented
+    #    per-part-stream bandwidth (S3/GCS-like) — the regime the codec
+    #    targets, where encode overlaps wire time and smaller parts
+    #    finish sooner.
+    #  - ram sink: unthrottled memory — transparency number; a RAM-speed
+    #    memcpy sink is faster than any entropy coder on this box, so
+    #    this leg shows the encode-bound floor, not the value prop.
+    loop = asyncio.new_event_loop()
+    executor = ThreadPoolExecutor(
+        max_workers=4, thread_name_prefix="codec-bench"
+    )
+    ns = f"codec_bench_{os.getpid()}"
+    part = part_mb << 20
+    # bytes/s per concurrent part stream — mid-range of real S3/GCS
+    # multipart PUT connections (boto3's transfer defaults assume
+    # ~40MB/s/stream; measured S3 part streams run 25-90MB/s)
+    per_stream_bw = 48e6
+    write_codec = codec.resolve_codec("huff")
+    if write_codec == "raw":  # native lib absent: best available
+        write_codec = next(iter(out["codecs"]), "raw")
+    out["pipeline_codec"] = write_codec
+    out["sink_model_mbps_per_stream"] = int(per_stream_bw / 1e6)
+
+    class _ThrottledHandle:
+        """Per-part-stream token throttle over the memory handle: each
+        part's write occupies its stream for stored_bytes / bandwidth
+        seconds — concurrent parts proceed in parallel, like multipart
+        uploads against a cloud endpoint."""
+
+        supports_fused_digest = False
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        async def write_part(self, idx, off, buf, want_digest=False):
+            t0 = time.perf_counter()
+            r = await self._inner.write_part(
+                idx, off, buf, want_digest=want_digest
+            )
+            wire_s = memoryview(buf).nbytes / per_stream_bw
+            left = wire_s - (time.perf_counter() - t0)
+            if left > 0:
+                await asyncio.sleep(left)
+            return r
+
+        async def complete(self):
+            await self._inner.complete()
+
+        async def abort(self):
+            await self._inner.abort()
+
+    class _CloudModelPlugin:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+        async def begin_striped_write(self, path, total):
+            return _ThrottledHandle(
+                await self._inner.begin_striped_write(path, total)
+            )
+
+    def timed_stream(spec, fstride, throttled) -> tuple:
+        plugin = MemoryStoragePlugin(ns)
+        if throttled:
+            plugin = _CloudModelPlugin(plugin)
+        stager = HostArrayBufferStager(data, defensive_copy=False)
+        spans = stager.part_plan(part)
+        t0 = time.perf_counter()
+        tbl = {}
+        loop.run_until_complete(
+            stripe.streamed_part_write(
+                plugin, "o", stager, spans, executor,
+                window_parts=4, codec_spec=spec,
+                filter_stride=fstride, codec_sink=tbl.update,
+            )
+        )
+        dt = time.perf_counter() - t0
+        stored = sum(tbl["parts"]) if tbl else nbytes
+        reset_namespace(ns)
+        return dt, stored
+
+    try:
+        if write_codec != "raw":
+            spec = codec.WriteSpec(write_codec, 0, 1.05)
+            for label, throttled in (("cloud", True), ("ram", False)):
+                # interleave the legs' trials (raw, codec, raw, …) so a
+                # CPU-contention burst on the shared sandbox taxes both
+                # legs alike instead of biasing whichever ran through
+                # it; best-of-N per leg then drops the taxed trials
+                raws, encs = [], []
+                for _ in range(5):
+                    raws.append(timed_stream(None, 0, throttled)[0])
+                    encs.append(timed_stream(spec, stride, throttled))
+                t_raw = min(raws)
+                t_enc = min(t for t, _ in encs)
+                stored = encs[0][1]
+                leg = {
+                    "write_raw_gbps": round(gb / t_raw, 3),
+                    "write_codec_gbps": round(gb / t_enc, 3),
+                    "write_codec_vs_raw": round(t_raw / t_enc, 3),
+                    "ratio": round(nbytes / stored, 3),
+                }
+                out[f"{label}_sink"] = leg
+            # headline axes = the cloud-model leg (the codec's regime)
+            out["write_raw_gbps"] = out["cloud_sink"]["write_raw_gbps"]
+            out["write_codec_gbps"] = out["cloud_sink"]["write_codec_gbps"]
+            out["write_codec_vs_raw"] = out["cloud_sink"][
+                "write_codec_vs_raw"
+            ]
+            out["pipeline_ratio"] = out["cloud_sink"]["ratio"]
+    finally:
+        loop.close()
+        executor.shutdown(wait=False)
+        reset_namespace(ns)
     return out
 
 
@@ -809,6 +1005,14 @@ def run_child() -> None:
             result["stripe"] = _stripe_probe()
         except Exception as e:
             result["stripe"] = {"error": f"{e!r}"[:200]}
+        # per-part compression sub-block: codec ratios/throughput on a
+        # noisy bf16 payload + pipeline effective GB/s codec-on vs off
+        try:
+            result.setdefault("stripe", {})["codec"] = _codec_probe()
+        except Exception as e:
+            result.setdefault("stripe", {})["codec"] = {
+                "error": f"{e!r}"[:200]
+            }
         print(json.dumps(result), flush=True)
         # spot-check one leaf round-tripped
         import ml_dtypes

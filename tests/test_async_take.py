@@ -97,6 +97,56 @@ def test_async_take_source_mutation_safe(tmp_path, patch_storage):
     np.testing.assert_array_equal(out, np.arange(1024, dtype=np.float64))
 
 
+def test_async_commit_fails_when_codec_tables_lost(tmp_path, monkeypatch):
+    """Regression: the KV crc channel carries the codec frame tables —
+    the decode recipe for compressed objects.  Losing it must FAIL the
+    async commit (no metadata marker), not durably commit a snapshot
+    whose compressed bytes restore through the raw path."""
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.coordination import Coordinator
+
+    orig = Coordinator.kv_set
+
+    def failing_kv_set(self, key, value):
+        if "/crcs/" in key and value != "{}":
+            raise RuntimeError("kv channel down")
+        return orig(self, key, value)
+
+    monkeypatch.setattr(Coordinator, "kv_set", failing_kv_set)
+    with knobs.override_codec("zlib"):
+        pending = Snapshot.async_take(str(tmp_path / "s"), _app_state())
+        with pytest.raises(RuntimeError):
+            pending.wait()
+    assert not os.path.exists(str(tmp_path / "s" / SNAPSHOT_METADATA_FNAME))
+
+
+def test_async_commit_tolerates_lost_checksums_without_codec(
+    tmp_path, monkeypatch
+):
+    """The pre-codec contract stands when nothing was compressed:
+    checksums are best-effort, a lost crc channel still commits."""
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.coordination import Coordinator
+
+    orig = Coordinator.kv_set
+
+    def failing_kv_set(self, key, value):
+        if "/crcs/" in key and value != "{}":
+            raise RuntimeError("kv channel down")
+        return orig(self, key, value)
+
+    monkeypatch.setattr(Coordinator, "kv_set", failing_kv_set)
+    with knobs.override_codec("raw"):
+        pending = Snapshot.async_take(str(tmp_path / "s"), _app_state())
+        snap = pending.wait()
+    assert os.path.exists(str(tmp_path / "s" / SNAPSHOT_METADATA_FNAME))
+    dest = StateDict(
+        w=np.zeros(4096, np.float32), b=np.zeros(16, np.float32), step=0
+    )
+    snap.restore({"app": dest})
+    np.testing.assert_array_equal(dest["w"], np.arange(4096, dtype=np.float32))
+
+
 def test_two_async_takes_sequential(tmp_path):
     s1 = Snapshot.async_take(str(tmp_path / "a"), _app_state())
     s1.wait()

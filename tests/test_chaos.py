@@ -563,3 +563,131 @@ def test_chaos_multirank_restore_peer_fault_aborts_all_ranks(tmp_path):
         assert f"rank {r} CHAOS-OK" in out
     assert "rank 0 PEER-ABORT" in results[0][1]
     assert "rank 1 ORIGIN-RAISED" in results[1][1]
+
+
+# ================================================== codec scenarios
+#
+# The codec layer's chaos contract: a transient fault inside the encode
+# stage retries like any storage transient (the take commits, bytes
+# round-trip), and a corrupted compressed frame on a fast-tier copy is
+# caught by the stored-byte digest check BEFORE the frames reach a
+# decoder — restore silently falls back to the durable tier and repairs
+# the fast copy, exactly like raw-object corruption.
+
+
+def _codec_name():
+    from torchsnapshot_tpu import codec
+
+    names = [n for n in codec.available_codecs() if n != "raw"]
+    return names[0]
+
+
+def _float_chaos_state(n=1 << 15, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "app": StateDict(
+            w=(rng.standard_normal(n) * 0.02).astype(np.float32)
+        )
+    }
+
+
+def _assert_float_roundtrip(path, n=1 << 15, seed=0, storage_options=None):
+    want = _float_chaos_state(n, seed)["app"]["w"]
+    dest = {"app": StateDict(w=np.zeros(n, np.float32))}
+    Snapshot(path, storage_options=storage_options).restore(dest)
+    np.testing.assert_array_equal(dest["app"]["w"], want)
+
+
+def test_chaos_codec_encode_transient_retries_cleanly(tmp_path):
+    """A transient mid-pipeline fault in the encode stage must retry
+    under the shared policy and commit — never fail the take."""
+    path = str(tmp_path / "s")
+    r0 = _retries()
+    with knobs.override_codec(_codec_name()), (
+        knobs.override_write_checksums(True)
+    ), knobs.override_failpoints("scheduler.codec.encode=conn:1:2"):
+        snap = Snapshot.take(path, _float_chaos_state(seed=11))
+    assert _retries() - r0 >= 2
+    assert snap.metadata.codecs, "object did not store compressed"
+    _assert_float_roundtrip(path, seed=11)
+    assert snap.verify(deep=True).ok
+
+
+def test_chaos_codec_encode_fatal_aborts_without_commit(tmp_path):
+    """A persistent encode failure aborts the take cleanly: no commit
+    marker, no temp files."""
+    path = str(tmp_path / "s")
+    with knobs.override_codec(_codec_name()), (
+        knobs.override_retry_max_attempts(2)
+    ), knobs.override_failpoints("scheduler.codec.encode=conn"):
+        with pytest.raises(Exception):
+            Snapshot.take(path, _float_chaos_state(seed=12))
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+    assert not glob.glob(os.path.join(path, "**", ".tsnp-tmp-*"),
+                         recursive=True)
+
+
+def _encoded_fast_victim(fast):
+    """The fast-tier copy of a codec-encoded payload (frame magic)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_corruption_fuzz import _payload_files
+
+    from torchsnapshot_tpu import codec
+
+    for p in _payload_files(fast):
+        with open(p, "rb") as f:
+            if f.read(4) == codec.FRAME_MAGIC:
+                return p
+    raise AssertionError(f"no encoded payload under {fast}")
+
+
+def _corrupt_frame(victim, flavor):
+    from torchsnapshot_tpu import codec
+
+    with open(victim, "r+b") as f:
+        if flavor == "truncated":
+            f.truncate(os.path.getsize(victim) // 2)
+        elif flavor == "bad_magic":
+            f.write(b"XXXX")
+        elif flavor == "codec_id_mismatch":
+            f.seek(5)
+            cid = f.read(1)[0]
+            other = next(
+                i for n, i in codec.CODEC_IDS.items()
+                if i not in (0, cid)
+            )
+            f.seek(5)
+            f.write(bytes([other]))
+        else:
+            raise AssertionError(flavor)
+
+
+@pytest.mark.parametrize(
+    "flavor", ["truncated", "bad_magic", "codec_id_mismatch"]
+)
+def test_chaos_corrupt_fast_frame_falls_back_and_repairs(tmp_path, flavor):
+    """A corrupted compressed frame on the fast tier: the stored-byte
+    digest check catches it before any decoder sees the bytes, restore
+    silently serves the durable copy, and the fast copy is repaired."""
+    fast, durable = str(tmp_path / "fast"), str(tmp_path / "durable")
+    opts = {"tier": {"fast_url": fast, "policy": "write_through"}}
+    with knobs.override_codec(_codec_name()), (
+        knobs.override_write_checksums(True)
+    ):
+        snap = Snapshot.take(
+            durable, _float_chaos_state(seed=13), storage_options=opts
+        )
+    assert snap.metadata.codecs
+    victim = _encoded_fast_victim(fast)
+    _corrupt_frame(victim, flavor)
+    corrupt0 = obs.counter("tier.fast_corrupt").value
+    repairs0 = obs.counter("tier.fast_repairs").value
+    _assert_float_roundtrip(durable, seed=13, storage_options=opts)
+    assert obs.counter("tier.fast_corrupt").value > corrupt0
+    assert obs.counter("tier.fast_repairs").value > repairs0
+    # repaired in place: fast copy again byte-identical to durable
+    rel = os.path.relpath(victim, fast)
+    with open(victim, "rb") as f_fast, open(
+        os.path.join(durable, rel), "rb"
+    ) as f_dur:
+        assert f_fast.read() == f_dur.read()

@@ -1,0 +1,805 @@
+"""Codec layer suite: frame format, byte-shuffle filters, store-raw
+fallback, ranged framed reads, and full-stack bitwise round-trips with
+compression enabled — across codecs × filters × striped/unstriped ×
+all four storage backends — plus pre-codec-era manifest compatibility
+and knob-override behavior (CODEC=raw must vanish entirely).
+"""
+
+import asyncio
+import contextlib
+import json
+import os
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+from torchsnapshot_tpu import codec
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+from torchsnapshot_tpu.storage.memory import (
+    MemoryStoragePlugin,
+    reset_namespace,
+)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _b(x):
+    """Materialize a decode result (bytes-like: view/array/bytes)."""
+    return bytes(memoryview(x).cast("B"))
+
+
+# codecs exercisable on this host (zstd/lz4 ride along when installed)
+CODECS = [n for n in codec.available_codecs() if n != "raw"]
+
+
+def _spec(name, level=0, min_ratio=1.05):
+    return codec.WriteSpec(name, level, min_ratio)
+
+
+def _compressible(n, seed=0):
+    """Noisy-float-like bytes: compress honestly but not trivially."""
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n // 4) * 0.02).astype("<f4").tobytes()
+
+
+def _incompressible(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8
+    ).tobytes()
+
+
+# ------------------------------------------------------------- filters
+
+
+@pytest.mark.parametrize("stride", [2, 4, 8])
+@pytest.mark.parametrize("tail", [0, 1, 3])
+def test_shuffle_is_self_inverse(stride, tail):
+    data = _incompressible(stride * 100 + tail, seed=stride)
+    out = codec.shuffle(memoryview(data), stride)
+    assert len(out) == len(data)
+    assert _b(codec.unshuffle(memoryview(out), stride)) == data
+
+
+def test_filter_for_dtype_floats_only():
+    assert codec.filter_for_dtype("float32") == 4
+    assert codec.filter_for_dtype("bfloat16") == 2
+    assert codec.filter_for_dtype("float16") == 2
+    assert codec.filter_for_dtype("float64") == 8
+    for non_float in ("int32", "uint8", "bool", "bytes", None, ""):
+        assert codec.filter_for_dtype(non_float) == 0
+
+
+def test_shuffle_improves_float_ratio():
+    """The reason the filter exists: shuffled noisy floats compress
+    better than unshuffled ones (exponent/sign bytes cluster)."""
+    data = _compressible(1 << 18)
+    plain = len(codec._REGISTRY["zlib"].compress(memoryview(data), 1))
+    shuf = codec.shuffle(memoryview(data), 4)
+    shuffled = len(codec._REGISTRY["zlib"].compress(memoryview(shuf), 1))
+    assert shuffled < plain
+
+
+# ------------------------------------------------------- frame format
+
+
+@pytest.mark.parametrize("name", CODECS)
+@pytest.mark.parametrize("stride", [0, 4])
+def test_frame_round_trip(name, stride):
+    data = _compressible(1 << 16, seed=1)
+    frame = codec.encode_frame(memoryview(data), _spec(name), stride)
+    raw, consumed = codec.decode_frame(memoryview(frame))
+    assert consumed == len(frame)
+    assert _b(raw) == data
+
+
+@pytest.mark.parametrize("name", CODECS)
+def test_incompressible_part_falls_back_to_raw_frame(name):
+    data = _incompressible(1 << 14, seed=2)
+    before = obs.counter(codec.CODEC_PARTS_RAW_FALLBACK).value
+    frame = codec.encode_frame(memoryview(data), _spec(name), 0)
+    assert obs.counter(codec.CODEC_PARTS_RAW_FALLBACK).value == before + 1
+    # raw frame: codec id 0, payload is the bytes themselves, exactly
+    # one header of overhead
+    codec_id, filter_id, raw_len, enc_len = codec.parse_frame_header(
+        memoryview(frame)
+    )
+    assert (codec_id, filter_id) == (0, 0)
+    assert raw_len == enc_len == len(data)
+    assert len(frame) == codec.FRAME_HEADER_BYTES + len(data)
+    raw, _ = codec.decode_frame(memoryview(frame))
+    assert _b(raw) == data
+
+
+def test_empty_part_encodes_and_decodes():
+    frame = codec.encode_frame(memoryview(b""), _spec("zlib"), 4)
+    raw, consumed = codec.decode_frame(memoryview(frame))
+    assert _b(raw) == b"" and consumed == len(frame)
+
+
+def test_frame_header_rejects_corruption():
+    data = _compressible(1 << 12)
+    frame = bytearray(codec.encode_frame(memoryview(data), _spec("zlib"), 0))
+    with pytest.raises(codec.CodecFrameError, match="magic"):
+        codec.parse_frame_header(memoryview(b"XXXX" + bytes(frame[4:])))
+    with pytest.raises(codec.CodecFrameError, match="truncated frame header"):
+        codec.parse_frame_header(memoryview(bytes(frame[:10])))
+    with pytest.raises(codec.CodecFrameError, match="truncated frame payload"):
+        codec.decode_frame(memoryview(bytes(frame[:-5])))
+    bad_version = bytes(frame[:4]) + b"\xff" + bytes(frame[5:])
+    with pytest.raises(codec.CodecFrameError, match="version"):
+        codec.parse_frame_header(memoryview(bad_version))
+    bad_codec = bytes(frame[:5]) + b"\xfe" + bytes(frame[6:])
+    with pytest.raises(codec.CodecFrameError, match="unknown codec id"):
+        codec.parse_frame_header(memoryview(bad_codec))
+
+
+def test_corrupt_payload_raises_frame_error():
+    data = _compressible(1 << 14)
+    for name in CODECS:
+        frame = bytearray(
+            codec.encode_frame(memoryview(data), _spec(name), 4)
+        )
+        cid = frame[5]
+        if cid == 0:
+            continue  # fell back to raw; corruption lands at digest layers
+        body = codec.FRAME_HEADER_BYTES + 8
+        frame[body : body + 4] = b"\x00\xff\x00\xff"
+        with pytest.raises(codec.CodecFrameError):
+            codec.decode_frame(memoryview(bytes(frame)))
+
+
+@pytest.mark.skipif("huff" not in CODECS, reason="native lib absent")
+def test_huff_decoder_survives_corruption_fuzz():
+    """The native decoder must never crash on corrupt input — only
+    raise (regression: an overfull/overlong code-length table smashed
+    the decode table on the stack).  Silent wrong decodes are fine:
+    the frame layer's raw_len check and the digest layers catch them."""
+    import random
+
+    from torchsnapshot_tpu import _csrc
+
+    data = _compressible(1 << 14, seed=13)
+    clean = _csrc.huff_compress(memoryview(data))
+    rng = random.Random(0)
+    for _ in range(300):
+        corrupt = bytearray(clean)
+        for _ in range(rng.randint(1, 8)):
+            corrupt[rng.randrange(len(corrupt))] = rng.randrange(256)
+        try:
+            _csrc.huff_decompress(memoryview(bytes(corrupt)), len(data))
+        except ValueError:
+            pass
+    assert _b(_csrc.huff_decompress(memoryview(clean), len(data))) == data
+
+
+def test_unavailable_codec_raises_typed_error(monkeypatch):
+    """A frame naming a codec this host can't decode must fail with a
+    typed error naming it — not a confusing decompress crash."""
+    data = _compressible(1 << 12)
+    frame = codec.encode_frame(
+        memoryview(data), _spec("zlib", min_ratio=1.0), 0
+    )
+    assert frame[5] == codec.CODEC_IDS["zlib"]
+    monkeypatch.setattr(
+        codec._REGISTRY["zlib"], "_avail", lambda: False
+    )
+    with pytest.raises(codec.CodecUnavailableError, match="zlib"):
+        codec.decode_frame(memoryview(frame))
+    # raw-fallback frames decode regardless of codec availability
+    raw_frame = codec.encode_frame(
+        memoryview(_incompressible(1 << 12)), _spec("zlib"), 0
+    )
+    raw, _ = codec.decode_frame(memoryview(raw_frame))
+
+
+def test_resolve_codec_unknown_degrades_to_raw():
+    with knobs.override_codec("not-a-codec"):
+        assert codec.resolve_codec() == "raw"
+        assert codec.resolve_write_spec() is None
+
+
+def test_validate_table_rejects_garbage():
+    good = codec.make_table("zlib", 4096, 8192, [100, 120])
+    assert codec.validate_table(good)
+    assert codec.table_stored_size(good) == 220
+    for bad in (
+        {},
+        {"codec": "zlib"},
+        {"codec": "zlib", "part_size": 0, "raw_size": 1, "parts": [1]},
+        {"codec": "zlib", "part_size": 4, "raw_size": 1, "parts": [0]},
+        {"codec": 3, "part_size": 4, "raw_size": 1, "parts": [1]},
+    ):
+        assert not codec.validate_table(bad)
+
+
+# --------------------------------------------- engine-level framed I/O
+
+
+def _engine_backends(tmp_path):
+    ns = f"codec-{os.getpid()}-{tmp_path.name}"
+    reset_namespace(ns)
+    backends = [
+        MemoryStoragePlugin(ns),
+        FSStoragePlugin(str(tmp_path / "fs")),
+    ]
+    from test_s3_storage import make_plugin
+
+    backends.append(make_plugin())
+
+    from test_gcs_chunked import FakeBucket
+
+    from torchsnapshot_tpu.resilience import SharedProgress
+    from torchsnapshot_tpu.storage.gcs import GCSStoragePlugin
+
+    g = GCSStoragePlugin.__new__(GCSStoragePlugin)
+    g.prefix = "run"
+    g._bucket = FakeBucket()
+    g._executor = ThreadPoolExecutor(max_workers=2)
+    g._retry = SharedProgress(window_s=30.0, label="gcs-codec")
+    g._chunk_bytes = 1 << 20
+    backends.append(g)
+    return backends
+
+
+def _frame_stream(data, name, part_size, stride=0):
+    spans = [
+        (lo, min(lo + part_size, len(data)))
+        for lo in range(0, len(data), part_size)
+    ]
+    frames = [
+        codec.encode_frame(memoryview(data)[lo:hi], _spec(name), stride)
+        for lo, hi in spans
+    ]
+    table = codec.make_table(
+        name, part_size, len(data), [len(f) for f in frames]
+    )
+    return b"".join(frames), table
+
+
+@pytest.mark.parametrize("name", CODECS)
+def test_framed_read_all_backends_bitwise(tmp_path, name):
+    """Write an encoded frame stream through each backend's plain write
+    path, then framed-read it back whole and by ragged raw ranges —
+    bitwise equality against the raw source on all four backends."""
+    data = _compressible(3 * 4096 + 123, seed=7) + _incompressible(
+        2 * 4096, seed=8
+    )
+    stored, table = _frame_stream(data, name, 4096, stride=4)
+    ranges = [
+        None, [0, len(data)], [0, 1], [4095, 4097], [5000, 5000],
+        [1234, 11111], [len(data) - 1, len(data)],
+    ]
+    for plugin in _engine_backends(tmp_path):
+        run(plugin.write(WriteIO(path="0/obj", buf=stored)))
+
+        async def check():
+            for br in ranges:
+                buf = await codec.framed_read(
+                    plugin, "0/obj", table, byte_range=br
+                )
+                lo, hi = br if br is not None else (0, len(data))
+                assert bytes(memoryview(buf).cast("B")) == data[lo:hi]
+
+        run(check())
+
+
+def test_framed_read_honors_into(tmp_path):
+    data = _compressible(4096 * 2, seed=9)
+    stored, table = _frame_stream(data, "zlib", 4096)
+    ns = f"codec-into-{os.getpid()}"
+    reset_namespace(ns)
+    plugin = MemoryStoragePlugin(ns)
+    run(plugin.write(WriteIO(path="o", buf=stored)))
+    dst = np.zeros(len(data), dtype=np.uint8)
+    out = run(codec.framed_read(plugin, "o", table, into=dst))
+    assert out is dst
+    assert dst.tobytes() == data
+
+
+def test_framed_read_rejects_out_of_range(tmp_path):
+    data = _compressible(4096)
+    stored, table = _frame_stream(data, "zlib", 4096)
+    ns = f"codec-range-{os.getpid()}"
+    reset_namespace(ns)
+    plugin = MemoryStoragePlugin(ns)
+    run(plugin.write(WriteIO(path="o", buf=stored)))
+    with pytest.raises(codec.CodecFrameError, match="outside"):
+        run(
+            codec.framed_read(
+                plugin, "o", table, byte_range=[0, len(data) + 1]
+            )
+        )
+
+
+# ------------------------------------------------- full-stack snapshots
+
+
+def _ctx(codec_name, striped=False):
+    ctx = contextlib.ExitStack()
+    ctx.enter_context(knobs.override_codec(codec_name))
+    ctx.enter_context(knobs.override_write_checksums(True))
+    if striped:
+        ctx.enter_context(knobs.override_stripe_part_size_bytes(1 << 14))
+        ctx.enter_context(
+            knobs.override_stripe_min_object_size_bytes(1 << 15)
+        )
+    return ctx
+
+
+def _float_state(seed=0, n=1 << 16):
+    rng = np.random.default_rng(seed)
+    return {
+        "model": StateDict(
+            w=(rng.standard_normal(n) * 0.02).astype(np.float32),
+            noise=rng.integers(0, 256, size=n, dtype=np.uint8),
+            step=np.int64(seed),
+        )
+    }
+
+
+def _assert_restores(path, seed=0, n=1 << 16, storage_options=None):
+    want = _float_state(seed, n)["model"]
+    got = StateDict(
+        w=np.zeros(n, np.float32),
+        noise=np.zeros(n, np.uint8),
+        step=np.int64(-1),
+    )
+    snap = Snapshot(path, storage_options=storage_options)
+    snap.restore({"model": got})
+    assert np.array_equal(got["w"], want["w"])
+    assert np.array_equal(got["noise"], want["noise"])
+    assert got["step"] == want["step"]
+    return snap
+
+
+def test_orbax_export_decodes_compressed_objects(tmp_path, monkeypatch):
+    """Regression: migrate_snapshot_to_orbax reads through the scheduler
+    like restore does — a codec-compressed snapshot must hand DECODED
+    payloads to the orbax writer, not stored frame bytes.  (The orbax
+    writer itself is stubbed: the bug sat in the read, not the write.)"""
+    from torchsnapshot_tpu.tricks import orbax_interop
+
+    path = str(tmp_path / "snap")
+    with _ctx(CODECS[0]):
+        snap = Snapshot.take(path, _float_state(seed=21))
+    assert snap.metadata.codecs, "fixture did not store compressed"
+    exported = {}
+    monkeypatch.setattr(
+        orbax_interop, "export_to_orbax",
+        lambda orbax_path, tree: exported.update(tree),
+    )
+    orbax_interop.migrate_snapshot_to_orbax(
+        path, str(tmp_path / "orbax"), key="model"
+    )
+    want = _float_state(seed=21)["model"]
+    np.testing.assert_array_equal(np.asarray(exported["w"]), want["w"])
+    np.testing.assert_array_equal(
+        np.asarray(exported["noise"]), want["noise"]
+    )
+
+
+@pytest.fixture
+def s3_resolver(monkeypatch):
+    from test_s3_storage import FakeBoto3Client
+
+    import torchsnapshot_tpu.snapshot as snap_mod
+    import torchsnapshot_tpu.storage as storage_mod
+    from torchsnapshot_tpu.storage.s3 import S3StoragePlugin
+
+    fake = FakeBoto3Client()
+    real = storage_mod.url_to_storage_plugin
+
+    def factory(path, *a, **kw):
+        if path.startswith("s3://"):
+            p = S3StoragePlugin.__new__(S3StoragePlugin)
+            p.bucket, _, p.prefix = path[len("s3://"):].partition("/")
+            p._backend = fake
+            p._is_fs = False
+            p._executor = ThreadPoolExecutor(max_workers=4)
+            return p
+        return real(path, *a, **kw)
+
+    monkeypatch.setattr(storage_mod, "url_to_storage_plugin", factory)
+    monkeypatch.setattr(snap_mod, "url_to_storage_plugin", factory)
+    return fake
+
+
+@pytest.mark.parametrize("name", CODECS)
+@pytest.mark.parametrize("striped", [False, True])
+def test_snapshot_round_trip_fs_and_memory(tmp_path, name, striped):
+    for path in (str(tmp_path / "fs-snap"), f"memory://codec-{name}-{striped}/s"):
+        with _ctx(name, striped):
+            snap = Snapshot.take(path, _float_state(seed=3))
+        codecs = snap.metadata.codecs
+        assert "0/model/w" in codecs or any(
+            "batched" in k for k in codecs
+        ), codecs
+        for tbl in codecs.values():
+            assert codec.validate_table(tbl)
+            assert tbl["codec"] == name
+        _assert_restores(path, seed=3)
+        assert snap.verify(deep=True).ok
+
+
+@pytest.mark.parametrize("striped", [False, True])
+def test_snapshot_round_trip_s3_fake(s3_resolver, striped):
+    with _ctx(CODECS[0], striped):
+        snap = Snapshot.take("s3://bkt/ck", _float_state(seed=4))
+    assert snap.metadata.codecs
+    _assert_restores("s3://bkt/ck", seed=4)
+    assert snap.verify(deep=True).ok
+    assert s3_resolver.multipart_uploads == {}  # no orphans
+
+
+def test_mixed_raw_and_encoded_parts_one_object(tmp_path):
+    """An object whose first half is compressible floats and second
+    half is random bytes stores a mix of encoded and raw-fallback
+    frames — and still round-trips bitwise."""
+    n = 1 << 16
+    rng = np.random.default_rng(5)
+    both = np.concatenate(
+        [
+            np.frombuffer(
+                (rng.standard_normal(n // 4) * 0.02)
+                .astype(np.float32)
+                .tobytes(),
+                dtype=np.uint8,
+            ),
+            rng.integers(0, 256, size=n, dtype=np.uint8),
+        ]
+    )
+    app = {"m": StateDict(x=both)}
+    path = str(tmp_path / "mixed")
+    enc0 = obs.counter(codec.CODEC_PARTS_ENCODED).value
+    raw0 = obs.counter(codec.CODEC_PARTS_RAW_FALLBACK).value
+    with _ctx(CODECS[0]), knobs.override_stripe_part_size_bytes(1 << 13):
+        snap = Snapshot.take(path, app)
+    assert obs.counter(codec.CODEC_PARTS_ENCODED).value > enc0
+    assert obs.counter(codec.CODEC_PARTS_RAW_FALLBACK).value > raw0
+    got = StateDict(x=np.zeros_like(both))
+    snap.restore({"m": got})
+    assert np.array_equal(got["x"], both)
+    assert snap.verify(deep=True).ok
+
+
+def test_pre_codec_era_manifest_restores_unchanged(tmp_path):
+    """A snapshot written with the codec off (== every snapshot written
+    before this layer existed: no "codecs" key in its metadata at all)
+    restores through the raw path untouched."""
+    path = str(tmp_path / "old")
+    with knobs.override_codec("raw"), knobs.override_write_checksums(True):
+        Snapshot.take(path, _float_state(seed=6))
+    raw_meta = (tmp_path / "old" / ".snapshot_metadata").read_text()
+    assert "codecs" not in json.loads(raw_meta.rsplit("\n", 2)[0])
+    snap = _assert_restores(path, seed=6)
+    assert snap.metadata.codecs == {}
+    assert snap._codec_tables() is None
+    assert snap.verify(deep=True).ok
+
+
+def test_codec_raw_disables_stage_entirely(tmp_path):
+    """CODEC=raw (the default) must leave zero trace: no codecs table,
+    no codec counters moving, stored bytes == raw bytes."""
+    path = str(tmp_path / "rawsnap")
+    before = {
+        n: obs.counter(n).value
+        for n in (
+            codec.CODEC_BYTES_IN,
+            codec.CODEC_BYTES_OUT,
+            codec.CODEC_PARTS_ENCODED,
+            codec.CODEC_PARTS_RAW_FALLBACK,
+        )
+    }
+    with knobs.override_codec("raw"), knobs.override_write_checksums(
+        True
+    ), knobs.override_disable_batching(True):
+        snap = Snapshot.take(path, _float_state(seed=7))
+    for n, v in before.items():
+        assert obs.counter(n).value == v, n
+    assert snap.metadata.codecs == {}
+    want = _float_state(seed=7)["model"]["w"]
+    stored = (tmp_path / "rawsnap" / "0" / "model" / "w").read_bytes()
+    assert stored == want.tobytes()
+
+
+def test_restore_without_write_codec_installed(tmp_path, monkeypatch):
+    """A snapshot whose frames name an uninstalled codec restores only
+    its raw-fallback parts — everything else fails with the typed
+    error naming the codec."""
+    path = str(tmp_path / "zl")
+    with _ctx("zlib"):
+        snap = Snapshot.take(path, _float_state(seed=8))
+    assert any(
+        t["codec"] == "zlib" for t in snap.metadata.codecs.values()
+    )
+    monkeypatch.setattr(
+        codec._REGISTRY["zlib"], "_avail", lambda: False
+    )
+    n = 1 << 16
+    got = StateDict(
+        w=np.zeros(n, np.float32),
+        noise=np.zeros(n, np.uint8),
+        step=np.int64(-1),
+    )
+    with pytest.raises(Exception) as ei:
+        Snapshot(path).restore({"model": got})
+    assert "zlib" in str(ei.value)
+
+
+def test_metadata_codecs_json_round_trip():
+    from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+    table = codec.make_table("huff", 4096, 10000, [700, 700, 500], [1, 2, 1900])
+    md = SnapshotMetadata(
+        version="0.0.0", world_size=1, manifest={}, codecs={"0/m/w": table}
+    )
+    back = SnapshotMetadata.from_yaml(md.to_json())
+    assert back.codecs == {"0/m/w": table}
+
+
+def test_knob_override_level_and_min_ratio():
+    with knobs.override_codec_level(9):
+        assert knobs.get_codec_level() == 9
+    with knobs.override_codec_min_ratio(0.5):
+        # floored at 1.0: a ratio below 1 would keep frames LARGER
+        # than the raw bytes
+        assert knobs.get_codec_min_ratio() == 1.0
+    with knobs.override_codec("HUFF"):
+        assert knobs.get_codec() == "huff"
+
+
+def test_tier_promotion_copies_frames_without_reencoding(tmp_path):
+    """Write-back tiering + codec: the promoter must copy the fast
+    tier's already-encoded frames to the durable tier verbatim — byte
+    identity, no second encode (codec counters frozen during the
+    drain) — and the durable copy must restore."""
+    from torchsnapshot_tpu.tier.promoter import drain_promotions
+
+    fast, durable = str(tmp_path / "fast"), str(tmp_path / "durable")
+    opts = {"tier": {"fast_url": fast, "policy": "write_back"}}
+    with _ctx(CODECS[0]):
+        snap = Snapshot.take(
+            durable, _float_state(seed=9), storage_options=opts
+        )
+    assert snap.metadata.codecs
+    enc0 = obs.counter(codec.CODEC_BYTES_IN).value
+    drain_promotions()
+    assert obs.counter(codec.CODEC_BYTES_IN).value == enc0, (
+        "promotion re-encoded already-encoded frames"
+    )
+    for dirpath, _dirs, files in os.walk(fast):
+        for f in files:
+            fp = os.path.join(dirpath, f)
+            dp = os.path.join(durable, os.path.relpath(fp, fast))
+            with open(fp, "rb") as a, open(dp, "rb") as b:
+                assert a.read() == b.read(), fp
+    # durable-only restore (lost-host shape)
+    import shutil
+
+    shutil.rmtree(fast)
+    _assert_restores(durable, seed=9, storage_options=opts)
+
+
+def test_deep_verify_catches_corrupt_encoded_object(tmp_path):
+    """Bit rot inside an encoded frame must surface in verify(deep) —
+    either as a raw-crc mismatch after decode or as a frame decode
+    failure — never as a silent pass."""
+    path = str(tmp_path / "rot")
+    with _ctx(CODECS[0]):
+        snap = Snapshot.take(path, _float_state(seed=10))
+    loc = next(iter(snap.metadata.codecs))
+    victim = os.path.join(path, *loc.split("/"))
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0x20]))
+    result = snap.verify(deep=True)
+    assert not result.ok
+    assert result.corrupt or result.unreadable
+
+
+def test_shallow_verify_uses_stored_sizes(tmp_path):
+    """The stat pass must expect the STORED frame-stream size for
+    encoded objects (the raw size would flag every compressed object
+    as truncated) — and still catch real truncation."""
+    path = str(tmp_path / "sizes")
+    with _ctx(CODECS[0]):
+        snap = Snapshot.take(path, _float_state(seed=11))
+    assert snap.verify(deep=False).ok
+    loc = next(iter(snap.metadata.codecs))
+    victim = os.path.join(path, *loc.split("/"))
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) - 7)
+    result = snap.verify(deep=False)
+    assert [t[0] for t in result.truncated] == [loc]
+
+
+# ------------------------------------------- backend part-size floors
+
+
+def test_min_frame_bytes_floors_undersized_frames():
+    """A frame that compresses below the backend's non-final-part floor
+    (StripedWriteHandle.min_part_bytes; S3's EntityTooSmall) stores raw
+    — but only when the raw frame actually clears the floor."""
+    data = _compressible(1 << 16, seed=12)
+    name = CODECS[0]
+    # sanity: unfloored, this part encodes
+    enc = codec.encode_frame(memoryview(data), _spec(name), 4)
+    codec_id, _, _, _ = codec.parse_frame_header(memoryview(enc))
+    assert codec_id != 0
+    # floor above the encoded size but under raw+header: raw fallback
+    floored = codec.encode_frame(
+        memoryview(data), _spec(name), 4, min_frame_bytes=len(data)
+    )
+    codec_id, filter_id, raw_len, enc_len = codec.parse_frame_header(
+        memoryview(floored)
+    )
+    assert (codec_id, filter_id) == (0, 0)
+    assert raw_len == enc_len == len(data)
+    raw, _ = codec.decode_frame(memoryview(floored))
+    assert _b(raw) == data
+    # floor that even the raw frame can't clear: keep the smaller
+    # encoded frame (the backend rejects either; don't inflate)
+    kept = codec.encode_frame(
+        memoryview(data), _spec(name), 4,
+        min_frame_bytes=len(data) + codec.FRAME_HEADER_BYTES + 1,
+    )
+    assert bytes(memoryview(kept)) == bytes(memoryview(enc))
+
+
+def test_encode_retry_counts_metrics_once(monkeypatch):
+    """Regression: a transient INSIDE the encode attempt retries under
+    the shared policy, but the codec counters must count the part's
+    bytes exactly once — incident ratios derived from bytes_in/out
+    would otherwise misreport during the retries they exist for."""
+    calls = {"n": 0}
+    orig = codec._encode_frame_uncounted
+
+    def flaky(view, spec, filter_stride=0, min_frame_bytes=0):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("transient mid-encode")
+        return orig(view, spec, filter_stride, min_frame_bytes)
+
+    monkeypatch.setattr(codec, "_encode_frame_uncounted", flaky)
+    data = _compressible(1 << 14)
+    b_in0 = obs.counter(codec.CODEC_BYTES_IN).value
+    parts0 = obs.counter(codec.CODEC_PARTS_ENCODED).value
+    frame = run(
+        codec.encode_frame_async(
+            memoryview(data), _spec(CODECS[0]), 4, None
+        )
+    )
+    assert calls["n"] == 2
+    assert obs.counter(codec.CODEC_BYTES_IN).value == b_in0 + len(data)
+    assert obs.counter(codec.CODEC_PARTS_ENCODED).value == parts0 + 1
+    _, _, raw_len, _ = codec.parse_frame_header(memoryview(frame))
+    assert raw_len == len(data)
+
+
+def test_streamed_write_honors_backend_part_floor():
+    """Through the real stage->write stream against a handle declaring
+    min_part_bytes: every part but the last clears the floor (stored
+    raw when its frame would be undersized), and the object still
+    round-trips bitwise."""
+    from torchsnapshot_tpu.preparers.array import HostArrayBufferStager
+    from torchsnapshot_tpu.storage import stripe
+
+    part = 1 << 14
+    data = np.frombuffer(
+        _compressible(4 * part, seed=13), dtype=np.uint8
+    ).copy()
+    ns = "codec-part-floor"
+    plugin = MemoryStoragePlugin(ns)
+
+    class _FlooredPlugin:
+        def __getattr__(self, attr):
+            return getattr(plugin, attr)
+
+        async def begin_striped_write(self, path, total):
+            h = await plugin.begin_striped_write(path, total)
+            h.min_part_bytes = part  # frames compress below this
+            h.supports_fused_digest = False
+            return h
+
+    stager = HostArrayBufferStager(data, defensive_copy=False)
+    spans = stager.part_plan(part)
+    tbl = {}
+    executor = ThreadPoolExecutor(max_workers=2)
+    try:
+        run(
+            stripe.streamed_part_write(
+                _FlooredPlugin(), "obj", stager, spans, executor,
+                window_parts=4,
+                codec_spec=_spec(CODECS[0]),
+                filter_stride=4,
+                codec_sink=tbl.update,
+            )
+        )
+        lens = tbl["parts"]
+        assert len(lens) == len(spans)
+        # non-final parts: raw fallback == span + one header
+        for (lo, hi), n in zip(spans[:-1], lens[:-1]):
+            assert n == (hi - lo) + codec.FRAME_HEADER_BYTES
+        # the last part is exempt from the floor and still compresses
+        assert lens[-1] < spans[-1][1] - spans[-1][0]
+        got = run(codec.framed_read(plugin, "obj", tbl))
+        assert bytes(memoryview(got).cast("B")) == data.tobytes()
+    finally:
+        executor.shutdown(wait=False)
+        reset_namespace(ns)
+
+
+def test_streamed_write_stage_failure_fails_fast_under_codec():
+    """Regression: a part failing BEFORE its encode stage (stager
+    error, stage failpoint, raw digest) must poison the offset cascade
+    like an encode failure does — otherwise part idx+1 awaits a start
+    future that never resolves and the stream wedges forever instead
+    of raising."""
+    from torchsnapshot_tpu.preparers.array import HostArrayBufferStager
+    from torchsnapshot_tpu.storage import stripe
+
+    part = 1 << 14
+    data = np.frombuffer(
+        _compressible(4 * part, seed=17), dtype=np.uint8
+    ).copy()
+    ns = "codec-stage-fail"
+    plugin = MemoryStoragePlugin(ns)
+
+    class _FailingStager(HostArrayBufferStager):
+        async def stage_part(self, span, executor):
+            if span[0] == part:  # part 1 dies before encode
+                raise OSError("staging buffer lost")
+            return await super().stage_part(span, executor)
+
+    stager = _FailingStager(data, defensive_copy=False)
+    spans = stager.part_plan(part)
+    executor = ThreadPoolExecutor(max_workers=2)
+    try:
+        with pytest.raises(OSError, match="staging buffer lost"):
+            run(
+                asyncio.wait_for(
+                    stripe.streamed_part_write(
+                        plugin, "obj", stager, spans, executor,
+                        window_parts=4,
+                        codec_spec=_spec(CODECS[0]),
+                        filter_stride=4,
+                        codec_sink=lambda _t: None,
+                    ),
+                    timeout=30,
+                )
+            )
+    finally:
+        executor.shutdown(wait=False)
+        reset_namespace(ns)
+
+
+def test_s3_handle_declares_entity_too_small_floor():
+    from torchsnapshot_tpu.storage.s3 import _S3StripedWriteHandle
+
+    assert _S3StripedWriteHandle.min_part_bytes == 5 << 20
+
+
+@pytest.mark.skipif("huff" not in CODECS, reason="native lib absent")
+def test_huff_compress_headroom_unpins_capacity():
+    """The headroom path must not return a slice view pinning the full
+    raw-sized capacity allocation — the stripe byte-gate credits the
+    saved bytes as freed, so they must actually free."""
+    from torchsnapshot_tpu import _csrc
+
+    data = _compressible(8 << 20, seed=14)
+    shuffled = codec.shuffle(memoryview(data), 4)
+    out = _csrc.huff_compress(memoryview(shuffled), headroom=24)
+    assert len(out) < len(data)  # compressible payload
+    held = out.base.nbytes if out.base is not None else out.nbytes
+    assert held - out.nbytes <= 1 << 20

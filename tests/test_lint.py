@@ -1087,3 +1087,85 @@ def test_shipped_baseline_is_empty():
     fixed or allowlisted — the ratchet exists for future legacy debt,
     and an empty baseline means none was grandfathered in."""
     assert load_baseline(DEFAULT_BASELINE) == {}
+
+
+def test_instrumentation_covers_codec_entry_points():
+    """The codec layer's pipeline entry points must carry spans — an
+    unbracketed encode_frame_async would make compression latency
+    invisible exactly where a slow take needs attribution."""
+    findings = _run(
+        "instrumentation",
+        """
+        async def encode_frame_async(view, spec, stride, executor):
+            return encode_frame(view, spec, stride)
+
+        async def framed_read(storage, path, table):
+            with obs.span("codec/framed_read", path=path):
+                return None
+        """,
+        filename="torchsnapshot_tpu/codec.py",
+    )
+    assert len(findings) == 1
+    assert "encode_frame_async" in findings[0].message
+
+
+def test_instrumentation_codec_clean_when_bracketed():
+    findings = _run(
+        "instrumentation",
+        """
+        async def encode_frame_async(view, spec, stride, executor):
+            with obs.span("codec/encode_part"):
+                return encode_frame(view, spec, stride)
+
+        async def framed_read(storage, path, table):
+            with obs.span("codec/framed_read", path=path):
+                return None
+
+        def encode_frame(view, spec, stride):
+            return b""  # deliberately uncovered (hot sync path)
+        """,
+        filename="torchsnapshot_tpu/codec.py",
+    )
+    assert findings == []
+
+
+@pytest.mark.parametrize(
+    "expr",
+    [
+        "os.environ.get('TORCHSNAPSHOT_TPU_CODEC')",
+        "os.environ['TORCHSNAPSHOT_TPU_CODEC_LEVEL']",
+        "os.getenv('TORCHSNAPSHOT_TPU_CODEC_MIN_RATIO', '1.05')",
+    ],
+)
+def test_codec_knob_env_reads_flagged_outside_knobs(expr):
+    """The three codec knobs are registry knobs like any other: raw env
+    reads outside knobs.py bypass override helpers and defaults."""
+    findings = _run(
+        "knob-registry",
+        f"""
+        import os
+
+        def f():
+            return {expr}
+        """,
+        filename="torchsnapshot_tpu/codec.py",
+    )
+    assert len(findings) == 1
+
+
+def test_codec_knob_reads_via_knobs_module_clean():
+    findings = _run(
+        "knob-registry",
+        """
+        from . import knobs
+
+        def resolve():
+            return (
+                knobs.get_codec(),
+                knobs.get_codec_level(),
+                knobs.get_codec_min_ratio(),
+            )
+        """,
+        filename="torchsnapshot_tpu/codec.py",
+    )
+    assert findings == []

@@ -209,3 +209,67 @@ def test_human_formatter_tb_sizes():
     assert _human(3 * 1024**4) == "3.0TB"
     assert _human(1536) == "1.5KB"
     assert _human(100) == "100B"
+
+
+def _take_codec_stats_fixture(tmp_path):
+    from torchsnapshot_tpu import codec, knobs
+
+    name = [n for n in codec.available_codecs() if n != "raw"][0]
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "codec-snap")
+    with knobs.override_codec(name), knobs.override_write_checksums(True):
+        Snapshot.take(
+            path,
+            {
+                "m": StateDict(
+                    w=(rng.standard_normal(1 << 15) * 0.02).astype(
+                        np.float32
+                    ),
+                )
+            },
+        )
+    return path, name
+
+
+def test_cli_stats_codec_rollup_json(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    path, name = _take_codec_stats_fixture(tmp_path)
+    assert main(["stats", path, "--json"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    rollup = stats["codec"]
+    assert name in rollup["by_codec"]
+    b = rollup["by_codec"][name]
+    assert b["objects"] >= 1
+    assert 0 < b["stored_bytes"] < b["raw_bytes"]
+    assert rollup["ratio"] > 1.0
+    assert rollup["raw_bytes"] >= (1 << 15) * 4
+
+
+def test_cli_stats_codec_rollup_human(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    path, name = _take_codec_stats_fixture(tmp_path)
+    assert main(["stats", path]) == 0
+    out = capsys.readouterr().out
+    assert "codec:" in out
+    assert name in out
+    assert "x)" in out  # per-codec achieved ratio
+
+
+def test_cli_stats_codec_rollup_raw_snapshot(tmp_path, capsys):
+    """A snapshot with compression off (or pre-codec-era) reports its
+    objects under the synthetic "raw" codec with ratio 1."""
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.__main__ import main
+
+    path = str(tmp_path / "raw-snap")
+    with knobs.override_codec("raw"), knobs.override_write_checksums(True):
+        Snapshot.take(
+            path, {"m": StateDict(w=np.arange(1000, dtype=np.float32))}
+        )
+    assert main(["stats", path, "--json"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    rollup = stats["codec"]
+    assert set(rollup["by_codec"]) == {"raw"}
+    assert rollup["ratio"] == 1.0

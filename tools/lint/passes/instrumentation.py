@@ -61,6 +61,14 @@ MODULE_FUNCTIONS: Dict[str, Set[str]] = {
     "torchsnapshot_tpu/storage/stripe.py": {
         "striped_write", "striped_read", "streamed_part_write",
     },
+    # the codec layer's pipeline entry points: the per-part encode
+    # bracket is where compression latency becomes attributable in a
+    # trace (the synchronous encode_frame is deliberately unbracketed —
+    # it runs inside encode_frame_async's span), and framed_read is the
+    # decode-side analogue of striped_read
+    "torchsnapshot_tpu/codec.py": {
+        "encode_frame_async", "framed_read",
+    },
 }
 
 _BRACKET_NAMES = {"log_event", "span"}

@@ -100,6 +100,48 @@ def _entry_stats(entry) -> dict:
     }
 
 
+def _codec_rollup(metadata) -> dict:
+    """Per-snapshot compression rollup from the manifest codec tables
+    (codec.py): how many storage objects each codec carries, raw vs
+    stored bytes, and the overall achieved ratio.  Objects in the
+    whole-object digest table but NOT the codec table are stored raw;
+    a pre-codec-era snapshot (no tables at all) reports all-raw."""
+    from .codec import table_stored_size, validate_table
+
+    codecs_tbl = metadata.codecs or {}
+    objects_tbl = metadata.objects or {}
+    by_codec: dict = {}
+
+    def bucket(name):
+        return by_codec.setdefault(
+            name, {"objects": 0, "raw_bytes": 0, "stored_bytes": 0}
+        )
+
+    for loc, tbl in codecs_tbl.items():
+        if not validate_table(tbl):
+            continue
+        b = bucket(tbl["codec"])
+        b["objects"] += 1
+        b["raw_bytes"] += int(tbl["raw_size"])
+        b["stored_bytes"] += table_stored_size(tbl)
+    for loc, rec in objects_tbl.items():
+        if loc in codecs_tbl:
+            continue
+        if isinstance(rec, (list, tuple)) and len(rec) == 3:
+            b = bucket("raw")
+            b["objects"] += 1
+            b["raw_bytes"] += int(rec[2])
+            b["stored_bytes"] += int(rec[2])
+    raw_total = sum(b["raw_bytes"] for b in by_codec.values())
+    stored_total = sum(b["stored_bytes"] for b in by_codec.values())
+    return {
+        "by_codec": by_codec,
+        "raw_bytes": raw_total,
+        "stored_bytes": stored_total,
+        "ratio": (raw_total / stored_total) if stored_total else None,
+    }
+
+
 def _cmd_stats(args) -> int:
     """Per-entry size/dtype/chunk rollups from the manifest (the
     operator's "where did my bytes go" view; machine-readable with
@@ -142,6 +184,7 @@ def _cmd_stats(args) -> int:
         "largest": [
             {"path": p, **st} for p, st in largest
         ],
+        "codec": _codec_rollup(metadata),
     }
     if args.json:
         print(json.dumps(stats, indent=2))
@@ -157,6 +200,27 @@ def _cmd_stats(args) -> int:
     print("  by dtype:")
     for dt, st in sorted(by_dtype.items(), key=lambda kv: -kv[1]["bytes"]):
         print(f"    {dt:<14} {st['count']:>6}  {_human(st['bytes'])}")
+    rollup = stats["codec"]
+    if rollup["by_codec"]:
+        ratio = rollup["ratio"]
+        print(
+            f"  codec: {_human(rollup['raw_bytes'])} raw -> "
+            f"{_human(rollup['stored_bytes'])} stored"
+            + (f" ({ratio:.2f}x)" if ratio else "")
+        )
+        for name, st in sorted(
+            rollup["by_codec"].items(), key=lambda kv: -kv[1]["raw_bytes"]
+        ):
+            r = (
+                st["raw_bytes"] / st["stored_bytes"]
+                if st["stored_bytes"]
+                else 0.0
+            )
+            print(
+                f"    {name:<14} {st['objects']:>6}  "
+                f"{_human(st['raw_bytes'])} -> "
+                f"{_human(st['stored_bytes'])} ({r:.2f}x)"
+            )
     print(f"  largest {len(largest)}:")
     width = max((len(p) for p, _ in largest), default=10)
     for p, st in largest:

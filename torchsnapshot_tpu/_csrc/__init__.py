@@ -258,6 +258,30 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint32),
         ]
+        try:
+            # newer symbols (the "huff" block codec): tolerate a cached
+            # .so from older source — codec.py then reports huff
+            # unavailable instead of crashing every native-ext consumer
+            for sym in ("tsnp_huff_compress", "tsnp_huff_decompress"):
+                fn = getattr(lib, sym)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                ]
+            for sym in ("tsnp_byte_shuffle", "tsnp_byte_unshuffle"):
+                fn = getattr(lib, sym)
+                fn.restype = None
+                fn.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_int64,
+                    ctypes.c_void_p,
+                ]
+        except AttributeError:
+            logger.debug("loaded fastio lacks the huff codec symbols")
         _lib = lib
         return _lib
 
@@ -316,6 +340,92 @@ def digest(data) -> Optional[tuple]:
     out = (ctypes.c_uint32 * 2)()
     lib.tsnp_digest(_buffer_address(view), view.nbytes, out)
     return (int(out[0]), int(out[1]))
+
+
+def byte_shuffle(data, stride: int, inverse: bool = False):
+    """Byte-shuffle (or unshuffle) ``data`` with the native cache-blocked
+    transpose — GIL-free, one pass, no intermediate copy; None when the
+    native lib (or its shuffle symbols) is unavailable."""
+    import numpy as np
+
+    lib = load()
+    if lib is None or not hasattr(lib, "tsnp_byte_shuffle"):
+        return None
+    view = memoryview(data).cast("B")
+    out = np.empty(view.nbytes, dtype=np.uint8)
+    fn = lib.tsnp_byte_unshuffle if inverse else lib.tsnp_byte_shuffle
+    fn(_buffer_address(view), view.nbytes, stride, out.ctypes.data)
+    return out
+
+
+def huff_available() -> bool:
+    """True when the loaded native lib carries the huff codec symbols."""
+    lib = load()
+    return lib is not None and hasattr(lib, "tsnp_huff_compress")
+
+
+def huff_compress(data, headroom: int = 0):
+    """Compress ``data`` with the native block-Huffman coder; None when
+    the native lib (or its huff symbols) is unavailable.  The returned
+    stream may exceed the input by ~5 bytes per 128KB block on
+    incompressible data (raw-mode blocks) — codec.py's min-ratio check
+    handles store-raw fallback above this layer.
+
+    ``headroom``: reserve that many writable bytes BEFORE the stream
+    and return a uint8 array of headroom+stream (codec.py packs the
+    frame header into the reservation) — the stream is produced exactly
+    once, in place; with headroom=0 plain bytes are returned."""
+    import numpy as np
+
+    lib = load()
+    if lib is None or not hasattr(lib, "tsnp_huff_compress"):
+        return None
+    view = memoryview(data).cast("B")
+    if view.nbytes == 0:
+        return np.empty(headroom, dtype=np.uint8) if headroom else b""
+    cap = view.nbytes + view.nbytes // 64 + 4096
+    out = np.empty(headroom + cap, dtype=np.uint8)
+    rc = lib.tsnp_huff_compress(
+        _buffer_address(view), view.nbytes,
+        out.ctypes.data + headroom, cap,
+    )
+    if rc < 0:  # cap is sized so this cannot happen; guard anyway
+        return None
+    if headroom:
+        ret = out[: headroom + rc]
+        # a slice view pins the whole raw-sized capacity allocation for
+        # as long as the frame lives (through the write queue) — the
+        # stripe engine's byte-gate credits the saved bytes as freed, so
+        # they must actually free: shrink-copy when compression saved
+        # enough to matter
+        if out.nbytes - ret.nbytes > (1 << 20):
+            ret = ret.copy()
+        return ret
+    return out[:rc].tobytes()
+
+
+def huff_decompress(data, raw_len: int):
+    """Decompress a huff stream to exactly ``raw_len`` bytes (bytes-like
+    uint8 array — no trailing tobytes copy on the restore hot path);
+    None when the native lib is unavailable; ValueError on malformed
+    input."""
+    import numpy as np
+
+    lib = load()
+    if lib is None or not hasattr(lib, "tsnp_huff_decompress"):
+        return None
+    view = memoryview(data).cast("B")
+    if raw_len == 0 and view.nbytes == 0:
+        return b""
+    out = np.empty(raw_len, dtype=np.uint8)
+    rc = lib.tsnp_huff_decompress(
+        _buffer_address(view), view.nbytes, out.ctypes.data, raw_len
+    )
+    if rc != raw_len:
+        raise ValueError(
+            f"corrupt huff stream: decoded {rc} of {raw_len} expected bytes"
+        )
+    return out
 
 
 def copy_digest(dst, src) -> Optional[tuple]:

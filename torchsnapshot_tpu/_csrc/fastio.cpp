@@ -525,3 +525,358 @@ void tsnp_copy_digest(void *dst, const void *src, int64_t size,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// "huff" block codec: static canonical-Huffman entropy coder (codec.py's
+// native backend).  Checkpoint float payloads after byte-shuffle
+// preconditioning are entropy-bound, not match-bound — the exponent byte
+// planes hold a handful of symbol values in near-random order, which an
+// LZ matcher can't exploit but an order-0 entropy coder compresses well
+// (~1.5x on noisy bf16).  Deflate's Huffman-only mode proves the ratio
+// but tops out ~65MB/s here; this flat table-driven coder runs several
+// times faster and, like everything in this file, entirely outside the
+// GIL so the staging executor's encode stage overlaps storage I/O.
+//
+// Stream layout: independent 128KB blocks, each
+//   [mode u8][raw_len i32le][payload]
+//   mode 0 raw:      payload = raw bytes (incompressible block)
+//   mode 1 huffman:  payload = [code lens 256 x 4bit][nbits u32le][bitstream]
+//   mode 2 constant: payload = the single byte value
+// Code lengths are capped at 12 bits (frequency flattening on overflow)
+// so decode is one 4K-entry table lookup per symbol.  The compressor
+// emits bit-REVERSED canonical codes into an LSB-first accumulator, so
+// the decoder's peeked low bits are exactly the table index (deflate's
+// trick).
+
+namespace {
+
+const int64_t kHuffBlock = 128 * 1024;
+const int kHuffMaxLen = 12;
+
+// Canonical code values (MSB-first semantics) from code lengths.
+void huff_canonical_codes(const uint8_t *lens, uint16_t *codes) {
+  int count[kHuffMaxLen + 1] = {0};
+  for (int i = 0; i < 256; i++)
+    count[lens[i]]++;
+  count[0] = 0;
+  uint32_t next[kHuffMaxLen + 1];
+  uint32_t code = 0;
+  for (int l = 1; l <= kHuffMaxLen; l++) {
+    code = (code + count[l - 1]) << 1;
+    next[l] = code;
+  }
+  for (int i = 0; i < 256; i++)
+    codes[i] = lens[i] ? static_cast<uint16_t>(next[lens[i]]++) : 0;
+}
+
+// Length-limited Huffman code lengths from symbol frequencies: two-queue
+// Huffman build, retried with flattened frequencies until the deepest
+// leaf fits kHuffMaxLen (the standard cheap substitute for package-merge;
+// the ratio loss on real blocks is <0.1%).
+void huff_build_lens(const uint32_t *freq_in, uint8_t *lens) {
+  uint32_t freq[256];
+  memcpy(freq, freq_in, sizeof(freq));
+  for (int attempt = 0;; attempt++) {
+    struct Node {
+      uint64_t f;
+      int l, r, sym;
+    };
+    Node nodes[512];
+    int order[256], n = 0;
+    for (int i = 0; i < 256; i++)
+      if (freq[i])
+        order[n++] = i;
+    memset(lens, 0, 256);
+    if (n == 0)
+      return;
+    if (n == 1) {
+      lens[order[0]] = 1;
+      return;
+    }
+    // insertion sort by frequency (256 symbols max; avoids <algorithm>)
+    for (int i = 1; i < n; i++) {
+      int v = order[i], j = i - 1;
+      while (j >= 0 && freq[order[j]] > freq[v]) {
+        order[j + 1] = order[j];
+        j--;
+      }
+      order[j + 1] = v;
+    }
+    for (int i = 0; i < n; i++) {
+      nodes[i].f = freq[order[i]];
+      nodes[i].l = nodes[i].r = -1;
+      nodes[i].sym = order[i];
+    }
+    int q1 = 0, q2 = n, q2e = n;
+    int root = -1;
+    for (int k = 0; k < n - 1; k++) {
+      int a, b;
+      a = (q1 < n && (q2 >= q2e || nodes[q1].f <= nodes[q2].f)) ? q1++ : q2++;
+      b = (q1 < n && (q2 >= q2e || nodes[q1].f <= nodes[q2].f)) ? q1++ : q2++;
+      nodes[q2e].f = nodes[a].f + nodes[b].f;
+      nodes[q2e].l = a;
+      nodes[q2e].r = b;
+      nodes[q2e].sym = -1;
+      root = q2e++;
+    }
+    uint8_t depth[512];
+    depth[root] = 0;
+    // children always precede their parent in creation order, so one
+    // top-down sweep from the root resolves every depth
+    for (int i = root; i >= n; i--) {
+      depth[nodes[i].l] = depth[i] + 1;
+      depth[nodes[i].r] = depth[i] + 1;
+    }
+    int maxd = 0;
+    for (int i = 0; i < n; i++)
+      if (depth[i] > maxd)
+        maxd = depth[i];
+    if (maxd <= kHuffMaxLen) {
+      for (int i = 0; i < n; i++)
+        lens[nodes[i].sym] = depth[i];
+      return;
+    }
+    for (int i = 0; i < 256; i++)
+      if (freq[i])
+        freq[i] = (freq[i] >> (2 * (attempt + 1))) + 1;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Byte-shuffle preconditioning (codec.py's filter): group byte plane i
+// of every `stride`-sized element together — dst[p*rows + r] =
+// src[r*stride + p].  Cache-blocked transpose, entirely outside the
+// GIL (the numpy reshape().T path holds it and costs an extra copy).
+// The sub-element tail (n % stride) is copied through unshuffled, so
+// the transform stays self-inverse for any length.
+void tsnp_byte_shuffle(const uint8_t *src, int64_t n, int64_t stride,
+                       uint8_t *dst) {
+  int64_t rows = n / stride;
+  const int64_t kBlock = 4096;
+  for (int64_t r0 = 0; r0 < rows; r0 += kBlock) {
+    int64_t r1 = r0 + kBlock < rows ? r0 + kBlock : rows;
+    for (int64_t p = 0; p < stride; p++) {
+      uint8_t *d = dst + p * rows + r0;
+      const uint8_t *s = src + r0 * stride + p;
+      for (int64_t r = r0; r < r1; r++) {
+        *d++ = *s;
+        s += stride;
+      }
+    }
+  }
+  memcpy(dst + rows * stride, src + rows * stride, n - rows * stride);
+}
+
+void tsnp_byte_unshuffle(const uint8_t *src, int64_t n, int64_t stride,
+                         uint8_t *dst) {
+  int64_t rows = n / stride;
+  const int64_t kBlock = 4096;
+  for (int64_t r0 = 0; r0 < rows; r0 += kBlock) {
+    int64_t r1 = r0 + kBlock < rows ? r0 + kBlock : rows;
+    for (int64_t p = 0; p < stride; p++) {
+      const uint8_t *s = src + p * rows + r0;
+      uint8_t *d = dst + r0 * stride + p;
+      for (int64_t r = r0; r < r1; r++) {
+        *d = *s++;
+        d += stride;
+      }
+    }
+  }
+  memcpy(dst + rows * stride, src + rows * stride, n - rows * stride);
+}
+
+// Compress src[0:n] into dst (capacity cap).  Returns the compressed
+// size, or -1 when dst is too small (callers size cap >= n + n/64 + 4096
+// so a real payload never hits it; a pathological all-raw stream grows
+// 5 bytes per 128KB block).
+int64_t tsnp_huff_compress(const uint8_t *src, int64_t n, uint8_t *dst,
+                           int64_t cap) {
+  uint8_t *op = dst;
+  const uint8_t *oend = dst + cap;
+  for (int64_t pos = 0; pos < n; pos += kHuffBlock) {
+    int bn = static_cast<int>(n - pos < kHuffBlock ? n - pos : kHuffBlock);
+    const uint8_t *bp = src + pos;
+    if (op + bn + 256 > oend)
+      return -1;
+    uint32_t freq[256] = {0};
+    for (int i = 0; i < bn; i++)
+      freq[bp[i]]++;
+    int nsym = 0, sym0 = 0;
+    for (int i = 0; i < 256; i++)
+      if (freq[i]) {
+        nsym++;
+        sym0 = i;
+      }
+    if (nsym == 1) {
+      *op++ = 2;
+      memcpy(op, &bn, 4);
+      op += 4;
+      *op++ = static_cast<uint8_t>(sym0);
+      continue;
+    }
+    uint8_t lens[256];
+    uint16_t codes[256], rcodes[256];
+    huff_build_lens(freq, lens);
+    huff_canonical_codes(lens, codes);
+    for (int s = 0; s < 256; s++) {
+      uint32_t c = codes[s], r = 0;
+      for (int b = 0; b < lens[s]; b++)
+        r = (r << 1) | ((c >> b) & 1);
+      rcodes[s] = static_cast<uint16_t>(r);
+    }
+    uint64_t bits = 0;
+    for (int i = 0; i < 256; i++)
+      bits += static_cast<uint64_t>(freq[i]) * lens[i];
+    int64_t est = 1 + 4 + 128 + 4 + static_cast<int64_t>((bits + 7) / 8);
+    if (est >= bn) {  // entropy coding wouldn't shrink this block
+      *op++ = 0;
+      memcpy(op, &bn, 4);
+      op += 4;
+      memcpy(op, bp, bn);
+      op += bn;
+      continue;
+    }
+    *op++ = 1;
+    memcpy(op, &bn, 4);
+    op += 4;
+    for (int i = 0; i < 256; i += 2)
+      *op++ = static_cast<uint8_t>(lens[i] | (lens[i + 1] << 4));
+    uint32_t nbits32 = static_cast<uint32_t>(bits);
+    memcpy(op, &nbits32, 4);
+    op += 4;
+    uint64_t acc = 0;
+    int nb = 0;
+    for (int i = 0; i < bn; i++) {
+      acc |= static_cast<uint64_t>(rcodes[bp[i]]) << nb;
+      nb += lens[bp[i]];
+      if (nb >= 32) {
+        memcpy(op, &acc, 4);
+        op += 4;
+        acc >>= 32;
+        nb -= 32;
+      }
+    }
+    while (nb > 0) {
+      *op++ = static_cast<uint8_t>(acc);
+      acc >>= 8;
+      nb -= 8;
+    }
+  }
+  return op - dst;
+}
+
+// Decompress src[0:n] into dst (capacity rawcap).  Returns the raw size,
+// or -1 on any malformed input (truncated block, bad mode byte, bit
+// stream shorter than its symbol count claims) — the Python layer maps
+// -1 to a typed corrupt-frame error.
+int64_t tsnp_huff_decompress(const uint8_t *src, int64_t n, uint8_t *dst,
+                             int64_t rawcap) {
+  const uint8_t *ip = src;
+  const uint8_t *iend = src + n;
+  uint8_t *op = dst;
+  uint8_t *oend = dst + rawcap;
+  while (ip < iend) {
+    if (ip + 5 > iend)
+      return -1;
+    uint8_t mode = *ip++;
+    int32_t bn;
+    memcpy(&bn, ip, 4);
+    ip += 4;
+    if (bn < 0 || op + bn > oend)
+      return -1;
+    if (mode == 0) {
+      if (ip + bn > iend)
+        return -1;
+      memcpy(op, ip, bn);
+      op += bn;
+      ip += bn;
+    } else if (mode == 2) {
+      if (ip >= iend)
+        return -1;
+      memset(op, *ip++, bn);
+      op += bn;
+    } else if (mode == 1) {
+      if (ip + 132 > iend)
+        return -1;
+      uint8_t lens[256];
+      for (int i = 0; i < 128; i++) {
+        lens[2 * i] = ip[i] & 15;
+        lens[2 * i + 1] = ip[i] >> 4;
+      }
+      ip += 128;
+      uint32_t nbits;
+      memcpy(&nbits, ip, 4);
+      ip += 4;
+      // Wire lengths are 4-bit nibbles (0..15) but the coder never
+      // emits above kHuffMaxLen=12 — larger values are corruption, and
+      // would index past count[]/next[] in huff_canonical_codes.
+      // Kraft check: an overfull length table (sum 2^-len > 1) is not a
+      // prefix code — canonical construction would assign code values
+      // wider than their lengths.  Undersubscribed tables are fine:
+      // their unused table slots stay 0xffff and decode fails cleanly
+      // on first hit.
+      uint64_t kraft = 0;
+      for (int s = 0; s < 256; s++) {
+        if (lens[s] > kHuffMaxLen)
+          return -1;
+        if (lens[s])
+          kraft += 1u << (kHuffMaxLen - lens[s]);
+      }
+      if (kraft > (1u << kHuffMaxLen))
+        return -1;
+      uint16_t codes[256];
+      huff_canonical_codes(lens, codes);
+      uint16_t table[1 << kHuffMaxLen];
+      memset(table, 0xff, sizeof(table));
+      for (int s = 0; s < 256; s++) {
+        int l = lens[s];
+        if (!l)
+          continue;
+        uint32_t c = codes[s], r = 0;
+        for (int b = 0; b < l; b++)
+          r = (r << 1) | ((c >> b) & 1);
+        for (uint32_t f = 0; f < (1u << (kHuffMaxLen - l)); f++)
+          table[r | (f << l)] = static_cast<uint16_t>(s | (l << 8));
+      }
+      const uint8_t *bs = ip;
+      int64_t nbytes = (static_cast<int64_t>(nbits) + 7) / 8;
+      if (bs + nbytes > iend)
+        return -1;
+      uint64_t acc = 0;
+      int nb = 0;
+      int64_t bpos = 0;
+      for (int i = 0; i < bn; i++) {
+        if (nb < kHuffMaxLen) {
+          if (bpos + 4 <= nbytes) {
+            uint32_t w;
+            memcpy(&w, bs + bpos, 4);
+            acc |= static_cast<uint64_t>(w) << nb;
+            bpos += 4;
+            nb += 32;
+          } else {
+            while (nb < kHuffMaxLen && bpos < nbytes) {
+              acc |= static_cast<uint64_t>(bs[bpos++]) << nb;
+              nb += 8;
+            }
+          }
+        }
+        uint16_t e = table[acc & ((1 << kHuffMaxLen) - 1)];
+        int l = e >> 8;
+        if (l == 0xff || l == 0 || l > nb)
+          return -1;  // invalid code or bit stream exhausted mid-symbol
+        *op++ = static_cast<uint8_t>(e);
+        acc >>= l;
+        nb -= l;
+      }
+      ip = bs + nbytes;
+    } else {
+      return -1;
+    }
+  }
+  return op - dst;
+}
+
+}  // extern "C"

@@ -1,0 +1,775 @@
+"""Per-part compression codec layer for the stripe engine.
+
+Checkpoint bytes move under an explicit host-memory budget with staging
+overlapped against storage I/O (scheduler.py); this module makes
+compression a *tenant of that same pipeline* instead of a stage
+serialized in front of it: each 64MB part's encode runs on the staging
+executor between its raw digest and its ``write_part`` dispatch, so
+compression overlaps the storage I/O of earlier parts, and every
+compressed byte is a byte not paid for at S3/GCS bandwidth, durable-tier
+storage cost, tier-promotion copy time, or many-reader restore fan-in.
+
+Design rules, in dependency order:
+
+- **Digests are computed over the RAW bytes, before encoding.**  Entry
+  crc32s, the incremental-dedup objects table, and deep-verify all keep
+  today's values bitwise; the *stored* (encoded) digest is recorded
+  separately per object so the tier layer's digest-verified fast reads
+  keep working against the bytes actually on disk.
+- **Every part is an independently-decodable frame** (24-byte header:
+  magic + codec id + filter id + raw/encoded lengths), so ranged restore
+  and part-parallel reads survive compression — a raw byte range maps
+  to the overlapping frames via the manifest's per-object codec table,
+  and frames decode concurrently on the read executor.
+- **Store-raw is the per-part fallback** whenever the encoded frame
+  isn't smaller than the raw bytes by ``CODEC_MIN_RATIO`` — the
+  zero-copy value prop survives for incompressible parts (mantissa
+  noise, already-compressed blobs), which simply pay one 24-byte header.
+- **Codecs are optional dependencies.**  ``zlib`` is stdlib and always
+  present; ``zstd``/``lz4`` import lazily (the ``ml_dtypes`` pattern)
+  and an unavailable *write*-side codec degrades to ``raw`` with one
+  warning, while an unavailable *read*-side codec raises a typed
+  ``CodecUnavailableError`` naming it (raw-fallback frames still
+  decode).  ``huff`` is the native fastio block-Huffman coder — float
+  checkpoint payloads after byte-shuffle preconditioning are
+  entropy-bound, which LZ matchers can't exploit; see fastio.cpp.
+- **Byte-shuffle preconditioning** groups the i-th byte of every
+  element together (filter id == the element stride), turning bf16/f32
+  noise into compressible byte planes; ``filter_for_dtype`` picks the
+  stride for float dtypes and 0 (none) for bytes/objects/ints.
+
+Integrity model: frames carry lengths, not checksums — corruption
+inside an encoded payload surfaces as a decode failure or as a
+raw-digest mismatch at the verify layers (manifest entry crc32s, the
+tier plugin's stored-digest check), exactly where raw payloads'
+corruption already surfaces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import struct
+import time
+import weakref
+import zlib
+from concurrent.futures import Executor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import knobs, obs
+from .io_types import ReadIO, StoragePlugin, resolve_read_destination
+from .resilience import classify_generic, retry_call
+from .resilience.failpoints import failpoint
+from .resilience.retry import SharedProgress
+
+logger = logging.getLogger(__name__)
+
+# ----------------------------------------------------------------- frame
+
+FRAME_MAGIC = b"TSCF"
+FRAME_VERSION = 1
+# magic(4) + version(1) + codec_id(1) + filter_id(1) + reserved(1)
+# + raw_len(u64le) + enc_len(u64le)
+FRAME_HEADER_BYTES = 24
+_HEADER = struct.Struct("<4sBBBBQQ")
+
+CODEC_IDS: Dict[str, int] = {
+    "raw": 0,
+    "zlib": 1,
+    "zstd": 2,
+    "lz4": 3,
+    "huff": 4,
+}
+_ID_TO_NAME = {v: k for k, v in CODEC_IDS.items()}
+
+
+class CodecError(IOError):
+    """Base for codec-layer failures."""
+
+
+class CodecFrameError(CodecError):
+    """A frame failed structural validation: bad magic/version, a
+    truncated payload, a codec/filter id outside the registry, or a
+    decode that produced the wrong byte count."""
+
+
+class CodecUnavailableError(CodecError):
+    """The frame names a codec this host cannot decode (optional
+    dependency not installed / native extension not built)."""
+
+    def __init__(self, codec: str, detail: str = "") -> None:
+        self.codec = codec
+        super().__init__(
+            f"codec {codec!r} is not available on this host{detail} — "
+            f"install it to restore this snapshot (raw-fallback parts "
+            f"decode regardless)"
+        )
+
+
+# -------------------------------------------------------------- registry
+
+
+def _zlib_compress(view: memoryview, level: int) -> bytes:
+    # zlib accepts any C-contiguous buffer: no bytes() staging copy
+    return zlib.compress(view, level if 1 <= level <= 9 else 1)
+
+
+def _zlib_decompress(view: memoryview, raw_len: int) -> bytes:
+    return zlib.decompress(view)
+
+
+def _zstd_mod():
+    try:
+        import zstandard
+
+        return zstandard
+    except ImportError:
+        return None
+
+
+def _zstd_compress(view: memoryview, level: int) -> bytes:
+    # zstd/lz4/zlib all take buffer-protocol objects directly: a 64MB
+    # part must not pay a GIL-held bytes() staging memcpy per encode
+    zstandard = _zstd_mod()
+    return zstandard.ZstdCompressor(
+        level=level if level else 3
+    ).compress(view)
+
+
+def _zstd_decompress(view: memoryview, raw_len: int) -> bytes:
+    zstandard = _zstd_mod()
+    return zstandard.ZstdDecompressor().decompress(
+        view, max_output_size=raw_len
+    )
+
+
+def _lz4_mod():
+    try:
+        import lz4.frame
+
+        return lz4.frame
+    except ImportError:
+        return None
+
+
+def _lz4_compress(view: memoryview, level: int) -> bytes:
+    return _lz4_mod().compress(view, compression_level=level)
+
+
+def _lz4_decompress(view: memoryview, raw_len: int) -> bytes:
+    return _lz4_mod().decompress(view)
+
+
+def _huff_compress(view: memoryview, level: int) -> bytes:
+    # encode_frame's huff fast path builds the frame in place
+    # (headroom=FRAME_HEADER_BYTES) and bypasses this entry; it exists
+    # so the registry stays uniform — a generic caller gets the same
+    # bare stream the fast path frames
+    from . import _csrc
+
+    out = _csrc.huff_compress(view)
+    if out is None:  # availability is checked before compress is called
+        raise CodecUnavailableError("huff", " (native fastio lib absent)")
+    return out
+
+
+def _huff_decompress(view: memoryview, raw_len: int) -> bytes:
+    from . import _csrc
+
+    try:
+        out = _csrc.huff_decompress(view, raw_len)
+    except ValueError as e:
+        raise CodecFrameError(f"corrupt huff frame payload: {e}") from e
+    if out is None:
+        raise CodecUnavailableError("huff", " (native fastio lib absent)")
+    return out
+
+
+def _huff_available() -> bool:
+    from . import _csrc
+
+    return _csrc.huff_available()
+
+
+class _Codec:
+    __slots__ = ("name", "codec_id", "_compress", "_decompress", "_avail")
+
+    def __init__(self, name, compress, decompress, avail) -> None:
+        self.name = name
+        self.codec_id = CODEC_IDS[name]
+        self._compress = compress
+        self._decompress = decompress
+        self._avail = avail
+
+    def available(self) -> bool:
+        return self._avail()
+
+    def compress(self, view: memoryview, level: int) -> bytes:
+        return self._compress(view, level)
+
+    def decompress(self, view: memoryview, raw_len: int) -> bytes:
+        return self._decompress(view, raw_len)
+
+
+_REGISTRY: Dict[str, _Codec] = {
+    "zlib": _Codec("zlib", _zlib_compress, _zlib_decompress, lambda: True),
+    "zstd": _Codec(
+        "zstd", _zstd_compress, _zstd_decompress,
+        lambda: _zstd_mod() is not None,
+    ),
+    "lz4": _Codec(
+        "lz4", _lz4_compress, _lz4_decompress,
+        lambda: _lz4_mod() is not None,
+    ),
+    "huff": _Codec("huff", _huff_compress, _huff_decompress, _huff_available),
+}
+
+
+def available_codecs() -> List[str]:
+    """Codec names usable on this host, ``raw`` first."""
+    return ["raw"] + [n for n, c in _REGISTRY.items() if c.available()]
+
+
+_warned_unavailable: set = set()
+
+
+def resolve_codec(name: Optional[str] = None) -> str:
+    """Resolve the write-side codec: the argument, else the CODEC knob.
+    Unknown or unavailable codecs degrade to ``raw`` with one warning —
+    a typo'd env var or a missing optional dependency must never fail a
+    take (compression is an optimization, not a correctness
+    dependency)."""
+    name = (name or knobs.get_codec()).lower()
+    if name == "raw":
+        return "raw"
+    codec = _REGISTRY.get(name)
+    if codec is None or not codec.available():
+        if name not in _warned_unavailable:
+            _warned_unavailable.add(name)
+            why = "unknown codec" if codec is None else "not installed"
+            logger.warning(
+                "TORCHSNAPSHOT_TPU_CODEC=%r %s (available: %s); writing "
+                "raw", name, why, ",".join(available_codecs()),
+            )
+        return "raw"
+    return name
+
+
+# --------------------------------------------------------------- filters
+
+# dtypes whose byte planes separate well: float formats, where the
+# exponent/sign bytes are low-entropy and the mantissa bytes are noise.
+# Ints/bytes/objects keep filter 0 — shuffling random bytes or text
+# mostly just costs a pass.
+_FLOAT_ITEMSIZE = {
+    "float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+}
+
+
+def filter_for_dtype(dtype_str: Optional[str]) -> int:
+    """Byte-shuffle stride for a manifest dtype string (0 = no filter)."""
+    if not dtype_str:
+        return 0
+    return _FLOAT_ITEMSIZE.get(dtype_str.lower(), 0)
+
+
+def shuffle(view: memoryview, stride: int):
+    """Byte-shuffle: group byte plane i of every ``stride``-sized
+    element together.  A tail shorter than one element (a part span not
+    aligned to the itemsize) is appended unshuffled — the operation
+    stays self-inverse per frame regardless of alignment.
+
+    Returns a bytes-like object (the native path hands back a uint8
+    array with no extra copy; the numpy fallback returns bytes) — this
+    is the encode hot path, one call per 64MB part on the staging
+    executor, so the native transpose matters twice: it skips a copy
+    and it runs outside the GIL, letting part encodes actually
+    parallelize across executor threads."""
+    from . import _csrc
+
+    out = _csrc.byte_shuffle(view, stride)
+    if out is not None:
+        return out
+    import numpy as np
+
+    n = view.nbytes
+    body = n - (n % stride)
+    arr = np.frombuffer(view, dtype=np.uint8, count=body)
+    res = np.ascontiguousarray(
+        arr.reshape(-1, stride).T
+    ).tobytes()
+    if body != n:
+        res += bytes(view[body:])
+    return res
+
+
+def unshuffle(view: memoryview, stride: int):
+    """Inverse of ``shuffle``; bytes-like (the native path returns the
+    coder's uint8 array as-is — a 64MB decode must not pay a tobytes
+    memcpy per frame on the restore hot path)."""
+    from . import _csrc
+
+    out = _csrc.byte_shuffle(view, stride, inverse=True)
+    if out is not None:
+        return out
+    import numpy as np
+
+    n = view.nbytes
+    body = n - (n % stride)
+    arr = np.frombuffer(view, dtype=np.uint8, count=body)
+    res = np.ascontiguousarray(
+        arr.reshape(stride, -1).T
+    ).tobytes()
+    if body != n:
+        res += bytes(view[body:])
+    return res
+
+
+# ------------------------------------------------------------- metrics
+
+CODEC_BYTES_IN = obs.CODEC_BYTES_IN
+CODEC_BYTES_OUT = obs.CODEC_BYTES_OUT
+CODEC_PARTS_RAW_FALLBACK = obs.CODEC_PARTS_RAW_FALLBACK
+CODEC_PARTS_ENCODED = obs.CODEC_PARTS_ENCODED
+CODEC_PARTS_DECODED = obs.CODEC_PARTS_DECODED
+
+
+def _enc_hist(name: str):
+    return obs.histogram(f"storage.codec.encode_latency_s.{name}")
+
+
+def _dec_hist(name: str):
+    return obs.histogram(f"storage.codec.decode_latency_s.{name}")
+
+
+# --------------------------------------------------------- write spec
+
+
+class WriteSpec:
+    """Resolved write-side codec parameters, read once per pipeline run
+    (CODEC=raw resolves to ``None`` at the call site, so the disabled
+    path costs one knob read per take and nothing per part)."""
+
+    __slots__ = ("codec", "level", "min_ratio")
+
+    def __init__(self, codec: str, level: int, min_ratio: float) -> None:
+        self.codec = codec
+        self.level = level
+        self.min_ratio = min_ratio
+
+
+def resolve_write_spec() -> Optional[WriteSpec]:
+    """The active write-side spec, or None when the codec resolves to
+    raw (the zero-overhead disabled path)."""
+    name = resolve_codec()
+    if name == "raw":
+        return None
+    return WriteSpec(
+        name, knobs.get_codec_level(), knobs.get_codec_min_ratio()
+    )
+
+
+# ------------------------------------------------------ frame encode
+
+
+def _count_encode(
+    codec_name: str, raw_len: int, frame_len: int, fallback: bool, dt: float
+) -> None:
+    """Metrics for ONE part's successful encode — kept out of the
+    retried attempt so a transient (chaos encode failpoint) doesn't
+    count the same part's bytes twice."""
+    _enc_hist(codec_name).observe(dt)
+    obs.counter(CODEC_BYTES_IN).inc(raw_len)
+    obs.counter(CODEC_BYTES_OUT).inc(frame_len)
+    obs.counter(
+        CODEC_PARTS_RAW_FALLBACK if fallback else CODEC_PARTS_ENCODED
+    ).inc()
+
+
+def encode_frame(
+    view: memoryview,
+    spec: WriteSpec,
+    filter_stride: int = 0,
+    min_frame_bytes: int = 0,
+):
+    """Encode one part into a self-describing frame (bytes-like; the
+    native paths return uint8 arrays assembled with no staging copies —
+    this runs once per 64MB part on the staging executor, where every
+    GIL-holding memcpy serializes otherwise-parallel encodes).  Falls
+    back to a raw frame (codec 0, filter 0, payload = the raw bytes)
+    whenever the encoded frame isn't smaller than the raw part by
+    ``spec.min_ratio`` — incompressible parts pay one header, never a
+    decode-side codec dependency.
+
+    ``min_frame_bytes`` is the backend's non-final-part floor
+    (StripedWriteHandle.min_part_bytes; S3's EntityTooSmall): a frame
+    that compresses BELOW it also falls back to raw — but only when the
+    raw frame actually clears the floor (when even raw is undersized,
+    the smaller encoded frame is kept; the backend rejects either)."""
+    frame, raw_len, fallback, dt = _encode_frame_uncounted(
+        view, spec, filter_stride, min_frame_bytes
+    )
+    _count_encode(
+        spec.codec, raw_len, memoryview(frame).nbytes, fallback, dt
+    )
+    return frame
+
+
+def _encode_frame_uncounted(
+    view: memoryview,
+    spec: WriteSpec,
+    filter_stride: int = 0,
+    min_frame_bytes: int = 0,
+) -> tuple:
+    """``encode_frame`` minus metrics: ``(frame, raw_len, fallback,
+    encode_seconds)``.  The retried async path counts once on success
+    via ``_count_encode``."""
+    import numpy as np
+
+    view = memoryview(view).cast("B")
+    raw_len = view.nbytes
+    codec = _REGISTRY[spec.codec]
+    t0 = time.perf_counter()
+    filtered = shuffle(view, filter_stride) if filter_stride > 1 else view
+    if spec.codec == "huff":
+        # native fast path: the coder writes its stream directly after
+        # a header-sized reservation — the frame is built in place
+        from . import _csrc
+
+        out = _csrc.huff_compress(
+            memoryview(filtered), headroom=FRAME_HEADER_BYTES
+        )
+        if out is None:
+            raise CodecUnavailableError("huff", " (native fastio lib absent)")
+        enc_len = len(out) - FRAME_HEADER_BYTES
+    else:
+        enc = codec.compress(memoryview(filtered), spec.level)
+        enc_len = len(enc)
+        out = None
+    dt = time.perf_counter() - t0
+    frame_len = FRAME_HEADER_BYTES + enc_len
+    if raw_len < spec.min_ratio * frame_len or (
+        0 < frame_len < min_frame_bytes <= FRAME_HEADER_BYTES + raw_len
+    ):
+        raw_out = np.empty(FRAME_HEADER_BYTES + raw_len, dtype=np.uint8)
+        _HEADER.pack_into(
+            raw_out, 0, FRAME_MAGIC, FRAME_VERSION, 0, 0, 0,
+            raw_len, raw_len,
+        )
+        raw_out[FRAME_HEADER_BYTES:] = np.frombuffer(view, dtype=np.uint8)
+        return raw_out, raw_len, True, dt
+    header = (
+        FRAME_MAGIC, FRAME_VERSION, codec.codec_id,
+        filter_stride if filter_stride > 1 else 0, 0, raw_len, enc_len,
+    )
+    if out is not None:
+        _HEADER.pack_into(out, 0, *header)
+    else:
+        out = np.empty(frame_len, dtype=np.uint8)
+        _HEADER.pack_into(out, 0, *header)
+        out[FRAME_HEADER_BYTES:] = np.frombuffer(
+            memoryview(enc), dtype=np.uint8
+        )
+    return out, raw_len, False, dt
+
+
+_ENCODE_SLOTS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _encode_slots(loop: asyncio.AbstractEventLoop) -> asyncio.Semaphore:
+    """Per-loop cap on concurrent part encodes at the physical core
+    count.  The window gate admits parts in bursts (write completions
+    cluster), and N-way-contended encodes each take N× longer — same
+    aggregate throughput, but every frame reaches the wire late and the
+    storage streams sit idle for the whole burst.  Capping at the cores
+    that can actually run them keeps per-frame latency minimal, which
+    is what feeds the wire steadily."""
+    sem = _ENCODE_SLOTS.get(loop)
+    if sem is None:
+        sem = asyncio.Semaphore(max(1, os.cpu_count() or 1))
+        _ENCODE_SLOTS[loop] = sem
+    return sem
+
+
+async def encode_frame_async(
+    view: memoryview,
+    spec: WriteSpec,
+    filter_stride: int,
+    executor: Optional[Executor],
+    *,
+    path: str = "",
+    part: int = 0,
+    min_frame_bytes: int = 0,
+) -> bytes:
+    """``encode_frame`` on the staging executor, under the shared retry
+    policy with the ``scheduler.codec.encode`` failpoint inside the
+    attempt — a transient mid-pipeline encode fault (chaos schedules)
+    retries like any storage transient instead of failing the take."""
+
+    def attempt() -> tuple:
+        failpoint("scheduler.codec.encode", path=path, part=part)
+        # metrics-free attempt: a retried transient must not count the
+        # same part's bytes twice (_count_encode runs once, on success)
+        return _encode_frame_uncounted(
+            view, spec, filter_stride, min_frame_bytes
+        )
+
+    with obs.span(
+        "codec/encode_part", path=path, part=part,
+        bytes=memoryview(view).nbytes, codec=spec.codec,
+    ):
+        async with _encode_slots(asyncio.get_running_loop()):
+            frame, raw_len, fallback, dt = await retry_call(
+                attempt,
+                op_name=f"encode {path} [part {part}]",
+                backend="codec",
+                classify=classify_generic,
+                progress=_encode_progress(),
+                executor=executor,
+            )
+    _count_encode(
+        spec.codec, raw_len, memoryview(frame).nbytes, fallback, dt
+    )
+    return frame
+
+
+_ENCODE_PROGRESS: Optional[SharedProgress] = None
+
+
+def _encode_progress() -> SharedProgress:
+    global _ENCODE_PROGRESS
+    if _ENCODE_PROGRESS is None:
+        _ENCODE_PROGRESS = SharedProgress(label="codec.encode")
+    return _ENCODE_PROGRESS
+
+
+# ------------------------------------------------------ frame decode
+
+
+def parse_frame_header(view: memoryview, offset: int = 0) -> Tuple[int, int, int, int]:
+    """(codec_id, filter_id, raw_len, enc_len) of the frame at
+    ``offset``; raises CodecFrameError on structural problems."""
+    view = memoryview(view).cast("B")
+    if offset + FRAME_HEADER_BYTES > view.nbytes:
+        raise CodecFrameError(
+            f"truncated frame header at offset {offset}: "
+            f"{view.nbytes - offset} of {FRAME_HEADER_BYTES} bytes"
+        )
+    magic, version, codec_id, filter_id, _r, raw_len, enc_len = (
+        _HEADER.unpack_from(view, offset)
+    )
+    if magic != FRAME_MAGIC:
+        raise CodecFrameError(
+            f"bad frame magic at offset {offset}: {bytes(magic)!r}"
+        )
+    if version != FRAME_VERSION:
+        raise CodecFrameError(f"unsupported frame version {version}")
+    if codec_id not in _ID_TO_NAME:
+        raise CodecFrameError(f"unknown codec id {codec_id} in frame")
+    return codec_id, filter_id, raw_len, enc_len
+
+
+def decode_frame(view: memoryview, offset: int = 0) -> Tuple[Any, int]:
+    """Decode the frame at ``offset``; returns (raw bytes-like, total
+    frame length).  The raw value may be a view into ``view`` (raw-
+    fallback frames) or a coder-owned uint8 array — consumers copy into
+    their destination, so no per-frame staging copy is paid here.
+    Typed errors: CodecFrameError for corruption, CodecUnavailableError
+    when the frame names a codec this host can't decode."""
+    view = memoryview(view).cast("B")
+    codec_id, filter_id, raw_len, enc_len = parse_frame_header(view, offset)
+    start = offset + FRAME_HEADER_BYTES
+    if start + enc_len > view.nbytes:
+        raise CodecFrameError(
+            f"truncated frame payload at offset {offset}: "
+            f"{view.nbytes - start} of {enc_len} bytes"
+        )
+    payload = view[start : start + enc_len]
+    if codec_id == 0:
+        if enc_len != raw_len:
+            raise CodecFrameError(
+                f"raw frame length mismatch: header says raw={raw_len} "
+                f"enc={enc_len}"
+            )
+        return payload, FRAME_HEADER_BYTES + enc_len
+    name = _ID_TO_NAME[codec_id]
+    codec = _REGISTRY[name]
+    if not codec.available():
+        raise CodecUnavailableError(name)
+    t0 = time.perf_counter()
+    try:
+        raw = codec.decompress(payload, raw_len)
+    except CodecError:
+        raise
+    except Exception as e:  # noqa: BLE001 — decoder-internal errors
+        raise CodecFrameError(
+            f"corrupt {name} frame payload at offset {offset}: {e!r}"
+        ) from e
+    if len(raw) != raw_len:
+        raise CodecFrameError(
+            f"{name} frame decoded to {len(raw)} bytes, header says "
+            f"{raw_len}"
+        )
+    if filter_id > 1:
+        raw = unshuffle(memoryview(raw), filter_id)
+    _dec_hist(name).observe(time.perf_counter() - t0)
+    obs.counter(CODEC_PARTS_DECODED).inc()
+    return raw, FRAME_HEADER_BYTES + enc_len
+
+
+# ----------------------------------------------------------- codec table
+#
+# The manifest records, per encoded storage object (SnapshotMetadata
+# .codecs[location]):
+#   {"codec":  <registry name chosen at write time>,
+#    "part_size": <raw bytes per frame (last frame may be short)>,
+#    "raw_size":  <total raw bytes>,
+#    "parts":  [<full frame length in stored bytes>, ...],
+#    "digest": [crc32, adler32, stored_size]}    # of the STORED bytes;
+#                                                # optional (WRITE_CHECKSUMS)
+# Raw frame offsets are i*part_size; stored frame offsets are prefix
+# sums of "parts" — enough to map any raw byte range to the frames
+# covering it.  Objects absent from the table are stored raw (including
+# everything written before this layer existed).
+
+
+def make_table(
+    codec_name: str,
+    part_size: int,
+    raw_size: int,
+    frame_lens: List[int],
+    stored_digest: Optional[List[int]] = None,
+) -> Dict[str, Any]:
+    tbl: Dict[str, Any] = {
+        "codec": codec_name,
+        "part_size": int(part_size),
+        "raw_size": int(raw_size),
+        "parts": [int(x) for x in frame_lens],
+    }
+    if stored_digest is not None:
+        tbl["digest"] = [int(x) for x in stored_digest]
+    return tbl
+
+
+def table_stored_size(table: Dict[str, Any]) -> int:
+    return sum(table["parts"])
+
+
+def validate_table(table: Dict[str, Any]) -> bool:
+    """Structural sanity of a manifest codec-table entry (metadata is
+    self-checksummed, so this guards against version skew, not
+    corruption)."""
+    try:
+        return (
+            isinstance(table.get("codec"), str)
+            and int(table["part_size"]) > 0
+            and int(table["raw_size"]) >= 0
+            and all(int(x) > 0 for x in table["parts"])
+        )
+    except (KeyError, TypeError, ValueError):
+        return False
+
+
+def _frame_spans(
+    table: Dict[str, Any]
+) -> List[Tuple[int, int, int, int]]:
+    """(raw_lo, raw_hi, enc_lo, enc_hi) per frame."""
+    part_size = int(table["part_size"])
+    raw_size = int(table["raw_size"])
+    spans = []
+    enc_lo = 0
+    raw_lo = 0
+    for frame_len in table["parts"]:
+        raw_hi = min(raw_lo + part_size, raw_size)
+        spans.append((raw_lo, raw_hi, enc_lo, enc_lo + int(frame_len)))
+        raw_lo = raw_hi
+        enc_lo += int(frame_len)
+    return spans
+
+
+def part_read_concurrency() -> int:
+    """Concurrent frame reads/decodes per object — same bound as the
+    stripe engine's part concurrency (one object must not monopolize
+    every storage slot)."""
+    return max(2, min(knobs.get_max_per_rank_io_concurrency(), 8))
+
+
+async def framed_read(
+    storage: StoragePlugin,
+    path: str,
+    table: Dict[str, Any],
+    *,
+    byte_range: Optional[List[int]] = None,
+    into: Any = None,
+    executor: Optional[Executor] = None,
+) -> Any:
+    """Read raw bytes ``[byte_range)`` of an encoded object: ranged-read
+    the overlapping frames concurrently, decode each on ``executor``
+    while later frames are still in flight, and assemble into one
+    buffer (honoring the ``into`` destination hint by identity, the
+    plugins' read-into contract).
+
+    A raw range that straddles a frame decodes the whole frame and
+    slices — so heavily tiled reads of one frame pay repeated decodes
+    (documented in docs/compression.md; restore's budget-tiled paths
+    size tiles at the budget, typically >= the part size)."""
+    raw_size = int(table["raw_size"])
+    if byte_range is None:
+        lo, hi = 0, raw_size
+    else:
+        lo, hi = int(byte_range[0]), int(byte_range[1])
+    if not (0 <= lo <= hi <= raw_size):
+        raise CodecFrameError(
+            f"raw range [{lo}, {hi}) outside encoded object {path!r} "
+            f"of raw size {raw_size}"
+        )
+    length = hi - lo
+    out = resolve_read_destination(into, length)
+    if length == 0:
+        return out
+    out_view = memoryview(out).cast("B")
+    frames = [
+        s for s in _frame_spans(table) if s[0] < hi and s[1] > lo
+    ]
+    sem = asyncio.Semaphore(part_read_concurrency())
+    loop = asyncio.get_running_loop()
+
+    with obs.span(
+        "codec/framed_read", path=path, bytes=length, frames=len(frames),
+        codec=table.get("codec"),
+    ):
+
+        async def one(raw_lo: int, raw_hi: int, enc_lo: int, enc_hi: int):
+            async with sem:
+                rio = ReadIO(path=path, byte_range=[enc_lo, enc_hi])
+                await storage.read(rio)
+                frame = memoryview(rio.buf).cast("B")
+                if frame.nbytes != enc_hi - enc_lo:
+                    raise CodecFrameError(
+                        f"frame read of {path!r} [{enc_lo}:{enc_hi}] "
+                        f"returned {frame.nbytes} bytes"
+                    )
+
+                def decode_and_place() -> None:
+                    raw, _ = decode_frame(frame)
+                    if len(raw) != raw_hi - raw_lo:
+                        raise CodecFrameError(
+                            f"frame of {path!r} decoded to {len(raw)} "
+                            f"bytes, table says {raw_hi - raw_lo}"
+                        )
+                    s = max(raw_lo, lo)
+                    e = min(raw_hi, hi)
+                    out_view[s - lo : e - lo] = memoryview(raw)[
+                        s - raw_lo : e - raw_lo
+                    ]
+
+                if executor is not None:
+                    await loop.run_in_executor(executor, decode_and_place)
+                else:
+                    decode_and_place()
+
+        await asyncio.gather(*(one(*f) for f in frames))
+    return out
+
+

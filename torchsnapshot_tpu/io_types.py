@@ -51,6 +51,14 @@ class Future(Generic[T]):
 class BufferStager(abc.ABC):
     """Deferred producer of a write buffer (reference io_types.py:24-38)."""
 
+    # Codec preconditioning hint (codec.py): the element stride the
+    # byte-shuffle filter should use for this stager's bytes (0 = no
+    # filter).  Preparers set it from the manifest dtype (float formats
+    # shuffle; ints/bytes/objects don't) — a pure hint, never
+    # correctness-bearing: the chosen stride is recorded in each frame's
+    # header, so restore needs nothing from the stager.
+    codec_filter_stride: int = 0
+
     @abc.abstractmethod
     async def stage_buffer(self, executor: Optional[Executor] = None) -> Any:
         """Produce the bytes to write (bytes / memoryview). May launch
@@ -130,6 +138,17 @@ class WriteReq:
     digest_sink: Optional[Callable[[List[int]], None]] = None
     # filled via digest_sink; consumed by the dedup check
     object_digest: Optional[Tuple[int, int, int]] = None
+    # codec layer (codec.py): receives the object's frame table when the
+    # write was stored compressed — the snapshot take points this at its
+    # per-rank codec map, which rides the crc gather into
+    # SnapshotMetadata.codecs.  Writes WITHOUT a sink are never encoded
+    # (nothing could record how to decode them).
+    codec_sink: Optional[Callable[[dict], None]] = None
+    # incremental takes: the BASE snapshot's codec-table entry for this
+    # location (None = base stored it raw).  A successful dedup link
+    # copies the base's stored bytes, so its frame table must carry over
+    # verbatim.
+    dedup_codec: Optional[dict] = None
 
 
 def check_read_crc(read_req: "ReadReq", buf: Any) -> None:
@@ -166,6 +185,25 @@ class ReadReq:
     # consumers detect honor by identity (``buf is into``) and fall
     # back to the normal copy otherwise, so ignoring is always safe.
     into: Any = None
+
+
+def resolve_read_destination(into: Any, length: int) -> Any:
+    """The assembly buffer for a ``length``-byte read honoring the
+    ``into`` hint (see ReadReq.into): ``into`` itself when it is a
+    writable buffer of exactly ``length`` bytes (callers detect honor
+    by identity), else a fresh uint8 array.  Shared by every ranged
+    parallel assembler (striped_read, codec.framed_read) so the
+    into-honoring contract can't diverge between them."""
+    if into is not None:
+        try:
+            v = memoryview(into).cast("B")
+            if not v.readonly and v.nbytes == length:
+                return into
+        except (TypeError, ValueError):
+            pass  # exotic/non-contiguous hint: assemble normally
+    import numpy as np
+
+    return np.empty(length, dtype=np.uint8)
 
 
 @dataclass
@@ -224,6 +262,14 @@ class StripedWriteHandle(abc.ABC):
     # (crc32, adler32) fused with its copy/upload — the stripe engine
     # then skips its separate per-part digest pass
     supports_fused_digest: bool = False
+
+    # smallest part the backend accepts in any position but the last
+    # (S3 rejects CompleteMultipartUpload with EntityTooSmall when a
+    # non-final part is under 5MiB).  0 = no floor.  The codec stream
+    # consults this: an encoded frame that lands under the floor stores
+    # that part raw instead (raw parts are sized by the stripe knob,
+    # which backends size above their floor)
+    min_part_bytes: int = 0
 
     @abc.abstractmethod
     async def write_part(

@@ -48,6 +48,9 @@ _BREAKER_COOLDOWN_S = "BREAKER_COOLDOWN_S"
 _S3_ENDPOINT_URL = "S3_ENDPOINT_URL"
 _STRIPE_PART_SIZE_BYTES = "STRIPE_PART_SIZE_BYTES"
 _STRIPE_MIN_OBJECT_SIZE_BYTES = "STRIPE_MIN_OBJECT_SIZE_BYTES"
+_CODEC = "CODEC"
+_CODEC_LEVEL = "CODEC_LEVEL"
+_CODEC_MIN_RATIO = "CODEC_MIN_RATIO"
 _TIER_POLICY = "TIER_POLICY"
 _TIER_FAST_KEEP_LAST_N = "TIER_FAST_KEEP_LAST_N"
 _TIER_VERIFY_FAST_READS = "TIER_VERIFY_FAST_READS"
@@ -203,6 +206,22 @@ _DEFAULTS = {
     # part, not the object.  Set MIN to 0 to disable striping entirely.
     _STRIPE_PART_SIZE_BYTES: 64 * 1024 * 1024,
     _STRIPE_MIN_OBJECT_SIZE_BYTES: 128 * 1024 * 1024,
+    # Per-part compression (codec.py): "raw" (off — the default; the
+    # pipeline pays one knob read per take and nothing per part),
+    # "zlib" (stdlib), "zstd"/"lz4" (optional imports; missing degrades
+    # to raw with one warning), or "huff" (native fastio block-Huffman
+    # coder — the fast entropy option for byte-shuffled float
+    # payloads).  Parts encode on the staging executor between the raw
+    # digest and the storage write, so compression overlaps I/O under
+    # the same budget; digests/dedup/deep-verify stay raw-byte-exact.
+    _CODEC: "raw",
+    # Codec-native compression level; 0 = each codec's own default
+    # (zlib 1, zstd 3, lz4 0, huff has no levels).
+    _CODEC_LEVEL: 0,
+    # Store-raw fallback: a part keeps its encoded frame only when
+    # raw_size >= CODEC_MIN_RATIO * frame_size — incompressible parts
+    # stay raw (zero decode dependency, one 24-byte header).
+    _CODEC_MIN_RATIO: 1.05,
     # Default policy for tiered storage (tier/) when the tier options
     # don't name one: "write_back" acks a take when the FAST tier
     # commits and promotes to the durable tier in the background (the
@@ -454,6 +473,21 @@ def get_stripe_min_object_size_bytes() -> Optional[int]:
     return max(v, get_stripe_part_size_bytes() + 1)
 
 
+def get_codec() -> str:
+    """Write-side codec name (validated/availability-resolved by
+    codec.resolve_codec — an unknown name degrades to raw there, with a
+    warning, never mid-take)."""
+    return str(_get_raw(_CODEC)).lower()
+
+
+def get_codec_level() -> int:
+    return _get_int(_CODEC_LEVEL)
+
+
+def get_codec_min_ratio() -> float:
+    return max(1.0, float(_get_raw(_CODEC_MIN_RATIO)))
+
+
 def get_tier_policy() -> str:
     v = str(_get_raw(_TIER_POLICY)).lower()
     if v not in ("write_back", "write_through"):
@@ -615,6 +649,18 @@ def override_stripe_part_size_bytes(value: int):
 
 def override_stripe_min_object_size_bytes(value: int):
     return _override(_STRIPE_MIN_OBJECT_SIZE_BYTES, value)
+
+
+def override_codec(value: str):
+    return _override(_CODEC, value)
+
+
+def override_codec_level(value: int):
+    return _override(_CODEC_LEVEL, value)
+
+
+def override_codec_min_ratio(value: float):
+    return _override(_CODEC_MIN_RATIO, value)
 
 
 def override_tier_policy(value: str):

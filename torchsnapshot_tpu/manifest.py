@@ -411,7 +411,16 @@ class SnapshotMetadata:
     # digest matches the base snapshot's object at the same location is
     # linked, not rewritten.  Two independent checksums + exact length
     # so one 32-bit collision can't silently dedup changed content.
+    # NOTE under compression (codec.py) these digests stay RAW-byte
+    # digests — dedup and deep-verify semantics are codec-invariant; the
+    # STORED-byte digest lives in the codecs table below.
     objects: Dict[str, List[int]] = field(default_factory=dict)
+    # location → codec frame table for objects stored compressed
+    # (codec.make_table: codec name, raw part size, raw size, per-frame
+    # stored lengths, stored-byte digest).  ABSENT location ⇒ the object
+    # is stored raw — which makes every pre-codec-era snapshot (no
+    # "codecs" key at all) restore through the unchanged raw path.
+    codecs: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
     def to_json(self) -> str:
         d = {
@@ -421,6 +430,8 @@ class SnapshotMetadata:
         }
         if self.objects:
             d["objects"] = self.objects
+        if self.codecs:
+            d["codecs"] = self.codecs
         return json.dumps(d, sort_keys=True)
 
     # JSON is a YAML subset; emit JSON for speed, accept YAML on read
@@ -493,6 +504,11 @@ class SnapshotMetadata:
             objects={
                 k: ([int(x) for x in v] if isinstance(v, list) else [int(v)])
                 for k, v in (d.get("objects") or {}).items()
+            },
+            codecs={
+                k: dict(v)
+                for k, v in (d.get("codecs") or {}).items()
+                if isinstance(v, dict)
             },
         )
 

@@ -24,6 +24,11 @@ import time
 from typing import Any
 
 from .metrics import (  # noqa: F401
+    CODEC_BYTES_IN,
+    CODEC_BYTES_OUT,
+    CODEC_PARTS_DECODED,
+    CODEC_PARTS_ENCODED,
+    CODEC_PARTS_RAW_FALLBACK,
     BUDGET_BYTES_IN_USE,
     BYTES_DEDUPED,
     BYTES_OFFLOADED,

@@ -83,6 +83,16 @@ STRIPE_ABORTS = "storage.stripe.aborts"
 STRIPE_STREAMED_WRITES = "storage.stripe.streamed_writes"
 STRIPE_PART_WRITE_LATENCY_S = "storage.stripe.part_write_latency_s"
 STRIPE_PART_READ_LATENCY_S = "storage.stripe.part_read_latency_s"
+# Per-part compression (codec.py): raw bytes entering the encode stage,
+# stored (frame) bytes leaving it, parts that kept their encoded frame
+# vs fell back to store-raw (min-ratio check), and frames decoded on
+# restore.  Per-codec encode/decode latencies land in
+# storage.codec.{encode,decode}_latency_s.<codec> histograms.
+CODEC_BYTES_IN = "storage.codec.bytes_in"
+CODEC_BYTES_OUT = "storage.codec.bytes_out"
+CODEC_PARTS_ENCODED = "storage.codec.parts_encoded"
+CODEC_PARTS_RAW_FALLBACK = "storage.codec.parts_raw_fallback"
+CODEC_PARTS_DECODED = "storage.codec.parts_decoded"
 # GC/retention: bytes of storage objects reclaimed by delete_snapshot
 GC_BYTES_RECLAIMED = "snapshot.gc.bytes_reclaimed"
 # Resilience (resilience/): transient-error retries (total, plus

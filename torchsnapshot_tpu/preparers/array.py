@@ -705,6 +705,11 @@ class ArrayIOPreparer:
             stager = HostArrayBufferStager(
                 _to_host_view(obj), defensive_copy=is_async_snapshot
             )
+        # codec preconditioning hint: float payloads byte-shuffle before
+        # compression (codec.filter_for_dtype; 0 disables the filter)
+        from ..codec import filter_for_dtype
+
+        stager.codec_filter_stride = filter_for_dtype(entry.dtype)
         return entry, [
             WriteReq(
                 path=location,
@@ -900,6 +905,11 @@ class ChunkedArrayIOPreparer:
                 stager = HostArrayBufferStager(
                     _to_host_view(obj)[r0:r1], defensive_copy=is_async_snapshot
                 )
+            from ..codec import filter_for_dtype
+
+            stager.codec_filter_stride = filter_for_dtype(
+                array_dtype_str(obj)
+            )
             write_reqs.append(
                 WriteReq(
                     path=chunk_location,

@@ -202,14 +202,21 @@ class ShardedArrayIOPreparer:
                     )
                 )
                 index = relative_slices(sub, box)
+                shard_stager = JaxArrayBufferStager(
+                    data,
+                    index=index if sub != box else None,
+                    nbytes=box_nelems(sub) * itemsize,
+                )
+                # codec preconditioning hint (see preparers/array.py)
+                from ..codec import filter_for_dtype
+
+                shard_stager.codec_filter_stride = filter_for_dtype(
+                    array_dtype_str(obj)
+                )
                 write_reqs.append(
                     WriteReq(
                         path=location,
-                        buffer_stager=JaxArrayBufferStager(
-                            data,
-                            index=index if sub != box else None,
-                            nbytes=box_nelems(sub) * itemsize,
-                        ),
+                        buffer_stager=shard_stager,
                         checksum_sinks=[
                             (
                                 lambda c, s=shards[-1]: setattr(

@@ -41,6 +41,7 @@ from typing import Awaitable, Callable, List, Optional
 
 import psutil
 
+from . import codec as codec_mod
 from . import knobs
 from .io_types import (
     ReadIO,
@@ -131,6 +132,72 @@ def _apply_checksum_sinks(buf, sinks, digest_sink=None, precomputed=None) -> Non
         if d is None:
             d = (crc32_fast(view), adler32_fast(view))
         digest_sink([d[0], d[1], view.nbytes])
+
+
+async def _encode_staged_buffer(
+    p: "_WritePipeline",
+    wr: WriteReq,
+    spec: "codec_mod.WriteSpec",
+    executor: Optional[ThreadPoolExecutor],
+):
+    """Whole-staged writes' compress stage: encode the staged buffer as
+    stripe-part-sized frames CONCURRENTLY on the staging executor (a
+    multi-part object's frames encode in parallel; a small object is one
+    frame), assemble the stored byte stream, and hand the frame table to
+    the write's codec_sink.  The raw buffer is released on return — the
+    caller replaces ``p.buf`` with the encoded stream, so storage I/O
+    and budget accounting both see stored bytes."""
+    import numpy as np
+
+    view = memoryview(p.buf).cast("B")
+    raw_size = view.nbytes
+    if raw_size == 0:
+        return p.buf  # nothing to encode; stays a raw (table-less) object
+    part_size = knobs.get_stripe_part_size_bytes()
+    spans = stripe.plan_parts(raw_size, part_size)
+    stride = getattr(wr.buffer_stager, "codec_filter_stride", 0)
+    frames = await asyncio.gather(
+        *(
+            codec_mod.encode_frame_async(
+                view[lo:hi], spec, stride, executor,
+                path=wr.path, part=i,
+            )
+            for i, (lo, hi) in enumerate(spans)
+        )
+    )
+    frame_lens = [len(f) for f in frames]
+    stored_size = sum(frame_lens)
+    out = np.empty(stored_size, dtype=np.uint8)
+    pos = 0
+    for i, n in enumerate(frame_lens):
+        out[pos : pos + n] = np.frombuffer(frames[i], dtype=np.uint8)
+        # drop each frame as it lands: peak memory stays raw + stored
+        # instead of raw + 2x stored while the stream assembles
+        frames[i] = None
+        pos += n
+    stored_digest = None
+    if knobs.write_checksums_enabled():
+        def _digest_stored():
+            from ._csrc import digest as native_digest
+            from .utils.checksums import adler32_fast, crc32_fast
+
+            d = native_digest(out)
+            if d is None:
+                d = (crc32_fast(out), adler32_fast(out))
+            return [d[0], d[1], stored_size]
+
+        if executor is not None:
+            stored_digest = await asyncio.get_running_loop().run_in_executor(
+                executor, _digest_stored
+            )
+        else:
+            stored_digest = _digest_stored()
+    wr.codec_sink(
+        codec_mod.make_table(
+            spec.codec, part_size, raw_size, frame_lens, stored_digest,
+        )
+    )
+    return out
 
 
 def get_process_memory_budget_bytes(local_process_count: int = 1) -> int:
@@ -311,6 +378,13 @@ async def _execute_write_pipelines(
     # digest before any byte moves).  Eligible pipelines reserve only a
     # window of parts from the budget and stage→write each part through
     # the stripe engine.
+    #
+    # Codec (codec.py): resolved ONCE per pipeline run — CODEC=raw
+    # resolves to None here and the whole layer vanishes (zero per-part
+    # cost).  Only writes carrying a codec_sink participate: the sink is
+    # how the per-object frame table reaches the manifest, and a write
+    # without one (external callers, metadata) could never be decoded.
+    codec_spec = codec_mod.resolve_write_spec()
     part_size = knobs.get_stripe_part_size_bytes()
     for p in pipelines:
         wr = p.write_req
@@ -383,6 +457,7 @@ async def _execute_write_pipelines(
         p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
         p.buf_size = _buf_nbytes(p.buf)
         wr = p.write_req
+        will_encode = codec_spec is not None and wr.codec_sink is not None
         if (wr.checksum_sinks or wr.digest_sink) and (
             knobs.write_checksums_enabled()
         ):
@@ -390,6 +465,7 @@ async def _execute_write_pipelines(
             if (
                 getattr(storage, "supports_fused_digest", False)
                 and wr.dedup is None
+                and not will_encode  # fused digest would hash STORED bytes
                 and precomputed is None
                 and not stripe.write_eligible(p.buf_size, storage)
                 and all(
@@ -417,6 +493,17 @@ async def _execute_write_pipelines(
                 wr.digest_sink,
                 precomputed,
             )
+        if will_encode and not (
+            wr.dedup is not None and wr.object_digest == wr.dedup[1]
+        ):
+            # compress stage (codec.py): digests above ran on the RAW
+            # bytes; the staged buffer is replaced by its encoded frames
+            # here, so everything downstream (striping decision, budget
+            # correction, bytes_written stats) sees STORED bytes.  A
+            # write whose dedup digest matched the base skips encoding
+            # entirely — it will link, not move bytes.
+            p.buf = await _encode_staged_buffer(p, wr, codec_spec, executor)
+            p.buf_size = _buf_nbytes(p.buf)
         return p
 
     async def write_one(p: _WritePipeline) -> _WritePipeline:
@@ -443,6 +530,11 @@ async def _execute_write_pipelines(
                 stats["deduped_bytes"] = (
                     stats.get("deduped_bytes", 0) + p.buf_size
                 )
+                # the linked object is a byte-copy of the BASE's stored
+                # object; if the base was codec-encoded, this snapshot's
+                # manifest must carry the base's frame table verbatim
+                if wr.codec_sink is not None and wr.dedup_codec is not None:
+                    wr.codec_sink(dict(wr.dedup_codec))
                 p.deduped = True
                 return p
             except Exception as e:  # noqa: BLE001
@@ -499,6 +591,9 @@ async def _execute_write_pipelines(
             stats["bytes_written"] += n
             m_written.inc(n)
 
+        stream_codec = (
+            codec_spec if wr.codec_sink is not None else None
+        )
         with obs_tracer.span(
             "pipeline/stream", path=wr.path, bytes=p.staging_cost,
             parts=len(p.stream_spans),
@@ -517,6 +612,11 @@ async def _execute_write_pipelines(
                 on_part_staged=on_part_staged,
                 on_part_done=on_part_done,
                 want_digests=want,
+                codec_spec=stream_codec,
+                filter_stride=getattr(
+                    wr.buffer_stager, "codec_filter_stride", 0
+                ),
+                codec_sink=wr.codec_sink,
             )
         p.buf_size = p.staging_cost
         if want and digests:
@@ -811,6 +911,7 @@ async def _execute_read_pipelines(
     storage: StoragePlugin,
     budget: _Budget,
     executor: ThreadPoolExecutor,
+    codec_tables: Optional[dict] = None,
 ) -> None:
     ready_for_io = deque(pipelines)
     io_tasks: set = set()
@@ -879,6 +980,25 @@ async def _execute_read_pipelines(
         ) as sp:
             failpoint("scheduler.read", path=p.read_req.path)
             rr = p.read_req
+            table = codec_tables.get(rr.path) if codec_tables else None
+            if table is not None:
+                # codec-encoded object (codec.py): the byte range is a
+                # RAW range — map it to the overlapping frames, read
+                # them as parallel ranged GETs and decode concurrently
+                # on the consume executor.  Subsumes the striped-read
+                # fan-out (frames ARE the parts).
+                p.buf = await codec_mod.framed_read(
+                    storage,
+                    rr.path,
+                    table,
+                    byte_range=rr.byte_range,
+                    into=rr.into,
+                    executor=executor,
+                )
+                if sp is not None:
+                    sp.attrs["codec"] = table.get("codec")
+                    sp.attrs["bytes"] = _buf_nbytes(p.buf)
+                return p
             if stripe.read_eligible(
                 rr.byte_range[1] - rr.byte_range[0]
                 if rr.byte_range is not None
@@ -1006,9 +1126,15 @@ def sync_execute_read_reqs(
     storage: StoragePlugin,
     memory_budget_bytes: int,
     rank: int,
+    codec_tables: Optional[dict] = None,
 ) -> None:
     """Execute read requests under the memory budget (reference
-    sync_execute_read_reqs, scheduler.py:449-463)."""
+    sync_execute_read_reqs, scheduler.py:449-463).
+
+    ``codec_tables``: location → manifest codec-table entry for objects
+    stored as compressed frames (SnapshotMetadata.codecs); reads of
+    those locations decode transparently — byte ranges stay RAW
+    everywhere above this call."""
     executor = ThreadPoolExecutor(
         max_workers=knobs.get_staging_threads(), thread_name_prefix="tsnp-consume"
     )
@@ -1017,7 +1143,9 @@ def sync_execute_read_reqs(
     loop_thread = _LoopThread(name="tsnp-read-loop")
     t0 = time.monotonic()
     fut = loop_thread.submit(
-        _execute_read_pipelines(pipelines, storage, budget, executor)
+        _execute_read_pipelines(
+            pipelines, storage, budget, executor, codec_tables
+        )
     )
     try:
         fut.result()

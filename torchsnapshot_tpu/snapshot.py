@@ -335,14 +335,20 @@ def _merge_crcs(
 
 
 def _crc_payload(
-    local_entries: Dict[str, Entry], object_crcs: Dict[str, int]
+    local_entries: Dict[str, Entry],
+    object_crcs: Dict[str, int],
+    object_codecs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One rank's post-staging checksum contribution: per-payload entry
-    crcs + whole-object crcs (the incremental-dedup table)."""
-    return {
+    crcs + whole-object crcs (the incremental-dedup table) + codec frame
+    tables for objects this rank stored compressed (codec.py)."""
+    out = {
         "entries": _collect_local_crcs(local_entries),
         "objects": dict(object_crcs),
     }
+    if object_codecs:
+        out["codecs"] = dict(object_codecs)
+    return out
 
 
 def _merge_crc_payloads(
@@ -353,6 +359,7 @@ def _merge_crc_payloads(
     )
     for p in payloads:
         metadata.objects.update(p.get("objects") or {})
+        metadata.codecs.update(p.get("codecs") or {})
 
 
 _STRIPE_EVENT_COUNTERS = (
@@ -363,15 +370,24 @@ _STRIPE_EVENT_COUNTERS = (
     obs.STRIPE_BYTES_WRITTEN,
     obs.STRIPE_BYTES_READ,
     obs.STRIPE_ABORTS,
+    # codec layer (codec.py): raw bytes in vs stored bytes out is the
+    # operation's achieved compression ratio; parts_raw_fallback says
+    # how much of the payload was incompressible
+    obs.CODEC_BYTES_IN,
+    obs.CODEC_BYTES_OUT,
+    obs.CODEC_PARTS_ENCODED,
+    obs.CODEC_PARTS_RAW_FALLBACK,
+    obs.CODEC_PARTS_DECODED,
 )
 
 
 def _stripe_event_stamp():
-    """Capture the stripe counters now; the returned stamp writes the
-    DELTAS into a take/restore event's metadata — how much of the
-    operation's I/O moved through striped paths (and whether any
-    multipart write had to abort) lands next to duration_s in the event
-    stream, where a throughput incident review will look first."""
+    """Capture the stripe + codec counters now; the returned stamp
+    writes the DELTAS into a take/restore event's metadata — how much of
+    the operation's I/O moved through striped paths (and whether any
+    multipart write had to abort), plus what the codec layer did to the
+    byte volume, lands next to duration_s in the event stream, where a
+    throughput incident review will look first."""
     before = {n: obs.counter(n).value for n in _STRIPE_EVENT_COUNTERS}
 
     def stamp(event: "Event") -> None:
@@ -450,7 +466,7 @@ class Snapshot:
             stamp_stripe = _stripe_event_stamp()
             (
                 metadata, pending_io, storage, commit_uid,
-                local_entries, object_crcs,
+                local_entries, object_crcs, object_codecs,
             ) = cls._take_impl(
                 path, app_state, replicated, coordinator,
                 is_async=False, base=base, leaf_transform=leaf_transform,
@@ -479,7 +495,9 @@ class Snapshot:
                     # finished above; gather them (foreground path:
                     # collectives are fine) and merge into every rank's
                     # metadata copy
-                    local_crcs = _crc_payload(local_entries, object_crcs)
+                    local_crcs = _crc_payload(
+                        local_entries, object_crcs, object_codecs
+                    )
                     if coordinator.world_size > 1:
                         crc_maps = coordinator.all_gather_object(local_crcs)
                     else:
@@ -542,7 +560,7 @@ class Snapshot:
         ):
             (
                 metadata, pending_io, storage, commit_uid,
-                local_entries, object_crcs,
+                local_entries, object_crcs, object_codecs,
             ) = cls._take_impl(
                 path, app_state, replicated, coordinator,
                 is_async=True, base=base, leaf_transform=leaf_transform,
@@ -557,6 +575,7 @@ class Snapshot:
             commit_uid=commit_uid,
             local_entries=local_entries,
             object_crcs=object_crcs,
+            object_codecs=object_codecs,
             storage_options=storage_options,
         )
 
@@ -573,7 +592,7 @@ class Snapshot:
         storage_options: Optional[Dict[str, Any]] = None,
     ) -> Tuple[
         SnapshotMetadata, PendingIOWork, Any, str,
-        Dict[str, Entry], Dict[str, int],
+        Dict[str, Entry], Dict[str, int], Dict[str, Any],
     ]:
         # reference _take_impl, snapshot.py:517-635
         rank, world = coordinator.rank, coordinator.world_size
@@ -864,6 +883,17 @@ class Snapshot:
         # incremental-dedup decision; attached AFTER batching so slab
         # objects are covered at their final paths
         object_crcs: Dict[str, List[int]] = {}
+        # codec frame tables (codec.py): filled by the scheduler for
+        # every object it stores compressed; rides the crc gather into
+        # SnapshotMetadata.codecs.  Sinks are attached unconditionally
+        # (one closure per request) — whether anything encodes is the
+        # scheduler's per-run CODEC-knob decision.
+        object_codecs: Dict[str, Any] = {}
+        for wr in write_reqs:
+            def _codec_sink(table: dict, wr=wr) -> None:
+                object_codecs[wr.path] = table
+
+            wr.codec_sink = _codec_sink
         if base is not None and base.rstrip("/") == path.rstrip("/"):
             # self-dedup would link an object onto itself (and the fs
             # fallback's unlink-before-link would destroy the only copy)
@@ -874,6 +904,7 @@ class Snapshot:
             base = None
         if knobs.write_checksums_enabled():
             base_objects: Dict[str, Any] = {}
+            base_codecs: Dict[str, Any] = {}
             if base is not None:
                 # rank 0 reads the base's object table once and shares it
                 # (every rank GETting a multi-MB metadata object from
@@ -882,15 +913,20 @@ class Snapshot:
                 # by the gather above
                 if rank == 0:
                     try:
-                        base_objects = Snapshot(base).metadata.objects or {}
+                        base_meta = Snapshot(base).metadata
+                        base_objects = base_meta.objects or {}
+                        # a dedup link copies the base's STORED bytes —
+                        # if those were codec frames, the frame table
+                        # must carry into this snapshot's manifest
+                        base_codecs = base_meta.codecs or {}
                     except Exception as e:  # noqa: BLE001
                         logger.warning(
                             "rank 0: incremental base %r unusable (%r); "
                             "performing a full save", base, e,
                         )
                 if world > 1:
-                    base_objects = coordinator.broadcast_object(
-                        base_objects, src=0
+                    base_objects, base_codecs = coordinator.broadcast_object(
+                        (base_objects, base_codecs), src=0
                     )
             for wr in write_reqs:
                 def _object_sink(digest: List[int], wr=wr) -> None:
@@ -908,6 +944,7 @@ class Snapshot:
                     and len(base_digest) == 3
                 ):
                     wr.dedup = (base, tuple(int(x) for x in base_digest))
+                    wr.dedup_codec = base_codecs.get(wr.path)
         elif base is not None:
             logger.warning(
                 "rank %d: take(base=...) needs WRITE_CHECKSUMS=1; "
@@ -963,7 +1000,7 @@ class Snapshot:
         )
         return (
             metadata, pending_io, storage, commit_uid,
-            local_entry_objs, object_crcs,
+            local_entry_objs, object_crcs, object_codecs,
         )
 
     # --------------------------------------------------------------- restore
@@ -1007,10 +1044,51 @@ class Snapshot:
         """Tiered storage: install the committed metadata's whole-object
         digest table on the plugin so fast/peer-tier reads verify before
         they are trusted (and silently fall back + repair on mismatch).
-        No-op for ordinary plugins."""
+        No-op for ordinary plugins.
+
+        Codec-encoded objects (codec.py) verify against their STORED
+        digest from the codec table — the bytes on disk are frames, so
+        the raw digest in ``objects`` would flag every intact copy as
+        corrupt.  An encoded object whose table carries no stored digest
+        is left unprimed (trust the read; the frame structure and the
+        entry crcs above still catch corruption)."""
         prime = getattr(storage, "prime_digests", None)
-        if prime is not None:
-            prime(self.metadata.objects or {})
+        if prime is None:
+            return
+        digests = dict(self.metadata.objects or {})
+        for loc, tbl in (self.metadata.codecs or {}).items():
+            stored = tbl.get("digest") if isinstance(tbl, dict) else None
+            if (
+                isinstance(stored, (list, tuple)) and len(stored) == 3
+            ):
+                digests[loc] = [int(x) for x in stored]
+            else:
+                digests.pop(loc, None)
+        prime(digests)
+
+    def _codec_tables(self) -> Optional[Dict[str, Any]]:
+        """location → validated codec frame table for objects this
+        snapshot stored compressed; None when nothing is encoded (the
+        common case — reads skip the lookup entirely).  Structurally
+        invalid entries (version skew) are dropped with a warning: the
+        read then sees stored frame bytes where raw bytes were expected
+        and fails loudly at the digest/parse layer instead of silently
+        misdecoding."""
+        from . import codec as codec_mod
+
+        codecs = self.metadata.codecs or {}
+        if not codecs:
+            return None
+        tables = {}
+        for loc, tbl in codecs.items():
+            if codec_mod.validate_table(tbl):
+                tables[loc] = tbl
+            else:
+                logger.warning(
+                    "manifest codec table for %r is structurally invalid "
+                    "(version skew?); treating the object as raw", loc,
+                )
+        return tables or None
 
     def restore(
         self,
@@ -1162,7 +1240,10 @@ class Snapshot:
             read_reqs = batch_read_requests(read_reqs)
         budget = get_process_memory_budget_bytes()
         try:
-            sync_execute_read_reqs(read_reqs, storage, budget, rank)
+            sync_execute_read_reqs(
+                read_reqs, storage, budget, rank,
+                codec_tables=self._codec_tables(),
+            )
             restored = {lpath: fut.obj for lpath, fut in futures.items()}
             state_dict = inflate(
                 container_entries,
@@ -1351,7 +1432,8 @@ class Snapshot:
             self._prime_tier_digests(storage)
             try:
                 sync_execute_read_reqs(
-                    read_reqs, storage, get_process_memory_budget_bytes(), rank
+                    read_reqs, storage, get_process_memory_budget_bytes(),
+                    rank, codec_tables=self._codec_tables(),
                 )
             finally:
                 storage.sync_close()
@@ -1389,6 +1471,7 @@ class Snapshot:
                     storage,
                     memory_budget_bytes or get_process_memory_budget_bytes(),
                     rank=0,
+                    codec_tables=self._codec_tables(),
                 )
             finally:
                 storage.sync_close()
@@ -1416,6 +1499,7 @@ class PendingSnapshot:
         commit_uid: str,
         local_entries: Optional[Dict[str, Entry]] = None,
         object_crcs: Optional[Dict[str, int]] = None,
+        object_codecs: Optional[Dict[str, Any]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.path = path
@@ -1427,6 +1511,13 @@ class PendingSnapshot:
         self._commit_uid = commit_uid
         self._local_entries = local_entries or {}
         self._object_crcs = object_crcs if object_crcs is not None else {}
+        # codec frame tables (codec.py): filled by the background
+        # staging/write work as objects store compressed; read at
+        # commit time on the same thread that runs sync_complete(), so
+        # every sink has fired before the payload is built
+        self._object_codecs = (
+            object_codecs if object_codecs is not None else {}
+        )
         self._exc: Optional[BaseException] = None
         self._snapshot: Optional[Snapshot] = None
         self._thread = threading.Thread(
@@ -1474,11 +1565,24 @@ class PendingSnapshot:
                         f"{uid}/crcs/{rank}",
                         _json.dumps(
                             _crc_payload(
-                                self._local_entries, self._object_crcs
+                                self._local_entries,
+                                self._object_crcs,
+                                self._object_codecs,
                             )
                         ),
                     )
-                except Exception:  # noqa: BLE001 — checksums best-effort
+                except Exception as e:  # noqa: BLE001
+                    if self._object_codecs:
+                        # codec frame tables ride this channel and are
+                        # the DECODE RECIPE for this rank's compressed
+                        # objects — committing without them produces a
+                        # durable snapshot that cannot be restored, so
+                        # this rank must fail the commit (arrive
+                        # carries the error; rank 0 withholds the
+                        # marker).  Plain checksums stay best-effort.
+                        status = f"err:codec tables lost: {e!r}"
+                        if self._exc is None:
+                            self._exc = e
                     coord.kv_set(f"{uid}/crcs/{rank}", "{}")
             else:
                 coord.kv_set(f"{uid}/crcs/{rank}", "{}")
@@ -1493,17 +1597,28 @@ class PendingSnapshot:
                     ]
                     failed = [s for s in statuses if s != "ok"]
                     if not failed:
+                        raw_payloads = None
                         try:
+                            raw_payloads = [
+                                coord.kv_get(f"{uid}/crcs/{r}")
+                                for r in range(world)
+                            ]
                             _merge_crc_payloads(
                                 self._metadata,
-                                [
-                                    _json.loads(
-                                        coord.kv_get(f"{uid}/crcs/{r}")
-                                    )
-                                    for r in range(world)
-                                ],
+                                [_json.loads(p) for p in raw_payloads],
                             )
-                        except Exception:  # noqa: BLE001 — best-effort
+                        except Exception:  # noqa: BLE001
+                            # plain checksums are best-effort, but codec
+                            # frame tables in these payloads are the
+                            # decode recipe for compressed objects — if
+                            # any rank reported one (or the reads failed
+                            # so we cannot tell), the commit must fail
+                            # rather than durably strand undecodable
+                            # bytes behind a raw-path manifest
+                            if raw_payloads is None or any(
+                                '"codecs"' in p for p in raw_payloads
+                            ):
+                                raise
                             logger.warning(
                                 "crc merge failed; committing without "
                                 "checksums", exc_info=True,

@@ -422,6 +422,11 @@ class _FSStripedWriteHandle(StripedWriteHandle):
         self._tmp = tmp
         self._fd = fd
         self._closed = False
+        # extent actually written: the preallocated size is an UPPER
+        # bound when parts carry data-dependent sizes (codec frames) —
+        # complete() truncates to this high-water mark, so raw-sized
+        # preallocation never publishes trailing zeros
+        self._hwm = 0
 
     async def write_part(
         self, index: int, offset: int, buf, want_digest: bool = False
@@ -429,6 +434,7 @@ class _FSStripedWriteHandle(StripedWriteHandle):
         # no fused part digest: pwrite has no digesting variant in the
         # native lib, so the engine computes part digests itself
         view = memoryview(buf).cast("B")
+        self._hwm = max(self._hwm, offset + view.nbytes)
 
         def attempt() -> None:
             failpoint(
@@ -456,6 +462,8 @@ class _FSStripedWriteHandle(StripedWriteHandle):
         def commit() -> None:
             failpoint("storage.fs.write.sync", path=self._path)
             try:
+                if os.fstat(self._fd).st_size != self._hwm:
+                    os.ftruncate(self._fd, self._hwm)
                 if durable:
                     os.fdatasync(self._fd)
             finally:

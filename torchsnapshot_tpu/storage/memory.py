@@ -178,6 +178,9 @@ class _MemoryStripedWriteHandle(StripedWriteHandle):
         self._path = path
         self._buf = np.empty(total, dtype=np.uint8)
         self._done = False
+        # ``total`` is an upper bound when parts carry data-dependent
+        # sizes (codec frames); complete() publishes up to this mark
+        self._hwm = 0
         # part copies fuse the (crc32, adler32) into the same native
         # cache-blocked pass when the lib is present — the part-level
         # twin of the plugin's fused whole-object write
@@ -202,6 +205,7 @@ class _MemoryStripedWriteHandle(StripedWriteHandle):
             )
         src = np.frombuffer(memoryview(buf).cast("B"), dtype=np.uint8)
         dst = self._buf[offset : offset + src.nbytes]
+        self._hwm = max(self._hwm, offset + src.nbytes)
 
         def copy():
             if want_digest and self.supports_fused_digest:
@@ -216,6 +220,11 @@ class _MemoryStripedWriteHandle(StripedWriteHandle):
         return await asyncio.get_running_loop().run_in_executor(None, copy)
 
     async def complete(self) -> None:
+        if self._hwm < self._buf.nbytes:
+            # variable-size parts under-filled the preallocation: copy
+            # out the written extent so the published object doesn't pin
+            # the (possibly much larger) raw-sized buffer
+            self._buf = self._buf[: self._hwm].copy()
         # publish the assembled buffer itself (no copy), read-only for
         # the same reason the fused-digest path hands out readonly
         # views: consumers must never mutate the stored object

@@ -287,6 +287,11 @@ class _S3StripedWriteHandle(StripedWriteHandle):
     faults).  Each part retries independently under the shared S3
     policy (SlowDown/5xx/conn transient) and feeds the s3 breaker."""
 
+    # S3's EntityTooSmall floor: every part except the last must be at
+    # least 5MiB — the codec stream stores a part raw rather than ship
+    # an undersized compressed frame
+    min_part_bytes: int = 5 << 20
+
     def __init__(
         self, plugin: S3StoragePlugin, path, key, upload_id, total_size
     ) -> None:
@@ -295,6 +300,11 @@ class _S3StripedWriteHandle(StripedWriteHandle):
         self._key = key
         self._upload_id = upload_id
         self._total_size = total_size
+        # bytes actually uploaded: equals total_size for fixed-size
+        # parts, smaller when parts carry data-dependent sizes (codec
+        # frames, where total_size is the raw upper bound) — the
+        # lost-response size verification must compare against this
+        self._bytes_uploaded = 0
         # part number -> ETag; parts complete on the plugin's single
         # event loop, so a plain dict needs no lock
         self._etags: dict = {}
@@ -325,6 +335,7 @@ class _S3StripedWriteHandle(StripedWriteHandle):
             breaker=get_breaker("s3"),
         )
         self._etags[part_number] = etag
+        self._bytes_uploaded += view.nbytes
 
     async def complete(self) -> None:
         parts = [
@@ -353,10 +364,11 @@ class _S3StripedWriteHandle(StripedWriteHandle):
             # retry sees NoSuchUpload (the upload id was consumed by
             # the success).  Before failing a take whose object is in
             # fact fully published, verify by size: a HEAD matching the
-            # planned total means the complete won.
+            # bytes actually uploaded means the complete won.
             try:
                 published = (
-                    await self._plugin.stat(self._path) == self._total_size
+                    await self._plugin.stat(self._path)
+                    == self._bytes_uploaded
                 )
             except Exception as stat_err:  # noqa: BLE001
                 obs.swallowed_exception(

@@ -40,11 +40,12 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from concurrent.futures import Executor
 from typing import Any, Callable, List, Optional, Tuple
 
 from .. import knobs, obs
-from ..io_types import ReadIO, StoragePlugin
+from ..io_types import ReadIO, StoragePlugin, resolve_read_destination
 from ..resilience.failpoints import failpoint
 
 
@@ -169,6 +170,52 @@ async def striped_write(
         obs.counter(obs.STRIPE_WRITES).inc()
 
 
+class _ByteGate:
+    """Strict-FIFO byte-credit admission for the stream window.  A part
+    acquires its raw span size before staging and gives credit back in
+    up to two steps: the bytes its encoded frame doesn't need the
+    moment the frame exists, the rest when its write completes.  The
+    FIFO discipline (a waiter never overtakes an earlier one, even when
+    its claim would fit) keeps part admission in index order, so the
+    codec offset cascade fills front-to-back and a large head part
+    can't be starved by smaller successors."""
+
+    __slots__ = ("_free", "_waiters")
+
+    def __init__(self, capacity: int) -> None:
+        self._free = capacity
+        self._waiters: deque = deque()
+
+    async def acquire(self, n: int) -> None:
+        if self._free >= n and not self._waiters:
+            self._free -= n
+            return
+        fut = asyncio.get_running_loop().create_future()
+        entry = (fut, n)
+        self._waiters.append(entry)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # the grant raced the cancellation: give it back
+                self.release(n)
+            else:
+                try:
+                    self._waiters.remove(entry)
+                except ValueError:
+                    pass
+            raise
+
+    def release(self, n: int) -> None:
+        self._free += n
+        while self._waiters and self._waiters[0][1] <= self._free:
+            fut, need = self._waiters.popleft()
+            if fut.done():  # cancelled while queued
+                continue
+            self._free -= need
+            fut.set_result(None)
+
+
 async def streamed_part_write(
     storage: StoragePlugin,
     path: str,
@@ -180,25 +227,70 @@ async def streamed_part_write(
     on_part_staged: Optional[Callable[[int], None]] = None,
     on_part_done: Optional[Callable[[int], None]] = None,
     want_digests: bool = False,
+    codec_spec: Any = None,
+    filter_stride: int = 0,
+    codec_sink: Optional[Callable[[dict], None]] = None,
 ) -> Optional[List[Tuple[int, int, int]]]:
     """Per-part stage→write streaming: stage span N, dispatch its write
-    the moment its bytes exist, while spans N+1… are still staging.  At
-    most ``window_parts`` parts are in flight (staged-but-unwritten or
-    writing), which is exactly the scheduler's budget reservation for
-    the whole object — the admission win that lets an object larger
-    than the budget move under it.
+    the moment its bytes exist, while spans N+1… are still staging.  In-
+    flight bytes (staged-but-unwritten or writing) are capped at
+    ``window_parts`` full-size parts, which is exactly the scheduler's
+    budget reservation for the whole object — the admission win that
+    lets an object larger than the budget move under it.  The cap is
+    byte-granular (_ByteGate): with a codec, a part's claim shrinks to
+    its frame size the moment its encode finishes, so later parts are
+    admitted while earlier frames drain to storage.
 
     Returns ordered per-part ``(crc32, adler32, size)`` digests when
     ``want_digests`` (computed on the executor while the NEXT part
     stages; the caller folds them into the object digest via
     ``utils.checksums.combine_piece_digests``), else None.
-    """
+
+    With ``codec_spec`` (codec.WriteSpec), each part additionally passes
+    through the compress stage between its RAW digest and its write:
+    encode runs on the staging executor, so part N's compression
+    overlaps parts N-1…'s storage I/O under the same window.  Encoded
+    frames have data-dependent sizes, so each part's storage offset
+    resolves from a forward cascade (part N's start = part N-1's end,
+    known the moment N-1's encode finishes — encodes run concurrently,
+    so the cascade settles far ahead of the uploads it gates).  The
+    handle is opened at the raw-size upper bound (+1 header per part)
+    and truncates to the high-water mark on complete.  Digests returned
+    stay RAW; the stored-byte digest and per-frame lengths flow to
+    ``codec_sink`` as the object's manifest codec-table entry."""
     backend = _backend_name(storage)
     total = spans[-1][1]
     m_part_lat = obs.histogram(obs.STRIPE_PART_WRITE_LATENCY_S)
-    sem = asyncio.Semaphore(window_parts)
+    # byte-granular window: capacity equals the scheduler's reservation
+    # (window_parts full-size parts).  Without a codec every part holds
+    # its raw size from stage to write-complete — identical admission
+    # to a window_parts semaphore.  With one, a part returns the bytes
+    # compression saved the moment its frame exists, so part N+window
+    # starts staging and encoding while earlier (smaller) frames are
+    # still on the wire — that early credit is what lets the pipeline
+    # hide encode cost instead of running encode waves and wire waves
+    # in lockstep.
+    gate = _ByteGate(window_parts * max(hi - lo for lo, hi in spans))
     digests: List[Optional[Tuple[int, int, int]]] = [None] * len(spans)
     loop = asyncio.get_running_loop()
+    if codec_spec is not None:
+        from .. import codec as codec_mod
+
+        # raw upper bound: a frame is never larger than raw + header
+        # (store-raw fallback caps expansion at FRAME_HEADER_BYTES)
+        ub_total = total + len(spans) * codec_mod.FRAME_HEADER_BYTES
+        enc_digests: List[Optional[Tuple[int, int, int]]] = (
+            [None] * len(spans)
+        )
+        frame_lens: List[int] = [0] * len(spans)
+        # starts[i] resolves to frame i's storage offset once every
+        # earlier frame's encoded size is known
+        starts: List[asyncio.Future] = [
+            loop.create_future() for _ in spans
+        ]
+        starts[0].set_result(0)
+    else:
+        ub_total = total
 
     def _digest(piece: Any) -> Tuple[int, int, int]:
         from ..utils.checksums import adler32_fast, crc32_fast
@@ -208,15 +300,24 @@ async def streamed_part_write(
 
     with obs.span(
         "stripe/stream_write", backend=backend, path=path, bytes=total,
-        parts=len(spans),
+        parts=len(spans), codec=getattr(codec_spec, "codec", None),
     ):
-        handle = await storage.begin_striped_write(path, total)
+        handle = await storage.begin_striped_write(path, ub_total)
 
-        fuse = want_digests and getattr(handle, "supports_fused_digest", False)
+        # fused copy+digest would hash the STORED bytes; under a codec
+        # the manifest digests must be RAW, so fusing is disabled and
+        # the raw digest runs before the encode stage
+        fuse = (
+            want_digests
+            and codec_spec is None
+            and getattr(handle, "supports_fused_digest", False)
+        )
 
         async def one(idx: int, span: Tuple[int, int]) -> None:
             lo, hi = span
-            async with sem:
+            await gate.acquire(hi - lo)
+            held = hi - lo
+            try:
                 failpoint("scheduler.stage.part", path=path, part=idx)
                 with obs.span(
                     "stripe/stage_part", path=path, part=idx, bytes=hi - lo
@@ -231,12 +332,55 @@ async def streamed_part_write(
                         )
                     else:
                         digests[idx] = _digest(piece)
+                offset = lo
+                if codec_spec is not None:
+                    # compress stage: encode on the staging executor
+                    # (raw digest above ran on the raw bytes), resolve
+                    # this frame's offset from the cascade, and release
+                    # the raw part the moment the frame exists
+                    frame = await codec_mod.encode_frame_async(
+                        memoryview(piece).cast("B"),
+                        codec_spec,
+                        filter_stride,
+                        executor,
+                        path=path,
+                        part=idx,
+                        # backend part-size floor (S3 EntityTooSmall)
+                        # binds every part but the last
+                        min_frame_bytes=(
+                            getattr(handle, "min_part_bytes", 0)
+                            if idx + 1 < len(spans)
+                            else 0
+                        ),
+                    )
+                    del piece
+                    frame_lens[idx] = len(frame)
+                    # the raw part is gone; return the bytes the frame
+                    # doesn't need (an expanded frame — store-raw header
+                    # overhead — keeps the full raw claim: ≤24B/part
+                    # inside the handle's preallocation headroom)
+                    early = held - min(held, len(frame))
+                    if early:
+                        gate.release(early)
+                        held -= early
+                    if want_digests:
+                        if executor is not None:
+                            enc_digests[idx] = await loop.run_in_executor(
+                                executor, _digest, frame
+                            )
+                        else:
+                            enc_digests[idx] = _digest(frame)
+                    offset = await starts[idx]
+                    if idx + 1 < len(spans):
+                        starts[idx + 1].set_result(offset + len(frame))
+                    piece = frame
+                nbytes = memoryview(piece).cast("B").nbytes
                 t0 = time.perf_counter()
                 with obs.span(
-                    "stripe/write_part", path=path, part=idx, bytes=hi - lo
+                    "stripe/write_part", path=path, part=idx, bytes=nbytes
                 ):
                     d = await handle.write_part(
-                        idx, lo, piece, want_digest=fuse
+                        idx, offset, piece, want_digest=fuse
                     )
                 dt = time.perf_counter() - t0
                 if fuse:
@@ -251,12 +395,32 @@ async def streamed_part_write(
                     else:
                         digests[idx] = _digest(piece)
                 m_part_lat.observe(dt)
-                obs.record_storage_io(backend, "write", hi - lo, dt)
+                obs.record_storage_io(backend, "write", nbytes, dt)
                 obs.counter(obs.STRIPE_PARTS_WRITTEN).inc()
-                obs.counter(obs.STRIPE_BYTES_WRITTEN).inc(hi - lo)
+                obs.counter(obs.STRIPE_BYTES_WRITTEN).inc(nbytes)
                 del piece  # the part's bytes die with its write
                 if on_part_done is not None:
-                    on_part_done(hi - lo)
+                    on_part_done(nbytes)
+            except BaseException as e:
+                # ANY failure in this part — stage failpoint, stager,
+                # raw digest, encode, or a poisoned upstream start —
+                # must keep the offset cascade flowing, or part idx+1
+                # awaits a start that never resolves and the stream
+                # wedges instead of failing
+                if (
+                    codec_spec is not None
+                    and idx + 1 < len(spans)
+                    and not starts[idx + 1].done()
+                ):
+                    starts[idx + 1].set_exception(
+                        RuntimeError(
+                            f"part {idx} of {path!r} failed "
+                            f"upstream: {e!r}"
+                        )
+                    )
+                raise
+            finally:
+                gate.release(held)
 
         try:
             try:
@@ -266,6 +430,16 @@ async def streamed_part_write(
                 )
             finally:
                 stager.release_source()
+                if codec_spec is not None:
+                    # settle the offset cascade: cancel never-resolved
+                    # futures and mark propagated errors retrieved, so a
+                    # failed stream can't log "exception never
+                    # retrieved" at GC
+                    for f in starts:
+                        if not f.done():
+                            f.cancel()
+                        elif not f.cancelled():
+                            f.exception()
             errs = [r for r in results if isinstance(r, BaseException)]
             if errs:
                 raise errs[0]
@@ -277,6 +451,19 @@ async def streamed_part_write(
         await handle.complete()
         obs.counter(obs.STRIPE_WRITES).inc()
         obs.counter(obs.STRIPE_STREAMED_WRITES).inc()
+    if codec_spec is not None and codec_sink is not None:
+        stored_digest = None
+        if want_digests and all(d is not None for d in enc_digests):
+            from ..utils.checksums import combine_piece_digests
+
+            stored_digest = list(combine_piece_digests(enc_digests))
+        part_size = spans[0][1] - spans[0][0]
+        codec_sink(
+            codec_mod.make_table(
+                codec_spec.codec, part_size, total, frame_lens,
+                stored_digest,
+            )
+        )
     return [d for d in digests if d is not None] if want_digests else None
 
 
@@ -304,16 +491,7 @@ async def striped_read(
     m_part_lat = obs.histogram(obs.STRIPE_PART_READ_LATENCY_S)
     sem = asyncio.Semaphore(part_concurrency())
 
-    out = None
-    if into is not None:
-        try:
-            v = memoryview(into).cast("B")
-            if not v.readonly and v.nbytes == length:
-                out = into
-        except (TypeError, ValueError):
-            pass  # exotic/non-contiguous hint: assemble normally
-    if out is None:
-        out = np.empty(length, dtype=np.uint8)
+    out = resolve_read_destination(into, length)
     out_view = memoryview(out).cast("B")
 
     with obs.span(

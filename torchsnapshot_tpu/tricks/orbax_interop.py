@@ -111,7 +111,10 @@ def migrate_snapshot_to_orbax(
     storage = url_to_storage_plugin(snapshot_path)
     try:
         sync_execute_read_reqs(
-            read_reqs, storage, get_process_memory_budget_bytes(), rank=0
+            read_reqs, storage, get_process_memory_budget_bytes(), rank=0,
+            # codec-compressed objects must decode here like every other
+            # read path — otherwise the export writes frame bytes
+            codec_tables=snap._codec_tables(),
         )
     finally:
         storage.sync_close()

@@ -23,6 +23,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import codec
 from .manifest import Entry, PrimitiveEntry, is_container_entry
 from .manifest_ops import get_manifest_for_rank
 from .preparers import prepare_read
@@ -190,6 +191,7 @@ def _check_crcs(
     manifest: Dict[str, Entry],
     result: VerifyResult,
     extents: Dict[str, int],
+    codec_tables: Optional[Dict[str, Any]] = None,
 ) -> set:
     """Deep mode: re-read every checksummed payload and compare crc32
     (catches bit rot / torn or overwritten content that sizes and parse
@@ -202,6 +204,8 @@ def _check_crcs(
     buffers its whole payload; 16 concurrent 128MB slabs would otherwise
     spike multi-GB on a small audit VM)."""
     import asyncio
+    import os
+    from concurrent.futures import ThreadPoolExecutor
 
     from .io_types import ReadIO
     from .utils.asyncio_utils import run_in_fresh_loop
@@ -211,6 +215,12 @@ def _check_crcs(
     if not targets:
         return set()
     budget_cap = get_process_memory_budget_bytes()
+    # codec frames decode on this pool so a 64MB decompress never blocks
+    # the loop thread that all the other reads are overlapping on
+    decode_pool = ThreadPoolExecutor(
+        max_workers=max(1, os.cpu_count() or 1),
+        thread_name_prefix="verify-decode",
+    )
 
     def size_of(loc, byte_range):
         if byte_range:
@@ -234,14 +244,32 @@ def _check_crcs(
                 in_use += nbytes
             try:
                 async with sem:
-                    read_io = ReadIO(
-                        path=loc,
-                        byte_range=(
-                            list(byte_range) if byte_range else None
-                        ),
+                    table = (
+                        codec_tables.get(loc) if codec_tables else None
                     )
-                    await storage.read(read_io)
-                    actual = crc32_fast(memoryview(read_io.buf).cast("B"))
+                    if table is not None:
+                        # encoded object: recorded crcs are RAW-byte
+                        # crcs, so decode through the frame layer (which
+                        # also proves the frames themselves are intact)
+                        buf = await codec.framed_read(
+                            storage,
+                            loc,
+                            table,
+                            byte_range=(
+                                list(byte_range) if byte_range else None
+                            ),
+                            executor=decode_pool,
+                        )
+                    else:
+                        read_io = ReadIO(
+                            path=loc,
+                            byte_range=(
+                                list(byte_range) if byte_range else None
+                            ),
+                        )
+                        await storage.read(read_io)
+                        buf = read_io.buf
+                    actual = crc32_fast(memoryview(buf).cast("B"))
                     return loc, byte_range, crc, actual, None
             except asyncio.CancelledError:
                 raise
@@ -257,7 +285,11 @@ def _check_crcs(
         )
 
     verified = set()
-    for loc, byte_range, crc, actual, err in run_in_fresh_loop(gather()):
+    try:
+        results = run_in_fresh_loop(gather())
+    finally:
+        decode_pool.shutdown(wait=False)
+    for loc, byte_range, crc, actual, err in results:
         if err is not None:
             # existence/size problems are already reported by the stat
             # pass; don't double-report missing objects here
@@ -326,6 +358,16 @@ def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
             for loc, rec in (snapshot.metadata.objects or {}).items()
             if isinstance(rec, (list, tuple)) and len(rec) == 3
         }
+        # codec-encoded objects (codec.py): what's on storage is the
+        # FRAME stream, so expected sizes come from the codec table's
+        # stored lengths — the raw sizes above would flag every encoded
+        # object as truncated
+        codec_tables = snapshot._codec_tables() or {}
+        for loc, tbl in codec_tables.items():
+            stored = codec.table_stored_size(tbl)
+            exact_sizes[loc] = stored
+            if loc in extents:
+                extents[loc] = stored
         for location, outcome in _stat_all(storage, sorted(extents)):
             expected = extents[location]
             if isinstance(outcome, FileNotFoundError):
@@ -342,7 +384,9 @@ def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
 
         crc_verified: set = set()
         if deep:
-            crc_verified = _check_crcs(storage, manifest, result, extents)
+            crc_verified = _check_crcs(
+                storage, manifest, result, extents, codec_tables
+            )
 
         for lpath, entry in sorted(manifest.items()):
             if is_container_entry(entry):
@@ -368,6 +412,7 @@ def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
                     storage,
                     get_process_memory_budget_bytes(),
                     rank,
+                    codec_tables=codec_tables or None,
                 )
                 if fut.obj is None:
                     raise RuntimeError("read produced no value")

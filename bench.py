@@ -245,6 +245,12 @@ def _quick_number(dev, init_s: float) -> None:
         jax.block_until_ready(dest.tree)
         restore_s = time.perf_counter() - t0
         gbps = total_gb / blocked_s
+        # same degradation contract as run_child's record: a goodput
+        # rollup error must cost the block, never the quick number
+        try:
+            goodput_block = _goodput_rollup()
+        except Exception as e:  # noqa: BLE001
+            goodput_block = {"error": f"{e!r}"[:200]}
         print(
             json.dumps(
                 {
@@ -259,6 +265,7 @@ def _quick_number(dev, init_s: float) -> None:
                     # reset above): bytes staged/written, budget
                     # high-water, per-backend latency histograms
                     "metrics": obs.metrics_snapshot(),
+                    "goodput": goodput_block,
                     "value": round(gbps, 3),
                     "vs_baseline": round(gbps / BASELINE_GBPS, 3),
                     "blocked_s": round(blocked_s, 4),
@@ -288,6 +295,27 @@ def _lint_probe() -> dict:
     from tools.lint import repo_summary
 
     return repo_summary(repo)
+
+
+def _goodput_rollup() -> dict:
+    """Goodput/SLO block for the BENCH record (obs/goodput.py):
+    time-to-unblock-train, take→durable-commit lag (covers write-back
+    promotion) and the checkpoint overhead fraction — the numbers that
+    say what the headline throughput COST the training loop.  Reads the
+    in-process tracker + gauges; no I/O."""
+    from torchsnapshot_tpu import obs
+
+    block = obs.goodput.block()
+    gauges = obs.metrics_snapshot().get("gauges", {})
+    for key, name in (
+        ("time_to_unblock_s", obs.GOODPUT_TIME_TO_UNBLOCK_S),
+        ("durability_lag_s", obs.GOODPUT_DURABILITY_LAG_S),
+        ("overhead_fraction", obs.GOODPUT_OVERHEAD_FRACTION),
+    ):
+        g = gauges.get(name)
+        if block.get(key) is None and g is not None:
+            block[key] = g["value"]
+    return block
 
 
 def _resilience_rollup() -> dict:
@@ -967,6 +995,13 @@ def run_child() -> None:
         # (registry reset at warmup_done, so this covers the measured
         # phases only)
         result["metrics"] = obs.metrics_snapshot()
+        # goodput/SLO block: what the measured take/restore cost the
+        # training loop (time-to-unblock, durable lag, overhead
+        # fraction) — every BENCH record embeds it (tier-1 asserted)
+        try:
+            result["goodput"] = _goodput_rollup()
+        except Exception as e:
+            result["goodput"] = {"error": f"{e!r}"[:200]}
         if obs.tracing_enabled():
             # TORCHSNAPSHOT_TPU_TRACE=1 drives: the span trace of the
             # measured phases lands next to the BENCH record, loadable

@@ -1,0 +1,295 @@
+"""Cross-rank aggregation + flight records (obs/aggregate.py): delta
+windowing, merge math, straggler attribution, the self-CRC'd
+``.snapshot_obsrecord`` persistence contract (written before the commit
+marker, best-effort, partial-on-missing-rank), and goodput accounting
+(obs/goodput.py).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+from torchsnapshot_tpu.obs import aggregate, goodput
+
+
+@pytest.fixture(autouse=True)
+def _fresh_goodput():
+    goodput.reset()
+    yield
+    goodput.reset()
+
+
+# ------------------------------------------------------------- delta
+
+
+def test_delta_windows_counters_and_histograms():
+    before = {
+        "counters": {"a": 5, "b": 2},
+        "gauges": {"g": {"value": 1.0, "max": 3.0}},
+        "histograms": {
+            "h": {"count": 2, "sum": 1.0, "min": 0.1, "max": 0.9,
+                  "bounds": [1.0], "counts": [2, 0]},
+        },
+    }
+    after = {
+        "counters": {"a": 9, "b": 2, "c": 4},
+        "gauges": {"g": {"value": 7.0, "max": 7.0}},
+        "histograms": {
+            "h": {"count": 5, "sum": 4.0, "min": 0.1, "max": 2.0,
+                  "bounds": [1.0], "counts": [3, 2]},
+            "born": {"count": 1, "sum": 0.5, "min": 0.5, "max": 0.5,
+                     "bounds": [1.0], "counts": [1, 0]},
+        },
+    }
+    d = aggregate.delta(before, after)
+    # unchanged counters are dropped; new ones delta against zero
+    assert d["counters"] == {"a": 4, "c": 4}
+    assert d["histograms"]["h"]["count"] == 3
+    assert d["histograms"]["h"]["sum"] == pytest.approx(3.0)
+    assert d["histograms"]["h"]["counts"] == [1, 2]
+    assert d["histograms"]["born"]["count"] == 1
+    # gauges are as-of-capture (not windowable)
+    assert d["gauges"]["g"]["value"] == 7.0
+
+
+def _payload(rank, counters=None, phases=None):
+    metrics = {"counters": counters or {}, "gauges": {}, "histograms": {}}
+    for phase, secs in (phases or {}).items():
+        metrics["histograms"][f"phase.{phase}_s"] = {
+            "count": 1, "sum": secs, "min": secs, "max": secs,
+            "bounds": [1.0], "counts": [1, 0],
+        }
+    return {
+        "rank": rank,
+        "op": "take",
+        "metrics": metrics,
+        "phases": {
+            p: {"seconds": s, "count": 1} for p, s in (phases or {}).items()
+        },
+        "backends": {},
+        "goodput": {"time_to_unblock_s": 0.5 + rank},
+        "slow_objects": [],
+    }
+
+
+def test_merge_sums_counters_and_merges_histograms():
+    a = _payload(0, counters={"bytes_written": 10, "x": 1},
+                 phases={"write": 0.2})
+    b = _payload(1, counters={"bytes_written": 32},
+                 phases={"write": 1.5, "stage": 0.1})
+    rec = aggregate.merge_payloads([a, b], op="take", path="p", world_size=2)
+    assert rec["merged"]["counters"]["bytes_written"] == 42
+    assert rec["merged"]["counters"]["x"] == 1
+    h = rec["merged"]["histograms"]["phase.write_s"]
+    assert h["count"] == 2 and h["sum"] == pytest.approx(1.7)
+    assert rec["ranks_reported"] == [0, 1]
+    assert rec["missing_ranks"] == []
+    # fleet goodput = slowest rank's
+    assert rec["goodput"]["time_to_unblock_s"] == 1.5
+
+
+def test_merge_names_straggler_rank_and_phase():
+    a = _payload(0, phases={"write": 0.1, "stage": 0.05})
+    b = _payload(1, phases={"write": 2.0, "stage": 0.06})
+    rec = aggregate.merge_payloads([a, b], op="take", path="p", world_size=2)
+    st = rec["straggler"]
+    assert st["rank"] == 1
+    assert st["phase"] == "write"
+    assert st["lead_over_peers_s"] == pytest.approx(2.06 - 0.15, abs=1e-6)
+
+
+def test_merge_notes_missing_ranks():
+    rec = aggregate.merge_payloads(
+        [_payload(0), None], op="take", path="p", world_size=3
+    )
+    assert rec["ranks_reported"] == [0]
+    assert rec["missing_ranks"] == [1, 2]
+    # empty payload set still yields a structurally valid record
+    rec2 = aggregate.merge_payloads([], op="take", path="p", world_size=2)
+    assert rec2["missing_ranks"] == [0, 1]
+    assert rec2["straggler"] is None
+
+
+# -------------------------------------------------- record round-trip
+
+
+def test_record_encode_decode_roundtrip_and_self_crc():
+    rec = aggregate.merge_payloads(
+        [_payload(0, counters={"bytes_written": 7})],
+        op="take", path="p", world_size=1,
+    )
+    data = aggregate.encode_record(rec)
+    assert aggregate.decode_record(data) == json.loads(
+        json.dumps(rec, sort_keys=True)
+    )
+    # every single-bit corruption of the body is detected
+    flipped = bytearray(data)
+    flipped[10] ^= 0x4
+    with pytest.raises(RuntimeError, match="corrupt|parseable"):
+        aggregate.decode_record(bytes(flipped))
+    with pytest.raises(RuntimeError):
+        aggregate.decode_record(data[: len(data) // 2])
+    with pytest.raises(RuntimeError, match="unexpected structure"):
+        aggregate.decode_record(b'{"not": "a record"}')
+
+
+# ------------------------------------------------ take/restore wiring
+
+
+def test_take_persists_obsrecord_with_summed_counters(tmp_path):
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": StateDict(x=np.arange(30000.0), n=1)})
+    assert os.path.exists(os.path.join(path, aggregate.OBSRECORD_FNAME))
+    rec = aggregate.read_obsrecord(path)
+    assert rec["op"] == "take"
+    assert rec["version"] == aggregate.RECORD_VERSION
+    assert rec["ranks_reported"] == [0] and rec["missing_ranks"] == []
+    # the record's window covers exactly this take
+    assert rec["merged"]["counters"]["bytes_staged"] >= 30000 * 8
+    phases = rec["per_rank"]["0"]["phases"]
+    assert "write" in phases and phases["write"]["seconds"] > 0
+    assert rec["straggler"]["rank"] == 0
+    # per-backend breakdown rides the per-rank rollup
+    assert "fs" in rec["per_rank"]["0"]["backends"]
+
+
+def test_obsrecord_lands_before_commit_marker(tmp_path, monkeypatch):
+    """The record must be durable evidence even for an ABORTED commit:
+    a metadata-write failure leaves the obsrecord in place and no
+    commit marker — never the reverse."""
+    import torchsnapshot_tpu.snapshot as snap_mod
+
+    path = str(tmp_path / "snap")
+    real = snap_mod.url_to_storage_plugin
+
+    def factory(p, *a, **kw):
+        plugin = real(p, *a, **kw)
+        orig = plugin.sync_write
+
+        def sync_write(write_io):
+            if write_io.path == ".snapshot_metadata":
+                raise OSError(28, "injected ENOSPC at commit")
+            return orig(write_io)
+
+        plugin.sync_write = sync_write
+        return plugin
+
+    monkeypatch.setattr(snap_mod, "url_to_storage_plugin", factory)
+    with pytest.raises(OSError):
+        Snapshot.take(path, {"m": StateDict(x=np.arange(64.0))})
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+    assert os.path.exists(os.path.join(path, aggregate.OBSRECORD_FNAME))
+    assert aggregate.read_obsrecord(path)["op"] == "take"
+
+
+def test_publish_failure_degrades_to_partial_record(tmp_path):
+    """A failed (best-effort) publish must cost only record coverage:
+    the take commits, the record notes the missing rank."""
+    path = str(tmp_path / "snap")
+    with knobs.override_failpoints("obs.publish=runtime"):
+        Snapshot.take(path, {"m": StateDict(x=np.arange(64.0))})
+    assert os.path.exists(os.path.join(path, ".snapshot_metadata"))
+    rec = aggregate.read_obsrecord(path)
+    assert rec["ranks_reported"] == []
+    assert rec["missing_ranks"] == [0]
+    # the roundtrip still restores fine
+    out = StateDict(x=np.zeros(64))
+    Snapshot(path).restore({"m": out})
+    assert np.array_equal(out["x"], np.arange(64.0))
+
+
+def test_async_take_persists_obsrecord(tmp_path):
+    path = str(tmp_path / "snap")
+    pending = Snapshot.async_take(
+        path, {"m": StateDict(x=np.arange(30000.0))}
+    )
+    pending.wait()
+    rec = aggregate.read_obsrecord(path)
+    assert rec["op"] == "take"
+    assert rec["merged"]["counters"]["bytes_written"] >= 30000 * 8
+
+
+def test_restore_merges_record_in_process(tmp_path):
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": StateDict(x=np.arange(30000.0))})
+    out = StateDict(x=np.zeros(30000))
+    Snapshot(path).restore({"m": out})
+    rec = aggregate.last_record("restore")
+    assert rec is not None and rec["op"] == "restore"
+    assert rec["merged"]["counters"]["bytes_read"] >= 30000 * 8
+    assert "read" in rec["per_rank"]["0"]["phases"]
+
+
+def test_read_obsrecord_missing_is_fnf(tmp_path):
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": StateDict(x=np.arange(8.0))})
+    os.remove(os.path.join(path, aggregate.OBSRECORD_FNAME))
+    with pytest.raises(FileNotFoundError, match="snapshot_obsrecord"):
+        aggregate.read_obsrecord(path)
+
+
+def test_slow_objects_recorded_under_trace(tmp_path):
+    path = str(tmp_path / "snap")
+    tr = obs.get_tracer()
+    with knobs.override_trace(1):
+        tr.reset()
+        Snapshot.take(path, {"m": StateDict(x=np.arange(30000.0))})
+    tr.reset()
+    rec = aggregate.read_obsrecord(path)
+    assert rec["slow_objects"], "traced take must record slowest objects"
+    o = rec["slow_objects"][0]
+    assert o["seconds"] > 0 and o["path"]
+
+
+# ----------------------------------------------------------- goodput
+
+
+def test_goodput_take_updates_gauges_and_block(tmp_path):
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": StateDict(x=np.arange(30000.0))})
+    snap = obs.metrics_snapshot()["gauges"]
+    assert snap[obs.GOODPUT_TIME_TO_UNBLOCK_S]["value"] > 0
+    assert snap[obs.GOODPUT_DURABILITY_LAG_S]["value"] > 0
+    block = goodput.block()
+    assert block["takes"] == 1
+    assert block["durable_commits"] == 1
+    assert block["time_to_unblock_s"] > 0
+    assert 0 <= block["overhead_fraction"] <= 1
+    json.dumps(block)  # JSON-safe by contract
+
+
+def test_goodput_async_take_unblocks_before_durable(tmp_path):
+    path = str(tmp_path / "snap")
+    pending = Snapshot.async_take(path, {"m": StateDict(x=np.arange(1 << 16, dtype=np.float64))})
+    # the blocked window ended at handle return — before wait()
+    assert goodput.block()["time_to_unblock_s"] is not None
+    pending.wait()
+    block = goodput.block()
+    assert block["durable_commits"] == 1
+    assert block["durability_lag_s"] >= block["time_to_unblock_s"] - 1e-3
+
+
+def test_goodput_write_back_lag_covers_promotion(tmp_path):
+    from torchsnapshot_tpu.tier.promoter import drain_promotions, get_promoter
+
+    fast = str(tmp_path / "fast")
+    durable = str(tmp_path / "durable")
+    opts = {"tier": {"fast_url": fast, "policy": "write_back"}}
+    get_promoter().pause()
+    try:
+        Snapshot.take(
+            durable, {"m": StateDict(x=np.arange(64.0))},
+            storage_options=opts,
+        )
+        # fast tier acked, but the durable marker has NOT landed: no
+        # durable commit recorded yet
+        assert goodput.block()["durable_commits"] == 0
+    finally:
+        get_promoter().resume()
+    drain_promotions()
+    block = goodput.block()
+    assert block["durable_commits"] == 1
+    assert block["durability_lag_s"] >= block["time_to_unblock_s"]

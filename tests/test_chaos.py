@@ -691,3 +691,57 @@ def test_chaos_corrupt_fast_frame_falls_back_and_repairs(tmp_path, flavor):
         os.path.join(durable, rel), "rb"
     ) as f_dur:
         assert f_fast.read() == f_dur.read()
+
+
+# ====================================== flight-record chaos scenarios
+#
+# The flight record (obs/aggregate.py) is best-effort telemetry: a rank
+# failing between its data writes and its obsrecord publish must cost
+# only record coverage — the commit proceeds, the merged record notes
+# the missing rank, and `doctor` renders the partial record cleanly.
+
+
+def test_chaos_rank_dies_before_obsrecord_publish_commit_survives(tmp_path):
+    body = r"""
+    state = {"app": StateDict(w=np.arange(256, dtype=np.float32) + rank)}
+    Snapshot.take(snap_dir, state, coordinator=coord)
+    assert os.path.exists(os.path.join(snap_dir, ".snapshot_metadata"))
+    print(f"rank {rank} CHAOS-OK")
+    """
+    t0 = time.monotonic()
+    results = _launch_chaos_workers(
+        tmp_path,
+        body,
+        env_per_rank=[
+            {},
+            # rank 1's publish dies after its data writes all landed
+            {"TORCHSNAPSHOT_TPU_FAILPOINTS": "obs.publish=runtime"},
+        ],
+    )
+    assert time.monotonic() - t0 < 80
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} CHAOS-OK" in out
+
+    snap_dir = os.path.join(str(tmp_path), "snap")
+    from torchsnapshot_tpu.obs import aggregate
+
+    rec = aggregate.read_obsrecord(snap_dir)
+    assert rec["ranks_reported"] == [0]
+    assert rec["missing_ranks"] == [1]
+    # the surviving rank's contribution is intact
+    assert rec["merged"]["counters"].get("bytes_staged", 0) > 0
+
+    # doctor degrades gracefully: renders the partial record, notes
+    # the missing rank, exits 0
+    out = subprocess.run(
+        [sys.executable, "-m", "torchsnapshot_tpu", "doctor", snap_dir],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=_REPO,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "MISSING: [1]" in out.stdout
+    assert "straggler: rank 0" in out.stdout

@@ -46,7 +46,10 @@ def _payload_files(root):
     out = []
     for dirpath, _dirs, files in os.walk(root):
         for f in files:
-            if f == ".snapshot_metadata":
+            # .snapshot_obsrecord is the flight-record telemetry
+            # sidecar (obs/aggregate.py) — self-CRC'd but never read on
+            # the restore path, so it is not a corruption-fuzz payload
+            if f in (".snapshot_metadata", ".snapshot_obsrecord"):
                 continue
             p = os.path.join(dirpath, f)
             if os.path.getsize(p) > 0:

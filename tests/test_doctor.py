@@ -1,0 +1,196 @@
+"""The ``doctor`` CLI (flight-record rendering, --json, --diff) and the
+2-process acceptance path: a distributed take produces ONE merged
+``.snapshot_obsrecord`` whose counters equal the sum of the per-rank
+registries, and ``doctor`` names an injected-slow rank as the straggler
+with the correct phase.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, obs
+from torchsnapshot_tpu.__main__ import main
+from torchsnapshot_tpu.obs import aggregate
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _take(tmp_path, name="snap", n=30000):
+    path = str(tmp_path / name)
+    Snapshot.take(path, {"m": StateDict(x=np.arange(float(n)))})
+    return path
+
+
+def test_doctor_renders_record(tmp_path, capsys):
+    path = _take(tmp_path)
+    assert main(["doctor", path]) == 0
+    out = capsys.readouterr().out
+    assert "[take]" in out
+    assert "straggler: rank 0" in out
+    assert "write" in out
+    assert "io:" in out and "staged" in out
+    assert "health:" in out
+
+
+def test_doctor_json(tmp_path, capsys):
+    path = _take(tmp_path)
+    assert main(["doctor", path, "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["record"] == "tsnp-obsrecord"
+    assert rec["straggler"]["rank"] == 0
+    assert rec["merged"]["counters"]["bytes_written"] > 0
+
+
+def test_doctor_diff(tmp_path, capsys):
+    a = _take(tmp_path, "a", n=1000)
+    b = _take(tmp_path, "b", n=200000)
+    assert main(["doctor", a, "--diff", b]) == 0
+    out = capsys.readouterr().out
+    assert "diff:" in out and "write" in out
+    capsys.readouterr()
+    assert main(["doctor", a, "--diff", b, "--json"]) == 0
+    diff = json.loads(capsys.readouterr().out)
+    # b staged/wrote more than a: positive byte deltas
+    assert diff["counters"]["bytes_written"]["delta"] > 0
+    assert "write" in diff["phases"]
+
+
+def test_doctor_missing_record_clean_error(tmp_path, capsys):
+    path = _take(tmp_path)
+    os.remove(os.path.join(path, aggregate.OBSRECORD_FNAME))
+    assert main(["doctor", path]) == 1
+    assert "error:" in capsys.readouterr().err
+
+
+def test_doctor_corrupt_record_clean_error(tmp_path, capsys):
+    path = _take(tmp_path)
+    rec_path = os.path.join(path, aggregate.OBSRECORD_FNAME)
+    with open(rec_path, "r+b") as f:
+        f.seek(20)
+        b = f.read(1)
+        f.seek(20)
+        f.write(bytes([b[0] ^ 0x20]))
+    assert main(["doctor", path]) == 1
+    assert "error:" in capsys.readouterr().err
+
+
+# ------------------------------------------- 2-process acceptance path
+
+
+def _run_workers(tmp_path, body, env_per_rank, world=2, timeout_s=120):
+    script = os.path.join(str(tmp_path), "worker.py")
+    with open(script, "w") as f:
+        f.write(
+            textwrap.dedent(
+                f"""
+                import json, os, sys
+                sys.path.insert(0, {_REPO!r})
+                import numpy as np
+                from torchsnapshot_tpu import (
+                    FileCoordinator, Snapshot, StateDict, obs,
+                )
+
+                rank = int(sys.argv[1])
+                world = int(sys.argv[2])
+                coord = FileCoordinator(
+                    {os.path.join(str(tmp_path), "kv")!r}, rank, world
+                )
+                snap_dir = {os.path.join(str(tmp_path), "snap")!r}
+                """
+            )
+            + textwrap.dedent(body)
+        )
+    base_env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, str(r), str(world)],
+            env={**base_env, **env_per_rank[r]},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout_s)[0].decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise AssertionError("worker wedged past the wall-clock bound")
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+    return outs
+
+
+def test_two_process_take_merged_record_and_straggler(tmp_path, capsys):
+    """Acceptance: a 2-process take produces one merged
+    ``.snapshot_obsrecord`` whose counters equal the sum of the
+    per-rank registries, and ``doctor`` names the failpoint-delayed
+    rank as the straggler in the write phase."""
+    body = r"""
+    before = obs.metrics_snapshot()
+    state = {"app": StateDict(
+        w=np.arange(4096, dtype=np.float32) + rank, step=rank,
+    )}
+    Snapshot.take(snap_dir, state, coordinator=coord)
+    after = obs.metrics_snapshot()
+    # bytes_staged settles strictly before the flight-record publish
+    # (all staging precedes sync_complete), so this independently
+    # recomputed per-rank delta must equal the record's contribution
+    print(json.dumps({
+        "rank": rank,
+        "bytes_staged": after["counters"].get("bytes_staged", 0)
+        - before["counters"].get("bytes_staged", 0),
+    }))
+    """
+    t0 = time.monotonic()
+    outs = _run_workers(
+        tmp_path,
+        body,
+        env_per_rank=[
+            {},
+            # injected slowness (never failure): every fs write on
+            # rank 1 sleeps 150ms — the straggler doctor must name
+            {"TORCHSNAPSHOT_TPU_FAILPOINTS": "storage.fs.write=delay150"},
+        ],
+    )
+    assert time.monotonic() - t0 < 110
+    per_rank = {}
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
+        d = json.loads(line)
+        per_rank[d["rank"]] = d["bytes_staged"]
+    assert set(per_rank) == {0, 1}
+    assert all(v > 0 for v in per_rank.values())
+
+    snap_dir = os.path.join(str(tmp_path), "snap")
+    rec = aggregate.read_obsrecord(snap_dir)
+    assert rec["world_size"] == 2
+    assert rec["ranks_reported"] == [0, 1]
+    assert rec["missing_ranks"] == []
+    # merged counters == sum of the per-rank registries' deltas
+    assert rec["merged"]["counters"]["bytes_staged"] == sum(
+        per_rank.values()
+    )
+    # straggler attribution: the delayed rank, in the write phase
+    st = rec["straggler"]
+    assert st["rank"] == 1, st
+    assert st["phase"] == "write", st
+    w1 = rec["per_rank"]["1"]["phases"]["write"]["seconds"]
+    w0 = rec["per_rank"]["0"]["phases"]["write"]["seconds"]
+    assert w1 > w0 + 0.1, (w0, w1)
+    # the fast rank's wait shows up as barrier time, not write time
+    assert "barrier" in rec["per_rank"]["0"]["phases"]
+
+    # doctor renders the same verdict
+    assert main(["doctor", snap_dir]) == 0
+    out = capsys.readouterr().out
+    assert "straggler: rank 1 (write phase" in out

@@ -1169,3 +1169,43 @@ def test_codec_knob_reads_via_knobs_module_clean():
         filename="torchsnapshot_tpu/codec.py",
     )
     assert findings == []
+
+
+def test_instrumentation_covers_obs_aggregate_goodput_and_promoter():
+    """The fleet-observability entry points are pinned into the
+    instrumentation pass's coverage map: dropping them in a refactor
+    must fail here, not silently shrink trace completeness."""
+    from tools.lint.passes.instrumentation import MODULE_FUNCTIONS, TARGETS
+
+    assert {
+        "publish", "exchange_and_merge", "write_obsrecord",
+        "read_obsrecord",
+    } <= MODULE_FUNCTIONS["torchsnapshot_tpu/obs/aggregate.py"]
+    assert {
+        "take_begin", "take_unblocked", "durable_commit",
+    } <= MODULE_FUNCTIONS["torchsnapshot_tpu/obs/goodput.py"]
+    # Promoter public methods are checked (pause/resume allowlisted as
+    # test-only event flips)
+    assert TARGETS["torchsnapshot_tpu/tier/promoter.py"]["Promoter"] == {
+        "pause", "resume",
+    }
+
+
+def test_instrumentation_flags_uncovered_goodput_entry_point():
+    from tools.lint.passes.instrumentation import check_source
+
+    bare = "def take_begin(path):\n    return 0\n"
+    violations = check_source(
+        bare, {}, "torchsnapshot_tpu/obs/goodput.py",
+        module_functions={"take_begin"},
+    )
+    assert len(violations) == 1 and "take_begin" in violations[0]
+    bracketed = (
+        "def take_begin(path):\n"
+        "    with obs.span('goodput/take_begin'):\n"
+        "        return 0\n"
+    )
+    assert check_source(
+        bracketed, {}, "torchsnapshot_tpu/obs/goodput.py",
+        module_functions={"take_begin"},
+    ) == []

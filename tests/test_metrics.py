@@ -88,6 +88,128 @@ def test_counter_thread_safety():
     assert c.value == 80_000
 
 
+def test_metrics_snapshot_thread_safety_fuzz():
+    """``metrics_snapshot()`` raced against concurrent counter/gauge/
+    histogram mutation AND registry growth from worker threads (the
+    scheduler now mutates from part-granular tasks): every snapshot
+    must be internally consistent JSON, and the final totals must be
+    exact — no lost updates, no dict-mutation crashes."""
+    import random
+
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+    done_incs = [0] * 6
+
+    def mutate(i):
+        rnd = random.Random(i)
+        try:
+            while not stop.is_set():
+                reg.counter(f"c{rnd.randrange(8)}").inc()
+                done_incs[i] += 1
+                reg.gauge(f"g{rnd.randrange(4)}").set(rnd.random())
+                reg.histogram(
+                    f"h{rnd.randrange(4)}", bounds=(0.5,)
+                ).observe(rnd.random())
+                # registry growth mid-snapshot: fresh names force the
+                # name->instrument dicts to mutate under the reader
+                reg.counter(f"new.{rnd.randrange(2000)}").inc()
+        except Exception as e:  # noqa: BLE001 — the failure under test
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=mutate, args=(i,)) for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    snaps = []
+    for _ in range(300):
+        snap = reg.snapshot()
+        snaps.append(snap)
+        json.dumps(snap)  # every snapshot is JSON-coherent
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # counter monotonicity across successive snapshots
+    prev = -1
+    for snap in snaps:
+        total = sum(
+            v for k, v in snap["counters"].items() if k.startswith("c")
+        )
+        assert total >= prev
+        prev = total
+    # exact final totals: no lost updates
+    final = reg.snapshot()
+    assert sum(
+        v for k, v in final["counters"].items()
+        if len(k) == 2 and k.startswith("c")
+    ) == sum(done_incs)
+    for h in (final["histograms"].get(f"h{i}") for i in range(4)):
+        if h is not None:
+            assert h["count"] == sum(h["counts"])
+
+
+def test_openmetrics_export_format():
+    from torchsnapshot_tpu.obs.export import export_openmetrics
+
+    reg = MetricsRegistry()
+    reg.counter("storage.fs.write_bytes").inc(42)
+    reg.gauge("budget_bytes_in_use").set(7.5)
+    h = reg.histogram("lat", bounds=(1.0, 10.0))
+    for v in (0.5, 5.0, 99.0):
+        h.observe(v)
+    text = export_openmetrics(reg)
+    lines = text.splitlines()
+    # the TYPE line names the SAMPLE metric (_total included), the
+    # classic-format convention node_exporter itself follows
+    assert "# TYPE tsnp_storage_fs_write_bytes_total counter" in lines
+    assert "tsnp_storage_fs_write_bytes_total 42" in lines
+    assert "tsnp_budget_bytes_in_use 7.5" in lines
+    assert "tsnp_budget_bytes_in_use_max 7.5" in lines
+    # histogram buckets are CUMULATIVE and end with +Inf == count
+    assert 'tsnp_lat_bucket{le="1"} 1' in lines
+    assert 'tsnp_lat_bucket{le="10"} 2' in lines
+    assert 'tsnp_lat_bucket{le="+Inf"} 3' in lines
+    assert "tsnp_lat_count 3" in lines
+    assert any(ln.startswith("tsnp_lat_sum ") for ln in lines)
+
+
+def test_metrics_textfile_knob_dumps_on_take(tmp_path):
+    from torchsnapshot_tpu import knobs
+
+    target = tmp_path / "metrics.prom"
+    with knobs.override_metrics_textfile(str(target)):
+        Snapshot.take(
+            str(tmp_path / "snap"),
+            {"m": StateDict(x=np.arange(1000.0))},
+        )
+    text = target.read_text()
+    assert "tsnp_bytes_written_total" in text
+    assert "tsnp_goodput_time_to_unblock_s" in text
+    # atomic-write discipline: no temp leftovers next to the target
+    assert not [
+        p for p in tmp_path.iterdir() if p.name.startswith(".tsnp-metrics-")
+    ]
+
+
+def test_metrics_textfile_off_by_default(tmp_path):
+    assert obs.maybe_write_metrics_textfile() is None
+
+
+def test_metrics_textfile_pid_placeholder(tmp_path):
+    """Co-hosted worker processes share the env var: the {pid}
+    placeholder keeps their dumps from clobbering one another."""
+    import os
+
+    from torchsnapshot_tpu import knobs
+
+    with knobs.override_metrics_textfile(str(tmp_path / "m-{pid}.prom")):
+        written = obs.maybe_write_metrics_textfile()
+    assert written == str(tmp_path / f"m-{os.getpid()}.prom")
+    assert os.path.exists(written)
+
+
 def test_buf_nbytes_extension_dtypes_and_fallbacks():
     import ml_dtypes
 

@@ -409,7 +409,12 @@ def test_multicontroller_sharded_save_restore(tmp_path):
         with open(tmp_path / f"writes_{r}.log") as f:
             writes.append({line.strip() for line in f})
     shard_writes = [
-        {w for w in ws if not w.endswith(".snapshot_metadata")}
+        # metadata and the flight-record sidecar (obs/aggregate.py) are
+        # commit/telemetry writes, not shard payloads
+        {
+            w for w in ws
+            if not w.endswith((".snapshot_metadata", ".snapshot_obsrecord"))
+        }
         for ws in writes
     ]
     assert shard_writes[0] and shard_writes[1]

@@ -447,3 +447,31 @@ def test_snapshot_aborted_error_names_origin_and_cause():
     assert decode_poison(encode_poison(info)) == info
     # garbled poison still aborts, with an opaque cause
     assert decode_poison("{not json").origin_rank == -1
+
+
+def test_failpoint_delay_kind_sleeps_without_raising():
+    """delay<ms> is injected SLOWNESS, not failure: the site proceeds
+    normally (no exception), the fire counter advances, and fire counts
+    bound it like any other spec."""
+    import time as _time
+
+    from torchsnapshot_tpu import knobs, obs
+    from torchsnapshot_tpu.resilience.failpoints import (
+        failpoint,
+        parse_failpoints,
+    )
+
+    (spec,) = parse_failpoints("a.b=delay50:1:2")
+    assert spec.kind == "delay50"
+    with pytest.raises(ValueError):
+        parse_failpoints("a.b=delayx")
+
+    fired0 = obs.counter(obs.RESILIENCE_FAILPOINTS_FIRED).value
+    with knobs.override_failpoints("slow.site=delay50:1:2"):
+        t0 = _time.monotonic()
+        failpoint("slow.site")  # sleeps ~50ms, returns
+        failpoint("slow.site")
+        failpoint("slow.site")  # count exhausted: no sleep
+        elapsed = _time.monotonic() - t0
+    assert 0.08 <= elapsed < 1.0
+    assert obs.counter(obs.RESILIENCE_FAILPOINTS_FIRED).value == fired0 + 2

@@ -248,6 +248,116 @@ def test_roundtrip_take_restore_produces_valid_perfetto_trace(tmp_path):
     assert len(tr) == 0
 
 
+def _run_coro(coro):
+    import asyncio
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_retry_backoff_spans_carry_attempt_and_verdict(traced):
+    """Each resilience/backoff span names its attempt index and the
+    classification verdict that triggered it; the LAST one additionally
+    carries the retry sequence's final verdict."""
+    from torchsnapshot_tpu.resilience.retry import (
+        SharedProgress,
+        classify_generic,
+        retry_call,
+    )
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    with knobs.override_retry_backoff_cap_s(0.001):
+        progress = SharedProgress(window_s=60.0, max_attempts=5, label="t")
+        out = _run_coro(
+            retry_call(
+                flaky, op_name="op", backend="testbe",
+                classify=classify_generic, progress=progress,
+            )
+        )
+    assert out == "ok"
+    backoffs = [
+        s for s in traced.spans() if s.name == "resilience/backoff"
+    ]
+    assert [s.attrs["attempt"] for s in backoffs] == [1, 2]
+    assert all(s.attrs["verdict"] == "transient" for s in backoffs)
+    assert all(s.attrs["backend"] == "testbe" for s in backoffs)
+    assert backoffs[-1].attrs["final_verdict"] == "success"
+    assert "final_verdict" not in backoffs[0].attrs
+
+
+def test_retry_exhaustion_stamps_final_verdict(traced):
+    from torchsnapshot_tpu.resilience.retry import (
+        SharedProgress,
+        classify_generic,
+        retry_call,
+    )
+
+    def doomed():
+        raise ConnectionError("always")
+
+    with knobs.override_retry_backoff_cap_s(0.001):
+        progress = SharedProgress(window_s=60.0, max_attempts=2, label="t2")
+        with pytest.raises(ConnectionError):
+            _run_coro(
+                retry_call(
+                    doomed, op_name="op", backend="testbe",
+                    classify=classify_generic, progress=progress,
+                )
+            )
+    backoffs = [
+        s for s in traced.spans() if s.name == "resilience/backoff"
+    ]
+    assert backoffs
+    assert backoffs[-1].attrs["final_verdict"] == "exhausted"
+
+
+def test_striped_write_per_part_slices_and_flow_arrows(tmp_path, traced):
+    """Perfetto keeps per-PART granularity for striped writes: each
+    stripe/stage_part slice carries a flow arrow to its matching
+    stripe/write_part slice, and part slices land on stripe stage
+    tracks (interval-partitioned) instead of thread tracks."""
+    path = str(tmp_path / "snap")
+    with knobs.override_stripe_part_size_bytes(1 << 16), (
+        knobs.override_stripe_min_object_size_bytes(1 << 16)
+    ):
+        Snapshot.take(
+            path,
+            {"app": StateDict(w=np.arange(1 << 18, dtype=np.float32))},
+        )
+    spans = traced.spans()
+    stage = [s for s in spans if s.name == "stripe/stage_part"]
+    write = [s for s in spans if s.name == "stripe/write_part"]
+    assert len(stage) == 16 and len(write) == 16
+    # one arrow per part: stage flow_out pairs with write flow_in
+    by_part_out = {s.attrs["part"]: s.flow_out for s in stage}
+    by_part_in = {s.attrs["part"]: s.flow_in for s in write}
+    assert by_part_out == by_part_in
+    assert all(fid is not None for fid in by_part_out.values())
+    doc = obs.to_trace_events(spans)
+    events = doc["traceEvents"]
+    # per-part slices on stripe tracks, not thread tracks
+    tracks = {
+        e["args"]["name"] for e in events
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert any(t.startswith("stripe/write_part") for t in tracks)
+    assert any(t.startswith("stripe/stage_part") for t in tracks)
+    # every part arrow survives the export as a matched s/f pair
+    flow_starts = {e["id"] for e in events if e["ph"] == "s"}
+    flow_ends = {e["id"] for e in events if e["ph"] == "f"}
+    assert set(by_part_out.values()) <= (flow_starts & flow_ends)
+
+
 def test_cli_trace_command(tmp_path, capsys):
     from torchsnapshot_tpu.__main__ import main
 

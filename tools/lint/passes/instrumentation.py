@@ -44,6 +44,13 @@ TARGETS: Dict[str, Dict[str, Set[str]]] = {
             "snapshot",
         },
     },
+    "torchsnapshot_tpu/tier/promoter.py": {
+        # the write-back promoter is a background actor whose queue
+        # transitions are exactly what an incident review reconstructs;
+        # pause/resume are test-only event flips with no I/O or queue
+        # effect — bracketing them would record noise, not signal
+        "Promoter": {"pause", "resume"},
+    },
 }
 
 # file (repo-relative) -> module-level functions that MUST be bracketed
@@ -68,6 +75,20 @@ MODULE_FUNCTIONS: Dict[str, Set[str]] = {
     # decode-side analogue of striped_read
     "torchsnapshot_tpu/codec.py": {
         "encode_frame_async", "framed_read",
+    },
+    # the distributed half of observability: these run on commit paths
+    # (publish/merge over the coordination KV, the obsrecord write/read)
+    # and MUST stay span-covered — a flight-record exchange that stalls
+    # a commit has to be attributable in the very traces it produces
+    "torchsnapshot_tpu/obs/aggregate.py": {
+        "publish", "exchange_and_merge", "write_obsrecord",
+        "read_obsrecord",
+    },
+    # goodput entry points run on every take (foreground + promoter
+    # threads); span coverage keeps their cost visible and their call
+    # points reconstructible from traces
+    "torchsnapshot_tpu/obs/goodput.py": {
+        "take_begin", "take_unblocked", "durable_commit",
     },
 }
 

@@ -2,6 +2,7 @@
 
     python -m torchsnapshot_tpu ls        <snapshot-path>
     python -m torchsnapshot_tpu stats     <snapshot-path> [--json] [--top N]
+    python -m torchsnapshot_tpu doctor    <snapshot-path> [--json] [--diff OTHER]
     python -m torchsnapshot_tpu manifest  <snapshot-path>
     python -m torchsnapshot_tpu verify    <snapshot-path> [--deep] [--rank N]
     python -m torchsnapshot_tpu steps     <manager-root>
@@ -232,6 +233,209 @@ def _cmd_stats(args) -> int:
             f"    {p:<{width}}  {detail:<28} "
             f"{_human(st['nbytes'])}{pieces_s}"
         )
+    return 0
+
+
+def _doctor_phase_rows(record) -> list:
+    """(rank, phase, seconds) rows from a record's per-rank rollups,
+    slowest rank first."""
+    rows = []
+    for rank, pr in sorted(
+        (record.get("per_rank") or {}).items(), key=lambda kv: int(kv[0])
+    ):
+        phases = pr.get("phases") or {}
+        total = sum(p.get("seconds", 0.0) for p in phases.values())
+        rows.append((int(rank), phases, total))
+    rows.sort(key=lambda r: -r[2])
+    return rows
+
+
+def _doctor_counters(record) -> dict:
+    """The incident-review counters a doctor run leads with."""
+    c = (record.get("merged") or {}).get("counters") or {}
+
+    def grab(prefix):
+        return {
+            k[len(prefix):]: v for k, v in c.items() if k.startswith(prefix)
+        }
+
+    codec_in = c.get("storage.codec.bytes_in", 0)
+    codec_out = c.get("storage.codec.bytes_out", 0)
+    return {
+        "bytes_staged": c.get("bytes_staged", 0),
+        "bytes_written": c.get("bytes_written", 0),
+        "bytes_read": c.get("bytes_read", 0),
+        "retries": c.get("resilience.retries", 0),
+        "retries_by_backend": {
+            k.split(".")[0]: v
+            for k, v in grab("resilience.").items()
+            if k.endswith(".retries")
+        },
+        "breaker_trips": c.get("resilience.breaker_trips", 0),
+        "aborts": c.get("resilience.aborts", 0),
+        "failpoints_fired": c.get("resilience.failpoints_fired", 0),
+        "stripe_parts_written": c.get("storage.stripe.parts_written", 0),
+        "stripe_aborts": c.get("storage.stripe.aborts", 0),
+        "codec_bytes_in": codec_in,
+        "codec_bytes_out": codec_out,
+        "codec_ratio": (
+            round(codec_in / codec_out, 3) if codec_out else None
+        ),
+        "exceptions_swallowed": c.get("exceptions.swallowed", 0),
+    }
+
+
+def _render_doctor(record) -> None:
+    print(
+        f"{record.get('path')}  [{record.get('op')}]  "
+        f"world_size={record.get('world_size')}"
+    )
+    missing = record.get("missing_ranks") or []
+    print(
+        f"  ranks reported: {record.get('ranks_reported')}"
+        + (f"  MISSING: {missing}" if missing else "")
+    )
+    gp = record.get("goodput") or {}
+    parts = []
+    for label, key in (
+        ("unblock", "time_to_unblock_s"),
+        ("durable-lag", "durability_lag_s"),
+        ("overhead", "overhead_fraction"),
+    ):
+        v = gp.get(key)
+        if v is not None:
+            parts.append(
+                f"{label} {v:.3f}s" if "fraction" not in key
+                else f"{label} {v:.1%}"
+            )
+    if parts:
+        print("  goodput: " + ", ".join(parts))
+    straggler = record.get("straggler")
+    if straggler:
+        print(
+            f"  straggler: rank {straggler['rank']} "
+            f"({straggler['phase']} phase, "
+            f"{straggler['seconds']:.3f}s; "
+            f"+{straggler.get('lead_over_peers_s', 0.0):.3f}s over peers)"
+        )
+    rows = _doctor_phase_rows(record)
+    if rows:
+        phases = sorted({p for _, ph, _ in rows for p in ph})
+        hdr = "  ".join(f"{p:>10}" for p in phases)
+        print(f"  {'rank':>6}  {hdr}  {'total':>10}")
+        for rank, ph, total in rows:
+            cells = "  ".join(
+                f"{ph.get(p, {}).get('seconds', 0.0):>10.3f}"
+                for p in phases
+            )
+            print(f"  {rank:>6}  {cells}  {total:>10.3f}")
+    c = _doctor_counters(record)
+    print(
+        f"  io: {_human(c['bytes_staged'])} staged, "
+        f"{_human(c['bytes_written'])} written, "
+        f"{_human(c['bytes_read'])} read"
+    )
+    health = (
+        f"  health: {c['retries']} retries, "
+        f"{c['breaker_trips']} breaker trips, {c['aborts']} aborts, "
+        f"{c['exceptions_swallowed']} swallowed"
+    )
+    if c["retries_by_backend"]:
+        health += f" (by backend: {c['retries_by_backend']})"
+    print(health)
+    if c["stripe_parts_written"] or c["stripe_aborts"]:
+        print(
+            f"  stripe: {c['stripe_parts_written']} parts written, "
+            f"{c['stripe_aborts']} aborts"
+        )
+    if c["codec_ratio"]:
+        print(
+            f"  codec: {_human(c['codec_bytes_in'])} raw -> "
+            f"{_human(c['codec_bytes_out'])} stored "
+            f"({c['codec_ratio']:.2f}x)"
+        )
+    slow = record.get("slow_objects") or []
+    if slow:
+        print("  slowest objects:")
+        for o in slow[:5]:
+            size = f" {_human(o['bytes'])}" if o.get("bytes") else ""
+            print(
+                f"    {o['path']}  [{o['phase']}]  "
+                f"{o['seconds']:.3f}s{size}"
+            )
+    else:
+        print(
+            "  slowest objects: (none recorded — run the take under "
+            "TORCHSNAPSHOT_TPU_TRACE=1 for object-level attribution)"
+        )
+
+
+def _doctor_diff(a, b) -> dict:
+    """Step-over-step comparison of two flight records: per-phase and
+    headline-counter deltas (b minus a)."""
+
+    def phase_totals(rec):
+        out = {}
+        for _, ph, _ in _doctor_phase_rows(rec):
+            for p, v in ph.items():
+                out[p] = out.get(p, 0.0) + v.get("seconds", 0.0)
+        return out
+
+    pa, pb = phase_totals(a), phase_totals(b)
+    ca, cb = _doctor_counters(a), _doctor_counters(b)
+    numeric = [
+        k for k in ca
+        if isinstance(ca.get(k), (int, float))
+        and isinstance(cb.get(k), (int, float))
+    ]
+    return {
+        "a": {"path": a.get("path"), "op": a.get("op")},
+        "b": {"path": b.get("path"), "op": b.get("op")},
+        "phases": {
+            p: {
+                "a_s": round(pa.get(p, 0.0), 6),
+                "b_s": round(pb.get(p, 0.0), 6),
+                "delta_s": round(pb.get(p, 0.0) - pa.get(p, 0.0), 6),
+            }
+            for p in sorted(set(pa) | set(pb))
+        },
+        "counters": {
+            k: {"a": ca[k], "b": cb[k], "delta": cb[k] - ca[k]}
+            for k in numeric
+        },
+        "straggler": {"a": a.get("straggler"), "b": b.get("straggler")},
+        "goodput": {"a": a.get("goodput"), "b": b.get("goodput")},
+    }
+
+
+def _cmd_doctor(args) -> int:
+    """Render a snapshot's persisted flight record (.snapshot_obsrecord):
+    who was slow, in which phase, what the retry/breaker/codec layers
+    did — the post-hoc "why was step N slow, and on which rank?" answer
+    without a re-run.  --diff compares two records step-over-step."""
+    from .obs import aggregate
+
+    record = aggregate.read_obsrecord(args.path)
+    if args.diff:
+        diff = _doctor_diff(record, aggregate.read_obsrecord(args.diff))
+        if args.json:
+            print(json.dumps(diff, indent=2))
+            return 0
+        print(f"diff: {args.path} -> {args.diff}")
+        print(f"  {'phase':>10}  {'a':>10}  {'b':>10}  {'delta':>10}")
+        for p, d in diff["phases"].items():
+            print(
+                f"  {p:>10}  {d['a_s']:>10.3f}  {d['b_s']:>10.3f}  "
+                f"{d['delta_s']:>+10.3f}"
+            )
+        for k, d in diff["counters"].items():
+            if d["delta"]:
+                print(f"  {k}: {d['a']} -> {d['b']} ({d['delta']:+})")
+        return 0
+    if args.json:
+        print(json.dumps(record, indent=2))
+        return 0
+    _render_doctor(record)
     return 0
 
 
@@ -499,6 +703,20 @@ def main(argv=None) -> int:
     p.add_argument("--top", type=int, default=10,
                    help="how many largest entries to list (default 10)")
     p.set_defaults(fn=_cmd_stats)
+
+    p = sub.add_parser(
+        "doctor",
+        help="render a snapshot's flight record (.snapshot_obsrecord): "
+        "straggler rank + phase, per-rank phase timings, retries, "
+        "breaker trips, codec ratio, goodput",
+    )
+    p.add_argument("path")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--diff", default=None, metavar="OTHER",
+                   help="compare against OTHER snapshot's record "
+                   "(step-over-step)")
+    p.set_defaults(fn=_cmd_doctor)
 
     p = sub.add_parser(
         "trace",

@@ -113,6 +113,19 @@ class Coordinator(abc.ABC):
         (the native barrier wait is opaque and can't poll poison)."""
         name = name or self._next_uid("bar")
         failpoint("coord.barrier", name=name)
+        # always-on barrier phase clock: the flight record's straggler
+        # attribution (obs/aggregate) reads this rank's cumulative
+        # barrier-wait seconds — a fast rank's take time hides in here
+        # while it waits for the straggler
+        t0 = time.monotonic()
+        try:
+            self._barrier_inner(name, timeout_s)
+        finally:
+            obs.histogram(obs.PHASE_BARRIER_S).observe(
+                time.monotonic() - t0
+            )
+
+    def _barrier_inner(self, name: str, timeout_s: float) -> None:
         scope = self._current_abort_scope()
         if scope is None:
             self._barrier_impl(name, timeout_s)

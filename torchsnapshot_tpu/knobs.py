@@ -51,6 +51,7 @@ _STRIPE_MIN_OBJECT_SIZE_BYTES = "STRIPE_MIN_OBJECT_SIZE_BYTES"
 _CODEC = "CODEC"
 _CODEC_LEVEL = "CODEC_LEVEL"
 _CODEC_MIN_RATIO = "CODEC_MIN_RATIO"
+_METRICS_TEXTFILE = "METRICS_TEXTFILE"
 _TIER_POLICY = "TIER_POLICY"
 _TIER_FAST_KEEP_LAST_N = "TIER_FAST_KEEP_LAST_N"
 _TIER_VERIFY_FAST_READS = "TIER_VERIFY_FAST_READS"
@@ -222,6 +223,11 @@ _DEFAULTS = {
     # raw_size >= CODEC_MIN_RATIO * frame_size — incompressible parts
     # stay raw (zero decode dependency, one 24-byte header).
     _CODEC_MIN_RATIO: 1.05,
+    # Prometheus textfile export (obs/export.py): when set to a path,
+    # take/restore/async-commit dump the metrics registry there in the
+    # text exposition format on their way out (atomic tmp+rename), for
+    # node_exporter textfile collectors.  Empty = off.
+    _METRICS_TEXTFILE: "",
     # Default policy for tiered storage (tier/) when the tier options
     # don't name one: "write_back" acks a take when the FAST tier
     # commits and promotes to the durable tier in the background (the
@@ -488,6 +494,15 @@ def get_codec_min_ratio() -> float:
     return max(1.0, float(_get_raw(_CODEC_MIN_RATIO)))
 
 
+def get_metrics_textfile() -> Optional[str]:
+    """Path for the OpenMetrics textfile dump, or None when export is
+    off (the default).  This is the ONLY sanctioned read of
+    TORCHSNAPSHOT_TPU_METRICS_TEXTFILE (tools/lint knob-registry
+    pass)."""
+    v = str(_get_raw(_METRICS_TEXTFILE) or "").strip()
+    return v or None
+
+
 def get_tier_policy() -> str:
     v = str(_get_raw(_TIER_POLICY)).lower()
     if v not in ("write_back", "write_through"):
@@ -661,6 +676,10 @@ def override_codec_level(value: int):
 
 def override_codec_min_ratio(value: float):
     return _override(_CODEC_MIN_RATIO, value)
+
+
+def override_metrics_textfile(value):
+    return _override(_METRICS_TEXTFILE, value or "")
 
 
 def override_tier_policy(value: str):

@@ -21,11 +21,11 @@ Key differences from the reference, by design:
 from __future__ import annotations
 
 import json
-import re
-import zlib
 from base64 import b64decode, b64encode
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Union
+
+from .utils.selfcrc import append_crc_trailer, strip_crc_trailer
 
 MANIFEST_VERSION = "0.1.0"
 
@@ -439,51 +439,19 @@ class SnapshotMetadata:
     # carries the self-checksum trailer; ``to_json`` stays the pure
     # document form (used for display / tests).
     def to_yaml(self) -> str:
-        body = self.to_json()
-        return f"{body}{_META_CRC_MARKER}{zlib.crc32(body.encode()):08x}"
+        return append_crc_trailer(self.to_json(), _META_CRC_MARKER)
 
     @classmethod
     def from_yaml(cls, s: str) -> "SnapshotMetadata":
-        body, marker, trailer = s.rpartition(_META_CRC_MARKER)
-        if marker:
-            t = trailer.strip()
-            # exactly 8 lowercase hex digits (the writer's %08x): a
-            # sloppy parse (int(x, 16)) would accept case-flipped,
-            # "0x"-prefixed, signed, or "_"-separated variants,
-            # breaking the every-bit-flip-fails property
-            recorded = None
-            if re.fullmatch(r"[0-9a-f]{8}", t):
-                recorded = int(t, 16)
-            actual = zlib.crc32(body.encode())
-            if recorded != actual:
-                shown = (
-                    f"recorded {recorded:#010x}"
-                    if recorded is not None
-                    else f"unparseable trailer {t[:24]!r}"
-                )
-                raise RuntimeError(
-                    "metadata checksum mismatch: .snapshot_metadata is "
-                    f"corrupt ({shown}, actual {actual:#010x})"
-                )
-            s = body
-        else:
-            # trailer absent — but a flip inside the MARKER BYTES
-            # themselves must not silently downgrade to the unverified
-            # legacy path (the YAML fallback would treat the mangled
-            # trailer as a comment and load the document unchecked).
-            # Structural anchor: our writer's only comment is the final
-            # trailer line, so a trailer-SHAPED final line ('#...') that
-            # failed the exact-marker match is corruption, not legacy.
-            # (Hand-written YAML ending in a comment line is rejected
-            # with this clear error — an accepted trade against a
-            # silent integrity downgrade.)
-            last_line = s[s.rfind("\n") + 1:].strip()
-            if last_line.startswith("#"):
-                raise RuntimeError(
-                    "metadata checksum mismatch: final line is "
-                    "trailer-shaped but does not match the expected "
-                    "marker — corrupt .snapshot_metadata trailer"
-                )
+        # shared trailer discipline (utils/selfcrc.py): strict %08x hex,
+        # every-bit-flip-fails, and a trailer-SHAPED final line that
+        # fails the marker match is corruption — never a silent
+        # downgrade to the unverified legacy parse.  (Hand-written YAML
+        # ending in a comment line is rejected with a clear error — an
+        # accepted trade against a silent integrity downgrade.)
+        s, _ = strip_crc_trailer(
+            s, _META_CRC_MARKER, "metadata", ".snapshot_metadata"
+        )
         # legacy/hand-written/plain-YAML metadata file — parse as
         # before, no self-check available
         try:

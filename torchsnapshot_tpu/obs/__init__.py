@@ -25,6 +25,16 @@ from typing import Any
 
 from .metrics import (  # noqa: F401
     CODEC_BYTES_IN,
+    GOODPUT_DURABILITY_LAG_S,
+    GOODPUT_OVERHEAD_FRACTION,
+    GOODPUT_TIME_TO_UNBLOCK_S,
+    PHASE_BARRIER_S,
+    PHASE_CONSUME_S,
+    PHASE_ENCODE_S,
+    PHASE_PREFIX,
+    PHASE_READ_S,
+    PHASE_STAGE_S,
+    PHASE_WRITE_S,
     CODEC_BYTES_OUT,
     CODEC_PARTS_DECODED,
     CODEC_PARTS_ENCODED,
@@ -75,6 +85,11 @@ from .metrics import (  # noqa: F401
     record_storage_io,
     reset_metrics,
 )
+from .export import (  # noqa: F401
+    export_openmetrics,
+    maybe_write_metrics_textfile,
+    write_metrics_textfile,
+)
 from .perfetto import to_trace_events, write_trace  # noqa: F401
 from .tracer import (  # noqa: F401
     Span,
@@ -110,7 +125,17 @@ __all__ = [
     "write_trace",
     "REGISTRY",
     "MetricsRegistry",
+    "aggregate",
+    "goodput",
+    "export_openmetrics",
+    "write_metrics_textfile",
+    "maybe_write_metrics_textfile",
 ]
+
+# The distributed/persistent half (cross-rank aggregation + flight
+# records) and the goodput tracker are reached as submodules:
+# ``obs.aggregate.read_obsrecord(...)``, ``obs.goodput.block()``.
+from . import aggregate, goodput  # noqa: E402,F401
 
 
 _swallow_logger = logging.getLogger(__name__)

@@ -93,6 +93,27 @@ CODEC_BYTES_OUT = "storage.codec.bytes_out"
 CODEC_PARTS_ENCODED = "storage.codec.parts_encoded"
 CODEC_PARTS_RAW_FALLBACK = "storage.codec.parts_raw_fallback"
 CODEC_PARTS_DECODED = "storage.codec.parts_decoded"
+# Phase timing (cross-rank straggler attribution, obs/aggregate.py):
+# always-on histograms of where a take/restore spent its wall time on
+# THIS rank.  One observe per pipeline task / coordination wait — cheap
+# enough to leave running; per-operation deltas ride the flight-record
+# exchange so rank 0 can name "rank 3, write phase" without a re-run.
+# stage/encode/write are take-side, read/consume restore-side, barrier
+# covers coordination waits in both directions.
+PHASE_STAGE_S = "phase.stage_s"
+PHASE_ENCODE_S = "phase.encode_s"
+PHASE_WRITE_S = "phase.write_s"
+PHASE_READ_S = "phase.read_s"
+PHASE_CONSUME_S = "phase.consume_s"
+PHASE_BARRIER_S = "phase.barrier_s"
+PHASE_PREFIX = "phase."
+# Goodput/SLO accounting (obs/goodput.py): how long the training loop
+# was blocked by the last checkpoint, last take→durable-commit lag
+# (covers write-back promotion), and the cumulative fraction of wall
+# time spent blocked on checkpointing since the first take.
+GOODPUT_TIME_TO_UNBLOCK_S = "goodput.time_to_unblock_s"
+GOODPUT_DURABILITY_LAG_S = "goodput.durability_lag_s"
+GOODPUT_OVERHEAD_FRACTION = "goodput.overhead_fraction"
 # GC/retention: bytes of storage objects reclaimed by delete_snapshot
 GC_BYTES_RECLAIMED = "snapshot.gc.bytes_reclaimed"
 # Resilience (resilience/): transient-error retries (total, plus

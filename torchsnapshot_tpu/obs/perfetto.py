@@ -28,8 +28,12 @@ from typing import Any, Dict, List, Optional
 from .tracer import Span, Tracer, get_tracer
 
 # Span-name prefixes that get one track per NAME (the pipeline stages);
-# anything else is tracked by its recording thread.
-_STAGE_PREFIXES = ("pipeline/", "storage/", "offload/")
+# anything else is tracked by its recording thread.  ``stripe/`` is
+# here so per-PART slices (stripe/stage_part, stripe/write_part) land
+# on stage tracks with interval partitioning — on thread tracks the
+# concurrent parts of one object would violate complete-event nesting
+# and striped pipelining would be invisible.
+_STAGE_PREFIXES = ("pipeline/", "storage/", "offload/", "stripe/")
 
 
 def _track_key(s: Span) -> str:

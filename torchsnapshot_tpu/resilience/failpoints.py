@@ -29,7 +29,12 @@ over-fire a bounded spec.
 Instrumented sites (kept in sync with docs/resilience.md):
 ``storage.{fs,s3,gcs,memory}.{write,read}``, ``storage.fs.write.sync``,
 ``scheduler.{stage,write,read}``, ``coord.{kv_set,kv_get,barrier}``,
-``tier.promote.{data,commit}``.
+``tier.promote.{data,commit}``, ``obs.publish``.
+
+Beyond the raising kinds, ``delay<ms>`` (e.g. ``delay250``) SLEEPS at
+the site instead of raising — deterministic injected slowness for
+straggler-attribution tests, where the flight record must name the
+delayed rank and phase without any failure in the run.
 """
 
 from __future__ import annotations
@@ -86,6 +91,20 @@ _ERROR_KINDS = {
     "runtime": lambda s: RuntimeError(f"injected failure at {s}"),
 }
 
+# delay<ms>: sleep instead of raise (injected slowness, not failure)
+_DELAY_RE = None  # compiled lazily below
+
+
+def _delay_ms(kind: str):
+    """Milliseconds for a ``delay<ms>`` kind, or None for raising kinds."""
+    global _DELAY_RE
+    if _DELAY_RE is None:
+        import re
+
+        _DELAY_RE = re.compile(r"delay(\d+)$")
+    m = _DELAY_RE.fullmatch(kind)
+    return int(m.group(1)) if m else None
+
 
 @dataclasses.dataclass
 class _Armed:
@@ -116,10 +135,10 @@ def parse_failpoints(spec: str, seed: int = 0) -> List[_Armed]:
         site, _, rhs = raw.partition("=")
         parts = rhs.split(":")
         kind = parts[0].strip().lower()
-        if kind not in _ERROR_KINDS:
+        if kind not in _ERROR_KINDS and _delay_ms(kind) is None:
             raise ValueError(
                 f"failpoint spec {raw!r}: unknown error kind {kind!r} "
-                f"(known: {sorted(_ERROR_KINDS)})"
+                f"(known: {sorted(_ERROR_KINDS)} or delay<ms>)"
             )
         probability = 1.0
         if len(parts) > 1 and parts[1].strip():
@@ -198,6 +217,18 @@ def failpoint(site: str, **attrs) -> None:
             if fp.remaining is not None:
                 fp.remaining -= 1
         obs.counter(obs.RESILIENCE_FAILPOINTS_FIRED).inc()
+        ms = _delay_ms(fp.kind)
+        if ms is not None:
+            # injected slowness: sleep and keep evaluating the remaining
+            # specs — the site proceeds normally, just late
+            logger.info(
+                "failpoint %s delayed %s by %dms (%s)",
+                fp.pattern, site, ms, attrs,
+            )
+            import time
+
+            time.sleep(ms / 1000.0)
+            continue
         exc = _ERROR_KINDS[fp.kind](site)
         logger.info(
             "failpoint %s fired at %s (%s): %r", fp.pattern, site, attrs, exc

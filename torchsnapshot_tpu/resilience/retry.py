@@ -149,6 +149,17 @@ async def _retry_loop(
 ) -> Any:
     loop = asyncio.get_running_loop() if executor is not None else None
     attempt = 0
+    # the most recent backoff span: the retry sequence's FINAL verdict
+    # (success / fatal / exhausted) is stamped onto it when the loop
+    # resolves, so a trace shows how each backoff chain ended without
+    # correlating spans by hand (the Span object stays referenced by
+    # the tracer, so post-close attr stamps reach the export)
+    last_backoff_span = None
+
+    def _stamp_final(verdict: str) -> None:
+        if last_backoff_span is not None:
+            last_backoff_span.attrs["final_verdict"] = verdict
+
     while True:
         try:
             if executor is not None:
@@ -160,6 +171,7 @@ async def _retry_loop(
             progress.record_progress()
             if breaker is not None:
                 breaker.record_success()
+            _stamp_final("success")
             return result
         except FileNotFoundError:
             # missing is an answer, not a backend failure (but a
@@ -193,6 +205,7 @@ async def _retry_loop(
             if verdict == FATAL:
                 if breaker is not None:
                     breaker.record_failure()
+                _stamp_final("fatal")
                 raise
             attempt += 1
             obs.counter(obs.RESILIENCE_RETRIES).inc()
@@ -200,15 +213,21 @@ async def _retry_loop(
             if not progress.should_retry(attempt):
                 if breaker is not None:
                     breaker.record_failure()
+                _stamp_final("exhausted")
                 raise
             logger.warning(
                 "%s %s failed (attempt %d, retrying): %r",
                 backend, op_name, attempt, e,
             )
+            # attempt + triggering verdict ride the span so a trace can
+            # reconstruct each backoff chain without log correlation
             with obs.span(
                 "resilience/backoff",
                 backend=backend, op=op_name, attempt=attempt,
-            ):
+                verdict=verdict,
+            ) as sp:
+                if sp is not None:
+                    last_backoff_span = sp
                 await progress.backoff(attempt)
 
 

@@ -418,6 +418,11 @@ async def _execute_write_pipelines(
     m_deduped = obs_metrics.counter(obs_metrics.BYTES_DEDUPED)
     m_budget = obs_metrics.gauge(obs_metrics.BUDGET_BYTES_IN_USE)
     m_ioq = obs_metrics.gauge(obs_metrics.IO_QUEUE_DEPTH)
+    # always-on phase clocks: per-operation deltas of these feed the
+    # cross-rank flight record's straggler attribution (obs/aggregate)
+    m_phase_stage = obs_metrics.histogram(obs_metrics.PHASE_STAGE_S)
+    m_phase_encode = obs_metrics.histogram(obs_metrics.PHASE_ENCODE_S)
+    m_phase_write = obs_metrics.histogram(obs_metrics.PHASE_WRITE_S)
     tracer = obs_tracer.get_tracer()
     adm_spans: dict = {}
     flow_ids: dict = {}
@@ -453,6 +458,9 @@ async def _execute_write_pipelines(
         return p
 
     async def _stage_one_inner(p: _WritePipeline) -> _WritePipeline:
+        # clock starts BEFORE the failpoint so injected delay<ms>
+        # slowness lands in the phase the flight record attributes
+        t_stage = time.perf_counter()
         failpoint("scheduler.stage", path=p.write_req.path)
         p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
         p.buf_size = _buf_nbytes(p.buf)
@@ -481,6 +489,7 @@ async def _execute_write_pipelines(
                 # the digest first), and slab writes already fold from
                 # the pack's per-member digests.
                 p.defer_digest = True
+                m_phase_stage.observe(time.perf_counter() - t_stage)
                 return p
             # content checksums into the manifest (entries are serialized
             # at commit, strictly after staging completes) — off-loop,
@@ -493,6 +502,7 @@ async def _execute_write_pipelines(
                 wr.digest_sink,
                 precomputed,
             )
+        m_phase_stage.observe(time.perf_counter() - t_stage)
         if will_encode and not (
             wr.dedup is not None and wr.object_digest == wr.dedup[1]
         ):
@@ -502,8 +512,10 @@ async def _execute_write_pipelines(
             # correction, bytes_written stats) sees STORED bytes.  A
             # write whose dedup digest matched the base skips encoding
             # entirely — it will link, not move bytes.
+            t_enc = time.perf_counter()
             p.buf = await _encode_staged_buffer(p, wr, codec_spec, executor)
             p.buf_size = _buf_nbytes(p.buf)
+            m_phase_encode.observe(time.perf_counter() - t_enc)
         return p
 
     async def write_one(p: _WritePipeline) -> _WritePipeline:
@@ -514,7 +526,11 @@ async def _execute_write_pipelines(
                 fid = flow_ids.pop(id(p), None)
                 if fid is not None:
                     sp.flow_in = fid
-            return await _write_one_inner(p)
+            t_write = time.perf_counter()
+            try:
+                return await _write_one_inner(p)
+            finally:
+                m_phase_write.observe(time.perf_counter() - t_write)
 
     async def _write_one_inner(p: _WritePipeline) -> _WritePipeline:
         failpoint("scheduler.write", path=p.write_req.path)
@@ -923,6 +939,9 @@ async def _execute_read_pipelines(
     m_read = obs_metrics.counter(obs_metrics.BYTES_READ)
     m_budget = obs_metrics.gauge(obs_metrics.BUDGET_BYTES_IN_USE_READ)
     m_ioq = obs_metrics.gauge(obs_metrics.IO_QUEUE_DEPTH_READ)
+    # restore-side phase clocks (flight-record straggler attribution)
+    m_phase_read = obs_metrics.histogram(obs_metrics.PHASE_READ_S)
+    m_phase_consume = obs_metrics.histogram(obs_metrics.PHASE_CONSUME_S)
     tracer = obs_tracer.get_tracer()
     adm_spans: dict = {}
     if obs_tracer.ENABLED:
@@ -978,45 +997,53 @@ async def _execute_read_pipelines(
             cost=p.consuming_cost,
             op="read",
         ) as sp:
+            # clock before failpoint: injected delay must be attributed
+            t_read = time.perf_counter()
             failpoint("scheduler.read", path=p.read_req.path)
-            rr = p.read_req
-            table = codec_tables.get(rr.path) if codec_tables else None
-            if table is not None:
-                # codec-encoded object (codec.py): the byte range is a
-                # RAW range — map it to the overlapping frames, read
-                # them as parallel ranged GETs and decode concurrently
-                # on the consume executor.  Subsumes the striped-read
-                # fan-out (frames ARE the parts).
-                p.buf = await codec_mod.framed_read(
-                    storage,
-                    rr.path,
-                    table,
-                    byte_range=rr.byte_range,
-                    into=rr.into,
-                    executor=executor,
-                )
-                if sp is not None:
-                    sp.attrs["codec"] = table.get("codec")
-                    sp.attrs["bytes"] = _buf_nbytes(p.buf)
-                return p
-            if stripe.read_eligible(
-                rr.byte_range[1] - rr.byte_range[0]
-                if rr.byte_range is not None
-                else p.consuming_cost
-            ) and await _striped_read(p, sp):
-                if sp is not None:
-                    sp.attrs["bytes"] = _buf_nbytes(p.buf)
-                return p
-            read_io = ReadIO(
-                path=rr.path,
+            try:
+                return await _read_one_inner(p, sp)
+            finally:
+                m_phase_read.observe(time.perf_counter() - t_read)
+
+    async def _read_one_inner(p: _ReadPipeline, sp) -> _ReadPipeline:
+        rr = p.read_req
+        table = codec_tables.get(rr.path) if codec_tables else None
+        if table is not None:
+            # codec-encoded object (codec.py): the byte range is a
+            # RAW range — map it to the overlapping frames, read
+            # them as parallel ranged GETs and decode concurrently
+            # on the consume executor.  Subsumes the striped-read
+            # fan-out (frames ARE the parts).
+            p.buf = await codec_mod.framed_read(
+                storage,
+                rr.path,
+                table,
                 byte_range=rr.byte_range,
                 into=rr.into,
+                executor=executor,
             )
-            await storage.read(read_io)
-            p.buf = read_io.buf
+            if sp is not None:
+                sp.attrs["codec"] = table.get("codec")
+                sp.attrs["bytes"] = _buf_nbytes(p.buf)
+            return p
+        if stripe.read_eligible(
+            rr.byte_range[1] - rr.byte_range[0]
+            if rr.byte_range is not None
+            else p.consuming_cost
+        ) and await _striped_read(p, sp):
             if sp is not None:
                 sp.attrs["bytes"] = _buf_nbytes(p.buf)
             return p
+        read_io = ReadIO(
+            path=rr.path,
+            byte_range=rr.byte_range,
+            into=rr.into,
+        )
+        await storage.read(read_io)
+        p.buf = read_io.buf
+        if sp is not None:
+            sp.attrs["bytes"] = _buf_nbytes(p.buf)
+        return p
 
     async def consume_one(p: _ReadPipeline) -> _ReadPipeline:
         with obs_tracer.span(
@@ -1028,6 +1055,7 @@ async def _execute_read_pipelines(
                 # actual size, not the pre-read estimate (object entries
                 # declare cost 1) — p.buf is released below, measure now
                 sp.attrs["bytes"] = _buf_nbytes(p.buf)
+            t_consume = time.perf_counter()
             if (
                 p.read_req.expected_crc32 is not None
                 and knobs.verify_on_restore()
@@ -1037,6 +1065,7 @@ async def _execute_read_pipelines(
                 )
             await p.read_req.buffer_consumer.consume_buffer(p.buf, executor)
             p.buf = None
+            m_phase_consume.observe(time.perf_counter() - t_consume)
             return p
 
     try:

@@ -464,6 +464,10 @@ class Snapshot:
             Event("take", {"path": path, "rank": coordinator.rank})
         ) as take_event:
             stamp_stripe = _stripe_event_stamp()
+            # flight-record window + goodput clock both start here so
+            # the persisted record describes exactly this take
+            obs_before = obs.aggregate.capture()
+            gp_begin = obs.goodput.take_begin(path)
             (
                 metadata, pending_io, storage, commit_uid,
                 local_entries, object_crcs, object_codecs,
@@ -503,11 +507,38 @@ class Snapshot:
                     else:
                         crc_maps = [local_crcs]
                     _merge_crc_payloads(metadata, crc_maps)
+                    # flight record, publish half: this rank's metrics
+                    # delta + phase rollup ride the KV under explicit
+                    # keys.  Best-effort — a lost payload degrades the
+                    # record to a partial one, never the commit.
+                    obs.aggregate.publish(
+                        coordinator,
+                        commit_uid,
+                        obs.aggregate.rank_payload(
+                            coordinator.rank, "take", obs_before
+                        ),
+                    )
                     # commit: all ranks done writing → rank 0 writes
                     # metadata (reference snapshot.py:202-209)
                     coordinator.barrier()
                     if coordinator.rank == 0:
                         coordinator.raise_if_poisoned(commit_uid)
+                        # flight record, merge half: every surviving
+                        # rank published before the barrier above, so
+                        # the merge sees them all; the record lands
+                        # strictly BEFORE the commit marker (an
+                        # interrupted write leaves an uncommitted
+                        # snapshot with a record, never the reverse)
+                        try:
+                            obs.aggregate.write_obsrecord(
+                                storage,
+                                obs.aggregate.collect_and_merge(
+                                    coordinator, commit_uid,
+                                    op="take", path=path,
+                                ),
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            obs.swallowed_exception("take.obsrecord", e)
                         # durable: the commit point must survive a host
                         # crash — a synced metadata file is the
                         # definition of "committed"
@@ -531,6 +562,14 @@ class Snapshot:
             finally:
                 stamp_stripe(take_event)
                 storage.sync_close()
+            # goodput: a sync take's unblock point is its return; the
+            # durable commit just happened too — except under a
+            # write-back tier, where the promoter reports it when the
+            # DURABLE metadata marker lands
+            if getattr(storage, "policy", None) != "write_back":
+                obs.goodput.durable_commit(path)
+            obs.goodput.take_unblocked(path, gp_begin)
+            obs.maybe_write_metrics_textfile()
         snapshot = cls(path, coordinator, storage_options=storage_options)
         snapshot._metadata_cache = metadata
         return snapshot
@@ -558,6 +597,8 @@ class Snapshot:
         with log_event(
             Event("async_take", {"path": path, "rank": coordinator.rank})
         ):
+            obs_before = obs.aggregate.capture()
+            gp_begin = obs.goodput.take_begin(path)
             (
                 metadata, pending_io, storage, commit_uid,
                 local_entries, object_crcs, object_codecs,
@@ -566,7 +607,7 @@ class Snapshot:
                 is_async=True, base=base, leaf_transform=leaf_transform,
                 storage_options=storage_options,
             )
-        return PendingSnapshot(
+        pending = PendingSnapshot(
             path=path,
             metadata=metadata,
             pending_io_work=pending_io,
@@ -577,7 +618,13 @@ class Snapshot:
             object_crcs=object_crcs,
             object_codecs=object_codecs,
             storage_options=storage_options,
+            obs_before=obs_before,
         )
+        # goodput: the unblock point IS this return — training state is
+        # independent of the snapshot from here; staging/IO/commit (and
+        # the flight-record exchange) drain in the background
+        obs.goodput.take_unblocked(path, gp_begin)
+        return pending
 
     @classmethod
     def _take_impl(
@@ -1115,6 +1162,7 @@ class Snapshot:
             Event("restore", {"path": self.path, "rank": rank})
         ) as restore_event:
             stamp_stripe = _stripe_event_stamp()
+            obs_before = obs.aggregate.capture()
             # abort-aware restore: the scope uid is agreed up front (the
             # per-instance uid counter runs in the same program order on
             # every rank), and covers EVERYTHING that can fail — even a
@@ -1152,6 +1200,21 @@ class Snapshot:
                             )
                         if world > 1:
                             coordinator.barrier()
+                    # restore flight record: cross-rank merge only (no
+                    # persistence — the snapshot may live on read-only
+                    # storage); rank 0 keeps the merged record
+                    # in-process (obs.aggregate.last_record("restore")).
+                    # All ranks just left the final barrier, so the
+                    # single-phase exchange converges in one KV round.
+                    obs.aggregate.exchange_and_merge(
+                        coordinator,
+                        abort_uid,
+                        obs.aggregate.rank_payload(
+                            rank, "restore", obs_before
+                        ),
+                        op="restore",
+                        path=self.path,
+                    )
             except SnapshotAbortedError:
                 raise
             except BaseException as e:
@@ -1163,6 +1226,7 @@ class Snapshot:
                 stamp_stripe(restore_event)
                 if storage is not None:
                     storage.sync_close()
+            obs.maybe_write_metrics_textfile()
 
     def _load_stateful(
         self,
@@ -1501,9 +1565,14 @@ class PendingSnapshot:
         object_crcs: Optional[Dict[str, int]] = None,
         object_codecs: Optional[Dict[str, Any]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
+        obs_before: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.path = path
         self._storage_options = storage_options
+        # metrics capture at async_take entry: the commit thread deltas
+        # against it after the background drain, so the flight record
+        # covers staging + I/O that ran after the caller unblocked
+        self._obs_before = obs_before or obs.aggregate.capture()
         self._metadata = metadata
         self._pending_io_work = pending_io_work
         self._storage = storage
@@ -1586,6 +1655,14 @@ class PendingSnapshot:
                     coord.kv_set(f"{uid}/crcs/{rank}", "{}")
             else:
                 coord.kv_set(f"{uid}/crcs/{rank}", "{}")
+            # flight record, publish half: before arrive, so rank 0's
+            # post-arrival merge always finds every surviving rank's
+            # payload.  Best-effort by contract.
+            obs.aggregate.publish(
+                coord,
+                uid,
+                obs.aggregate.rank_payload(rank, "take", self._obs_before),
+            )
             coord.kv_set(f"{uid}/arrive/{rank}", status)
             if rank == 0:
                 # ALWAYS set the depart key, even if the metadata write
@@ -1623,6 +1700,21 @@ class PendingSnapshot:
                                 "crc merge failed; committing without "
                                 "checksums", exc_info=True,
                             )
+                        # flight record, merge half: every surviving
+                        # rank published before its arrive key, and
+                        # all arrive keys were read above — persist
+                        # the merged record BEFORE the commit marker
+                        try:
+                            obs.aggregate.write_obsrecord(
+                                self._storage,
+                                obs.aggregate.collect_and_merge(
+                                    coord, uid, op="take", path=self.path,
+                                ),
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            obs.swallowed_exception(
+                                "async_commit.obsrecord", e
+                            )
                         # durable-commit invariant: never write the
                         # commit marker after the scope was poisoned
                         coord.raise_if_poisoned(uid)
@@ -1646,6 +1738,13 @@ class PendingSnapshot:
                 self._exc = RuntimeError(
                     f"async snapshot commit failed: {depart}"
                 )
+            if depart == "ok" and (
+                getattr(self._storage, "policy", None) != "write_back"
+            ):
+                # goodput: the durable marker just landed (write-back
+                # tiers report from the promoter's metadata copy
+                # instead)
+                obs.goodput.durable_commit(self.path)
         except BaseException as e:  # noqa: BLE001
             if self._exc is None:
                 self._exc = e
@@ -1655,6 +1754,7 @@ class PendingSnapshot:
             # outlive the commit arbitrarily (e.g. held by a manager's
             # sweep list), so drop them the moment they're consumed
             self._pending_io_work = None
+            obs.maybe_write_metrics_textfile()
             try:
                 self._storage.sync_close()
             except Exception:

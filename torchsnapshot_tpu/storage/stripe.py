@@ -261,6 +261,13 @@ async def streamed_part_write(
     backend = _backend_name(storage)
     total = spans[-1][1]
     m_part_lat = obs.histogram(obs.STRIPE_PART_WRITE_LATENCY_S)
+    # per-part phase clocks: streamed parts never pass through the
+    # scheduler's stage_one/write_one (where the whole-object phase
+    # observations live), so the part IS the phase unit here — these
+    # feed the flight record's straggler attribution (obs/aggregate)
+    m_phase_stage = obs.histogram(obs.PHASE_STAGE_S)
+    m_phase_encode = obs.histogram(obs.PHASE_ENCODE_S)
+    m_phase_write = obs.histogram(obs.PHASE_WRITE_S)
     # byte-granular window: capacity equals the scheduler's reservation
     # (window_parts full-size parts).  Without a codec every part holds
     # its raw size from stage to write-complete — identical admission
@@ -318,11 +325,23 @@ async def streamed_part_write(
             await gate.acquire(hi - lo)
             held = hi - lo
             try:
+                flow_id = None
+                # clock before the failpoint: injected delay<ms>
+                # slowness must land in the stage phase it simulates
+                t_stage = time.perf_counter()
                 failpoint("scheduler.stage.part", path=path, part=idx)
                 with obs.span(
                     "stripe/stage_part", path=path, part=idx, bytes=hi - lo
-                ):
+                ) as stage_sp:
                     piece = await stager.stage_part(span, executor)
+                    if stage_sp is not None:
+                        # Perfetto flow arrow anchor: this part's stage
+                        # slice links to its write slice below, so the
+                        # stage→write pipelining of a striped object is
+                        # visible per PART in the trace, not just as
+                        # one object-level arrow
+                        flow_id = stage_sp.flow_out = obs.next_flow_id()
+                m_phase_stage.observe(time.perf_counter() - t_stage)
                 if on_part_staged is not None:
                     on_part_staged(hi - lo)
                 if want_digests and not fuse:
@@ -338,6 +357,7 @@ async def streamed_part_write(
                     # (raw digest above ran on the raw bytes), resolve
                     # this frame's offset from the cascade, and release
                     # the raw part the moment the frame exists
+                    t_enc = time.perf_counter()
                     frame = await codec_mod.encode_frame_async(
                         memoryview(piece).cast("B"),
                         codec_spec,
@@ -353,6 +373,7 @@ async def streamed_part_write(
                             else 0
                         ),
                     )
+                    m_phase_encode.observe(time.perf_counter() - t_enc)
                     del piece
                     frame_lens[idx] = len(frame)
                     # the raw part is gone; return the bytes the frame
@@ -378,11 +399,14 @@ async def streamed_part_write(
                 t0 = time.perf_counter()
                 with obs.span(
                     "stripe/write_part", path=path, part=idx, bytes=nbytes
-                ):
+                ) as write_sp:
+                    if write_sp is not None and flow_id is not None:
+                        write_sp.flow_in = flow_id
                     d = await handle.write_part(
                         idx, offset, piece, want_digest=fuse
                     )
                 dt = time.perf_counter() - t0
+                m_phase_write.observe(dt)
                 if fuse:
                     if d is not None:
                         digests[idx] = (d[0], d[1], hi - lo)

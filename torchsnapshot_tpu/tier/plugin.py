@@ -47,6 +47,13 @@ from .promoter import PromotionGroup, get_promoter
 logger = logging.getLogger(__name__)
 
 _METADATA_FNAME = ".snapshot_metadata"  # == snapshot.SNAPSHOT_METADATA_FNAME
+# telemetry sidecar (obs/aggregate.py), written by rank 0 AFTER
+# finalize_take already handed the data objects to the promoter — it
+# must never join group.paths (a post-enqueue mutation would race the
+# running data job, and recovery would treat a missing record as a
+# missing payload).  The promoter's commit job copies it best-effort
+# just before the durable marker instead.
+_OBSRECORD_FNAME = ".snapshot_obsrecord"
 
 
 class _FastTierCorrupt(Exception):
@@ -180,7 +187,8 @@ class TieredStoragePlugin(StoragePlugin):
                         durable=write_io.durable,
                     )
                 )
-                self._group.paths.add(write_io.path)
+                if write_io.path != _OBSRECORD_FNAME:
+                    self._group.paths.add(write_io.path)
                 self._verified.add(write_io.path)
             except Exception as e:  # noqa: BLE001 — fast tier is a cache
                 logger.warning(
@@ -206,7 +214,7 @@ class TieredStoragePlugin(StoragePlugin):
                 # of the commit marker (single-FIFO ordering)
                 get_promoter().enqueue_data(group)
             get_promoter().enqueue_commit(group)
-        else:
+        elif write_io.path != _OBSRECORD_FNAME:
             self._group.paths.add(write_io.path)
 
     async def _replicate_metadata(self, write_io: WriteIO) -> None:

@@ -31,9 +31,12 @@ import threading
 import time
 from typing import List, Optional, Set, Tuple
 
+from .. import obs
+
 logger = logging.getLogger(__name__)
 
 _METADATA_FNAME = ".snapshot_metadata"  # == snapshot.SNAPSHOT_METADATA_FNAME
+_OBSRECORD_FNAME = ".snapshot_obsrecord"  # == obs.aggregate.OBSRECORD_FNAME
 _DONE_TIMEOUT_S = 600.0
 
 
@@ -87,13 +90,18 @@ class Promoter:
                 self._thread.start()
 
     def enqueue_data(self, group: PromotionGroup) -> None:
-        self._ensure_thread()
-        self._queue.put(("data", group))
+        with obs.span(
+            "tier/enqueue_data", durable=group.durable_url,
+            objects=len(group.paths),
+        ):
+            self._ensure_thread()
+            self._queue.put(("data", group))
 
     def enqueue_commit(self, group: PromotionGroup) -> None:
-        group.commit_enqueued_ts = time.monotonic()
-        self._ensure_thread()
-        self._queue.put(("commit", group))
+        with obs.span("tier/enqueue_commit", durable=group.durable_url):
+            group.commit_enqueued_ts = time.monotonic()
+            self._ensure_thread()
+            self._queue.put(("commit", group))
 
     # ------------------------------------------------------- test hooks
 
@@ -108,13 +116,14 @@ class Promoter:
     def drain(self, raise_on_error: bool = True) -> None:
         """Block until every queued job finished; re-raise the first job
         error (promotion failures are otherwise background warnings)."""
-        self._queue.join()
-        with self._lock:
-            errors, self._errors = self._errors, []
-        if errors and raise_on_error:
-            raise RuntimeError(
-                f"{len(errors)} promotion job(s) failed"
-            ) from errors[0][1]
+        with obs.span("tier/drain"):
+            self._queue.join()
+            with self._lock:
+                errors, self._errors = self._errors, []
+            if errors and raise_on_error:
+                raise RuntimeError(
+                    f"{len(errors)} promotion job(s) failed"
+                ) from errors[0][1]
 
     # ------------------------------------------------------------ worker
 
@@ -148,7 +157,6 @@ class Promoter:
                 self._queue.task_done()
 
     def _run_job(self, kind: str, group: PromotionGroup) -> None:
-        from .. import obs
         from ..scheduler import (
             get_process_memory_budget_bytes,
             sync_execute_copy_reqs,
@@ -221,6 +229,23 @@ class Promoter:
                         )
                 from ..io_types import ReadIO, WriteIO
 
+                # flight-record sidecar first, best-effort: the durable
+                # tier keeps the record-lands-before-marker ordering,
+                # and a missing/unreadable record never blocks the
+                # durable commit (it is telemetry, not payload — the
+                # tier plugin deliberately keeps it out of group.paths)
+                try:
+                    rec_io = ReadIO(path=_OBSRECORD_FNAME)
+                    src.sync_read(rec_io)
+                    dst.sync_write(
+                        WriteIO(
+                            path=_OBSRECORD_FNAME,
+                            buf=bytes(memoryview(rec_io.buf).cast("B")),
+                        )
+                    )
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    obs.swallowed_exception("tier.promote.obsrecord", e)
+
                 read_io = ReadIO(path=_METADATA_FNAME)
                 src.sync_read(read_io)
                 dst.sync_write(
@@ -234,6 +259,11 @@ class Promoter:
                 obs.histogram(obs.PROMOTION_LAG_S).observe(
                     time.monotonic() - group.commit_enqueued_ts
                 )
+            # goodput: under write-back, THIS is the durable commit —
+            # the take→durable lag ends when the durable marker lands,
+            # not when the fast tier acked
+            obs.goodput.durable_commit(group.durable_url)
+            obs.maybe_write_metrics_textfile()
         finally:
             src.sync_close()
             dst.sync_close()

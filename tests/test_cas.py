@@ -1,0 +1,753 @@
+"""Content-addressed chunk store (cas/): chunk-level incremental
+snapshots, delta chains, refcounted GC, fsck.
+
+The contract under test: payload bytes live in a shared per-root chunk
+pool; a take writes only chunks no committed step already stored;
+restore and deep-verify are bitwise-identical to plain snapshots; and
+ANY step of a chain can be deleted without breaking the others
+(refcounts, not chain order, decide chunk lifetime).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import (
+    Snapshot,
+    SnapshotManager,
+    StateDict,
+    delete_snapshot,
+    knobs,
+    obs,
+)
+from torchsnapshot_tpu import cas as cas_mod
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHUNK = 32 * 1024
+
+
+@pytest.fixture()
+def small_chunks():
+    with knobs.override_cas_chunk_size_bytes(CHUNK):
+        yield
+
+
+def _mgr(tmp_path, **kw):
+    return SnapshotManager(str(tmp_path / "run"), cas=True, **kw)
+
+
+def _arr(n=16 * 1024, seed=0.0):
+    return np.arange(n, dtype=np.float64) + seed
+
+
+def _cas_written() -> int:
+    return obs.counter(obs.CAS_BYTES_WRITTEN).value
+
+
+def _cas_shared() -> int:
+    return obs.counter(obs.CAS_BYTES_SHARED).value
+
+
+def _index(mgr):
+    store = cas_mod.ChunkStore(mgr.cas["root"])
+    try:
+        return cas_mod.ChunkIndex.load(store)
+    finally:
+        store.sync_close()
+
+
+def _step_keys(mgr, step):
+    return {
+        k
+        for t in cas_mod.chunk_tables_from_metadata(
+            mgr.snapshot(step).metadata
+        ).values()
+        for k in t["keys"]
+    }
+
+
+def _roundtrip(mgr, step, want):
+    dest = StateDict(w=np.zeros_like(want))
+    mgr.snapshot(step).restore({"app": dest})
+    np.testing.assert_array_equal(dest["w"], want)
+
+
+# ------------------------------------------------------------ key math
+
+
+def test_chunk_key_embeds_exact_size():
+    key = cas_mod.chunk_key((0xDEADBEEF, 0x12345678, 65536))
+    assert key == "deadbeef-12345678-65536"
+    assert cas_mod.key_size(key) == 65536
+    assert cas_mod.chunk_location(key).startswith("objects/de/")
+
+
+def test_table_validation_rejects_skew():
+    good = cas_mod.make_table(
+        CHUNK, CHUNK + 10, ["a" * 8 + "-" + "b" * 8 + f"-{CHUNK}",
+                            "a" * 8 + "-" + "b" * 8 + "-10"]
+    )
+    assert cas_mod.validate_table(good)
+    assert not cas_mod.validate_table(None)
+    assert not cas_mod.validate_table({"chunk_size": CHUNK, "size": 5})
+    # wrong key count for the size
+    bad = dict(good, keys=good["keys"][:1])
+    assert not cas_mod.validate_table(bad)
+    # key whose embedded size disagrees with its span
+    bad = dict(good, keys=[good["keys"][0], "aa-bb-999"])
+    assert not cas_mod.validate_table(bad)
+
+
+def test_record_resolve_root_relative_and_absolute(tmp_path):
+    snap = str(tmp_path / "run" / "step_0000000001")
+    sibling = str(tmp_path / "run" / "cas")
+    assert cas_mod.record_root(snap, sibling) == "../cas"
+    assert cas_mod.resolve_root(snap, "../cas") == sibling
+    other = "s3://bucket/elsewhere"
+    assert cas_mod.record_root(snap, other) == other
+    assert cas_mod.resolve_root(snap, other) == other
+
+
+# ------------------------------------------------- basic take/restore
+
+
+def test_cas_take_roundtrips_and_deep_verifies(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    _roundtrip(mgr, 1, w)
+    assert mgr.snapshot(1).verify(deep=True).ok
+    # the step directory holds NO payload objects — only the marker and
+    # the telemetry sidecar; bytes live in the pool
+    files = {
+        f
+        for _, _, fs in os.walk(mgr.path_for_step(1))
+        for f in fs
+    }
+    assert files <= {".snapshot_metadata", ".snapshot_obsrecord"}
+    # raw digests preserved: the objects table carries (crc, adler,
+    # size) exactly as a plain take would
+    md = Snapshot(mgr.path_for_step(1)).metadata
+    assert md.objects
+    for rec in md.objects.values():
+        assert len(rec) == 3
+    assert md.cas["chunks"]
+    assert md.cas["root"] == "../cas"
+
+
+def test_chunk_level_sharing_across_steps(tmp_path, small_chunks):
+    """Mutating ONE chunk-sized slice of a tensor re-writes one chunk;
+    the rest is shared — the chunk-level (not whole-object) contract."""
+    mgr = _mgr(tmp_path)
+    w = _arr(64 * 1024)  # 512KB = 16 chunks
+    with knobs.override_disable_batching(True):
+        mgr.save({"app": StateDict(w=w)}, step=1)
+        w2 = w.copy()
+        w2[:100] += 1.0  # dirties only chunk 0
+        c0, s0 = _cas_written(), _cas_shared()
+        mgr.save({"app": StateDict(w=w2)}, step=2)
+        written, shared = _cas_written() - c0, _cas_shared() - s0
+    assert written == CHUNK
+    assert shared == w.nbytes - CHUNK
+    _roundtrip(mgr, 1, w)
+    _roundtrip(mgr, 2, w2)
+    assert mgr.snapshot(2).verify(deep=True).ok
+
+
+def test_identical_resave_writes_nothing(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    c0 = _cas_written()
+    mgr.save({"app": StateDict(w=w)}, step=2)
+    assert _cas_written() - c0 == 0
+    _roundtrip(mgr, 2, w)
+
+
+def test_streamed_cas_part_pipeline(tmp_path, small_chunks):
+    """Objects over the stripe floor go through the per-part
+    stage→digest→store pipeline; unchanged parts skip their writes."""
+    mgr = _mgr(tmp_path)
+    big = _arr(512 * 1024)  # 4MB
+    with knobs.override_stripe_min_object_size_bytes(1 << 20), \
+         knobs.override_disable_batching(True):
+        mgr.save({"app": StateDict(w=big)}, step=1)
+        big2 = big.copy()
+        big2[-4:] *= 2.0  # dirties only the LAST chunk
+        c0 = _cas_written()
+        mgr.save({"app": StateDict(w=big2)}, step=2)
+        assert _cas_written() - c0 == CHUNK
+    _roundtrip(mgr, 1, big)
+    _roundtrip(mgr, 2, big2)
+    assert mgr.snapshot(2).verify(deep=True).ok
+
+
+def test_cas_ranged_read_object(tmp_path, small_chunks):
+    """read_object resolves chunk refs transparently, including reads
+    whose byte ranges straddle chunk boundaries."""
+    mgr = _mgr(tmp_path)
+    w = _arr(64 * 1024)
+    with knobs.override_disable_batching(True):
+        mgr.save({"app": StateDict(w=w)}, step=1)
+    got = mgr.snapshot(1).read_object("0/app/w")
+    np.testing.assert_array_equal(got, w)
+
+
+def test_pre_cas_snapshot_restores_unchanged(tmp_path):
+    """A snapshot with no `cas` key restores through the per-step
+    path — byte-identical behavior, no pool lookups."""
+    w = _arr()
+    Snapshot.take(str(tmp_path / "plain"), {"app": StateDict(w=w)})
+    md = Snapshot(str(tmp_path / "plain")).metadata
+    assert md.cas == {}
+    dest = StateDict(w=np.zeros_like(w))
+    Snapshot(str(tmp_path / "plain")).restore({"app": dest})
+    np.testing.assert_array_equal(dest["w"], w)
+
+
+def test_cas_without_checksums_degrades_to_plain(tmp_path):
+    w = _arr()
+    with knobs.override_write_checksums(False):
+        mgr = _mgr(tmp_path)
+        mgr.save({"app": StateDict(w=w)}, step=1)
+    md = mgr.snapshot(1).metadata
+    assert md.cas == {}  # plain per-step snapshot
+    _roundtrip(mgr, 1, w)
+
+
+def test_cas_on_memory_backend(small_chunks):
+    from torchsnapshot_tpu.storage.memory import reset_namespace
+
+    for ns in ("casroot/step_1", "casroot/cas"):
+        reset_namespace(ns)
+    w = _arr()
+    snap = Snapshot.take(
+        "memory://casroot/step_1", {"app": StateDict(w=w)}, cas=True
+    )
+    assert snap.metadata.cas["chunks"]
+    dest = StateDict(w=np.zeros_like(w))
+    Snapshot("memory://casroot/step_1").restore({"app": dest})
+    np.testing.assert_array_equal(dest["w"], w)
+    assert Snapshot("memory://casroot/step_1").verify(deep=True).ok
+
+
+def test_materialize_resolves_chunk_refs(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    state = mgr.snapshot(1).materialize(rank=0)
+    np.testing.assert_array_equal(state["app"]["w"], w)
+
+
+# --------------------------------------------- delta chains / deletion
+
+
+def test_delete_any_middle_step_keeps_chain_intact(tmp_path, small_chunks):
+    """THE acceptance property: delete an arbitrary middle step of a
+    5-step chain; every remaining step restores bitwise-identical and
+    deep-verifies clean."""
+    mgr = _mgr(tmp_path)
+    base = _arr(64 * 1024)
+    states = {}
+    for step in range(1, 6):
+        arr = base.copy()
+        arr[: step * 700] += float(step)
+        states[step] = arr
+        mgr.save({"app": StateDict(w=arr)}, step=step)
+    delete_snapshot(
+        mgr.path_for_step(3), metadata=mgr.snapshot(3).metadata
+    )
+    assert 3 not in mgr.steps()
+    for step in (1, 2, 4, 5):
+        _roundtrip(mgr, step, states[step])
+        res = mgr.snapshot(step).verify(deep=True)
+        assert res.ok, (step, str(res))
+    # and after a zero-grace sweep the survivors STILL verify (only
+    # step 3's unique chunks may go)
+    mgr.cas_gc(grace_s=0.0)
+    for step in (1, 2, 4, 5):
+        assert mgr.snapshot(step).verify(deep=True).ok, step
+
+
+def test_delete_first_and_last_step(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    w1, w2, w3 = _arr(seed=1), _arr(seed=2), _arr(seed=3)
+    for step, w in ((1, w1), (2, w2), (3, w3)):
+        mgr.save({"app": StateDict(w=w)}, step=step)
+    delete_snapshot(mgr.path_for_step(1), metadata=mgr.snapshot(1).metadata)
+    delete_snapshot(mgr.path_for_step(3), metadata=mgr.snapshot(3).metadata)
+    mgr.cas_gc(grace_s=0.0)
+    _roundtrip(mgr, 2, w2)
+    assert mgr.snapshot(2).verify(deep=True).ok
+
+
+def test_bytes_reclaimed_counts_only_zero_ref_chunks(tmp_path, small_chunks):
+    """Satellite regression: `snapshot.gc.bytes_reclaimed` must count
+    only chunks whose refcount actually dropped to zero — a shared
+    chunk's bytes are NOT reclaimed by deleting one referrer."""
+    mgr = _mgr(tmp_path)
+    shared = _arr(32 * 1024)  # 8 chunks shared by both steps
+    with knobs.override_disable_batching(True):
+        mgr.save(
+            {"app": StateDict(shared=shared, mine=_arr(8 * 1024, 5))},
+            step=1,
+        )
+        mgr.save(
+            {"app": StateDict(shared=shared, mine=_arr(8 * 1024, 9))},
+            step=2,
+        )
+    only_step1 = _step_keys(mgr, 1) - _step_keys(mgr, 2)
+    expect = sum(cas_mod.key_size(k) for k in only_step1)
+    c0 = obs.counter(obs.GC_BYTES_RECLAIMED).value
+    delete_snapshot(
+        mgr.path_for_step(1), metadata=mgr.snapshot(1).metadata
+    )
+    reclaimed = obs.counter(obs.GC_BYTES_RECLAIMED).value - c0
+    assert reclaimed == expect
+    assert reclaimed < shared.nbytes  # the shared bytes were NOT counted
+    # step 2 fully intact, shared chunks included
+    dest = StateDict(
+        shared=np.zeros_like(shared), mine=np.zeros(8 * 1024)
+    )
+    mgr.snapshot(2).restore({"app": dest})
+    np.testing.assert_array_equal(dest["shared"], shared)
+    np.testing.assert_array_equal(dest["mine"], _arr(8 * 1024, 9))
+    assert mgr.snapshot(2).verify(deep=True).ok
+
+
+def test_retention_releases_refs_and_sweeps(tmp_path, small_chunks):
+    with knobs.override_cas_gc_grace_s(0.0):
+        mgr = _mgr(tmp_path, keep_last_n=2)
+        arrs = {}
+        for step in range(1, 5):
+            arrs[step] = _arr(seed=step * 1000)
+            mgr.save({"app": StateDict(w=arrs[step])}, step=step)
+        assert mgr.steps() == [3, 4]
+        mgr.gc()  # runs the chunk-pool mark+sweep too
+        idx = _index(mgr)
+        live = idx.live_keys()
+        assert _step_keys(mgr, 3) <= live
+        assert _step_keys(mgr, 4) <= live
+        for step in (3, 4):
+            _roundtrip(mgr, step, arrs[step])
+            assert mgr.snapshot(step).verify(deep=True).ok
+
+
+# -------------------------------------------------- two-phase GC rules
+
+
+def test_grace_window_defers_physical_deletion(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    keys = _step_keys(mgr, 1)
+    delete_snapshot(mgr.path_for_step(1), metadata=mgr.snapshot(1).metadata)
+    # orphan-marked but inside the (default, 900s) grace window: the
+    # bytes stay
+    idx = _index(mgr)
+    assert all("orphaned_at" in idx.chunks[k] for k in keys)
+    out = mgr.cas_gc()  # default grace
+    assert out["swept_chunks"] == 0
+    store = cas_mod.ChunkStore(mgr.cas["root"])
+    for k in keys:
+        assert store.storage.sync_stat(
+            cas_mod.chunk_location(k)
+        ) == cas_mod.key_size(k)
+    store.sync_close()
+    # past the window the sweep reclaims them
+    out = mgr.cas_gc(grace_s=0.0)
+    assert out["swept_chunks"] == len(keys)
+    assert _index(mgr).chunks == {}
+
+
+def test_orphaned_chunks_are_not_dedup_candidates(tmp_path, small_chunks):
+    """A take must never reference an orphan-marked chunk (the sweep
+    could race it past the grace window): identical content saved after
+    the only referrer's deletion is REWRITTEN, resurrecting the key."""
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    delete_snapshot(mgr.path_for_step(1), metadata=mgr.snapshot(1).metadata)
+    assert _index(mgr).live_keys() == set()
+    mgr.save({"app": StateDict(w=w)}, step=2)
+    idx = _index(mgr)
+    assert _step_keys(mgr, 2) <= idx.live_keys()
+    mgr.cas_gc(grace_s=0.0)
+    assert mgr.snapshot(2).verify(deep=True).ok
+    _roundtrip(mgr, 2, w)
+
+
+def test_fsck_rebuilds_after_corrupt_index(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    w1, w2 = _arr(seed=1), _arr(seed=2)
+    mgr.save({"app": StateDict(w=w1)}, step=1)
+    mgr.save({"app": StateDict(w=w2)}, step=2)
+    idx_path = os.path.join(mgr.cas["root"], "index.json")
+    with open(idx_path, "w") as f:
+        f.write('{"chunks": {TRUNCATED')
+    store = cas_mod.ChunkStore(mgr.cas["root"])
+    with pytest.raises(cas_mod.ChunkIndexCorruptError):
+        cas_mod.ChunkIndex.load(store)
+    store.sync_close()
+    out = mgr.fsck()
+    assert out["snapshots_committed"] == 2
+    assert out["missing_chunks"] == []
+    idx = _index(mgr)
+    assert _step_keys(mgr, 1) | _step_keys(mgr, 2) <= idx.live_keys()
+    for step, w in ((1, w1), (2, w2)):
+        _roundtrip(mgr, step, w)
+        assert mgr.snapshot(step).verify(deep=True).ok
+
+
+def test_fsck_marks_unreferenced_pool_chunks(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    mgr.save({"app": StateDict(w=_arr())}, step=1)
+    # drop a foreign chunk into the pool (a crashed take's leftover)
+    stray_key = cas_mod.chunk_key((1, 2, 64))
+    loc = os.path.join(
+        mgr.cas["root"], cas_mod.chunk_location(stray_key)
+    )
+    os.makedirs(os.path.dirname(loc), exist_ok=True)
+    with open(loc, "wb") as f:
+        f.write(b"x" * 64)
+    out = mgr.fsck()
+    assert out["orphans_marked"] == 1
+    # grace window applies from fsck time; a zero-grace sweep reclaims
+    out = mgr.cas_gc(grace_s=0.0)
+    assert out["swept_chunks"] == 1
+    assert not os.path.exists(loc)
+    assert mgr.snapshot(1).verify(deep=True).ok
+
+
+def test_corrupt_index_at_take_time_self_heals(tmp_path, small_chunks):
+    """A take that finds a corrupt index auto-fscks and proceeds; dedup
+    against the rebuilt index still works."""
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    with open(os.path.join(mgr.cas["root"], "index.json"), "w") as f:
+        f.write("garbage")
+    c0 = _cas_written()
+    mgr.save({"app": StateDict(w=w)}, step=2)
+    assert _cas_written() - c0 == 0  # rebuilt index fed the dedup
+    assert mgr.snapshot(2).verify(deep=True).ok
+
+
+# --------------------------------------------------- corruption safety
+
+
+def test_deep_verify_catches_corrupt_chunk(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    key = sorted(_step_keys(mgr, 1))[0]
+    loc = os.path.join(mgr.cas["root"], cas_mod.chunk_location(key))
+    raw = bytearray(open(loc, "rb").read())
+    raw[0] ^= 0xFF
+    with open(loc, "wb") as f:
+        f.write(raw)
+    res = mgr.snapshot(1).verify(deep=True)
+    assert not res.ok
+    assert res.corrupt or res.unreadable
+
+
+def test_shallow_verify_catches_missing_chunk(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    mgr.save({"app": StateDict(w=_arr())}, step=1)
+    key = sorted(_step_keys(mgr, 1))[0]
+    os.remove(os.path.join(mgr.cas["root"], cas_mod.chunk_location(key)))
+    res = mgr.snapshot(1).verify(deep=False)
+    assert not res.ok
+    assert any(key in m for m in res.missing)
+
+
+# ------------------------------------------------------ tier composure
+
+
+def test_tiered_manager_with_cas(tmp_path, small_chunks):
+    """Tier × CAS: chunks live at the durable-rooted pool, the promoter
+    copies only per-step objects (there are none), and evicting a FAST
+    copy never releases the durable step's chunk refs."""
+    from torchsnapshot_tpu import drain_promotions
+
+    mgr = SnapshotManager(
+        str(tmp_path / "durable"),
+        cas=True,
+        tier={"fast_root": str(tmp_path / "fast"), "policy": "write_back"},
+    )
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    drain_promotions()
+    assert mgr.durable_steps() == [1]
+    keys = _step_keys(mgr, 1)
+    # evict the fast copy: refs must survive (release_cas=False path)
+    delete_snapshot(
+        mgr.fast_path_for_step(1),
+        manifest=mgr.snapshot(1).get_manifest(),
+        release_cas=False,
+    )
+    idx = _index(mgr)
+    assert keys <= idx.live_keys()
+    _roundtrip(mgr, 1, w)
+    assert mgr.snapshot(1).verify(deep=True).ok
+
+
+# ------------------------------------------------------------ CLI / knob
+
+
+def test_cas_cli_rollup_json_parity(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    w2 = w.copy()
+    w2[:10] += 1
+    mgr.save({"app": StateDict(w=w2)}, step=2)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, "-m", "torchsnapshot_tpu", "cas",
+         mgr.cas["root"], "--json"],
+        capture_output=True, text=True, env=env, cwd=_REPO, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    idx = doc["index"]
+    assert idx["live_chunks"] > 0
+    assert idx["orphaned_chunks"] == 0
+    assert sum(idx["refcount_histogram"].values()) == idx["chunks"]
+    per_step = idx["per_step"]
+    s2 = per_step[cas_mod.norm_ref(mgr.path_for_step(2))]
+    assert s2["shared_bytes"] > 0 and s2["new_bytes"] > 0
+    human = subprocess.run(
+        [sys.executable, "-m", "torchsnapshot_tpu", "cas",
+         mgr.cas["root"]],
+        capture_output=True, text=True, env=env, cwd=_REPO, timeout=120,
+    )
+    assert human.returncode == 0, human.stderr
+    assert "live chunks" in human.stdout
+    assert "refcount histogram" in human.stdout
+
+
+def test_stats_cli_cas_rollup(tmp_path, small_chunks, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    mgr = _mgr(tmp_path)
+    mgr.save({"app": StateDict(w=_arr())}, step=1)
+    assert main(["stats", mgr.path_for_step(1), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["cas"]["chunked_objects"] >= 1
+    assert doc["cas"]["index"]["live_chunks"] >= 1
+    assert main(["stats", mgr.path_for_step(1)]) == 0
+    assert "cas:" in capsys.readouterr().out
+
+
+def test_cas_knob_enables_manager_default(tmp_path, small_chunks):
+    with knobs.override_cas(True):
+        mgr = SnapshotManager(str(tmp_path / "run"))
+    assert mgr.cas is not None
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    assert mgr.snapshot(1).metadata.cas["chunks"]
+    _roundtrip(mgr, 1, w)
+    # explicit opt-out beats the knob
+    with knobs.override_cas(True):
+        assert SnapshotManager(str(tmp_path / "run2"), cas=False).cas is None
+
+
+def test_async_save_with_cas(tmp_path, small_chunks):
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    c0 = _cas_written()
+    pend = mgr.save({"app": StateDict(w=w)}, step=2, async_=True)
+    snap = pend.wait()
+    assert _cas_written() - c0 == 0  # fully deduped in the background
+    assert snap.metadata.cas["chunks"]
+    _roundtrip(mgr, 2, w)
+    assert mgr.snapshot(2).verify(deep=True).ok
+
+
+def test_incremental_flag_with_cas_skips_base(tmp_path, small_chunks):
+    """manager.save(incremental=True) under CAS must not do base links
+    — the chunk store subsumes them."""
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    mgr.save({"app": StateDict(w=w)}, step=2, incremental=True)
+    md = mgr.snapshot(2).metadata
+    assert md.cas["chunks"]
+    _roundtrip(mgr, 2, w)
+    assert mgr.snapshot(2).verify(deep=True).ok
+
+
+# ----------------------------------------------- review regressions
+
+
+def test_manager_cas_accepts_int_toggles(tmp_path):
+    """cas=0/1 (the knob's own spelling) must toggle, not crash."""
+    assert SnapshotManager(str(tmp_path / "a"), cas=0).cas is None
+    assert SnapshotManager(str(tmp_path / "b"), cas=1).cas is not None
+
+
+def test_mark_keeps_uncommitted_refs_on_live_chunks():
+    """Regression: mark() must not prune a not-yet-committed ref from a
+    chunk that stays live — an in-flight take (or a write-back step
+    whose durable marker trails promotion) would lose its shared-chunk
+    references, and deleting its peers would then sweep chunks the
+    later-committed step depends on."""
+    idx = cas_mod.ChunkIndex()
+    key = cas_mod.chunk_key((1, 2, 64))
+    idx.add_refs("committed_step", {"loc": {"keys": [key]}})
+    idx.add_refs("inflight_step", {"loc": {"keys": [key]}})
+    idx.mark(lambda ref: ref == "committed_step")
+    entry = idx.chunks[key]
+    assert "orphaned_at" not in entry
+    assert set(entry["refs"]) == {"committed_step", "inflight_step"}
+    # the delete of the committed peer must now NOT zero the chunk
+    zeroed = idx.release("committed_step")
+    assert zeroed == []
+    assert "orphaned_at" not in idx.chunks[key]
+
+
+def test_commit_refs_fails_on_missing_untracked_chunk(tmp_path, small_chunks):
+    """The skip-write safety net: committing a step whose referenced
+    chunk is neither index-tracked nor present in the pool (a sweep
+    raced the take) must FAIL the commit, never produce a committed
+    step with missing chunks."""
+    root = str(tmp_path / "pool")
+    store = cas_mod.ChunkStore(root)
+    ghost = cas_mod.chunk_key((3, 4, 128))
+    with pytest.raises(RuntimeError, match="missing from the pool"):
+        cas_mod.commit_refs(
+            store, str(tmp_path / "stepX"), {"loc": {"keys": [ghost]}}
+        )
+    store.sync_close()
+
+
+def test_fsck_refuses_empty_scan_over_populated_pool(tmp_path, small_chunks):
+    """A default sibling scan that finds no committed snapshots while
+    the pool holds chunks is ambiguous with a custom pool layout —
+    fsck must refuse rather than orphan-mark every committed step's
+    chunks; explicit snapshot_paths assert the situation is real."""
+    mgr = _mgr(tmp_path)
+    mgr.save({"app": StateDict(w=_arr())}, step=1)
+    # a custom-layout pool: the steps are NOT siblings of the root
+    lonely = str(tmp_path / "elsewhere" / "pool")
+    os.makedirs(lonely, exist_ok=True)
+    import shutil
+
+    shutil.copytree(
+        os.path.join(mgr.cas["root"], "objects"),
+        os.path.join(lonely, "objects"),
+    )
+    with pytest.raises(RuntimeError, match="found no\\s+committed"):
+        cas_mod.fsck(lonely)
+    # explicit (and genuinely empty) candidates are honored
+    out = cas_mod.fsck(lonely, snapshot_paths=[])
+    assert out["snapshots_committed"] == 0
+    assert out["orphans_marked"] > 0
+
+
+def test_orbax_export_resolves_chunk_refs(tmp_path, small_chunks, monkeypatch):
+    """Regression: migrate_snapshot_to_orbax reads through the
+    scheduler — a CAS snapshot's chunk-ref'd objects (no per-step
+    storage object at all) must assemble from the pool, not
+    FileNotFoundError.  (The orbax writer is stubbed: the bug sat in
+    the read.)"""
+    from torchsnapshot_tpu.tricks import orbax_interop
+
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"model": StateDict(w=w)}, step=1)
+    assert mgr.snapshot(1).metadata.cas["chunks"]
+    exported = {}
+    monkeypatch.setattr(
+        orbax_interop, "export_to_orbax",
+        lambda orbax_path, tree: exported.update(tree),
+    )
+    orbax_interop.migrate_snapshot_to_orbax(
+        mgr.path_for_step(1), str(tmp_path / "orbax"), key="model"
+    )
+    np.testing.assert_array_equal(np.asarray(exported["w"]), w)
+
+
+def test_fsck_refuses_unlistable_root_with_empty_scan():
+    """Cloud twin of the empty-scan refusal: an un-listable pool root
+    whose sibling scan finds nothing must refuse the rebuild (an empty
+    index would silently wipe every committed step's refs) rather than
+    save one."""
+    from torchsnapshot_tpu.storage.memory import reset_namespace
+
+    reset_namespace("fsckcloud/cas")
+    with pytest.raises(RuntimeError, match="cannot be listed"):
+        cas_mod.fsck("memory://fsckcloud/cas")
+
+
+def test_fsck_missing_chunk_blocks_dedup_until_healed(tmp_path, small_chunks):
+    """A live index entry whose pool bytes were lost out-of-band must
+    not feed dedup (a take would commit an unrestorable step): fsck
+    flags it, live_keys excludes it, and a take that re-writes the
+    content heals the pool and clears the flag."""
+    mgr = _mgr(tmp_path)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    key = sorted(_step_keys(mgr, 1))[0]
+    loc = os.path.join(mgr.cas["root"], cas_mod.chunk_location(key))
+    os.remove(loc)
+    out = mgr.fsck()
+    assert key in out["missing_chunks"]
+    idx = _index(mgr)
+    assert idx.chunks[key].get("missing") is True
+    assert key not in idx.live_keys()
+    assert idx.rollup()["missing_chunks"] == 1
+    # identical content re-saves: the chunk is REWRITTEN (not deduped
+    # against the ghost entry), the flag clears, and both steps verify
+    c0 = _cas_written()
+    mgr.save({"app": StateDict(w=w)}, step=2)
+    assert _cas_written() - c0 >= cas_mod.key_size(key)
+    idx = _index(mgr)
+    assert not idx.chunks[key].get("missing")
+    assert os.path.exists(loc)
+    for step in (1, 2):
+        assert mgr.snapshot(step).verify(deep=True).ok, step
+        _roundtrip(mgr, step, w)
+
+
+def test_streamed_cas_shared_bytes_feed_bytes_deduped(
+    tmp_path, small_chunks
+):
+    """Regression: the streamed CAS path must credit skipped-chunk
+    bytes to the global bytes_deduped counter like the whole-staged
+    path does."""
+    mgr = _mgr(tmp_path)
+    big = _arr(512 * 1024)  # 4MB
+    with knobs.override_stripe_min_object_size_bytes(1 << 20), \
+         knobs.override_disable_batching(True):
+        mgr.save({"app": StateDict(w=big)}, step=1)
+        d0 = obs.counter(obs.BYTES_DEDUPED).value
+        mgr.save({"app": StateDict(w=big)}, step=2)
+        assert (
+            obs.counter(obs.BYTES_DEDUPED).value - d0 == big.nbytes
+        )
+
+
+def test_fsck_handles_fs_scheme_roots(tmp_path, small_chunks):
+    """Regression: `fs://`-spelled roots (the codebase's local scheme)
+    must be listable for fsck's sibling scan and pool scan — a corrupt
+    index under an fs:// root self-heals exactly like a bare path."""
+    mgr = SnapshotManager(f"fs://{tmp_path}/run", cas=True)
+    w = _arr()
+    mgr.save({"app": StateDict(w=w)}, step=1)
+    idx_path = str(tmp_path / "run" / "cas" / "index.json")
+    with open(idx_path, "w") as f:
+        f.write("garbage")
+    # auto-fsck at take time heals and the save commits + dedups
+    c0 = _cas_written()
+    mgr.save({"app": StateDict(w=w)}, step=2)
+    assert _cas_written() - c0 == 0
+    for step in (1, 2):
+        assert mgr.snapshot(step).verify(deep=True).ok, step

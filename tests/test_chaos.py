@@ -745,3 +745,148 @@ def test_chaos_rank_dies_before_obsrecord_publish_commit_survives(tmp_path):
     assert out.returncode == 0, out.stderr
     assert "MISSING: [1]" in out.stdout
     assert "straggler: rank 0" in out.stdout
+
+
+# ============================================== chunk-store (cas/) races
+
+
+def _cas_pool_keys(cas_root):
+    from torchsnapshot_tpu.cas.index import _list_pool_keys
+
+    return _list_pool_keys(cas_root)
+
+
+def test_chaos_cas_crash_after_index_update_before_marker(tmp_path):
+    """A rank dying AFTER the chunk-index update but BEFORE the
+    `.snapshot_metadata` marker (the deterministic `cas.index.commit`
+    crash window) must converge: the next fsck drops the dead step's
+    refs, GC reclaims its unique chunks after the grace window, no
+    committed step's chunk is ever deleted, and re-taking the step
+    commits cleanly."""
+    from torchsnapshot_tpu import SnapshotManager
+    from torchsnapshot_tpu import cas as cas_mod
+
+    root = str(tmp_path / "run")
+    mgr = SnapshotManager(root, cas=True)
+    with knobs.override_cas_chunk_size_bytes(16 * 1024):
+        mgr.save(_state(seed=1), step=1)
+        with knobs.override_failpoints("cas.index.commit=runtime"):
+            with pytest.raises(RuntimeError):
+                mgr.save(_state(seed=2), step=2)
+        # the marker was withheld: step 2 is aborted for every reader
+        assert not os.path.exists(
+            os.path.join(mgr.path_for_step(2), ".snapshot_metadata")
+        )
+        # the index holds refs for the dead step (update preceded the
+        # crash); its chunks are present but unprotected by any commit
+        store = cas_mod.ChunkStore(mgr.cas["root"])
+        idx = cas_mod.ChunkIndex.load(store)
+        dead_ref = cas_mod.norm_ref(mgr.path_for_step(2))
+        dead_keys = {
+            k for k, e in idx.chunks.items() if dead_ref in e["refs"]
+        }
+        assert dead_keys, "index update must precede the crash window"
+        store.sync_close()
+
+        # convergence half 1: fsck rebuilds refs from COMMITTED
+        # manifests only and orphan-marks the dead step's unique chunks
+        out = mgr.fsck()
+        assert out["snapshots_committed"] == 1
+        assert out["missing_chunks"] == []
+        # convergence half 2: the sweep past the grace window reclaims
+        # the dead chunks and nothing a committed step references
+        step1_keys = {
+            k
+            for t in cas_mod.chunk_tables_from_metadata(
+                mgr.snapshot(1).metadata
+            ).values()
+            for k in t["keys"]
+        }
+        gc_out = mgr.cas_gc(grace_s=0.0)
+        assert gc_out["swept_chunks"] == len(dead_keys - step1_keys)
+        assert _cas_pool_keys(mgr.cas["root"]) == step1_keys
+        assert mgr.snapshot(1).verify(deep=True).ok
+        _assert_roundtrip(mgr.path_for_step(1), seed=1)
+
+        # the crashed step re-takes cleanly and round-trips
+        mgr.save(_state(seed=2), step=2)
+        assert mgr.snapshot(2).verify(deep=True).ok
+        _assert_roundtrip(mgr.path_for_step(2), seed=2)
+
+
+def test_chaos_cas_gc_racing_take_never_deletes_referenced_chunk(tmp_path):
+    """GC racing a concurrent take: the take registered its chunk refs
+    (index update) but has not yet written its commit marker when the
+    last OTHER step referencing those chunks is deleted and a full
+    mark+sweep runs.  The grace window must keep the chunks on disk;
+    the take's commit then resurrects them — never a committed step
+    with swept chunks."""
+    import threading
+
+    from torchsnapshot_tpu import SnapshotManager
+    from torchsnapshot_tpu import cas as cas_mod
+    from torchsnapshot_tpu.manager import delete_snapshot
+
+    root = str(tmp_path / "run")
+    mgr = SnapshotManager(root, cas=True)
+    with knobs.override_cas_chunk_size_bytes(16 * 1024):
+        mgr.save(_state(seed=5), step=1)
+        shared_keys = {
+            k
+            for t in cas_mod.chunk_tables_from_metadata(
+                mgr.snapshot(1).metadata
+            ).values()
+            for k in t["keys"]
+        }
+
+        # deterministic interleave: pause step 2's commit BETWEEN its
+        # index update and its metadata marker
+        refs_registered = threading.Event()
+        gc_done = threading.Event()
+        real_commit_refs = cas_mod.commit_refs
+
+        def paused_commit_refs(store, ref_id, tables):
+            real_commit_refs(store, ref_id, tables)
+            refs_registered.set()
+            assert gc_done.wait(30), "interleave wedged"
+
+        cas_mod.commit_refs = paused_commit_refs
+        errs = []
+
+        def take_step2():
+            try:
+                # identical content: every chunk of step 2 is a chunk
+                # of step 1 — the exact shared-ownership hazard
+                mgr2 = SnapshotManager(root, cas=True)
+                mgr2.save(_state(seed=5), step=2)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=take_step2)
+        t.start()
+        try:
+            assert refs_registered.wait(30), "take never reached commit"
+            # the race: drop the only COMMITTED referent and run a full
+            # mark+sweep while step 2 is in flight.  The default grace
+            # window (not 0!) is the contract under test.
+            delete_snapshot(
+                mgr.path_for_step(1), metadata=mgr.snapshot(1).metadata
+            )
+            gc_out = mgr.cas_gc()  # default grace window
+            assert gc_out["swept_chunks"] == 0
+            assert shared_keys <= _cas_pool_keys(mgr.cas["root"])
+        finally:
+            cas_mod.commit_refs = real_commit_refs
+            gc_done.set()
+            t.join(60)
+        assert not errs, errs
+        # the in-flight take committed; its chunks are live again and
+        # a post-commit mark+sweep resurrects rather than deletes
+        gc_out = mgr.cas_gc(grace_s=0.0)
+        assert gc_out["swept_chunks"] == 0
+        store = cas_mod.ChunkStore(mgr.cas["root"])
+        idx = cas_mod.ChunkIndex.load(store)
+        assert shared_keys <= idx.live_keys()
+        store.sync_close()
+        assert mgr.snapshot(2).verify(deep=True).ok
+        _assert_roundtrip(mgr.path_for_step(2), seed=5)

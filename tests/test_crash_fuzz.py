@@ -102,10 +102,10 @@ fs_mod.FSStoragePlugin.write = slow_write
 
 # widen the mid-GC window and announce it so the parent can kill inside
 real_delete = mgr_mod.delete_snapshot
-def slow_delete(path, manifest=None):
+def slow_delete(path, manifest=None, **kw):
     print("GC_DELETING", flush=True)
     time.sleep(3 * delay)
-    real_delete(path, manifest)
+    real_delete(path, manifest, **kw)
 mgr_mod.delete_snapshot = slow_delete
 
 mgr = SnapshotManager(root, keep_last_n=2)

@@ -1191,6 +1191,22 @@ def test_instrumentation_covers_obs_aggregate_goodput_and_promoter():
     }
 
 
+def test_instrumentation_covers_cas_entry_points():
+    """The chunk store's engines, the index rebuild, and the GC/commit
+    mutations (cas/) are pinned into the instrumentation coverage map —
+    the skip-vs-write decision and chunk deletions are exactly what an
+    incremental-checkpoint incident review reconstructs."""
+    from tools.lint.passes.instrumentation import MODULE_FUNCTIONS
+
+    assert {
+        "chunked_write", "cas_streamed_write", "chunked_read",
+    } <= MODULE_FUNCTIONS["torchsnapshot_tpu/cas/store.py"]
+    assert {"fsck"} <= MODULE_FUNCTIONS["torchsnapshot_tpu/cas/index.py"]
+    assert {
+        "commit_refs", "release_step", "run_gc",
+    } <= MODULE_FUNCTIONS["torchsnapshot_tpu/cas/gc.py"]
+
+
 def test_instrumentation_flags_uncovered_goodput_entry_point():
     from tools.lint.passes.instrumentation import check_source
 

@@ -196,6 +196,50 @@ def test_retry_exhaustion_raises_original_error():
         run(go())
 
 
+def test_retry_stale_progress_clock_does_not_exhaust_new_op():
+    """Regression: a SharedProgress that sat idle longer than the
+    window (a process-global one like the codec's, or a plugin quiet
+    between takes) must not make a NEW op's first transient read as
+    "no progress for the whole window" — the window floor is the op's
+    own start time."""
+    import time as _time
+
+    progress = SharedProgress(window_s=60.0, label="t-stale")
+    # simulate minutes of idleness since the last recorded progress
+    progress.last_progress = _time.monotonic() - 3600.0
+
+    async def no_sleep(attempt):
+        return None
+
+    progress.backoff = no_sleep
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient after idle gap")
+        return "ok"
+
+    async def go():
+        return await retry_call(
+            flaky,
+            op_name="op",
+            backend="testbk",
+            classify=lambda e: TRANSIENT,
+            progress=progress,
+        )
+
+    assert run(go()) == "ok"
+    assert calls["n"] == 3
+    # the shared semantics survive: a pipeline genuinely stalled past
+    # the window SINCE the op began still gives up
+    progress.last_progress = _time.monotonic() - 3600.0
+    assert not progress.should_retry(
+        1, started=_time.monotonic() - 61.0
+    )
+    assert progress.should_retry(1, started=_time.monotonic() - 1.0)
+
+
 def test_shared_progress_deterministic_jitter():
     a = SharedProgress(label="same")
     b = SharedProgress(label="same")

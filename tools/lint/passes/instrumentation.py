@@ -90,6 +90,21 @@ MODULE_FUNCTIONS: Dict[str, Set[str]] = {
     "torchsnapshot_tpu/obs/goodput.py": {
         "take_begin", "take_unblocked", "durable_commit",
     },
+    # the chunk store's engines (cas/): skip-vs-write decisions and the
+    # assembling reads are where an incremental take's byte volume is
+    # decided — an unattributable CAS path would hide exactly the
+    # numbers the subsystem exists to improve
+    "torchsnapshot_tpu/cas/store.py": {
+        "chunked_write", "cas_streamed_write", "chunked_read",
+    },
+    # index rebuild is a recovery operation an incident review must be
+    # able to reconstruct
+    "torchsnapshot_tpu/cas/index.py": {"fsck"},
+    # the GC/commit paths are durability-critical mutations of shared
+    # state — same discipline as manager.delete_snapshot above
+    "torchsnapshot_tpu/cas/gc.py": {
+        "commit_refs", "release_step", "run_gc",
+    },
 }
 
 _BRACKET_NAMES = {"log_event", "span"}

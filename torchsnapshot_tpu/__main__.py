@@ -7,6 +7,7 @@
     python -m torchsnapshot_tpu verify    <snapshot-path> [--deep] [--rank N]
     python -m torchsnapshot_tpu steps     <manager-root>
     python -m torchsnapshot_tpu tiers     <durable-root> --fast <fast-root> [--json]
+    python -m torchsnapshot_tpu cas       <cas-root> [--json] [--fsck] [--gc]
     python -m torchsnapshot_tpu delete    <snapshot-path> --yes
     python -m torchsnapshot_tpu trace     <snapshot-path> [--out FILE]
     python -m torchsnapshot_tpu lint      [root] [--json] [--pass ID]
@@ -143,6 +144,75 @@ def _codec_rollup(metadata) -> dict:
     }
 
 
+def _cas_stats_rollup(snapshot) -> dict:
+    """CAS rollup for one snapshot: how much of its payload is
+    chunk-ref'd (vs per-step objects), and — when the pool's index is
+    reachable — the pool-wide live/orphan counts, refcount histogram
+    and per-step shared-vs-new byte attribution.  ``{}`` for non-CAS
+    snapshots so the stats document shape stays stable."""
+    from . import cas as cas_mod
+
+    metadata = snapshot.metadata
+    meta_cas = metadata.cas or {}
+    if not meta_cas:
+        return {}
+    tables = cas_mod.chunk_tables_from_metadata(metadata)
+    distinct = {k for t in tables.values() for k in t["keys"]}
+    out = {
+        "root": meta_cas.get("root"),
+        "chunked_objects": len(tables),
+        "chunked_bytes": sum(int(t["size"]) for t in tables.values()),
+        "distinct_chunks": len(distinct),
+        "distinct_chunk_bytes": sum(
+            cas_mod.key_size(k) for k in distinct
+        ),
+    }
+    store = cas_mod.ChunkStore(
+        cas_mod.resolve_root(snapshot.path, str(meta_cas.get("root")))
+    )
+    try:
+        out["index"] = cas_mod.ChunkIndex.load(store).rollup()
+    except Exception as e:  # noqa: BLE001 — index unreachable/corrupt:
+        # the per-snapshot numbers above still stand
+        out["index_error"] = f"{e!r}"[:200]
+    finally:
+        store.sync_close()
+    return out
+
+
+def _render_cas_stats(rollup: dict) -> None:
+    if not rollup:
+        return
+    print(
+        f"  cas: {rollup['chunked_objects']} chunked objects, "
+        f"{_human(rollup['chunked_bytes'])} logical -> "
+        f"{rollup['distinct_chunks']} chunks, "
+        f"{_human(rollup['distinct_chunk_bytes'])} distinct "
+        f"(pool: {rollup.get('root')})"
+    )
+    idx = rollup.get("index")
+    if not idx:
+        if rollup.get("index_error"):
+            print(f"    index unreadable: {rollup['index_error']}")
+        return
+    print(
+        f"    pool: {idx['live_chunks']} live "
+        f"({_human(idx['live_bytes'])}), {idx['orphaned_chunks']} "
+        f"orphaned ({_human(idx['orphaned_bytes'])})"
+    )
+    hist = ", ".join(
+        f"{n} ref{'s' if n != '1' else ''}: {c}"
+        for n, c in idx["refcount_histogram"].items()
+    )
+    if hist:
+        print(f"    refcounts: {hist}")
+    for step, st in idx["per_step"].items():
+        print(
+            f"    {step}: {_human(st['new_bytes'])} new + "
+            f"{_human(st['shared_bytes'])} shared"
+        )
+
+
 def _cmd_stats(args) -> int:
     """Per-entry size/dtype/chunk rollups from the manifest (the
     operator's "where did my bytes go" view; machine-readable with
@@ -186,6 +256,7 @@ def _cmd_stats(args) -> int:
             {"path": p, **st} for p, st in largest
         ],
         "codec": _codec_rollup(metadata),
+        "cas": _cas_stats_rollup(snap),
     }
     if args.json:
         print(json.dumps(stats, indent=2))
@@ -222,6 +293,7 @@ def _cmd_stats(args) -> int:
                 f"{_human(st['raw_bytes'])} -> "
                 f"{_human(st['stored_bytes'])} ({r:.2f}x)"
             )
+    _render_cas_stats(stats["cas"])
     print(f"  largest {len(largest)}:")
     width = max((len(p) for p, _ in largest), default=10)
     for p, st in largest:
@@ -261,7 +333,16 @@ def _doctor_counters(record) -> dict:
 
     codec_in = c.get("storage.codec.bytes_in", 0)
     codec_out = c.get("storage.codec.bytes_out", 0)
+    cas_written = c.get("cas.bytes_written", 0)
+    cas_shared = c.get("cas.bytes_shared", 0)
     return {
+        "cas_bytes_written": cas_written,
+        "cas_bytes_shared": cas_shared,
+        "cas_dedup_ratio": (
+            round((cas_written + cas_shared) / cas_written, 3)
+            if cas_written
+            else None
+        ),
         "bytes_staged": c.get("bytes_staged", 0),
         "bytes_written": c.get("bytes_written", 0),
         "bytes_read": c.get("bytes_read", 0),
@@ -353,6 +434,13 @@ def _render_doctor(record) -> None:
             f"  codec: {_human(c['codec_bytes_in'])} raw -> "
             f"{_human(c['codec_bytes_out'])} stored "
             f"({c['codec_ratio']:.2f}x)"
+        )
+    if c["cas_bytes_written"] or c["cas_bytes_shared"]:
+        ratio = c["cas_dedup_ratio"]
+        print(
+            f"  cas: {_human(c['cas_bytes_written'])} new + "
+            f"{_human(c['cas_bytes_shared'])} shared"
+            + (f" ({ratio:.2f}x dedup)" if ratio else "")
         )
     slow = record.get("slow_objects") or []
     if slow:
@@ -525,21 +613,32 @@ def _cmd_tiers(args) -> int:
     for step in candidates:
         durable_path = mgr.path_for_step(step)
         fast_path = mgr.fast_path_for_step(step)
-        manifest = None
+        metadata = None
         durable_committed = False
         fast_committed = False
         try:
-            manifest = Snapshot(durable_path).get_manifest()
+            metadata = Snapshot(durable_path).metadata
             durable_committed = True
         except Exception:  # noqa: BLE001
             pass
         try:
-            fast_manifest = Snapshot(fast_path).get_manifest()
+            fast_metadata = Snapshot(fast_path).metadata
             fast_committed = True
-            manifest = manifest or fast_manifest
+            metadata = metadata or fast_metadata
         except Exception:  # noqa: BLE001
             pass
-        locations = entry_locations(manifest) if manifest else []
+        # chunk-ref'd locations (cas/) are pool residents, not per-step
+        # objects — counting them as missing would misreport every
+        # CAS-backed step as partially resident
+        locations = (
+            [
+                loc
+                for loc in entry_locations(metadata.manifest)
+                if loc not in ((metadata.cas or {}).get("chunks") or {})
+            ]
+            if metadata
+            else []
+        )
         fast_n, fast_b = _residency(fast_path, locations)
         dur_n, dur_b = _residency(durable_path, locations)
         status = (
@@ -668,6 +767,69 @@ def _cmd_lint(args) -> int:
     return lint_main(list(args))
 
 
+def _cmd_cas(args) -> int:
+    """Operate on a chunk pool directly: index rollup (default),
+    ``--fsck`` rebuild from committed manifests, ``--gc`` mark+sweep.
+    ``root`` is the CAS root itself (``<manager-root>/cas``)."""
+    from . import cas as cas_mod
+
+    out: dict = {"root": args.root}
+    if args.fsck:
+        out["fsck"] = cas_mod.fsck(args.root)
+    if args.gc:
+        out["gc"] = cas_mod.run_gc(args.root, grace_s=args.grace)
+    store = cas_mod.ChunkStore(args.root)
+    try:
+        out["index"] = cas_mod.ChunkIndex.load(store).rollup()
+    except cas_mod.ChunkIndexCorruptError as e:
+        out["index_error"] = str(e)
+    finally:
+        store.sync_close()
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0 if "index_error" not in out else 1
+    if "index_error" in out:
+        print(f"error: {out['index_error']} (run with --fsck to rebuild)",
+              file=sys.stderr)
+        return 1
+    idx = out["index"]
+    print(f"{args.root}")
+    if out.get("fsck"):
+        f = out["fsck"]
+        print(
+            f"  fsck: {f['snapshots_committed']} committed snapshots, "
+            f"{f['chunks']} chunks, {f['orphans_marked']} orphans marked"
+            + (
+                f", {len(f['missing_chunks'])} MISSING"
+                if f["missing_chunks"]
+                else ""
+            )
+        )
+    if out.get("gc"):
+        g = out["gc"]
+        print(
+            f"  gc: {g['marked']} marked, {g['swept_chunks']} swept "
+            f"({_human(g['swept_bytes'])})"
+        )
+    print(
+        f"  {idx['live_chunks']} live chunks "
+        f"({_human(idx['live_bytes'])}), {idx['orphaned_chunks']} "
+        f"orphaned ({_human(idx['orphaned_bytes'])})"
+    )
+    hist = ", ".join(
+        f"{n}: {cnt}" for n, cnt in idx["refcount_histogram"].items()
+    )
+    if hist:
+        print(f"  refcount histogram: {hist}")
+    for step, st in idx["per_step"].items():
+        print(
+            f"  {step}: {st['chunks']} chunks, "
+            f"{_human(st['new_bytes'])} new + "
+            f"{_human(st['shared_bytes'])} shared"
+        )
+    return 0
+
+
 def _cmd_delete(args) -> int:
     from .manager import delete_snapshot
 
@@ -767,6 +929,23 @@ def main(argv=None) -> int:
     # dispatch happens before the parser (see main's lint intercept);
     # this registration exists for `--help` discoverability
     p.set_defaults(fn=lambda _args: _cmd_lint([]))
+
+    p = sub.add_parser(
+        "cas",
+        help="chunk-pool operations: index rollup (live/orphaned "
+        "chunks, refcounts, per-step shared-vs-new), --fsck index "
+        "rebuild, --gc mark+sweep",
+    )
+    p.add_argument("root", help="the CAS root (<manager-root>/cas)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--fsck", action="store_true",
+                   help="rebuild the index from committed manifests")
+    p.add_argument("--gc", action="store_true",
+                   help="run the two-phase mark+sweep")
+    p.add_argument("--grace", type=float, default=None,
+                   help="override the GC grace window (seconds)")
+    p.set_defaults(fn=_cmd_cas)
 
     p = sub.add_parser("delete", help="delete one snapshot (metadata-first)")
     p.add_argument("path")

@@ -1,0 +1,44 @@
+"""Content-addressed chunk store: chunk-level incremental snapshots
+with delta chains and refcounted GC.
+
+Layered UNDER the snapshot format: payload bytes are stored as
+content-keyed chunks in a shared per-root pool (``<root>/cas``), a
+take skips staging-pipeline writes for chunks an earlier committed
+step already stored, the manifest records chunk references (raw
+digests preserved — dedup and deep-verify stay bitwise-identical),
+and retention becomes refcounted two-phase GC so ANY step can be
+deleted without breaking the others.  See docs/incremental.md.
+
+Modules:
+
+- ``store``  — chunk keys/paths, the ``ChunkStore``, the
+  chunked/streamed write engines and the assembling read.
+- ``index``  — the refcounted self-CRC'd ``index.json`` plus ``fsck``
+  (rebuild from committed manifests).
+- ``gc``     — commit-side ref registration, release-on-delete, and
+  the mark/grace/sweep collector.
+"""
+
+from .gc import commit_refs, release_step, run_gc  # noqa: F401
+from .index import (  # noqa: F401
+    CHUNK_INDEX_FNAME,
+    ChunkIndex,
+    ChunkIndexCorruptError,
+    chunk_tables_from_metadata,
+    fsck,
+    norm_ref,
+)
+from .store import (  # noqa: F401
+    CasWriteContext,
+    ChunkStore,
+    cas_streamed_write,
+    chunk_key,
+    chunk_location,
+    chunked_read,
+    chunked_write,
+    key_size,
+    make_table,
+    record_root,
+    resolve_root,
+    validate_table,
+)

@@ -1,0 +1,248 @@
+"""Two-phase refcounted GC over the chunk pool, plus the commit-side
+index update.
+
+Lifecycle of a chunk:
+
+1. **written** by a take (or skipped — content already pooled);
+2. **referenced**: the take's rank 0 calls ``commit_refs`` strictly
+   BEFORE writing the ``.snapshot_metadata`` marker — from that moment
+   the chunk is protected even though the step isn't committed yet;
+3. **orphan-marked** (phase one): ``release_step`` (a deliberate
+   delete) or ``run_gc``'s mark pass finds it with zero live refs and
+   stamps ``orphaned_at`` — nothing is deleted yet;
+4. **swept** (phase two): after the grace window
+   (``TORCHSNAPSHOT_TPU_CAS_GC_GRACE_S``) the sweep RE-VERIFIES every
+   remaining ref against the commit markers and only then deletes the
+   chunk bytes and the index entry.  A chunk re-referenced at any
+   point before deletion is resurrected.
+
+The grace window is the concurrency story: a take that looked a chunk
+up as live can always commit its ref before a racing GC's sweep may
+touch it, as long as the window exceeds the take's duration.  Takes
+additionally never dedup against already-orphaned chunks
+(``ChunkIndex.live_keys``), so sweeps only ever race AGAINST
+resurrection, never against a fresh reference to a marked chunk.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import knobs, obs
+from ..resilience.failpoints import failpoint
+from .index import (
+    ChunkIndex,
+    ChunkIndexCorruptError,
+    _snapshot_is_committed,
+    fsck,
+    index_lock,
+    norm_ref,
+)
+from .store import ChunkStore, chunk_location, key_size
+
+logger = logging.getLogger(__name__)
+
+
+def commit_refs(
+    store: ChunkStore,
+    ref_id: str,
+    tables: Dict[str, Dict[str, Any]],
+) -> None:
+    """Register one take's chunk references in the index — called by
+    rank 0 strictly BEFORE the ``.snapshot_metadata`` marker (sync and
+    async commit paths both).  A crash after this but before the marker
+    leaves refs for an uncommitted step; the mark phase treats them as
+    dead and the grace window reclaims the chunks, so nothing leaks and
+    nothing committed is ever endangered.
+
+    Raises when a referenced chunk the index did not already track is
+    MISSING from the pool — the skip-write safety net: a sweep that
+    raced this take past the grace window (or an operator deleting pool
+    files by hand) must fail the take's commit here, never produce a
+    committed step whose restore hits missing chunks."""
+    with obs.span(
+        "cas/commit_refs", ref=ref_id, objects=len(tables)
+    ), index_lock(store.root):
+        try:
+            index = ChunkIndex.load(store)
+        except ChunkIndexCorruptError:
+            logger.warning(
+                "corrupt chunk index under %r at commit time; rebuilding "
+                "via fsck before registering refs", store.root,
+            )
+            fsck(store.root)
+            index = ChunkIndex.load(store)
+        # verify pool presence for keys the index has no entry for
+        # (newly written this take, or re-written content whose prior
+        # entry was swept mid-take) and for entries fsck flagged
+        # missing (this take re-wrote the content, healing the pool).
+        # Other index-tracked entries — live OR orphaned — are
+        # guaranteed on storage: the sweep removes the entry and the
+        # bytes together, under this same lock.
+        ref_keys = {
+            str(k) for t in tables.values() for k in t.get("keys", ())
+        }
+        check = sorted(
+            k
+            for k in ref_keys
+            if k not in index.chunks or index.chunks[k].get("missing")
+        )
+        missing = _stat_missing(store, check)
+        if missing:
+            raise RuntimeError(
+                f"cas commit for {ref_id!r}: {len(missing)} referenced "
+                f"chunk(s) missing from the pool (first: {missing[:3]}) "
+                f"— a GC sweep raced this take?  The commit is aborted; "
+                f"re-take the step."
+            )
+        for key in check:
+            entry = index.chunks.get(key)
+            if entry is not None:
+                entry.pop("missing", None)  # verifiably healed
+        index.add_refs(ref_id, tables)
+        index.save(store)
+        # deterministic crash window for the chaos suite: index updated,
+        # marker not yet written
+        failpoint("cas.index.commit", ref=ref_id)
+
+
+def _stat_missing(store: ChunkStore, keys: list) -> list:
+    """Keys (of ``keys``) absent from the pool or present with the
+    wrong size — concurrent stats, one event loop."""
+    if not keys:
+        return []
+    import asyncio
+
+    from ..utils.asyncio_utils import run_in_fresh_loop
+
+    async def gather():
+        sem = asyncio.Semaphore(16)
+
+        async def one(key: str):
+            async with sem:
+                try:
+                    ok = await store.stat(key) == key_size(key)
+                except FileNotFoundError:
+                    ok = False
+                return key, ok
+
+        return await asyncio.gather(*(one(k) for k in keys))
+
+    return [k for k, ok in run_in_fresh_loop(gather()) if not ok]
+
+
+def release_step(
+    cas_root: str,
+    path: str,
+    grace_s: Optional[float] = None,
+    now: Optional[float] = None,
+) -> int:
+    """Drop one deleted step's chunk refs and run a sweep for anything
+    already past the grace window.  Returns the byte count of chunks
+    whose refcount dropped to zero — the bytes this deletion actually
+    un-shared (chunks other steps still reference are NOT counted;
+    that is the ``snapshot.gc.bytes_reclaimed`` contract under CAS)."""
+    now = time.time() if now is None else now
+    store = ChunkStore(cas_root)
+    with obs.span(
+        "cas/release_step", root=cas_root, ref=path
+    ), index_lock(cas_root):
+        try:
+            try:
+                index = ChunkIndex.load(store)
+            except ChunkIndexCorruptError:
+                logger.warning(
+                    "corrupt chunk index under %r during delete; refs for "
+                    "%r will be reclaimed by the next fsck/gc",
+                    cas_root, path,
+                )
+                return 0
+            zeroed = index.release(norm_ref(path), now=now)
+            _sweep(store, index, grace_s, now)
+            index.save(store)
+            return sum(size for _key, size in zeroed)
+        finally:
+            store.sync_close()
+
+
+def run_gc(
+    cas_root: str,
+    snapshot_paths: Optional[List[str]] = None,
+    grace_s: Optional[float] = None,
+    now: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Full mark + sweep: refs are verified against the commit markers
+    (refs of never-committed or since-deleted steps go dead, committed
+    refs resurrect their chunks), then everything orphaned longer than
+    the grace window is re-verified and deleted.  The committed-ness
+    probes go straight to each ref's own commit marker (memoized per
+    ref) — ``snapshot_paths`` is used ONLY by the corrupt-index fsck
+    fallback, which needs the candidate list to rebuild from."""
+    now = time.time() if now is None else now
+    store = ChunkStore(cas_root)
+    with obs.span("cas/gc", root=cas_root), index_lock(cas_root):
+        try:
+            try:
+                index = ChunkIndex.load(store)
+            except ChunkIndexCorruptError:
+                logger.warning(
+                    "corrupt chunk index under %r; rebuilding via fsck "
+                    "before GC", cas_root,
+                )
+                fsck(cas_root, snapshot_paths, now=now)
+                index = ChunkIndex.load(store)
+            marked = index.mark(_snapshot_is_committed, now=now)
+            swept_keys, swept_bytes = _sweep(store, index, grace_s, now)
+            index.save(store)
+            return {
+                "root": cas_root,
+                "marked": marked,
+                "swept_chunks": swept_keys,
+                "swept_bytes": swept_bytes,
+                "chunks": len(index.chunks),
+            }
+        finally:
+            store.sync_close()
+
+
+def _sweep(
+    store: ChunkStore,
+    index: ChunkIndex,
+    grace_s: Optional[float],
+    now: float,
+) -> tuple:
+    """Phase two, in place on ``index``: delete chunks orphaned past
+    the grace window whose refs STILL all point at uncommitted steps
+    (the re-verification that makes a sweep racing a resurrecting
+    commit lose safely)."""
+    grace = knobs.get_cas_gc_grace_s() if grace_s is None else grace_s
+    swept = 0
+    swept_bytes = 0
+    verdicts: Dict[str, bool] = {}
+
+    def committed(ref: str) -> bool:
+        if ref not in verdicts:
+            verdicts[ref] = _snapshot_is_committed(ref)
+        return verdicts[ref]
+
+    for key in index.sweep_due(grace, now=now):
+        entry = index.chunks[key]
+        if any(committed(r) for r in entry["refs"]):
+            # resurrected since the mark; refs are kept as-is (an
+            # uncommitted-LOOKING ref may be an in-flight take's — see
+            # ChunkIndex.mark)
+            entry.pop("orphaned_at", None)
+            continue
+        try:
+            store.storage.sync_delete(chunk_location(key))
+        except FileNotFoundError:
+            pass  # idempotent: a previous partial sweep got the bytes
+        index.remove(key)
+        swept += 1
+        swept_bytes += entry["size"]
+    if swept:
+        obs.counter(obs.CAS_CHUNKS_SWEPT).inc(swept)
+        obs.counter(obs.CAS_BYTES_SWEPT).inc(swept_bytes)
+    return swept, swept_bytes

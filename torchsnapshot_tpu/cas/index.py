@@ -1,0 +1,481 @@
+"""The refcounted chunk index: which steps reference which chunks.
+
+One JSON document (``index.json`` at the CAS root, self-CRC'd with the
+shared trailer discipline from utils/selfcrc.py) mapping chunk key →
+``{size, refs, added_by, orphaned_at?}``:
+
+- ``refs`` — snapshot paths (normalized) whose committed manifests
+  reference the chunk.  A take adds its refs strictly BEFORE its
+  ``.snapshot_metadata`` marker, so an in-flight take's chunks are
+  protected from GC the moment they could matter; refs belonging to a
+  take that died pre-commit are cleaned up by the mark phase below.
+- ``added_by`` — the step that first introduced the chunk (feeds the
+  per-step new-vs-shared rollup in the ``stats``/``cas`` CLIs).
+- ``orphaned_at`` — set by the MARK phase when no ref looks committed;
+  the SWEEP phase deletes the chunk only after the grace window has
+  passed AND a re-verification still finds every ref dead.  A chunk
+  re-referenced while orphaned is resurrected (``orphaned_at``
+  cleared), which is what makes "GC racing a concurrent take" safe.
+
+Mutators are rank-0-only by convention (the same discipline as
+``manager_index.json``); the document is written atomically by every
+backend (fs temp+rename, object stores by nature).
+
+``fsck`` rebuilds the whole index from committed manifests — the
+recovery path after index corruption or a crash that left the index
+behind reality.  On listable roots (local fs) it also discovers
+on-disk chunks no manifest references and marks them orphaned so the
+sweep can reclaim them.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from .. import obs
+from ..io_types import ReadIO, WriteIO
+from ..utils.selfcrc import append_crc_trailer, strip_crc_trailer
+from .store import CHUNK_DIR, ChunkStore, key_size
+
+logger = logging.getLogger(__name__)
+
+CHUNK_INDEX_FNAME = "index.json"
+_INDEX_CRC_MARKER = "\n#tsnp-cas-crc32:"
+INDEX_VERSION = 1
+
+# One lock per pool root: every load-modify-save of index.json in this
+# process serializes through it.  The async-commit thread's commit_refs
+# legitimately races the training thread's retention/GC on rank 0 —
+# without this, interleaved read-modify-writes would clobber refs a
+# committed step depends on.  Cross-PROCESS mutators remain excluded by
+# the rank-0-single-writer convention (same as manager_index.json); the
+# grace window is the safety margin for out-of-band `cas --gc` runs.
+_LOCKS_GUARD = threading.Lock()
+_INDEX_LOCKS: Dict[str, Any] = {}
+
+
+def index_lock(root: str):
+    with _LOCKS_GUARD:
+        lock = _INDEX_LOCKS.get(root)
+        if lock is None:
+            lock = _INDEX_LOCKS[root] = threading.RLock()
+        return lock
+
+
+class ChunkIndexCorruptError(RuntimeError):
+    """The index document failed its self-checksum or its parse — run
+    ``fsck`` (or let the next take auto-fsck) to rebuild it from the
+    committed manifests."""
+
+
+def norm_ref(path: str) -> str:
+    """Canonical ref id for a snapshot path (trailing slashes and the
+    implicit-fs scheme spelling must not split one step into two ids)."""
+    p = path.rstrip("/")
+    if p.startswith("fs://"):
+        p = p[len("fs://"):]
+    return p
+
+
+class ChunkIndex:
+    def __init__(self, chunks: Optional[Dict[str, Dict[str, Any]]] = None):
+        # key -> {"size": int, "refs": [id...], "added_by": id,
+        #         "orphaned_at": float (absent when live)}
+        self.chunks: Dict[str, Dict[str, Any]] = chunks or {}
+
+    # ------------------------------------------------------ persistence
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"version": INDEX_VERSION, "chunks": self.chunks},
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_serialized(cls, s: str) -> "ChunkIndex":
+        try:
+            body, _ = strip_crc_trailer(
+                s, _INDEX_CRC_MARKER, "chunk index", CHUNK_INDEX_FNAME
+            )
+            d = json.loads(body)
+            chunks = {
+                str(k): dict(v) for k, v in (d.get("chunks") or {}).items()
+            }
+            for key, entry in chunks.items():
+                entry["size"] = int(entry.get("size", key_size(key)))
+                entry["refs"] = [str(r) for r in entry.get("refs", [])]
+        except Exception as e:
+            raise ChunkIndexCorruptError(
+                f"unusable {CHUNK_INDEX_FNAME}: {e!r}"
+            ) from e
+        return cls(chunks)
+
+    @classmethod
+    def load(cls, store: ChunkStore) -> "ChunkIndex":
+        """The committed index, or an empty one when none exists yet.
+        Raises ``ChunkIndexCorruptError`` (never silently degrades) on
+        a corrupt document."""
+        rio = ReadIO(path=CHUNK_INDEX_FNAME)
+        try:
+            store.storage.sync_read(rio)
+        except FileNotFoundError:
+            return cls()
+        return cls.from_serialized(bytes(rio.buf).decode())
+
+    def save(self, store: ChunkStore) -> None:
+        store.storage.sync_write(
+            WriteIO(
+                path=CHUNK_INDEX_FNAME,
+                buf=append_crc_trailer(
+                    self.to_json(), _INDEX_CRC_MARKER
+                ).encode(),
+                durable=True,
+            )
+        )
+
+    # ------------------------------------------------------- accounting
+
+    def live_keys(self) -> Set[str]:
+        """Keys a take may dedup against: present, NOT marked orphaned
+        (an orphaned chunk could be swept while the take is in flight,
+        so new takes re-write that content instead), and NOT flagged
+        missing by fsck (dedup against a chunk whose bytes are gone
+        would commit an unrestorable step; re-writing the content is
+        also what heals the pool)."""
+        return {
+            k
+            for k, e in self.chunks.items()
+            if "orphaned_at" not in e and not e.get("missing")
+        }
+
+    def add_refs(
+        self, ref_id: str, tables: Dict[str, Dict[str, Any]]
+    ) -> None:
+        """Register every chunk the given step's tables reference;
+        resurrects orphan-marked chunks (the step proved them live)."""
+        ref_id = norm_ref(ref_id)
+        for table in tables.values():
+            for key in table.get("keys", ()):
+                entry = self.chunks.get(key)
+                if entry is None:
+                    entry = self.chunks[key] = {
+                        "size": key_size(key),
+                        "refs": [],
+                        "added_by": ref_id,
+                    }
+                if ref_id not in entry["refs"]:
+                    entry["refs"].append(ref_id)
+                entry.pop("orphaned_at", None)
+
+    def release(
+        self, ref_id: str, now: Optional[float] = None
+    ) -> List[Tuple[str, int]]:
+        """Drop one step's refs; chunks left with zero refs are marked
+        orphaned at ``now`` (phase one of the two-phase GC — physical
+        deletion waits for the grace window).  Returns the
+        ``(key, size)`` pairs whose refcount dropped to zero — the
+        bytes this deletion actually un-shares."""
+        ref_id = norm_ref(ref_id)
+        now = time.time() if now is None else now
+        zeroed: List[Tuple[str, int]] = []
+        for key, entry in self.chunks.items():
+            if ref_id in entry["refs"]:
+                entry["refs"].remove(ref_id)
+                if not entry["refs"] and "orphaned_at" not in entry:
+                    entry["orphaned_at"] = now
+                    zeroed.append((key, entry["size"]))
+        return zeroed
+
+    def mark(
+        self,
+        is_committed: Callable[[str], bool],
+        now: Optional[float] = None,
+    ) -> int:
+        """Phase one over the WHOLE index: chunks with no committed ref
+        get orphan-marked; chunks with at least one committed ref are
+        resurrected.  Returns how many chunks were newly marked.
+
+        Refs that merely LOOK dead are never pruned here: an in-flight
+        take (index update before marker) and a write-back step whose
+        durable marker trails its promotion both hold not-yet-committed
+        refs that will become committed — dropping them from a chunk
+        that stays live (shared with a committed step) would leave the
+        later-committed step ref-less, and deleting its peers would
+        then sweep chunks it depends on.  Dead refs on live chunks cost
+        only rollup noise and are reconciled by ``release``/``fsck``;
+        all-dead chunks go through the orphan mark + grace + re-verify
+        sweep, which is where actual cleanup belongs."""
+        now = time.time() if now is None else now
+        verdicts: Dict[str, bool] = {}
+
+        def committed(ref: str) -> bool:
+            if ref not in verdicts:
+                verdicts[ref] = bool(is_committed(ref))
+            return verdicts[ref]
+
+        marked = 0
+        for key, entry in self.chunks.items():
+            if any(committed(r) for r in entry["refs"]):
+                entry.pop("orphaned_at", None)
+            elif "orphaned_at" not in entry:
+                entry["orphaned_at"] = now
+                marked += 1
+        return marked
+
+    def sweep_due(
+        self, grace_s: float, now: Optional[float] = None
+    ) -> List[str]:
+        """Keys whose orphan mark has outlived the grace window —
+        sweep candidates; the caller re-verifies refs before deleting."""
+        now = time.time() if now is None else now
+        return sorted(
+            k
+            for k, e in self.chunks.items()
+            if "orphaned_at" in e and now - e["orphaned_at"] >= grace_s
+        )
+
+    def remove(self, key: str) -> None:
+        self.chunks.pop(key, None)
+
+    # ---------------------------------------------------------- rollups
+
+    def rollup(self) -> Dict[str, Any]:
+        """Operator view for the ``stats``/``cas`` CLIs: live/orphaned
+        counts and bytes, the refcount histogram, and per-step
+        shared-vs-new byte attribution."""
+        live = orphaned = live_bytes = orphaned_bytes = 0
+        missing = 0
+        ref_hist: Dict[str, int] = {}
+        per_step: Dict[str, Dict[str, int]] = {}
+        for key, entry in self.chunks.items():
+            size = entry["size"]
+            if entry.get("missing"):
+                missing += 1
+            if "orphaned_at" in entry:
+                orphaned += 1
+                orphaned_bytes += size
+            else:
+                live += 1
+                live_bytes += size
+            n = len(entry["refs"])
+            ref_hist[str(n)] = ref_hist.get(str(n), 0) + 1
+            for ref in entry["refs"]:
+                st = per_step.setdefault(
+                    ref, {"chunks": 0, "new_bytes": 0, "shared_bytes": 0}
+                )
+                st["chunks"] += 1
+                if entry.get("added_by") == ref:
+                    st["new_bytes"] += size
+                else:
+                    st["shared_bytes"] += size
+        return {
+            "chunks": len(self.chunks),
+            "live_chunks": live,
+            "orphaned_chunks": orphaned,
+            "missing_chunks": missing,
+            "live_bytes": live_bytes,
+            "orphaned_bytes": orphaned_bytes,
+            "refcount_histogram": dict(sorted(ref_hist.items())),
+            "per_step": {k: per_step[k] for k in sorted(per_step)},
+        }
+
+
+# ---------------------------------------------------------------- fsck
+
+
+def _snapshot_is_committed(path: str) -> bool:
+    """A readable, intact ``.snapshot_metadata`` is the definition of
+    committed — the same contract the restore path enforces."""
+    from ..snapshot import Snapshot
+
+    try:
+        Snapshot(path).metadata  # noqa: B018 — parse == verification
+        return True
+    except Exception:  # noqa: BLE001 — absent or corrupt: not committed
+        return False
+
+
+def _local_base(root: str) -> Optional[str]:
+    """The listable local path behind ``root``: bare paths and the
+    ``fs://`` scheme (what url_to_storage_plugin maps to the fs
+    plugin; ``file://`` accepted as an alias) resolve; cloud/opaque
+    schemes return None."""
+    if "://" not in root:
+        return root
+    scheme, path = root.split("://", 1)
+    return path if scheme in ("", "fs", "file") else None
+
+
+def _scan_sibling_snapshots(cas_root: str) -> List[str]:
+    """Candidate snapshot dirs next to the CAS root (the manager
+    layout), local fs only — cloud roots must pass explicit paths."""
+    import os
+
+    base = _local_base(cas_root.rstrip("/"))
+    if base is None:
+        return []
+    parent = os.path.dirname(base)
+    cas_name = os.path.basename(base)
+    try:
+        names = os.listdir(parent)
+    except FileNotFoundError:
+        return []
+    return sorted(
+        os.path.join(parent, n)
+        for n in names
+        if n != cas_name and os.path.isdir(os.path.join(parent, n))
+    )
+
+
+def _list_pool_keys(cas_root: str) -> Optional[Set[str]]:
+    """Every chunk key physically present in the pool, or None when the
+    backend can't list (cloud roots: fsck then rebuilds refs only, and
+    unreferenced chunks are reclaimed when their writers re-run GC)."""
+    import os
+
+    local = _local_base(cas_root.rstrip("/"))
+    if local is None:
+        return None
+    base = os.path.join(local, CHUNK_DIR)
+    keys: Set[str] = set()
+    try:
+        fanout = os.listdir(base)
+    except FileNotFoundError:
+        return set()
+    for d in fanout:
+        sub = os.path.join(base, d)
+        try:
+            keys.update(os.listdir(sub))
+        except NotADirectoryError:
+            continue
+    return keys
+
+
+def fsck(
+    cas_root: str,
+    snapshot_paths: Optional[List[str]] = None,
+    now: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Rebuild the chunk index from committed manifests.
+
+    The recovery path after index corruption, or after a crash between
+    a take's index update and its commit marker: refs are recomputed
+    from what is ACTUALLY committed, and (on listable roots) chunks in
+    the pool that no committed manifest references are orphan-marked so
+    the next sweep reclaims them — never deleted here, because an
+    in-flight take may be about to commit refs to them (the grace
+    window still applies).
+
+    ``snapshot_paths``: the candidate steps; defaults to scanning the
+    CAS root's parent directory (local fs manager layout).  A default
+    scan that finds ZERO committed snapshots while the pool holds
+    chunks is refused: it is indistinguishable from a custom
+    (non-sibling) pool layout, and rebuilding would orphan-mark every
+    chunk of every committed step — pass explicit ``snapshot_paths``
+    (``SnapshotManager.fsck()`` does) to assert the empty set is
+    real."""
+    from ..snapshot import Snapshot
+
+    now = time.time() if now is None else now
+    scanned = snapshot_paths is None
+    store = ChunkStore(cas_root)
+    with obs.span("cas/fsck", root=cas_root), index_lock(cas_root):
+        try:
+            if snapshot_paths is None:
+                snapshot_paths = _scan_sibling_snapshots(cas_root)
+            index = ChunkIndex()
+            committed = 0
+            for path in snapshot_paths:
+                try:
+                    md = Snapshot(path).metadata
+                except Exception:  # noqa: BLE001 — aborted/corrupt step
+                    continue
+                committed += 1
+                tables = chunk_tables_from_metadata(md)
+                if tables:
+                    index.add_refs(norm_ref(path), tables)
+            pool_keys = _list_pool_keys(cas_root)
+            if scanned and committed == 0 and (
+                pool_keys is None or pool_keys
+            ):
+                # pool_keys None = un-listable (cloud) root, where the
+                # sibling scan also can't see snapshots — an empty
+                # rebuild would silently wipe every committed step's
+                # refs, so refuse BOTH the populated-pool and the
+                # can't-tell case
+                raise RuntimeError(
+                    f"cas fsck: sibling scan of {cas_root!r} found no "
+                    f"committed snapshots while the pool "
+                    f"{'cannot be listed' if pool_keys is None else f'holds {len(pool_keys)} chunk(s)'}"
+                    f" — a custom or cloud pool layout?  Rebuilding "
+                    f"would orphan (or silently un-ref) every chunk; "
+                    f"pass the snapshot paths explicitly "
+                    f"(SnapshotManager.fsck())."
+                )
+            orphans = 0
+            if pool_keys is not None:
+                for key in pool_keys - set(index.chunks):
+                    try:
+                        size = key_size(key)
+                    except (ValueError, IndexError):
+                        continue  # foreign file in the pool: leave it
+                    index.chunks[key] = {
+                        "size": size,
+                        "refs": [],
+                        "added_by": None,
+                        "orphaned_at": now,
+                    }
+                    orphans += 1
+            missing = sorted(
+                set(index.chunks) - pool_keys
+            ) if pool_keys is not None else []
+            if missing:
+                logger.warning(
+                    "cas fsck: %d referenced chunk(s) MISSING from the "
+                    "pool under %r (first: %s) — the referencing steps "
+                    "will fail deep verification",
+                    len(missing), cas_root, missing[:3],
+                )
+                for key in missing:
+                    # keep the refs (the damage report) but flag the
+                    # entry: takes must not dedup against bytes that
+                    # are gone — re-writing the content is what heals
+                    # the pool (commit_refs clears the flag once the
+                    # bytes verifiably exist again)
+                    index.chunks[key]["missing"] = True
+            index.save(store)
+            obs.counter(obs.CAS_FSCKS).inc()
+            return {
+                "root": cas_root,
+                "snapshots_committed": committed,
+                "chunks": len(index.chunks),
+                "orphans_marked": orphans,
+                "missing_chunks": missing,
+            }
+        finally:
+            store.sync_close()
+
+
+def chunk_tables_from_metadata(metadata: Any) -> Dict[str, Dict[str, Any]]:
+    """location → VALIDATED chunk table for a snapshot's chunk-ref'd
+    objects (structurally invalid tables are dropped with a warning so
+    the read path fails loudly at the storage layer instead of
+    assembling garbage)."""
+    from .store import validate_table
+
+    cas = getattr(metadata, "cas", None) or {}
+    out: Dict[str, Dict[str, Any]] = {}
+    for loc, table in (cas.get("chunks") or {}).items():
+        if validate_table(table):
+            out[loc] = table
+        else:
+            logger.warning(
+                "manifest chunk table for %r is structurally invalid "
+                "(version skew?); treating the object as plain storage",
+                loc,
+            )
+    return out

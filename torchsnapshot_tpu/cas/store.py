@@ -1,0 +1,449 @@
+"""Content-addressed chunk store: the shared chunk pool under a
+snapshot root.
+
+A chunk is an immutable byte range of a staged storage object, named by
+its content key — ``<crc32>-<adler32>-<size>`` in hex/decimal, the same
+two-independent-checksums-plus-exact-length trust basis the incremental
+dedup path already uses (one 32-bit collision can never silently alias
+two different chunks).  Chunks live under ``objects/<kk>/<key>`` at the
+CAS root (``<manager-root>/cas`` by default) and are shared by every
+step that references them; the refcounted index (index.py) tracks who.
+
+Write side: a take digests each staged object in ``chunk_size`` spans
+(deterministic boundaries — an unchanged slice of a mutated tensor
+produces the same key every step) and skips the write for any chunk the
+committed index already holds; only new content moves.  The streamed
+variant does the same per part inside the part pipeline, so a large
+object's unchanged parts release their admission window the moment
+their digest resolves — a skipped part never occupies a storage slot.
+
+Read side: ``chunked_read`` maps a RAW byte range onto the overlapping
+chunks and fans out parallel ranged reads, assembling into the
+``into`` destination when given (the same contract as striped/framed
+reads).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from .. import obs
+from ..io_types import ReadIO, StoragePlugin, WriteIO, resolve_read_destination
+from ..resilience.failpoints import failpoint
+from ..storage.stripe import plan_parts
+
+CHUNK_DIR = "objects"
+
+
+def chunk_key(digest: Tuple[int, int, int]) -> str:
+    """Content key for a chunk digest ``(crc32, adler32, size)``."""
+    crc, adler, size = digest
+    return f"{crc:08x}-{adler:08x}-{int(size)}"
+
+
+def key_size(key: str) -> int:
+    """The exact byte length a key's content must have (embedded in the
+    key itself, so integrity checks need no extra metadata read)."""
+    return int(key.rsplit("-", 1)[1])
+
+
+def chunk_location(key: str) -> str:
+    # two-hex-char fan-out keeps any one directory from holding the
+    # whole pool (fs roots; object stores don't care)
+    return f"{CHUNK_DIR}/{key[:2]}/{key}"
+
+
+def make_table(chunk_size: int, size: int, keys: List[str]) -> Dict[str, Any]:
+    """The manifest chunk-ref entry for one storage object: its raw
+    byte stream is the concatenation of ``keys``' chunk payloads, tiled
+    at ``chunk_size`` (last chunk short)."""
+    return {"chunk_size": int(chunk_size), "size": int(size), "keys": list(keys)}
+
+
+def validate_table(table: Any) -> bool:
+    """Structural check (version-skew guard, same contract as
+    codec.validate_table): a table that fails here is treated as absent
+    so the read fails loudly at the storage layer instead of silently
+    assembling garbage."""
+    if not isinstance(table, dict):
+        return False
+    try:
+        chunk_size = int(table["chunk_size"])
+        size = int(table["size"])
+        keys = table["keys"]
+    except (KeyError, TypeError, ValueError):
+        return False
+    if chunk_size <= 0 or size < 0 or not isinstance(keys, list):
+        return False
+    if len(keys) != len(plan_parts(size, chunk_size)):
+        return False
+    spans = plan_parts(size, chunk_size)
+    for key, (lo, hi) in zip(keys, spans):
+        try:
+            if key_size(str(key)) != hi - lo:
+                return False
+        except (ValueError, IndexError):
+            return False
+    return True
+
+
+def record_root(snapshot_path: str, cas_root: str) -> str:
+    """How the CAS root is written into a snapshot's metadata: relative
+    (``../cas``) when the root is a sibling of the snapshot directory —
+    the manager layout — so a rehomed checkpoint tree keeps restoring;
+    the configured URL verbatim otherwise."""
+    snap = snapshot_path.rstrip("/")
+    root = cas_root.rstrip("/")
+    parent = snap.rsplit("/", 1)[0] if "/" in snap else ""
+    if parent and root.startswith(parent + "/"):
+        rest = root[len(parent) + 1 :]
+        if rest and "/" not in rest:
+            return f"../{rest}"
+    return root
+
+
+def resolve_root(snapshot_path: str, recorded: str) -> str:
+    """Inverse of ``record_root`` at restore time."""
+    if recorded.startswith("../"):
+        snap = snapshot_path.rstrip("/")
+        parent = snap.rsplit("/", 1)[0] if "/" in snap else ""
+        return f"{parent}/{recorded[3:]}" if parent else recorded[3:]
+    return recorded
+
+
+class ChunkStore:
+    """Plugin-backed access to one CAS root's chunk pool.  Thin: all
+    policy (what to write, what to skip, when to delete) lives in the
+    callers; this owns only paths and idempotent chunk I/O."""
+
+    def __init__(
+        self, root: str, storage: Optional[StoragePlugin] = None
+    ) -> None:
+        self.root = root.rstrip("/")
+        self._storage = storage
+
+    @property
+    def storage(self) -> StoragePlugin:
+        if self._storage is None:
+            from ..storage import url_to_storage_plugin
+
+            self._storage = url_to_storage_plugin(self.root)
+        return self._storage
+
+    async def has(self, key: str) -> bool:
+        try:
+            return await self.storage.stat(chunk_location(key)) == key_size(key)
+        except FileNotFoundError:
+            return False
+
+    async def put(self, key: str, buf: Any) -> bool:
+        """Store ``buf`` under ``key`` unless an intact copy is already
+        durable (the promoter discipline: only content not already in
+        the pool moves).  Returns True when bytes were written.
+        Concurrent same-key puts are safe — both write the same content
+        and every backend's publish is atomic (fs temp+rename, object
+        stores by nature)."""
+        failpoint("cas.chunk.put", key=key)
+        if await self.has(key):
+            return False
+        await self.storage.write(WriteIO(path=chunk_location(key), buf=buf))
+        return True
+
+    async def read_chunk(
+        self,
+        key: str,
+        byte_range: Optional[Tuple[int, int]] = None,
+        into: Any = None,
+    ) -> Any:
+        rio = ReadIO(
+            path=chunk_location(key),
+            byte_range=list(byte_range) if byte_range else None,
+            into=into,
+        )
+        await self.storage.read(rio)
+        return rio.buf
+
+    async def stat(self, key: str) -> int:
+        return await self.storage.stat(chunk_location(key))
+
+    async def delete(self, key: str) -> None:
+        await self.storage.delete(chunk_location(key))
+
+    def sync_close(self) -> None:
+        if self._storage is not None:
+            self._storage.sync_close()
+            self._storage = None
+
+
+@dataclass
+class CasWriteContext:
+    """Everything one WriteReq needs to route through the chunk store:
+    attached by the take (snapshot.py) and consumed by the scheduler's
+    skip-write short-circuit.  ``known_keys`` is the committed index's
+    LIVE key set at take start (orphaned chunks are deliberately
+    excluded — a chunk already marked for sweeping must be re-written,
+    not referenced, or GC could race the in-flight take past the grace
+    window).  ``sink`` receives the object's chunk table, which rides
+    the post-staging checksum gather into ``SnapshotMetadata.cas``."""
+
+    store: ChunkStore
+    known_keys: Set[str]
+    chunk_size: int
+    sink: Callable[[Dict[str, Any]], None]
+    # chunks this context newly wrote (shared across the take's write
+    # reqs): a slab rewritten by two reqs in one take must not double-
+    # write, and intra-take repeats (tied weights) dedup for free
+    written_this_take: Set[str] = field(default_factory=set)
+
+
+def _digest_piece(piece: Any) -> Tuple[int, int, int]:
+    from ..utils.checksums import adler32_fast, crc32_fast
+
+    v = memoryview(piece).cast("B")
+    return (crc32_fast(v), adler32_fast(v), v.nbytes)
+
+
+def _chunk_concurrency() -> int:
+    from ..storage.stripe import part_concurrency
+
+    return part_concurrency()
+
+
+async def chunked_write(
+    ctx: CasWriteContext,
+    path: str,
+    buf: Any,
+    executor: Any = None,
+) -> Tuple[Dict[str, Any], int, int]:
+    """Store a whole-staged buffer as content-addressed chunks: digest
+    each span (on ``executor``), write only chunks the committed index
+    doesn't hold, and hand the chunk table to ``ctx.sink``.  Returns
+    ``(table, bytes_written, bytes_shared)``."""
+    view = memoryview(buf).cast("B")
+    total = view.nbytes
+    spans = plan_parts(total, ctx.chunk_size)
+    keys: List[Optional[str]] = [None] * len(spans)
+    loop = asyncio.get_running_loop()
+    sem = asyncio.Semaphore(_chunk_concurrency())
+    written = 0
+    shared = 0
+    m_written_b = obs.counter(obs.CAS_BYTES_WRITTEN)
+    m_shared_b = obs.counter(obs.CAS_BYTES_SHARED)
+    m_written_c = obs.counter(obs.CAS_CHUNKS_WRITTEN)
+    m_shared_c = obs.counter(obs.CAS_CHUNKS_SHARED)
+
+    with obs.span("cas/chunked_write", path=path, bytes=total, chunks=len(spans)):
+
+        async def one(idx: int, lo: int, hi: int) -> None:
+            nonlocal written, shared
+            piece = view[lo:hi]
+            if executor is not None:
+                digest = await loop.run_in_executor(
+                    executor, _digest_piece, piece
+                )
+            else:
+                digest = _digest_piece(piece)
+            key = chunk_key(digest)
+            keys[idx] = key
+            if key in ctx.known_keys or key in ctx.written_this_take:
+                shared += hi - lo
+                m_shared_b.inc(hi - lo)
+                m_shared_c.inc()
+                return
+            ctx.written_this_take.add(key)
+            async with sem:
+                with obs.span("cas/put_chunk", key=key, bytes=hi - lo):
+                    did_write = await ctx.store.put(key, piece)
+            if did_write:
+                written += hi - lo
+                m_written_b.inc(hi - lo)
+                m_written_c.inc()
+            else:
+                # durable already (an uncommitted earlier take, or a
+                # sibling rank racing this one): shared for accounting
+                shared += hi - lo
+                m_shared_b.inc(hi - lo)
+                m_shared_c.inc()
+
+        results = await asyncio.gather(
+            *(one(i, lo, hi) for i, (lo, hi) in enumerate(spans)),
+            return_exceptions=True,
+        )
+        errs = [r for r in results if isinstance(r, BaseException)]
+        if errs:
+            raise errs[0]
+    table = make_table(ctx.chunk_size, total, [k for k in keys])
+    ctx.sink(table)
+    return table, written, shared
+
+
+async def cas_streamed_write(
+    ctx: CasWriteContext,
+    path: str,
+    stager: Any,
+    spans: List[Tuple[int, int]],
+    executor: Any,
+    *,
+    window_parts: int,
+    on_part_staged: Optional[Callable[[int], None]] = None,
+    on_part_done: Optional[Callable[[int], None]] = None,
+    on_part_shared: Optional[Callable[[int], None]] = None,
+) -> List[Tuple[int, int, int]]:
+    """Per-part stage→digest→store streaming through the chunk pool:
+    the CAS twin of ``stripe.streamed_part_write``.  Part N stages,
+    digests (digest strictly BEFORE any write — the key IS the dedup
+    lookup), and either skips (content already committed: the part's
+    admission window releases immediately and no storage op runs) or
+    stores its chunk, while parts N+1… are still staging.  Spans must
+    tile the object at ``ctx.chunk_size`` so keys line up with the
+    chunk plan.  Returns ordered per-part raw digests for the caller to
+    fold into the whole-object digest."""
+    total = spans[-1][1]
+    digests: List[Optional[Tuple[int, int, int]]] = [None] * len(spans)
+    keys: List[Optional[str]] = [None] * len(spans)
+    loop = asyncio.get_running_loop()
+    window = asyncio.Semaphore(window_parts)
+    m_phase_stage = obs.histogram(obs.PHASE_STAGE_S)
+    m_phase_write = obs.histogram(obs.PHASE_WRITE_S)
+    m_written_b = obs.counter(obs.CAS_BYTES_WRITTEN)
+    m_shared_b = obs.counter(obs.CAS_BYTES_SHARED)
+    m_written_c = obs.counter(obs.CAS_CHUNKS_WRITTEN)
+    m_shared_c = obs.counter(obs.CAS_CHUNKS_SHARED)
+
+    with obs.span(
+        "cas/stream_write", path=path, bytes=total, chunks=len(spans)
+    ):
+
+        async def one(idx: int, span: Tuple[int, int]) -> None:
+            lo, hi = span
+            await window.acquire()
+            try:
+                t_stage = time.perf_counter()
+                failpoint("scheduler.stage.part", path=path, part=idx)
+                with obs.span(
+                    "cas/stage_part", path=path, part=idx, bytes=hi - lo
+                ):
+                    piece = await stager.stage_part(span, executor)
+                m_phase_stage.observe(time.perf_counter() - t_stage)
+                if on_part_staged is not None:
+                    on_part_staged(hi - lo)
+                if executor is not None:
+                    digest = await loop.run_in_executor(
+                        executor, _digest_piece, piece
+                    )
+                else:
+                    digest = _digest_piece(piece)
+                digests[idx] = digest
+                key = chunk_key(digest)
+                keys[idx] = key
+                if key in ctx.known_keys or key in ctx.written_this_take:
+                    # skip-write short-circuit: the content is already in
+                    # the pool — drop the staged part NOW (the finally
+                    # below releases the admission window) and never
+                    # enter the storage path
+                    m_shared_b.inc(hi - lo)
+                    m_shared_c.inc()
+                    if on_part_shared is not None:
+                        on_part_shared(hi - lo)
+                    if on_part_done is not None:
+                        on_part_done(0)
+                    return
+                ctx.written_this_take.add(key)
+                t0 = time.perf_counter()
+                with obs.span(
+                    "cas/put_chunk", key=key, part=idx, bytes=hi - lo
+                ):
+                    did_write = await ctx.store.put(key, piece)
+                m_phase_write.observe(time.perf_counter() - t0)
+                if did_write:
+                    m_written_b.inc(hi - lo)
+                    m_written_c.inc()
+                    if on_part_done is not None:
+                        on_part_done(hi - lo)
+                else:
+                    m_shared_b.inc(hi - lo)
+                    m_shared_c.inc()
+                    if on_part_shared is not None:
+                        on_part_shared(hi - lo)
+                    if on_part_done is not None:
+                        on_part_done(0)
+            finally:
+                window.release()
+
+        try:
+            results = await asyncio.gather(
+                *(one(i, s) for i, s in enumerate(spans)),
+                return_exceptions=True,
+            )
+        finally:
+            stager.release_source()
+        errs = [r for r in results if isinstance(r, BaseException)]
+        if errs:
+            raise errs[0]
+        # a failed take leaves already-written chunks in the pool with
+        # no index refs — harmless orphans the two-phase GC reclaims
+    ctx.sink(make_table(ctx.chunk_size, total, [k for k in keys]))
+    return [d for d in digests if d is not None]
+
+
+async def chunked_read(
+    store: ChunkStore,
+    path: str,
+    table: Dict[str, Any],
+    byte_range: Optional[List[int]] = None,
+    into: Any = None,
+) -> Any:
+    """Materialize ``[start, end)`` of a chunk-ref'd object's RAW byte
+    stream: parallel ranged reads of the overlapping chunks assembled
+    into one buffer (honoring the ``into`` destination hint by
+    identity, same contract as striped/framed reads)."""
+    chunk_size = int(table["chunk_size"])
+    size = int(table["size"])
+    keys = table["keys"]
+    if byte_range is None:
+        start, end = 0, size
+    else:
+        start, end = int(byte_range[0]), int(byte_range[1])
+    if not 0 <= start <= end <= size:
+        raise ValueError(
+            f"byte range [{start}, {end}) outside chunked object "
+            f"{path!r} of size {size}"
+        )
+    length = end - start
+    out = resolve_read_destination(into, length)
+    out_view = memoryview(out).cast("B")
+    sem = asyncio.Semaphore(_chunk_concurrency())
+
+    with obs.span("cas/chunked_read", path=path, bytes=length):
+
+        async def one(idx: int) -> None:
+            clo = idx * chunk_size
+            chi = min(clo + chunk_size, size)
+            lo, hi = max(start, clo), min(end, chi)
+            if lo >= hi:
+                return
+            dst = out_view[lo - start : hi - start]
+            async with sem:
+                rng = (
+                    None
+                    if (lo == clo and hi == chi)
+                    else (lo - clo, hi - clo)
+                )
+                buf = await store.read_chunk(keys[idx], rng, into=dst)
+            if buf is not dst:
+                got = memoryview(buf).cast("B")
+                if got.nbytes != hi - lo:
+                    raise IOError(
+                        f"chunk {keys[idx]} of {path!r} returned "
+                        f"{got.nbytes} bytes, wanted {hi - lo}"
+                    )
+                dst[:] = got
+
+        if length:
+            first = start // chunk_size
+            last = (end - 1) // chunk_size
+            await asyncio.gather(*(one(i) for i in range(first, last + 1)))
+    return out

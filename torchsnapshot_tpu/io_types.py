@@ -149,6 +149,15 @@ class WriteReq:
     # copies the base's stored bytes, so its frame table must carry over
     # verbatim.
     dedup_codec: Optional[dict] = None
+    # content-addressed chunk store (cas/): a CasWriteContext routing
+    # this write through the shared chunk pool instead of a per-step
+    # object — the scheduler digests the staged bytes in chunk-size
+    # spans, skips the write for every chunk an earlier committed step
+    # already stored, and the context's sink records the chunk table
+    # into the manifest.  Mutually exclusive with ``dedup`` (chunk-level
+    # addressing subsumes whole-object base links) and with the codec
+    # layer (chunks store raw bytes — their keys ARE raw digests).
+    cas: Optional[Any] = None
 
 
 def check_read_crc(read_req: "ReadReq", buf: Any) -> None:

@@ -52,6 +52,9 @@ _CODEC = "CODEC"
 _CODEC_LEVEL = "CODEC_LEVEL"
 _CODEC_MIN_RATIO = "CODEC_MIN_RATIO"
 _METRICS_TEXTFILE = "METRICS_TEXTFILE"
+_CAS = "CAS"
+_CAS_CHUNK_SIZE_BYTES = "CAS_CHUNK_SIZE_BYTES"
+_CAS_GC_GRACE_S = "CAS_GC_GRACE_S"
 _TIER_POLICY = "TIER_POLICY"
 _TIER_FAST_KEEP_LAST_N = "TIER_FAST_KEEP_LAST_N"
 _TIER_VERIFY_FAST_READS = "TIER_VERIFY_FAST_READS"
@@ -223,6 +226,25 @@ _DEFAULTS = {
     # raw_size >= CODEC_MIN_RATIO * frame_size — incompressible parts
     # stay raw (zero decode dependency, one 24-byte header).
     _CODEC_MIN_RATIO: 1.05,
+    # Content-addressed chunk store (cas/): SnapshotManager saves write
+    # payload bytes as content-keyed chunks in a per-root shared pool
+    # (<root>/cas) instead of per-step objects — a take skips the write
+    # for every chunk whose content an earlier committed step already
+    # stored, and retention becomes refcounted GC (any step deletable).
+    # 0 = off (per-step objects, the default); managers can also opt in
+    # per-instance via SnapshotManager(cas=...).
+    _CAS: 0,
+    # Chunk granularity for content addressing: staged objects are
+    # digested and stored in chunks of this size, so unchanged SLICES of
+    # a mutated tensor dedup across steps.  Smaller chunks find more
+    # sharing but cost more index entries and storage ops per object.
+    _CAS_CHUNK_SIZE_BYTES: 16 * 1024 * 1024,
+    # Two-phase GC grace window: a chunk whose refcount drops to zero is
+    # only MARKED orphaned; the sweep deletes it this many seconds
+    # later.  The window is what makes GC safe against a concurrent
+    # in-flight take that dedups against a chunk just before its last
+    # referencing step is deleted — size it above your longest take.
+    _CAS_GC_GRACE_S: 900.0,
     # Prometheus textfile export (obs/export.py): when set to a path,
     # take/restore/async-commit dump the metrics registry there in the
     # text exposition format on their way out (atomic tmp+rename), for
@@ -494,6 +516,20 @@ def get_codec_min_ratio() -> float:
     return max(1.0, float(_get_raw(_CODEC_MIN_RATIO)))
 
 
+def cas_enabled() -> bool:
+    """Default-on content addressing for SnapshotManager saves (the
+    per-instance ``cas=`` argument overrides in either direction)."""
+    return bool(_get_int(_CAS))
+
+
+def get_cas_chunk_size_bytes() -> int:
+    return max(4096, _get_int(_CAS_CHUNK_SIZE_BYTES))
+
+
+def get_cas_gc_grace_s() -> float:
+    return max(0.0, float(_get_raw(_CAS_GC_GRACE_S)))
+
+
 def get_metrics_textfile() -> Optional[str]:
     """Path for the OpenMetrics textfile dump, or None when export is
     off (the default).  This is the ONLY sanctioned read of
@@ -676,6 +712,18 @@ def override_codec_level(value: int):
 
 def override_codec_min_ratio(value: float):
     return _override(_CODEC_MIN_RATIO, value)
+
+
+def override_cas(value: bool):
+    return _override(_CAS, int(value))
+
+
+def override_cas_chunk_size_bytes(value: int):
+    return _override(_CAS_CHUNK_SIZE_BYTES, value)
+
+
+def override_cas_gc_grace_s(value: float):
+    return _override(_CAS_GC_GRACE_S, value)
 
 
 def override_metrics_textfile(value):

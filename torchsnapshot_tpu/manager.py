@@ -71,7 +71,10 @@ def entry_locations(manifest: Dict[str, Entry]) -> List[str]:
 
 
 def delete_snapshot(
-    path: str, manifest: Optional[Dict[str, Entry]] = None
+    path: str,
+    manifest: Optional[Dict[str, Entry]] = None,
+    metadata: Optional[SnapshotMetadata] = None,
+    release_cas: bool = True,
 ) -> None:
     """Delete one snapshot, committed or aborted, metadata-first.
 
@@ -80,26 +83,43 @@ def delete_snapshot(
     between here and the last object delete can never be observed as a
     committed snapshot with missing data.
 
-    ``manifest``, when the caller already verified/parsed it, skips the
-    metadata re-read (one fewer cloud round-trip per eviction)."""
+    ``manifest``/``metadata``, when the caller already verified/parsed
+    them, skip the metadata re-read (one fewer cloud round-trip per
+    eviction); ``metadata`` additionally carries the chunk tables a
+    CAS-backed step needs for ref release.
+
+    ``release_cas``: with a chunk store (cas/), drop this step's chunk
+    refs from the shared index after the per-step objects go — chunks
+    whose refcount hits zero are orphan-marked (and swept past the
+    grace window); chunks other steps still reference survive, which is
+    what lets ANY step of a chain be deleted.  Pass False for deletes
+    of secondary COPIES of a step (fast-tier eviction under a tiered
+    manager: the durable step still owns its refs)."""
     with log_event(Event("delete_snapshot", {"path": path})), obs.span(
         "manager/delete_snapshot", path=path
     ):
-        _delete_snapshot_impl(path, manifest)
+        _delete_snapshot_impl(path, manifest, metadata, release_cas)
 
 
 def _delete_snapshot_impl(
-    path: str, manifest: Optional[Dict[str, Entry]] = None
+    path: str,
+    manifest: Optional[Dict[str, Entry]] = None,
+    metadata: Optional[SnapshotMetadata] = None,
+    release_cas: bool = True,
 ) -> None:
     storage = url_to_storage_plugin(path)
     try:
         locations: List[str] = []
+        if metadata is not None and manifest is None:
+            manifest = metadata.manifest
         if manifest is None:
             try:
                 read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
                 storage.sync_read(read_io)
-                md = SnapshotMetadata.from_yaml(bytes(read_io.buf).decode())
-                manifest = md.manifest
+                metadata = SnapshotMetadata.from_yaml(
+                    bytes(read_io.buf).decode()
+                )
+                manifest = metadata.manifest
             except FileNotFoundError:
                 pass  # aborted snapshot: no manifest to enumerate
             except Exception as e:  # noqa: BLE001 — corrupt metadata
@@ -111,8 +131,17 @@ def _delete_snapshot_impl(
                     "data objects may be left behind",
                     SNAPSHOT_METADATA_FNAME, path, e,
                 )
+        cas_info = (metadata.cas or {}) if metadata is not None else {}
+        chunked_locs = set(cas_info.get("chunks") or {})
         if manifest is not None:
-            locations = entry_locations(manifest)
+            # chunk-ref'd locations have no per-step object to delete —
+            # their bytes belong to the shared pool and are handled by
+            # the ref release below
+            locations = [
+                loc
+                for loc in entry_locations(manifest)
+                if loc not in chunked_locs
+            ]
         try:
             storage.sync_delete(SNAPSHOT_METADATA_FNAME)
         except FileNotFoundError:
@@ -127,6 +156,25 @@ def _delete_snapshot_impl(
                 reclaimed += extents.get(loc, 0)
             except FileNotFoundError:
                 pass  # idempotent: partial previous GC
+        if release_cas and chunked_locs:
+            from . import cas as cas_mod
+
+            # strictly AFTER the metadata delete: a crash window leaves
+            # dangling refs for an uncommitted step, which the mark
+            # phase reclaims — never a committed step with released
+            # refs.  Only bytes whose refcount dropped to ZERO count as
+            # reclaimed (shared chunks stay, and so do their bytes).
+            try:
+                reclaimed += cas_mod.release_step(
+                    cas_mod.resolve_root(path, str(cas_info.get("root"))),
+                    path,
+                )
+            except Exception as e:  # noqa: BLE001 — refs are reclaimed
+                # by the next gc/fsck; the delete itself succeeded
+                logger.warning(
+                    "chunk-ref release for deleted %r failed (%r); the "
+                    "next cas gc/fsck will reconcile", path, e,
+                )
         if reclaimed:
             obs.counter(obs.GC_BYTES_RECLAIMED).inc(reclaimed)
     finally:
@@ -169,12 +217,31 @@ class SnapshotManager:
         prefix: str = "step_",
         coordinator: Optional[Coordinator] = None,
         tier: Optional[Union[TierConfig, Dict[str, Any]]] = None,
+        cas: Optional[Union[bool, str, Dict[str, Any]]] = None,
     ) -> None:
         if keep_last_n is not None and keep_last_n < 1:
             raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
         self.root = root.rstrip("/")
         self.keep_last_n = keep_last_n
         self.prefix = prefix
+        # content-addressed chunk store (cas/): payload bytes live in a
+        # shared per-root pool and every save dedups at chunk level
+        # against ALL committed steps; retention releases refs instead
+        # of assuming exclusive ownership.  None defers to the
+        # TORCHSNAPSHOT_TPU_CAS knob; True places the pool at
+        # <root>/cas; a str names the pool root; a dict may add
+        # chunk_size_bytes.
+        if cas is None:
+            cas = knobs.cas_enabled()
+        if isinstance(cas, (bool, int)):
+            # accept 0/1 too — the knob this mirrors is an int env var
+            cas = {} if cas else None
+        elif isinstance(cas, str):
+            cas = {"root": cas}
+        if cas is not None:
+            cas = dict(cas)
+            cas.setdefault("root", f"{self.root}/cas")
+        self.cas: Optional[Dict[str, Any]] = cas
         # tiered storage (tier/): ``root`` names the DURABLE tier; per-
         # step snapshots also land under ``tier.fast_root`` and reads go
         # fast-first.  Fast-tier retention (fast_keep_last_n) runs on
@@ -445,8 +512,12 @@ class SnapshotManager:
                 **(take_kwargs.get("storage_options") or {}),
                 **tier_opts,
             }
+        if self.cas is not None:
+            take_kwargs["cas"] = self.cas
         base: Optional[str] = None
-        if incremental:
+        if incremental and self.cas is None:
+            # the chunk store subsumes whole-object base links: with cas
+            # on, EVERY save already dedups against all committed steps
             prev = self._coord.broadcast_object(
                 self.latest_step() if self._coord.rank == 0 else None,
                 src=0,
@@ -527,16 +598,21 @@ class SnapshotManager:
                 if idx and step not in idx and step < max(idx):
                     continue
                 try:
-                    manifest = Snapshot(
+                    fast_md = Snapshot(
                         self.fast_path_for_step(step)
-                    ).get_manifest()
+                    ).metadata
                 except Exception:  # noqa: BLE001 — not fast-committed
                     continue
                 group = PromotionGroup(
                     self.fast_path_for_step(step),
                     self.path_for_step(step),
                 )
-                group.paths = set(entry_locations(manifest))
+                # chunk-ref'd locations are NOT per-step objects: their
+                # bytes already live in the (durable-rooted) chunk pool,
+                # so the promoter copies only what isn't durable yet
+                group.paths = set(
+                    entry_locations(fast_md.manifest)
+                ) - set((fast_md.cas or {}).get("chunks") or {})
                 group.recovery = True
                 promoter = get_promoter()
                 promoter.enqueue_data(group)
@@ -607,12 +683,54 @@ class SnapshotManager:
         """Apply retention: delete all but the newest ``keep_last_n``
         committed snapshots (rank 0), and — tiered — all but the newest
         ``fast_keep_last_n`` fast-tier copies (every rank, own fast root
-        only).  Safe to call any time."""
+        only).  CAS-backed managers additionally run a chunk-pool
+        mark+sweep (rank 0).  Safe to call any time."""
         with log_event(Event("manager_gc", {"root": self.root})):
             self._apply_fast_retention()
-            if self._coord.rank != 0 or self.keep_last_n is None:
+            if self._coord.rank != 0:
                 return
-            self._apply_retention(self._committed())
+            if self.keep_last_n is not None:
+                self._apply_retention(self._committed())
+            if self.cas is not None:
+                self.cas_gc()
+
+    def cas_gc(
+        self, grace_s: Optional[float] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Two-phase mark+sweep over the shared chunk pool: refs are
+        verified against the commit markers, chunks with no committed
+        referent are orphan-marked, and marks older than the grace
+        window (``TORCHSNAPSHOT_TPU_CAS_GC_GRACE_S`` unless
+        ``grace_s``) are re-verified and deleted.  Rank-0 discipline
+        like the index.  Returns the sweep summary, or None when CAS is
+        off."""
+        with obs.span("manager/cas_gc", root=self.root):
+            if self.cas is None or self._coord.rank != 0:
+                return None
+            from . import cas as cas_mod
+
+            steps = sorted(set(self._read_index()) | set(self._scan_fs()))
+            return cas_mod.run_gc(
+                self.cas["root"],
+                [self.path_for_step(s) for s in steps],
+                grace_s=grace_s,
+            )
+
+    def fsck(self) -> Optional[Dict[str, Any]]:
+        """Rebuild the chunk index from this root's committed manifests
+        (cas.fsck) — the recovery path after index corruption or a
+        crash between a take's index update and its commit marker.
+        Returns the rebuild summary, or None when CAS is off."""
+        with obs.span("manager/fsck", root=self.root):
+            if self.cas is None:
+                return None
+            from . import cas as cas_mod
+
+            steps = sorted(set(self._read_index()) | set(self._scan_fs()))
+            return cas_mod.fsck(
+                self.cas["root"],
+                [self.path_for_step(s) for s in steps],
+            )
 
     def _apply_retention(self, committed: Dict[int, Snapshot]) -> None:
         if self.keep_last_n is None:
@@ -620,10 +738,11 @@ class SnapshotManager:
         evict = list(committed)[: -self.keep_last_n]
         for step in evict:
             logger.info("retention: deleting snapshot step %d", step)
-            # reuse the just-verified manifest: no metadata re-read
-            manifest = committed[step].get_manifest()
+            # reuse the just-verified metadata: no re-read, and the
+            # chunk tables travel with it so ref release works
+            metadata = committed[step].metadata
             delete_snapshot(
-                self.path_for_step(step), manifest=manifest
+                self.path_for_step(step), metadata=metadata
             )
             if self.tier is not None:
                 # the evicted step's fast copy goes with it (this rank's
@@ -631,9 +750,14 @@ class SnapshotManager:
                 # _apply_fast_retention sweeps).  A degraded fast disk
                 # must not fail a save whose checkpoint already
                 # committed — the leftover is retried by later sweeps.
+                # release_cas=False: the durable delete above already
+                # dropped this step's chunk refs; a COPY delete must
+                # never double-release them.
                 try:
                     delete_snapshot(
-                        self.fast_path_for_step(step), manifest=manifest
+                        self.fast_path_for_step(step),
+                        metadata=metadata,
+                        release_cas=False,
                     )
                 except Exception as e:  # noqa: BLE001
                     logger.warning(
@@ -671,16 +795,19 @@ class SnapshotManager:
             self.tier.fast_root, require_metadata=False
         )
         for step in fast_steps[:-keep] if keep else fast_steps:
-            manifest = None
+            # metadata (not just the manifest): the chunk-ref tables
+            # travel with it, so the delete skips per-step object
+            # deletes for locations that only ever lived in the pool
+            metadata = None
             # _durable_ok caches positives, so a step stuck unpromoted
             # (cloud outage) costs ONE metadata probe per sweep and a
             # confirmed-durable step costs none
             durable_ok = self._durable_ok(step)
             if durable_ok:
                 try:
-                    manifest = Snapshot(
+                    metadata = Snapshot(
                         self.path_for_step(step)
-                    ).get_manifest()
+                    ).metadata
                 except Exception as e:  # noqa: BLE001 — fall through below
                     logger.debug(
                         "fast-tier retention: durable manifest read for "
@@ -700,23 +827,28 @@ class SnapshotManager:
                     )
                     continue
                 try:
-                    manifest = Snapshot(
+                    metadata = Snapshot(
                         self.fast_path_for_step(step)
-                    ).get_manifest()
+                    ).metadata
                 except Exception as e:  # noqa: BLE001
                     logger.debug(
                         "fast-tier retention: fast manifest read for "
                         "step %d failed (%r); evicting without the "
                         "object list", step, e,
                     )
-                    manifest = None
+                    metadata = None
             logger.info(
                 "fast-tier retention: evicting local copy of step %d",
                 step,
             )
             try:
+                # cross-tier GC is refcount-aware: evicting the FAST
+                # copy of a durably-committed step must not release the
+                # step's chunk refs — the durable step still owns them
                 delete_snapshot(
-                    self.fast_path_for_step(step), manifest=manifest
+                    self.fast_path_for_step(step),
+                    metadata=metadata,
+                    release_cas=False,
                 )
             except Exception as e:  # noqa: BLE001 — degraded fast disk
                 # must not abort an already-committed save

@@ -421,6 +421,15 @@ class SnapshotMetadata:
     # is stored raw — which makes every pre-codec-era snapshot (no
     # "codecs" key at all) restore through the unchanged raw path.
     codecs: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    # Content-addressed chunk refs (cas/): {"root": <cas root, relative
+    # "../cas" under a manager layout>, "chunks": {location → chunk
+    # table (cas.make_table: chunk_size, raw size, ordered content
+    # keys)}}.  A location present here has NO per-step storage object —
+    # its raw byte stream assembles from the shared chunk pool; raw
+    # digests in ``objects`` above are preserved, so dedup comparisons
+    # and deep-verify stay bitwise-identical.  ABSENT key ⇒ pre-CAS
+    # snapshot: every read goes through the unchanged per-step path.
+    cas: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self) -> str:
         d = {
@@ -432,6 +441,8 @@ class SnapshotMetadata:
             d["objects"] = self.objects
         if self.codecs:
             d["codecs"] = self.codecs
+        if self.cas:
+            d["cas"] = self.cas
         return json.dumps(d, sort_keys=True)
 
     # JSON is a YAML subset; emit JSON for speed, accept YAML on read
@@ -478,6 +489,9 @@ class SnapshotMetadata:
                 for k, v in (d.get("codecs") or {}).items()
                 if isinstance(v, dict)
             },
+            cas=(
+                dict(d["cas"]) if isinstance(d.get("cas"), dict) else {}
+            ),
         )
 
     from_json = from_yaml

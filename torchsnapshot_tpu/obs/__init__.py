@@ -48,6 +48,13 @@ from .metrics import (  # noqa: F401
     BYTES_STAGED,
     BYTES_WRITTEN,
     BYTES_BUCKETS,
+    CAS_BYTES_SHARED,
+    CAS_BYTES_SWEPT,
+    CAS_BYTES_WRITTEN,
+    CAS_CHUNKS_SHARED,
+    CAS_CHUNKS_SWEPT,
+    CAS_CHUNKS_WRITTEN,
+    CAS_FSCKS,
     EVENT_HANDLER_ERRORS,
     EXCEPTIONS_SWALLOWED,
     GC_BYTES_RECLAIMED,

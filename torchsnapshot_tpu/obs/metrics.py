@@ -114,8 +114,22 @@ PHASE_PREFIX = "phase."
 GOODPUT_TIME_TO_UNBLOCK_S = "goodput.time_to_unblock_s"
 GOODPUT_DURABILITY_LAG_S = "goodput.durability_lag_s"
 GOODPUT_OVERHEAD_FRACTION = "goodput.overhead_fraction"
-# GC/retention: bytes of storage objects reclaimed by delete_snapshot
+# GC/retention: bytes of storage objects reclaimed by delete_snapshot.
+# Under the chunk store (cas/) this counts per-step objects PLUS only
+# the chunks whose refcount dropped to zero — shared chunks are not
+# reclaimed by deleting one of their referencing steps.
 GC_BYTES_RECLAIMED = "snapshot.gc.bytes_reclaimed"
+# Content-addressed chunk store (cas/): chunks/bytes a take actually
+# wrote vs skipped because an earlier committed step already stored the
+# content (bytes_shared / bytes_written is the take's dedup win), chunks
+# physically deleted by the two-phase GC sweep, and index rebuilds.
+CAS_CHUNKS_WRITTEN = "cas.chunks_written"
+CAS_CHUNKS_SHARED = "cas.chunks_shared"
+CAS_BYTES_WRITTEN = "cas.bytes_written"
+CAS_BYTES_SHARED = "cas.bytes_shared"
+CAS_CHUNKS_SWEPT = "cas.chunks_swept"
+CAS_BYTES_SWEPT = "cas.bytes_swept"
+CAS_FSCKS = "cas.fscks"
 # Resilience (resilience/): transient-error retries (total, plus
 # per-backend twins named resilience.<backend>.retries), cross-rank
 # aborts initiated via the poison protocol, deterministic failpoint

@@ -86,10 +86,22 @@ class SharedProgress:
     def record_progress(self) -> None:
         self.last_progress = time.monotonic()
 
-    def should_retry(self, attempt: int) -> bool:
+    def should_retry(
+        self, attempt: int, started: Optional[float] = None
+    ) -> bool:
+        """``started``: when the CURRENT op began — the window must
+        never count idle time from before the op existed.  A
+        SharedProgress can sit idle arbitrarily long between operations
+        (a process-global one like the codec's encodes; a plugin that
+        last saw traffic minutes ago), and without the floor the first
+        transient after such a gap would read as "no progress for the
+        whole window" and surface un-retried."""
         if attempt >= self.max_attempts:
             return False
-        return (time.monotonic() - self.last_progress) < self.window_s
+        anchor = self.last_progress
+        if started is not None and started > anchor:
+            anchor = started
+        return (time.monotonic() - anchor) < self.window_s
 
     def backoff_delay(self, attempt: int) -> float:
         cap = knobs.get_retry_backoff_cap_s()
@@ -149,6 +161,9 @@ async def _retry_loop(
 ) -> Any:
     loop = asyncio.get_running_loop() if executor is not None else None
     attempt = 0
+    # floor for the progress window: idle time BEFORE this op began is
+    # not this op's stall (see SharedProgress.should_retry)
+    started = time.monotonic()
     # the most recent backoff span: the retry sequence's FINAL verdict
     # (success / fatal / exhausted) is stamped onto it when the loop
     # resolves, so a trace shows how each backoff chain ended without
@@ -210,7 +225,7 @@ async def _retry_loop(
             attempt += 1
             obs.counter(obs.RESILIENCE_RETRIES).inc()
             obs.counter(f"resilience.{backend}.retries").inc()
-            if not progress.should_retry(attempt):
+            if not progress.should_retry(attempt, started=started):
                 if breaker is not None:
                     breaker.record_failure()
                 _stamp_final("exhausted")

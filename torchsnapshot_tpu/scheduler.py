@@ -43,6 +43,7 @@ import psutil
 
 from . import codec as codec_mod
 from . import knobs
+from .cas import store as cas_store_mod
 from .io_types import (
     ReadIO,
     ReadReq,
@@ -261,6 +262,10 @@ class _WritePipeline:
         "buf_size",
         "deduped",
         "defer_digest",
+        # chunk-store accounting (cas/): bytes actually written vs
+        # skipped because the content was already pooled
+        "cas_written",
+        "cas_shared",
     )
 
     def __init__(self, write_req: WriteReq) -> None:
@@ -279,6 +284,8 @@ class _WritePipeline:
         # checksums deferred to the write itself (fused digest-while-
         # writing on honoring plugins; post-write fallback otherwise)
         self.defer_digest = False
+        self.cas_written = 0
+        self.cas_shared = 0
 
 
 class PendingIOWork:
@@ -386,8 +393,37 @@ async def _execute_write_pipelines(
     # without one (external callers, metadata) could never be decoded.
     codec_spec = codec_mod.resolve_write_spec()
     part_size = knobs.get_stripe_part_size_bytes()
+    stream_floor = knobs.get_stripe_min_object_size_bytes()
     for p in pipelines:
         wr = p.write_req
+        if wr.cas is not None:
+            # CAS part pipeline (cas/store.cas_streamed_write): large
+            # objects stage→digest→store per CHUNK, so an unchanged
+            # part skips its write and releases its admission window
+            # the moment its digest resolves.  Needs whole-buffer-only
+            # checksum sinks (interior slab ranges want the assembled
+            # buffer) and the same size floor as striping — chunk puts
+            # need no striped-write plugin capability (each chunk is an
+            # ordinary whole-object write).
+            if (
+                stream_floor is not None
+                and p.staging_cost >= stream_floor
+                and all(
+                    rng is None for _, rng in (wr.checksum_sinks or ())
+                )
+            ):
+                spans = wr.buffer_stager.part_plan(wr.cas.chunk_size)
+                if (
+                    spans
+                    and len(spans) > 1
+                    and spans[-1][1] == p.staging_cost
+                ):
+                    p.stream_spans = spans
+                    p.admission_cost = min(
+                        p.staging_cost,
+                        _STREAM_WINDOW_PARTS * wr.cas.chunk_size,
+                    )
+            continue
         if (
             wr.dedup is None
             and stripe.write_eligible(p.staging_cost, storage)
@@ -465,7 +501,14 @@ async def _execute_write_pipelines(
         p.buf = await p.write_req.buffer_stager.stage_buffer(executor)
         p.buf_size = _buf_nbytes(p.buf)
         wr = p.write_req
-        will_encode = codec_spec is not None and wr.codec_sink is not None
+        # chunk-store writes never encode (chunk keys ARE raw digests;
+        # compressing would re-key identical content per take) and never
+        # defer digests (the skip-write decision needs them pre-write)
+        will_encode = (
+            codec_spec is not None
+            and wr.codec_sink is not None
+            and wr.cas is None
+        )
         if (wr.checksum_sinks or wr.digest_sink) and (
             knobs.write_checksums_enabled()
         ):
@@ -473,6 +516,7 @@ async def _execute_write_pipelines(
             if (
                 getattr(storage, "supports_fused_digest", False)
                 and wr.dedup is None
+                and wr.cas is None
                 and not will_encode  # fused digest would hash STORED bytes
                 and precomputed is None
                 and not stripe.write_eligible(p.buf_size, storage)
@@ -535,6 +579,17 @@ async def _execute_write_pipelines(
     async def _write_one_inner(p: _WritePipeline) -> _WritePipeline:
         failpoint("scheduler.write", path=p.write_req.path)
         wr = p.write_req
+        if wr.cas is not None:
+            # content-addressed skip-write short-circuit: digest the
+            # staged buffer in chunk-size spans and move only the
+            # chunks no committed step already pooled; the chunk table
+            # (not a per-step object) is what reaches the manifest
+            _table, p.cas_written, p.cas_shared = (
+                await cas_store_mod.chunked_write(
+                    wr.cas, wr.path, p.buf, executor
+                )
+            )
+            return p
         if wr.dedup is not None and wr.object_digest == wr.dedup[1]:
             # content unchanged vs the base snapshot: link/server-side
             # copy instead of moving the bytes again.  Any failure
@@ -618,22 +673,40 @@ async def _execute_write_pipelines(
             # chaos schedules keep covering streamed objects
             failpoint("scheduler.stage", path=wr.path)
             failpoint("scheduler.write", path=wr.path)
-            digests = await stripe.streamed_part_write(
-                storage,
-                wr.path,
-                wr.buffer_stager,
-                p.stream_spans,
-                executor,
-                window_parts=_STREAM_WINDOW_PARTS,
-                on_part_staged=on_part_staged,
-                on_part_done=on_part_done,
-                want_digests=want,
-                codec_spec=stream_codec,
-                filter_stride=getattr(
-                    wr.buffer_stager, "codec_filter_stride", 0
-                ),
-                codec_sink=wr.codec_sink,
-            )
+            if wr.cas is not None:
+                # CAS part pipeline: stage→digest→store per chunk;
+                # unchanged chunks skip their write and on_part_done
+                # reports 0 bytes for them, so accounting below sees
+                # only content that moved; skipped bytes feed
+                # bytes_deduped like the whole-staged CAS path does
+                digests = await cas_store_mod.cas_streamed_write(
+                    wr.cas,
+                    wr.path,
+                    wr.buffer_stager,
+                    p.stream_spans,
+                    executor,
+                    window_parts=_STREAM_WINDOW_PARTS,
+                    on_part_staged=on_part_staged,
+                    on_part_done=on_part_done,
+                    on_part_shared=m_deduped.inc,
+                )
+            else:
+                digests = await stripe.streamed_part_write(
+                    storage,
+                    wr.path,
+                    wr.buffer_stager,
+                    p.stream_spans,
+                    executor,
+                    window_parts=_STREAM_WINDOW_PARTS,
+                    on_part_staged=on_part_staged,
+                    on_part_done=on_part_done,
+                    want_digests=want,
+                    codec_spec=stream_codec,
+                    filter_stride=getattr(
+                        wr.buffer_stager, "codec_filter_stride", 0
+                    ),
+                    codec_sink=wr.codec_sink,
+                )
         p.buf_size = p.staging_cost
         if want and digests:
             from .utils.checksums import combine_piece_digests
@@ -743,7 +816,14 @@ async def _execute_write_pipelines(
                 else:
                     io_tasks.discard(task)
                     p = task.result()
-                    if not p.deduped:  # linked objects moved no bytes
+                    if p.write_req.cas is not None:
+                        # chunked objects account what actually moved;
+                        # skipped chunk bytes are the dedup win
+                        stats["bytes_written"] += p.cas_written
+                        m_written.inc(p.cas_written)
+                        if p.cas_shared:
+                            m_deduped.inc(p.cas_shared)
+                    elif not p.deduped:  # linked objects moved no bytes
                         stats["bytes_written"] += p.buf_size
                         m_written.inc(p.buf_size)
                     else:
@@ -928,6 +1008,7 @@ async def _execute_read_pipelines(
     budget: _Budget,
     executor: ThreadPoolExecutor,
     codec_tables: Optional[dict] = None,
+    cas_reads: Optional[tuple] = None,
 ) -> None:
     ready_for_io = deque(pipelines)
     io_tasks: set = set()
@@ -1007,6 +1088,25 @@ async def _execute_read_pipelines(
 
     async def _read_one_inner(p: _ReadPipeline, sp) -> _ReadPipeline:
         rr = p.read_req
+        if cas_reads is not None:
+            ctable = cas_reads[1].get(rr.path)
+            if ctable is not None:
+                # chunk-ref'd object (cas/): no per-step storage object
+                # exists at this location — assemble the RAW byte range
+                # from the shared chunk pool (parallel ranged chunk
+                # reads, into-honoring).  Chunked objects are never
+                # codec-encoded or striped, so this subsumes both.
+                p.buf = await cas_store_mod.chunked_read(
+                    cas_reads[0],
+                    rr.path,
+                    ctable,
+                    byte_range=rr.byte_range,
+                    into=rr.into,
+                )
+                if sp is not None:
+                    sp.attrs["cas"] = True
+                    sp.attrs["bytes"] = _buf_nbytes(p.buf)
+                return p
         table = codec_tables.get(rr.path) if codec_tables else None
         if table is not None:
             # codec-encoded object (codec.py): the byte range is a
@@ -1156,6 +1256,7 @@ def sync_execute_read_reqs(
     memory_budget_bytes: int,
     rank: int,
     codec_tables: Optional[dict] = None,
+    cas_reads: Optional[tuple] = None,
 ) -> None:
     """Execute read requests under the memory budget (reference
     sync_execute_read_reqs, scheduler.py:449-463).
@@ -1163,7 +1264,12 @@ def sync_execute_read_reqs(
     ``codec_tables``: location → manifest codec-table entry for objects
     stored as compressed frames (SnapshotMetadata.codecs); reads of
     those locations decode transparently — byte ranges stay RAW
-    everywhere above this call."""
+    everywhere above this call.
+
+    ``cas_reads``: ``(ChunkStore, {location → chunk table})`` for
+    chunk-ref'd objects (SnapshotMetadata.cas); reads of those
+    locations assemble from the shared chunk pool instead of the
+    snapshot's own storage — equally transparent."""
     executor = ThreadPoolExecutor(
         max_workers=knobs.get_staging_threads(), thread_name_prefix="tsnp-consume"
     )
@@ -1173,7 +1279,7 @@ def sync_execute_read_reqs(
     t0 = time.monotonic()
     fut = loop_thread.submit(
         _execute_read_pipelines(
-            pipelines, storage, budget, executor, codec_tables
+            pipelines, storage, budget, executor, codec_tables, cas_reads
         )
     )
     try:

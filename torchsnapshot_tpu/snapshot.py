@@ -338,16 +338,21 @@ def _crc_payload(
     local_entries: Dict[str, Entry],
     object_crcs: Dict[str, int],
     object_codecs: Optional[Dict[str, Any]] = None,
+    object_cas: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One rank's post-staging checksum contribution: per-payload entry
     crcs + whole-object crcs (the incremental-dedup table) + codec frame
-    tables for objects this rank stored compressed (codec.py)."""
+    tables for objects this rank stored compressed (codec.py) + chunk
+    tables for objects this rank routed through the chunk store
+    (cas/)."""
     out = {
         "entries": _collect_local_crcs(local_entries),
         "objects": dict(object_crcs),
     }
     if object_codecs:
         out["codecs"] = dict(object_codecs)
+    if object_cas:
+        out["cas"] = dict(object_cas)
     return out
 
 
@@ -360,6 +365,11 @@ def _merge_crc_payloads(
     for p in payloads:
         metadata.objects.update(p.get("objects") or {})
         metadata.codecs.update(p.get("codecs") or {})
+        if p.get("cas"):
+            # the root/chunk_size envelope was rank-agreed at planning
+            # time (set in _take_impl_inner); only the per-rank chunk
+            # tables merge here
+            metadata.cas.setdefault("chunks", {}).update(p["cas"])
 
 
 _STRIPE_EVENT_COUNTERS = (
@@ -399,6 +409,59 @@ def _stripe_event_stamp():
     return stamp
 
 
+def _normalize_cas_config(cas: Any, path: str) -> Optional[Dict[str, Any]]:
+    """Resolve a take's ``cas`` argument to ``{"root", "chunk_size"}``
+    (or None = off).  ``True`` places the pool next to the snapshot
+    (``<parent>/cas`` — the manager layout); a string names the root
+    URL; a dict may override ``chunk_size_bytes``."""
+    if not cas:
+        return None
+    cfg: Dict[str, Any] = {}
+    if isinstance(cas, str):
+        cfg["root"] = cas
+    elif isinstance(cas, dict):
+        cfg.update(cas)
+    if not cfg.get("root"):
+        snap = path.rstrip("/")
+        parent = snap.rsplit("/", 1)[0] if "/" in snap else ""
+        if not parent:
+            raise ValueError(
+                f"cas=True needs a parent directory to place the pool "
+                f"next to {path!r}; pass an explicit root instead"
+            )
+        cfg["root"] = f"{parent}/cas"
+    cfg["chunk_size"] = int(
+        cfg.pop("chunk_size_bytes", None)
+        or cfg.get("chunk_size")
+        or knobs.get_cas_chunk_size_bytes()
+    )
+    return {"root": cfg["root"].rstrip("/"), "chunk_size": cfg["chunk_size"]}
+
+
+def _cas_commit_refs(
+    metadata: SnapshotMetadata, path: str, store: Any = None
+) -> None:
+    """Register this take's chunk references in the shared index —
+    strictly BEFORE the ``.snapshot_metadata`` marker, on the same
+    (rank 0) code path, so a committed step's chunks can never be
+    unprotected.  A failure here fails the commit (a marker whose
+    chunks GC could reap would be a corrupt-by-construction snapshot)."""
+    from . import cas as cas_mod
+
+    tables = (metadata.cas or {}).get("chunks") or {}
+    if not tables:
+        return
+    owned = store is None
+    if owned:
+        root = cas_mod.resolve_root(path, metadata.cas["root"])
+        store = cas_mod.ChunkStore(root)
+    try:
+        cas_mod.commit_refs(store, path, tables)
+    finally:
+        if owned:
+            store.sync_close()
+
+
 def _validate_app_state(app_state: Dict[str, Any]) -> None:
     # reference snapshot.py:672-690
     for key, value in app_state.items():
@@ -436,6 +499,7 @@ class Snapshot:
         base: Optional[str] = None,
         leaf_transform: Optional[Callable[[str, Any], Any]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
+        cas: Optional[Any] = None,
     ) -> "Snapshot":
         """Synchronous distributed save (reference Snapshot.take,
         snapshot.py:112-228).
@@ -458,6 +522,16 @@ class Snapshot:
         of mostly-unchanged state (frozen layers, embeddings, dataloader
         state).  Requires WRITE_CHECKSUMS on both takes; each snapshot
         owns its objects, so deleting the base never corrupts this one.
+
+        ``cas`` (chunk-level incremental takes, cas/): ``True`` (pool at
+        ``<parent>/cas``), a root URL, or a config dict.  Payload bytes
+        go to a shared content-addressed chunk pool: any chunk an
+        earlier committed step under the same pool already stored is
+        skipped, the manifest records chunk references, and retention
+        becomes refcounted GC (``SnapshotManager``).  Subsumes ``base``
+        (chunk-level beats whole-object-vs-previous-step) and disables
+        the codec layer for chunked objects (keys are raw digests).
+        Requires WRITE_CHECKSUMS on every rank.
         """
         coordinator = coordinator or get_default_coordinator()
         with log_event(
@@ -471,10 +545,11 @@ class Snapshot:
             (
                 metadata, pending_io, storage, commit_uid,
                 local_entries, object_crcs, object_codecs,
+                object_cas, cas_store,
             ) = cls._take_impl(
                 path, app_state, replicated, coordinator,
                 is_async=False, base=base, leaf_transform=leaf_transform,
-                storage_options=storage_options,
+                storage_options=storage_options, cas=cas,
             )
             # Abort-aware commit (resilience/abort.py): a rank hitting
             # an unrecoverable error here poisons the commit scope and
@@ -500,7 +575,8 @@ class Snapshot:
                     # collectives are fine) and merge into every rank's
                     # metadata copy
                     local_crcs = _crc_payload(
-                        local_entries, object_crcs, object_codecs
+                        local_entries, object_crcs, object_codecs,
+                        object_cas,
                     )
                     if coordinator.world_size > 1:
                         crc_maps = coordinator.all_gather_object(local_crcs)
@@ -523,6 +599,16 @@ class Snapshot:
                     coordinator.barrier()
                     if coordinator.rank == 0:
                         coordinator.raise_if_poisoned(commit_uid)
+                        # chunk-store index update STRICTLY before the
+                        # commit marker (and strictly after the poison
+                        # re-check): a committed step's chunk refs are
+                        # registered before any reader can consider the
+                        # step committed, so refcounted GC can never
+                        # reap a committed step's chunks.  A crash in
+                        # the gap leaves refs for an uncommitted step —
+                        # mark-phase fodder, reclaimed after the grace
+                        # window.
+                        _cas_commit_refs(metadata, path, cas_store)
                         # flight record, merge half: every surviving
                         # rank published before the barrier above, so
                         # the merge sees them all; the record lands
@@ -562,6 +648,8 @@ class Snapshot:
             finally:
                 stamp_stripe(take_event)
                 storage.sync_close()
+                if cas_store is not None:
+                    cas_store.sync_close()
             # goodput: a sync take's unblock point is its return; the
             # durable commit just happened too — except under a
             # write-back tier, where the promoter reports it when the
@@ -584,6 +672,7 @@ class Snapshot:
         base: Optional[str] = None,
         leaf_transform: Optional[Callable[[str, Any], Any]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
+        cas: Optional[Any] = None,
     ) -> "PendingSnapshot":
         """Unblock-early save (reference Snapshot.async_take,
         snapshot.py:229-318).  Returns once the snapshot content is
@@ -602,10 +691,11 @@ class Snapshot:
             (
                 metadata, pending_io, storage, commit_uid,
                 local_entries, object_crcs, object_codecs,
+                object_cas, cas_store,
             ) = cls._take_impl(
                 path, app_state, replicated, coordinator,
                 is_async=True, base=base, leaf_transform=leaf_transform,
-                storage_options=storage_options,
+                storage_options=storage_options, cas=cas,
             )
         pending = PendingSnapshot(
             path=path,
@@ -619,6 +709,8 @@ class Snapshot:
             object_codecs=object_codecs,
             storage_options=storage_options,
             obs_before=obs_before,
+            object_cas=object_cas,
+            cas_store=cas_store,
         )
         # goodput: the unblock point IS this return — training state is
         # independent of the snapshot from here; staging/IO/commit (and
@@ -637,9 +729,11 @@ class Snapshot:
         base: Optional[str] = None,
         leaf_transform: Optional[Callable[[str, Any], Any]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
+        cas: Optional[Any] = None,
     ) -> Tuple[
         SnapshotMetadata, PendingIOWork, Any, str,
         Dict[str, Entry], Dict[str, int], Dict[str, Any],
+        Dict[str, Any], Any,
     ]:
         # reference _take_impl, snapshot.py:517-635
         rank, world = coordinator.rank, coordinator.world_size
@@ -672,7 +766,7 @@ class Snapshot:
                     path, app_state, replicated, coordinator, is_async,
                     rank, world, rng_states_at_entry, commit_uid, base,
                     leaf_transform=leaf_transform,
-                    storage_options=storage_options,
+                    storage_options=storage_options, cas=cas,
                 )
         except SnapshotAbortedError:
             raise
@@ -702,9 +796,11 @@ class Snapshot:
         base: Optional[str] = None,
         leaf_transform: Optional[Callable[[str, Any], Any]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
+        cas: Optional[Any] = None,
     ) -> Tuple[
         SnapshotMetadata, PendingIOWork, Any, str,
-        Dict[str, Entry], Dict[str, int],
+        Dict[str, Entry], Dict[str, int], Dict[str, Any],
+        Dict[str, Any], Any,
     ]:
 
         # path + replicated coalescing across ranks
@@ -723,25 +819,38 @@ class Snapshot:
         # KV round
         local_mode = _safe_replication_verify_mode()
         local_cksum = knobs.write_checksums_enabled()
+        local_cas = _normalize_cas_config(cas, path)
         if world > 1:
             gathered = coordinator.all_gather_object(
-                (sorted(set(replicated)), local_mode, base, local_cksum)
+                (
+                    sorted(set(replicated)), local_mode, base,
+                    local_cksum, local_cas,
+                )
             )
-            gathered_globs = [g for g, _, _, _ in gathered]
-            modes = [m for _, m, _, _ in gathered]
-            # incremental base + checksum participation must be
-            # rank-agreed: they gate a later broadcast of the base's
-            # object table, and divergent branches would deadlock it.
-            # Rank 0's base wins (like the path); dedup needs checksums
-            # on EVERY rank (each rank stages its own objects).
+            gathered_globs = [g for g, _, _, _, _ in gathered]
+            modes = [m for _, m, _, _, _ in gathered]
+            # incremental base + cas config + checksum participation
+            # must be rank-agreed: they gate later broadcasts (the
+            # base's object table / the chunk index's key set), and
+            # divergent branches would deadlock them.  Rank 0's base
+            # and cas win (like the path); dedup needs checksums on
+            # EVERY rank (each rank stages its own objects).
             base = gathered[0][2]
-            checksums_all = all(c for _, _, _, c in gathered)
+            cas_cfg = gathered[0][4]
+            checksums_all = all(c for _, _, _, c, _ in gathered)
             if not checksums_all and base is not None:
                 logger.warning(
                     "rank %d: WRITE_CHECKSUMS off on some rank; "
                     "incremental dedup disabled for this take", rank,
                 )
                 base = None
+            if not checksums_all and cas_cfg is not None:
+                logger.warning(
+                    "rank %d: WRITE_CHECKSUMS off on some rank; content "
+                    "addressing needs whole-pipeline digests — taking a "
+                    "plain (per-step object) snapshot", rank,
+                )
+                cas_cfg = None
             replicated_globs = sorted(
                 set(gathered_globs[0]).intersection(*map(set, gathered_globs[1:]))
             )
@@ -760,6 +869,13 @@ class Snapshot:
         else:
             replicated_globs = sorted(set(replicated))
             verify_mode = local_mode
+            cas_cfg = local_cas
+            if cas_cfg is not None and not local_cksum:
+                logger.warning(
+                    "take(cas=...) needs WRITE_CHECKSUMS=1; taking a "
+                    "plain (per-step object) snapshot"
+                )
+                cas_cfg = None
 
         storage = _storage_for(path, storage_options)
 
@@ -941,6 +1057,16 @@ class Snapshot:
                 object_codecs[wr.path] = table
 
             wr.codec_sink = _codec_sink
+        if cas_cfg is not None and base is not None:
+            # chunk-level addressing dedups against EVERY committed step
+            # sharing the pool — the whole-object base link is strictly
+            # weaker, and mixing the two storage models in one take
+            # would split ownership semantics
+            logger.info(
+                "rank %d: take(cas=...) supersedes base=%r; using "
+                "chunk-level content addressing", rank, base,
+            )
+            base = None
         if base is not None and base.rstrip("/") == path.rstrip("/"):
             # self-dedup would link an object onto itself (and the fs
             # fallback's unlink-before-link would destroy the only copy)
@@ -998,6 +1124,58 @@ class Snapshot:
                 "performing a full save", rank,
             )
 
+        # content-addressed chunk store (cas/): rank 0 reads the
+        # committed index's LIVE key set once and shares it (same
+        # thundering-herd economics as the base objects table above);
+        # every write request gets a context routing it through the
+        # pool, with one shared written-this-take set so intra-take
+        # repeats (tied weights, identical slabs on two reqs) dedup too
+        object_cas: Dict[str, Any] = {}
+        cas_store = None
+        if cas_cfg is not None:
+            from . import cas as cas_mod
+
+            cas_store = cas_mod.ChunkStore(cas_cfg["root"])
+            known_keys: set = set()
+            if rank == 0:
+                try:
+                    known_keys = cas_mod.ChunkIndex.load(
+                        cas_store
+                    ).live_keys()
+                except cas_mod.ChunkIndexCorruptError as e:
+                    logger.warning(
+                        "corrupt chunk index under %r (%r); rebuilding "
+                        "via fsck before this take", cas_cfg["root"], e,
+                    )
+                    try:
+                        cas_mod.fsck(cas_cfg["root"])
+                        known_keys = cas_mod.ChunkIndex.load(
+                            cas_store
+                        ).live_keys()
+                    except Exception as e2:  # noqa: BLE001
+                        logger.warning(
+                            "chunk-index fsck under %r failed (%r); "
+                            "this take writes every chunk (correct, "
+                            "just not deduplicated)", cas_cfg["root"], e2,
+                        )
+                        known_keys = set()
+            if world > 1:
+                known_keys = coordinator.broadcast_object(
+                    known_keys, src=0
+                )
+            written_this_take: set = set()
+            for wr in write_reqs:
+                def _cas_sink(table: dict, wr=wr) -> None:
+                    object_cas[wr.path] = table
+
+                wr.cas = cas_mod.CasWriteContext(
+                    store=cas_store,
+                    known_keys=known_keys,
+                    chunk_size=cas_cfg["chunk_size"],
+                    sink=_cas_sink,
+                    written_this_take=written_this_take,
+                )
+
         # gather per-rank manifests; every rank can build the global view
         # deterministically (reference _gather_manifest, snapshot.py:948-961)
         # NOTE: this serializes entry objects BEFORE staging runs, so
@@ -1021,6 +1199,18 @@ class Snapshot:
         metadata = SnapshotMetadata(
             version=MANIFEST_VERSION, world_size=world, manifest=global_manifest
         )
+        if cas_cfg is not None:
+            # the rank-agreed envelope; per-rank chunk tables merge in
+            # at commit (_merge_crc_payloads).  The root is recorded
+            # relative ("../cas") under the manager layout so a rehomed
+            # checkpoint tree keeps restoring.
+            from . import cas as cas_mod
+
+            metadata.cas = {
+                "root": cas_mod.record_root(path, cas_cfg["root"]),
+                "chunk_size": cas_cfg["chunk_size"],
+                "chunks": {},
+            }
 
         budget = get_process_memory_budget_bytes()
 
@@ -1047,7 +1237,8 @@ class Snapshot:
         )
         return (
             metadata, pending_io, storage, commit_uid,
-            local_entry_objs, object_crcs, object_codecs,
+            local_entry_objs, object_crcs, object_codecs, object_cas,
+            cas_store,
         )
 
     # --------------------------------------------------------------- restore
@@ -1137,6 +1328,28 @@ class Snapshot:
                 )
         return tables or None
 
+    def _cas_reads(self) -> Optional[Tuple[Any, Dict[str, Any]]]:
+        """``(ChunkStore, {location → validated chunk table})`` for
+        objects this snapshot stored as chunk references (cas/), or
+        None when nothing is chunk-ref'd — pre-CAS snapshots (no
+        ``cas`` key at all) restore through the unchanged per-step
+        path.  The caller owns closing the returned store."""
+        from . import cas as cas_mod
+
+        meta_cas = self.metadata.cas or {}
+        if not meta_cas:
+            return None
+        tables = cas_mod.chunk_tables_from_metadata(self.metadata)
+        if not tables:
+            return None
+        root = cas_mod.resolve_root(self.path, str(meta_cas.get("root")))
+        return cas_mod.ChunkStore(root), tables
+
+    @staticmethod
+    def _close_cas_reads(cas_reads: Optional[Tuple[Any, Any]]) -> None:
+        if cas_reads is not None:
+            cas_reads[0].sync_close()
+
     def restore(
         self,
         app_state: AppState,
@@ -1172,12 +1385,14 @@ class Snapshot:
             # typed SnapshotAbortedError naming it.
             abort_uid = coordinator._next_uid("restore")
             storage = None
+            cas_reads = None
             try:
                 with coordinator.abort_scope(abort_uid):
                     metadata = self.metadata
                     manifest_for_rank = get_manifest_for_rank(metadata, rank)
                     storage = _storage_for(self.path, self._storage_options)
                     self._prime_tier_digests(storage)
+                    cas_reads = self._cas_reads()
                     local_keys = sorted(app_state.keys())
                     if world > 1:
                         global_keys = sorted(
@@ -1197,6 +1412,7 @@ class Snapshot:
                             self._load_stateful(
                                 key, app_state[key], manifest_for_rank,
                                 storage, strict, rank, paths=paths,
+                                cas_reads=cas_reads,
                             )
                         if world > 1:
                             coordinator.barrier()
@@ -1226,6 +1442,7 @@ class Snapshot:
                 stamp_stripe(restore_event)
                 if storage is not None:
                     storage.sync_close()
+                self._close_cas_reads(cas_reads)
             obs.maybe_write_metrics_textfile()
 
     def _load_stateful(
@@ -1237,12 +1454,13 @@ class Snapshot:
         strict: bool,
         rank: int,
         paths: Optional[Sequence[str]] = None,
+        cas_reads: Optional[Tuple[Any, Dict[str, Any]]] = None,
     ) -> None:
         # reference _load_stateful, snapshot.py:727-782
         with obs.span("restore/load_stateful", key=key, rank=rank):
             self._load_stateful_impl(
                 key, stateful, manifest_for_rank, storage, strict, rank,
-                paths=paths,
+                paths=paths, cas_reads=cas_reads,
             )
 
     def _load_stateful_impl(
@@ -1254,6 +1472,7 @@ class Snapshot:
         strict: bool,
         rank: int,
         paths: Optional[Sequence[str]] = None,
+        cas_reads: Optional[Tuple[Any, Dict[str, Any]]] = None,
     ) -> None:
         key_manifest = {
             p: e
@@ -1307,6 +1526,7 @@ class Snapshot:
             sync_execute_read_reqs(
                 read_reqs, storage, budget, rank,
                 codec_tables=self._codec_tables(),
+                cas_reads=cas_reads,
             )
             restored = {lpath: fut.obj for lpath, fut in futures.items()}
             state_dict = inflate(
@@ -1494,13 +1714,16 @@ class Snapshot:
                 read_reqs = batch_read_requests(read_reqs)
             storage = _storage_for(self.path, self._storage_options)
             self._prime_tier_digests(storage)
+            cas_reads = self._cas_reads()
             try:
                 sync_execute_read_reqs(
                     read_reqs, storage, get_process_memory_budget_bytes(),
                     rank, codec_tables=self._codec_tables(),
+                    cas_reads=cas_reads,
                 )
             finally:
                 storage.sync_close()
+                self._close_cas_reads(cas_reads)
             leaves = {p: fut.obj for p, fut in futures.items()}
             return {
                 key: inflate(containers, leaves, prefix=key)
@@ -1529,6 +1752,7 @@ class Snapshot:
             )
             storage = _storage_for(self.path, self._storage_options)
             self._prime_tier_digests(storage)
+            cas_reads = self._cas_reads()
             try:
                 sync_execute_read_reqs(
                     reqs,
@@ -1536,9 +1760,11 @@ class Snapshot:
                     memory_budget_bytes or get_process_memory_budget_bytes(),
                     rank=0,
                     codec_tables=self._codec_tables(),
+                    cas_reads=cas_reads,
                 )
             finally:
                 storage.sync_close()
+                self._close_cas_reads(cas_reads)
             return fut.obj
 
 
@@ -1566,6 +1792,8 @@ class PendingSnapshot:
         object_codecs: Optional[Dict[str, Any]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
         obs_before: Optional[Dict[str, Any]] = None,
+        object_cas: Optional[Dict[str, Any]] = None,
+        cas_store: Optional[Any] = None,
     ) -> None:
         self.path = path
         self._storage_options = storage_options
@@ -1587,6 +1815,11 @@ class PendingSnapshot:
         self._object_codecs = (
             object_codecs if object_codecs is not None else {}
         )
+        # chunk tables (cas/): same lifecycle as the codec tables — read
+        # at commit time on the thread that ran sync_complete(), so
+        # every sink has fired; the store handle closes with the commit
+        self._object_cas = object_cas if object_cas is not None else {}
+        self._cas_store = cas_store
         self._exc: Optional[BaseException] = None
         self._snapshot: Optional[Snapshot] = None
         self._thread = threading.Thread(
@@ -1637,19 +1870,21 @@ class PendingSnapshot:
                                 self._local_entries,
                                 self._object_crcs,
                                 self._object_codecs,
+                                self._object_cas,
                             )
                         ),
                     )
                 except Exception as e:  # noqa: BLE001
-                    if self._object_codecs:
-                        # codec frame tables ride this channel and are
-                        # the DECODE RECIPE for this rank's compressed
-                        # objects — committing without them produces a
-                        # durable snapshot that cannot be restored, so
-                        # this rank must fail the commit (arrive
-                        # carries the error; rank 0 withholds the
-                        # marker).  Plain checksums stay best-effort.
-                        status = f"err:codec tables lost: {e!r}"
+                    if self._object_codecs or self._object_cas:
+                        # codec frame tables and chunk tables ride this
+                        # channel and are the DECODE/ASSEMBLY RECIPE for
+                        # this rank's compressed/chunk-ref'd objects —
+                        # committing without them produces a durable
+                        # snapshot that cannot be restored, so this rank
+                        # must fail the commit (arrive carries the
+                        # error; rank 0 withholds the marker).  Plain
+                        # checksums stay best-effort.
+                        status = f"err:codec/chunk tables lost: {e!r}"
                         if self._exc is None:
                             self._exc = e
                     coord.kv_set(f"{uid}/crcs/{rank}", "{}")
@@ -1686,20 +1921,29 @@ class PendingSnapshot:
                             )
                         except Exception:  # noqa: BLE001
                             # plain checksums are best-effort, but codec
-                            # frame tables in these payloads are the
-                            # decode recipe for compressed objects — if
+                            # frame tables / chunk tables in these
+                            # payloads are the decode/assembly recipe
+                            # for compressed/chunk-ref'd objects — if
                             # any rank reported one (or the reads failed
                             # so we cannot tell), the commit must fail
-                            # rather than durably strand undecodable
+                            # rather than durably strand unreadable
                             # bytes behind a raw-path manifest
                             if raw_payloads is None or any(
-                                '"codecs"' in p for p in raw_payloads
+                                '"codecs"' in p or '"cas"' in p
+                                for p in raw_payloads
                             ):
                                 raise
                             logger.warning(
                                 "crc merge failed; committing without "
                                 "checksums", exc_info=True,
                             )
+                        # chunk-store index update STRICTLY before the
+                        # commit marker (poison re-checked just below,
+                        # before the marker — same invariant as the
+                        # sync path)
+                        _cas_commit_refs(
+                            self._metadata, self.path, self._cas_store
+                        )
                         # flight record, merge half: every surviving
                         # rank published before its arrive key, and
                         # all arrive keys were read above — persist
@@ -1755,6 +1999,14 @@ class PendingSnapshot:
             # sweep list), so drop them the moment they're consumed
             self._pending_io_work = None
             obs.maybe_write_metrics_textfile()
+            if self._cas_store is not None:
+                try:
+                    self._cas_store.sync_close()
+                except Exception:  # noqa: BLE001 — teardown only
+                    logger.warning(
+                        "chunk-store close after async commit failed",
+                        exc_info=True,
+                    )
             try:
                 self._storage.sync_close()
             except Exception:

@@ -109,14 +109,20 @@ def migrate_snapshot_to_orbax(
         read_reqs.extend(reqs)
         futures[lpath] = fut
     storage = url_to_storage_plugin(snapshot_path)
+    cas_reads = snap._cas_reads()
     try:
         sync_execute_read_reqs(
             read_reqs, storage, get_process_memory_budget_bytes(), rank=0,
             # codec-compressed objects must decode here like every other
-            # read path — otherwise the export writes frame bytes
+            # read path — otherwise the export writes frame bytes — and
+            # chunk-ref'd objects (cas/) must assemble from the pool
+            # (they have no per-step storage object at all)
             codec_tables=snap._codec_tables(),
+            cas_reads=cas_reads,
         )
     finally:
         storage.sync_close()
+        if cas_reads is not None:
+            cas_reads[0].sync_close()
     tree = inflate(containers, {p: f.obj for p, f in futures.items()}, prefix=key)
     export_to_orbax(orbax_path, tree)

@@ -192,6 +192,7 @@ def _check_crcs(
     result: VerifyResult,
     extents: Dict[str, int],
     codec_tables: Optional[Dict[str, Any]] = None,
+    cas_reads: Optional[Any] = None,
 ) -> set:
     """Deep mode: re-read every checksummed payload and compare crc32
     (catches bit rot / torn or overwritten content that sizes and parse
@@ -244,10 +245,28 @@ def _check_crcs(
                 in_use += nbytes
             try:
                 async with sem:
+                    cas_table = (
+                        cas_reads[1].get(loc) if cas_reads else None
+                    )
                     table = (
                         codec_tables.get(loc) if codec_tables else None
                     )
-                    if table is not None:
+                    if cas_table is not None:
+                        # chunk-ref'd object (cas/): recorded crcs are
+                        # RAW-byte crcs of the assembled stream, so
+                        # reassemble through the chunk pool (which also
+                        # proves every referenced chunk is readable)
+                        from . import cas as cas_mod
+
+                        buf = await cas_mod.chunked_read(
+                            cas_reads[0],
+                            loc,
+                            cas_table,
+                            byte_range=(
+                                list(byte_range) if byte_range else None
+                            ),
+                        )
+                    elif table is not None:
                         # encoded object: recorded crcs are RAW-byte
                         # crcs, so decode through the frame layer (which
                         # also proves the frames themselves are intact)
@@ -349,6 +368,13 @@ def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
     storage = _storage_for(
         snapshot.path, getattr(snapshot, "_storage_options", None)
     )
+    # chunk-ref'd locations (cas/) have no per-step storage object:
+    # their residency check stats the referenced CHUNKS in the shared
+    # pool instead, and deep reads reassemble through it
+    cas_reads = (
+        snapshot._cas_reads() if hasattr(snapshot, "_cas_reads") else None
+    )
+    cas_tables = cas_reads[1] if cas_reads is not None else {}
     try:
         extents = _expected_extents(manifest)
         # the objects table (WRITE_CHECKSUMS takes) records exact sizes —
@@ -368,7 +394,9 @@ def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
             exact_sizes[loc] = stored
             if loc in extents:
                 extents[loc] = stored
-        for location, outcome in _stat_all(storage, sorted(extents)):
+        for location, outcome in _stat_all(
+            storage, sorted(set(extents) - set(cas_tables))
+        ):
             expected = extents[location]
             if isinstance(outcome, FileNotFoundError):
                 result.missing.append(location)
@@ -381,11 +409,38 @@ def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
                     result.truncated.append((location, exact, outcome))
                 elif outcome < expected:
                     result.truncated.append((location, expected, outcome))
+        if cas_tables:
+            from . import cas as cas_mod
+
+            chunk_sizes = {
+                cas_mod.chunk_location(k): cas_mod.key_size(k)
+                for loc in cas_tables
+                if loc in extents  # this rank's view only
+                for k in cas_tables[loc]["keys"]
+            }
+            for location, outcome in _stat_all(
+                cas_reads[0].storage, sorted(chunk_sizes)
+            ):
+                if isinstance(outcome, FileNotFoundError):
+                    result.missing.append(location)
+                elif isinstance(outcome, BaseException):
+                    result.unreadable.append(
+                        (location, f"stat: {outcome!r}")
+                    )
+                else:
+                    result.objects_checked += 1
+                    # the key embeds the exact length — any other size
+                    # is corruption, not a benign over-allocation
+                    if outcome != chunk_sizes[location]:
+                        result.truncated.append(
+                            (location, chunk_sizes[location], outcome)
+                        )
 
         crc_verified: set = set()
         if deep:
             crc_verified = _check_crcs(
-                storage, manifest, result, extents, codec_tables
+                storage, manifest, result, extents, codec_tables,
+                cas_reads,
             )
 
         for lpath, entry in sorted(manifest.items()):
@@ -413,6 +468,7 @@ def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
                     get_process_memory_budget_bytes(),
                     rank,
                     codec_tables=codec_tables or None,
+                    cas_reads=cas_reads,
                 )
                 if fut.obj is None:
                     raise RuntimeError("read produced no value")
@@ -420,6 +476,8 @@ def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
                 result.unreadable.append((lpath, repr(e)))
     finally:
         storage.sync_close()
+        if cas_reads is not None:
+            cas_reads[0].sync_close()
     if not result.ok:
         logger.warning("snapshot %r verification: %s", snapshot.path, result)
     return result

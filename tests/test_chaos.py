@@ -693,6 +693,67 @@ def test_chaos_corrupt_fast_frame_falls_back_and_repairs(tmp_path, flavor):
         assert f_fast.read() == f_dur.read()
 
 
+# ========================================= serving/mmap chaos scenarios
+
+
+@pytest.mark.parametrize("flavor", ["truncated", "evicted"])
+def test_chaos_fast_copy_truncated_or_evicted_under_mmap(tmp_path, flavor):
+    """Serving read path: the fast-tier copy is truncated (bit-rot /
+    torn write) or evicted (fast GC raced the reader) right before a
+    zero-copy read maps it.  The tier's verify-through-the-map digest
+    check (or the map-time extent check) catches it inside ordinary
+    exception handling — silent fallback to the durable copy, fast-tier
+    repair, NO SIGBUS-shaped crash path (see storage.fs.mmap_read for
+    the unlink-vs-truncate lifecycle contract)."""
+    from torchsnapshot_tpu.io_types import is_mmap_backed
+
+    fast, durable = str(tmp_path / "fast"), str(tmp_path / "durable")
+    opts = {"tier": {"fast_url": fast, "policy": "write_through"}}
+    arr = np.arange(1 << 14, dtype=np.float32)
+    with knobs.override_write_checksums(True):
+        Snapshot.take(durable, {"m": StateDict(w=arr)}, storage_options=opts)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_corruption_fuzz import _payload_files
+
+    victim = next(iter(_payload_files(fast)))
+    if flavor == "truncated":
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+    else:
+        os.remove(victim)
+    misses0 = obs.counter("tier.fast_misses").value
+    repairs0 = obs.counter("tier.fast_repairs").value
+    out = Snapshot(durable, storage_options=opts).read_object("0/m/w")
+    np.testing.assert_array_equal(np.asarray(out), arr)
+    assert obs.counter("tier.fast_misses").value > misses0
+    assert obs.counter("tier.fast_repairs").value > repairs0
+    # repaired: the next zero-copy read verifies and serves the mapping
+    out2 = Snapshot(durable, storage_options=opts).read_object("0/m/w")
+    assert is_mmap_backed(out2)
+    np.testing.assert_array_equal(np.asarray(out2), arr)
+
+
+def test_chaos_eviction_under_live_mapping_keeps_pages_valid(tmp_path):
+    """The unlink-only eviction discipline: evicting (unlinking) an
+    object while a reader holds a live mapping of it must leave every
+    mapped page readable — POSIX keeps the unlinked inode alive until
+    the last mapping drops.  This is the invariant that makes cache
+    eviction and fast-tier GC safe under zero-copy serving."""
+    from torchsnapshot_tpu.io_types import is_mmap_backed
+
+    arr = np.arange(1 << 16, dtype=np.float64)
+    Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=arr)})
+    out = Snapshot(str(tmp_path / "s")).read_object("0/m/w")
+    assert is_mmap_backed(out)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_corruption_fuzz import _payload_files
+
+    for p in _payload_files(str(tmp_path / "s")):
+        os.remove(p)  # evict: unlink, never truncate
+    # every page of the live mapping still reads the committed bytes
+    np.testing.assert_array_equal(np.asarray(out), arr)
+
+
 # ====================================== flight-record chaos scenarios
 #
 # The flight record (obs/aggregate.py) is best-effort telemetry: a rank

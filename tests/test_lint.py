@@ -1109,6 +1109,56 @@ def test_instrumentation_covers_codec_entry_points():
     assert "encode_frame_async" in findings[0].message
 
 
+def test_instrumentation_covers_serving_read_entry_points():
+    """Serving read path pins: the zero-copy mapping call (fs.mmap_read)
+    and the shared-host cache's single-flight fill must stay
+    span-covered — the fill holds a cross-process lock around a durable
+    GET, and the mapping is where serving I/O time would otherwise
+    vanish from copy-based accounting."""
+    from tools.lint.passes import instrumentation as instr
+
+    assert "mmap_read" in instr.MODULE_FUNCTIONS[
+        "torchsnapshot_tpu/storage/fs.py"
+    ]
+    assert "singleflight_fill" in instr.MODULE_FUNCTIONS[
+        "torchsnapshot_tpu/storage/hostcache.py"
+    ]
+    findings = _run(
+        "instrumentation",
+        """
+        async def singleflight_fill(plugin, path, cfile):
+            lock_fd = _lock_acquire(plugin._lock_path(cfile))
+            return None
+        """,
+        filename="torchsnapshot_tpu/storage/hostcache.py",
+    )
+    assert len(findings) == 1
+    assert "singleflight_fill" in findings[0].message
+    findings = _run(
+        "instrumentation",
+        """
+        def mmap_read(full, byte_range, path=""):
+            return None
+        """,
+        filename="torchsnapshot_tpu/storage/fs.py",
+    )
+    assert len(findings) == 1
+    assert "mmap_read" in findings[0].message
+
+
+def test_instrumentation_serving_clean_when_bracketed():
+    findings = _run(
+        "instrumentation",
+        """
+        def mmap_read(full, byte_range, path=""):
+            with obs.span("storage/mmap_read", path=path):
+                return None
+        """,
+        filename="torchsnapshot_tpu/storage/fs.py",
+    )
+    assert findings == []
+
+
 def test_instrumentation_codec_clean_when_bracketed():
     findings = _run(
         "instrumentation",

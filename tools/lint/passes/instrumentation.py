@@ -100,6 +100,14 @@ MODULE_FUNCTIONS: Dict[str, Set[str]] = {
     # index rebuild is a recovery operation an incident review must be
     # able to reconstruct
     "torchsnapshot_tpu/cas/index.py": {"fsck"},
+    # serving read path: the zero-copy mapping call is where a serving
+    # restore's I/O time vanishes from copy-based accounting — without
+    # its span the fastest reads would be the least attributable ones
+    "torchsnapshot_tpu/storage/fs.py": {"mmap_read"},
+    # the shared-host cache's single-flight fill holds a CROSS-PROCESS
+    # lock around a durable GET; a stall there blocks every co-located
+    # reader of the object, so the fill must be first-class in traces
+    "torchsnapshot_tpu/storage/hostcache.py": {"singleflight_fill"},
     # the GC/commit paths are durability-critical mutations of shared
     # state — same discipline as manager.delete_snapshot above
     "torchsnapshot_tpu/cas/gc.py": {

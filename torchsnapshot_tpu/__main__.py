@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -180,6 +181,59 @@ def _cas_stats_rollup(snapshot) -> dict:
     return out
 
 
+def _cache_stats_rollup() -> dict:
+    """Shared-host object cache rollup (storage/hostcache.py): the
+    cache directory's on-disk footprint plus this process's hit/miss
+    counters (with the cache enabled, even the stats command's own
+    manifest read routes through it)."""
+    from . import knobs, obs
+
+    out: dict = {}
+    cache_dir = knobs.get_cache_dir()
+    if cache_dir:
+        from .storage.hostcache import _OBJECTS_SUBDIR
+
+        files = 0
+        total = 0
+        for dirpath, _dirs, names in os.walk(
+            os.path.join(cache_dir, _OBJECTS_SUBDIR)
+        ):
+            for name in names:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, name))
+                    files += 1
+                except OSError:
+                    pass  # racing eviction by another process
+        out.update({"dir": cache_dir, "objects": files, "bytes": total})
+    c = obs.metrics_snapshot()["counters"]
+    for key, short in (
+        (obs.CACHE_HITS, "hits"),
+        (obs.CACHE_MISSES, "misses"),
+        (obs.CACHE_SINGLEFLIGHT_WAITS, "singleflight_waits"),
+        (obs.MMAP_READS, "mmap_reads"),
+    ):
+        if c.get(key):
+            out[short] = c[key]
+    return out
+
+
+def _render_cache_stats(rollup: dict) -> None:
+    if not rollup:
+        return
+    if "dir" in rollup:
+        print(
+            f"  cache: {rollup['objects']} objects, "
+            f"{_human(rollup['bytes'])} at {rollup['dir']}"
+        )
+    if rollup.get("hits") or rollup.get("misses"):
+        print(
+            f"    this run: {rollup.get('hits', 0)} hits / "
+            f"{rollup.get('misses', 0)} misses, "
+            f"{rollup.get('singleflight_waits', 0)} singleflight waits, "
+            f"{rollup.get('mmap_reads', 0)} mmap reads"
+        )
+
+
 def _render_cas_stats(rollup: dict) -> None:
     if not rollup:
         return
@@ -257,6 +311,7 @@ def _cmd_stats(args) -> int:
         ],
         "codec": _codec_rollup(metadata),
         "cas": _cas_stats_rollup(snap),
+        "cache": _cache_stats_rollup(),
     }
     if args.json:
         print(json.dumps(stats, indent=2))
@@ -294,6 +349,7 @@ def _cmd_stats(args) -> int:
                 f"{_human(st['stored_bytes'])} ({r:.2f}x)"
             )
     _render_cas_stats(stats["cas"])
+    _render_cache_stats(stats["cache"])
     print(f"  largest {len(largest)}:")
     width = max((len(p) for p, _ in largest), default=10)
     for p, st in largest:
@@ -357,6 +413,12 @@ def _doctor_counters(record) -> dict:
         "failpoints_fired": c.get("resilience.failpoints_fired", 0),
         "stripe_parts_written": c.get("storage.stripe.parts_written", 0),
         "stripe_aborts": c.get("storage.stripe.aborts", 0),
+        "cache_hits": c.get("storage.cache.hits", 0),
+        "cache_misses": c.get("storage.cache.misses", 0),
+        "cache_singleflight_waits": c.get(
+            "storage.cache.singleflight_waits", 0
+        ),
+        "mmap_reads": c.get("storage.mmap.reads", 0),
         "codec_bytes_in": codec_in,
         "codec_bytes_out": codec_out,
         "codec_ratio": (
@@ -442,6 +504,16 @@ def _render_doctor(record) -> None:
             f"{_human(c['cas_bytes_shared'])} shared"
             + (f" ({ratio:.2f}x dedup)" if ratio else "")
         )
+    if c["cache_hits"] or c["cache_misses"]:
+        served = c["cache_hits"] + c["cache_misses"]
+        hit_rate = c["cache_hits"] / served if served else 0.0
+        print(
+            f"  cache: {c['cache_hits']} hits / {c['cache_misses']} "
+            f"misses ({hit_rate:.0%} hit rate), "
+            f"{c['cache_singleflight_waits']} singleflight waits"
+        )
+    if c["mmap_reads"]:
+        print(f"  mmap: {c['mmap_reads']} zero-copy reads")
     slow = record.get("slow_objects") or []
     if slow:
         print("  slowest objects:")

@@ -492,6 +492,9 @@ def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
                         path=path,
                         byte_range=[lo, hi],
                         buffer_consumer=_MergedRangeConsumer(lo, subs),
+                        # a merged read executes as early as its most
+                        # urgent member asks (restore prioritization)
+                        priority=min(r.priority for r in run),
                     )
                 )
             run.clear()

@@ -194,6 +194,13 @@ class ReadReq:
     # consumers detect honor by identity (``buf is into``) and fall
     # back to the normal copy otherwise, so ignoring is always safe.
     into: Any = None
+    # Restore prioritization (serving): lower values execute first.
+    # The read scheduler orders its admission queue by this key (stable
+    # within a priority class), so a server restoring a snapshot can
+    # ask for its first-requested layers first and begin serving before
+    # the full snapshot lands.  Purely an ordering hint — correctness
+    # never depends on it.
+    priority: int = 0
 
 
 def resolve_read_destination(into: Any, length: int) -> Any:
@@ -249,6 +256,32 @@ class ReadIO:
     # destination hint (see ReadReq.into); honoring plugins read into
     # it and set ``buf = into``
     into: Any = None
+    # Zero-copy request: a plugin that declares ``supports_mmap_read``
+    # MAY serve this read as a READ-ONLY mmap-backed buffer (a numpy
+    # view over file-backed pages) instead of copying into the heap.
+    # Callers detect honor with ``is_mmap_backed(buf)``; plugins are
+    # free to ignore the flag (e.g. when the knob is off), so setting
+    # it is always safe.  Mutually exclusive with ``into`` in practice
+    # — a caller that wants bytes placed into its own buffer has no
+    # use for a foreign mapping.
+    want_mmap: bool = False
+
+
+def is_mmap_backed(buf: Any) -> bool:
+    """True when ``buf`` is (a view over) an ``mmap.mmap`` — the
+    detection contract for ReadIO.want_mmap honor.  Walks the
+    numpy ``.base`` / memoryview ``.obj`` ownership chain, so sliced
+    and dtype-viewed arrays over a mapping still report True."""
+    import mmap as _mmap
+
+    o = buf
+    for _ in range(8):  # ownership chains are shallow; bound the walk
+        if o is None:
+            return False
+        if isinstance(o, _mmap.mmap):
+            return True
+        o = o.obj if isinstance(o, memoryview) else getattr(o, "base", None)
+    return False
 
 
 class StripedWriteHandle(abc.ABC):
@@ -314,6 +347,21 @@ class StoragePlugin(abc.ABC):
     # READS need no capability flag — every plugin already honors
     # ReadIO.byte_range, so striped restore works against any backend.
     supports_striped_write: bool = False
+
+    # True when this plugin can honor ReadIO.want_mmap by serving raw
+    # object bytes as a read-only mmap-backed buffer (fs, the shared-
+    # host cache, tiered fast reads).
+    supports_mmap_read: bool = False
+
+    # STRICTER than supports_mmap_read: True only when every read this
+    # plugin serves stays off the Python heap (a local file map, or a
+    # cache whose fills stream in bounded spans) — it can never decline
+    # into buffering a whole object.  This is the flag the read
+    # scheduler keys budget-exempt admission (and the striped-read
+    # bypass) on: a composite that can fall back to a raw cloud GET
+    # (tier over uncached s3) must keep budgeted, striped reads on that
+    # degraded path, even though its fast leg serves mappings.
+    mmap_budget_exempt: bool = False
 
     async def begin_striped_write(
         self, path: str, total_size: int

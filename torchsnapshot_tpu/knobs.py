@@ -58,6 +58,9 @@ _CAS_GC_GRACE_S = "CAS_GC_GRACE_S"
 _TIER_POLICY = "TIER_POLICY"
 _TIER_FAST_KEEP_LAST_N = "TIER_FAST_KEEP_LAST_N"
 _TIER_VERIFY_FAST_READS = "TIER_VERIFY_FAST_READS"
+_MMAP = "MMAP"
+_CACHE_DIR = "CACHE_DIR"
+_CACHE_MAX_BYTES = "CACHE_MAX_BYTES"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -267,6 +270,29 @@ _DEFAULTS = {
     # is ranged); a mismatch silently falls back to a peer/durable copy
     # and repairs the fast one.  Needs WRITE_CHECKSUMS at take time.
     _TIER_VERIFY_FAST_READS: 1,
+    # Zero-copy mmap materialization (serving read path): plugins that
+    # declare supports_mmap_read (fs, the host cache) serve raw
+    # (uncompressed, unchunked) reads as read-only mmap-backed buffers
+    # instead of copying into the Python heap, and the read scheduler
+    # admits such reads budget-exempt — mapped pages are file-backed
+    # and reclaimable, so they must never serialize behind the host
+    # staging budget.  Codec frames and CAS chunk refs transparently
+    # keep the copying path (their bytes need a transform).  0 = every
+    # read copies (the pre-serving behavior).
+    _MMAP: 1,
+    # Shared-host object cache (storage/hostcache.py): when set to a
+    # directory path, durable reads route through a per-host cache —
+    # co-located readers (N inference workers cold-starting on one
+    # host) fetch each object from the durable tier exactly ONCE, under
+    # a cross-process file lock with single-flight semantics.  Cached
+    # objects are local files, so they serve mmap-backed when MMAP is
+    # on.  Empty = off (the default).
+    _CACHE_DIR: "",
+    # Soft size cap for the shared-host cache; a fill that pushes the
+    # cache past the cap evicts oldest-first by mtime (unlink only —
+    # never truncate, so live mmaps of evicted objects stay valid).
+    # 0 = unbounded.
+    _CACHE_MAX_BYTES: 0,
 }
 
 _OVERRIDES: dict = {}
@@ -557,6 +583,23 @@ def tier_verify_fast_reads() -> bool:
     return bool(_get_int(_TIER_VERIFY_FAST_READS))
 
 
+def mmap_enabled() -> bool:
+    return bool(_get_int(_MMAP))
+
+
+def get_cache_dir() -> Optional[str]:
+    """Shared-host object cache directory, or None when the cache is
+    off (the default).  This is the ONLY sanctioned read of
+    TORCHSNAPSHOT_TPU_CACHE_DIR (tools/lint knob-registry pass)."""
+    v = str(_get_raw(_CACHE_DIR) or "").strip()
+    return v or None
+
+
+def get_cache_max_bytes() -> Optional[int]:
+    v = _get_int(_CACHE_MAX_BYTES)
+    return v if v > 0 else None
+
+
 def restore_donation() -> str:
     """One of "on" | "off" | "auto" (see _RESTORE_DONATE above).
 
@@ -740,6 +783,18 @@ def override_tier_fast_keep_last_n(value: int):
 
 def override_tier_verify_fast_reads(value: bool):
     return _override(_TIER_VERIFY_FAST_READS, int(value))
+
+
+def override_mmap(value: bool):
+    return _override(_MMAP, int(value))
+
+
+def override_cache_dir(value):
+    return _override(_CACHE_DIR, value or "")
+
+
+def override_cache_max_bytes(value: int):
+    return _override(_CACHE_MAX_BYTES, value)
 
 
 def override_failpoint_seed(value: int):

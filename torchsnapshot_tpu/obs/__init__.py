@@ -48,6 +48,13 @@ from .metrics import (  # noqa: F401
     BYTES_STAGED,
     BYTES_WRITTEN,
     BYTES_BUCKETS,
+    CACHE_BYTES_FILLED,
+    CACHE_EVICTIONS,
+    CACHE_HITS,
+    CACHE_MISSES,
+    CACHE_SINGLEFLIGHT_WAITS,
+    MMAP_BYTES_MAPPED,
+    MMAP_READS,
     CAS_BYTES_SHARED,
     CAS_BYTES_SWEPT,
     CAS_BYTES_WRITTEN,

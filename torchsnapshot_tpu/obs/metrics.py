@@ -93,6 +93,23 @@ CODEC_BYTES_OUT = "storage.codec.bytes_out"
 CODEC_PARTS_ENCODED = "storage.codec.parts_encoded"
 CODEC_PARTS_RAW_FALLBACK = "storage.codec.parts_raw_fallback"
 CODEC_PARTS_DECODED = "storage.codec.parts_decoded"
+# Shared-host object cache (storage/hostcache.py): a hit served the
+# read from the per-host cache directory without touching the durable
+# tier; a miss performed the one durable GET that fills the entry; a
+# singleflight wait blocked behind another process's in-flight fill of
+# the SAME object and then served the filled entry (no GET of its own)
+# — on an N-reader cold start hits+waits should approach N-1 per
+# object while misses stay at exactly 1.
+CACHE_HITS = "storage.cache.hits"
+CACHE_MISSES = "storage.cache.misses"
+CACHE_SINGLEFLIGHT_WAITS = "storage.cache.singleflight_waits"
+CACHE_BYTES_FILLED = "storage.cache.bytes_filled"
+CACHE_EVICTIONS = "storage.cache.evictions"
+# Zero-copy mmap reads (io_types.ReadIO.want_mmap): reads served as
+# read-only file-backed mappings instead of heap copies, and the bytes
+# mapped (pages fault in lazily — mapped ≠ resident).
+MMAP_READS = "storage.mmap.reads"
+MMAP_BYTES_MAPPED = "storage.mmap.bytes_mapped"
 # Phase timing (cross-rank straggler attribution, obs/aggregate.py):
 # always-on histograms of where a take/restore spent its wall time on
 # THIS rank.  One observe per pipeline task / coordination wait — cheap

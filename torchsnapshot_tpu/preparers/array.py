@@ -419,6 +419,17 @@ class ArrayBufferConsumer(BufferConsumer):
         np_arr = array_from_buffer(
             buf, self.entry.dtype, tuple(self.entry.shape)
         )
+        if self.obj_out is None:
+            from ..io_types import is_mmap_backed
+
+            if is_mmap_backed(buf):
+                # zero-copy materialization: the result IS the mapping
+                # (a read-only view over file-backed pages) — no heap
+                # copy before the caller's device put.  Pages fault in
+                # on first touch and stay kernel-reclaimable, which is
+                # what keeps a many-reader cold start's RSS flat.
+                self.fut.set(np_arr)
+                return
         inline = (
             np_arr.nbytes < self._INLINE_CONSUME_MAX
             and not _is_jax_array(self.obj_out)

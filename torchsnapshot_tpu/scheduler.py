@@ -51,6 +51,7 @@ from .io_types import (
     WriteIO,
     WriteReq,
     check_read_crc,
+    is_mmap_backed,
 )
 from .obs import buf_nbytes as _buf_nbytes
 from .obs import metrics as obs_metrics
@@ -994,11 +995,17 @@ def sync_execute_copy_reqs(
 
 
 class _ReadPipeline:
-    __slots__ = ("read_req", "consuming_cost", "buf")
+    __slots__ = ("read_req", "consuming_cost", "admission_cost", "use_mmap", "buf")
 
     def __init__(self, read_req: ReadReq) -> None:
         self.read_req = read_req
         self.consuming_cost = read_req.buffer_consumer.get_consuming_cost_bytes()
+        # what budget admission debits: the consuming cost, except for
+        # mmap-served reads, which admit at 0 (set in
+        # _execute_read_pipelines) — their pages are file-backed and
+        # reclaimable, so they occupy no heap the budget protects
+        self.admission_cost = self.consuming_cost
+        self.use_mmap = False
         self.buf = None
 
 
@@ -1010,6 +1017,32 @@ async def _execute_read_pipelines(
     codec_tables: Optional[dict] = None,
     cas_reads: Optional[tuple] = None,
 ) -> None:
+    # Zero-copy serving (io_types.ReadIO.want_mmap): raw reads against
+    # a plugin whose reads NEVER transit the heap (mmap_budget_exempt —
+    # fs, the host cache, tiers whose both legs qualify) are served as
+    # read-only file-backed mappings and admitted BUDGET-EXEMPT —
+    # serializing reclaimable page mappings behind the host staging
+    # budget would throttle a many-reader cold start for no
+    # memory-safety gain.  Deliberately keyed on the STRICT capability,
+    # not supports_mmap_read: a tier over a raw cloud durable keeps its
+    # budgeted, striped reads on the degraded fallback path.  Codec
+    # frames and CAS chunk refs need a byte transform, so they keep the
+    # copying (budgeted) path; a read with an ``into`` destination is
+    # already one-touch and wants the bytes in ITS buffer, not a
+    # foreign mapping.
+    mmap_capable = knobs.mmap_enabled() and getattr(
+        storage, "mmap_budget_exempt", False
+    )
+    for p in pipelines:
+        rr = p.read_req
+        if (
+            mmap_capable
+            and rr.into is None
+            and not (codec_tables and rr.path in codec_tables)
+            and not (cas_reads is not None and rr.path in cas_reads[1])
+        ):
+            p.use_mmap = True
+            p.admission_cost = 0
     ready_for_io = deque(pipelines)
     io_tasks: set = set()
     consume_tasks: set = set()
@@ -1039,9 +1072,9 @@ async def _execute_read_pipelines(
         if sp is not None:
             tracer.end(sp, fire_event=True)
 
-    # smallest pending consuming cost — O(1) skip of the admission scan
+    # smallest pending admission cost — O(1) skip of the admission scan
     # on wakes where nothing can fit (see the write loop's twin)
-    min_pending_cost = min((p.consuming_cost for p in pipelines), default=0)
+    min_pending_cost = min((p.admission_cost for p in pipelines), default=0)
 
     # striped reads need the object's byte length up front; a whole-
     # object read only knows its consuming-cost ESTIMATE, so resolve it
@@ -1126,6 +1159,30 @@ async def _execute_read_pipelines(
                 sp.attrs["codec"] = table.get("codec")
                 sp.attrs["bytes"] = _buf_nbytes(p.buf)
             return p
+        if p.use_mmap:
+            # one map call serves any size — fanning out parallel ranged
+            # GETs (striping) would only buy page-cache copies, so the
+            # striped path is deliberately skipped here
+            read_io = ReadIO(
+                path=rr.path, byte_range=rr.byte_range, want_mmap=True
+            )
+            await storage.read(read_io)
+            p.buf = read_io.buf
+            if _buf_nbytes(p.buf) and not is_mmap_backed(p.buf):
+                # the plugin declined the mapping (e.g. a tiered read
+                # whose fast copy is gone falling back to a cloud
+                # durable): these bytes ARE heap — debit them so a
+                # burst of declined reads can't blow past the budget
+                # unaccounted.  May transiently overshoot the total;
+                # further admission stalls until the consume credits
+                # it back, which is exactly the wanted backpressure.
+                p.admission_cost = p.consuming_cost
+                budget.debit(p.admission_cost)
+                m_budget.set(budget.used)
+            if sp is not None:
+                sp.attrs["mmap"] = is_mmap_backed(p.buf)
+                sp.attrs["bytes"] = _buf_nbytes(p.buf)
+            return p
         if stripe.read_eligible(
             rr.byte_range[1] - rr.byte_range[0]
             if rr.byte_range is not None
@@ -1197,25 +1254,25 @@ async def _execute_read_pipelines(
                         break
                     p = ready_for_io.popleft()
                     if len(io_tasks) < io_concurrency and budget.fits(
-                        p.consuming_cost
+                        p.admission_cost
                     ):
-                        budget.debit(p.consuming_cost)
+                        budget.debit(p.admission_cost)
                         _admitted(p)
                         io_tasks.add(asyncio.ensure_future(read_one(p)))
                     else:
                         ready_for_io.append(p)
                         reappended = True
-                        if new_min is None or p.consuming_cost < new_min:
-                            new_min = p.consuming_cost
+                        if new_min is None or p.admission_cost < new_min:
+                            new_min = p.admission_cost
                 if not early_stop:
                     min_pending_cost = new_min if new_min is not None else 0
             if ready_for_io and not io_tasks and not consume_tasks:
                 p = ready_for_io.popleft()
-                budget.debit(p.consuming_cost)
+                budget.debit(p.admission_cost)
                 _admitted(p)
                 io_tasks.add(asyncio.ensure_future(read_one(p)))
                 min_pending_cost = min(
-                    (q.consuming_cost for q in ready_for_io), default=0
+                    (q.admission_cost for q in ready_for_io), default=0
                 )
             m_ioq.set(len(ready_for_io))
             if not io_tasks and not consume_tasks:
@@ -1237,7 +1294,7 @@ async def _execute_read_pipelines(
                 else:
                     consume_tasks.discard(task)
                     p = task.result()
-                    budget.credit(p.consuming_cost)
+                    budget.credit(p.admission_cost)
                     m_budget.set(budget.used)
     except BaseException:
         for t in io_tasks | consume_tasks:
@@ -1273,6 +1330,12 @@ def sync_execute_read_reqs(
     executor = ThreadPoolExecutor(
         max_workers=knobs.get_staging_threads(), thread_name_prefix="tsnp-consume"
     )
+    # Restore prioritization (ReadReq.priority): stable sort, so a
+    # server's first-requested layers head the admission queue and can
+    # start serving before the full snapshot lands.  The common case
+    # (all priorities 0) keeps its original order untouched.
+    if any(rr.priority for rr in read_reqs):
+        read_reqs = sorted(read_reqs, key=lambda rr: rr.priority)
     pipelines = [_ReadPipeline(rr) for rr in read_reqs]
     budget = _Budget(memory_budget_bytes)
     loop_thread = _LoopThread(name="tsnp-read-loop")

@@ -86,6 +86,19 @@ SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 AppState = Dict[str, Stateful]
 
 
+def _read_priority_for(lpath: str, priority_globs: Sequence[str]) -> int:
+    """Read-ordering class for a logical path under a ``priority`` glob
+    list (restore/materialize): the index of the FIRST matching glob —
+    lower executes earlier — with unmatched leaves after every named
+    class.  Same fnmatch dialect as the ``paths`` filter."""
+    import fnmatch
+
+    for i, g in enumerate(priority_globs):
+        if fnmatch.fnmatch(lpath, g):
+            return i
+    return len(priority_globs)
+
+
 def _replication_fingerprint(obj: Any, mode: str = "full") -> Tuple:
     """Per-leaf fingerprint used to verify that state claimed replicated
     actually matches across ranks (reference intersects the per-rank
@@ -1355,6 +1368,7 @@ class Snapshot:
         app_state: AppState,
         strict: bool = True,
         paths: Optional[Sequence[str]] = None,
+        priority: Optional[Sequence[str]] = None,
     ) -> None:
         """Distributed load/reshard into the given app state (reference
         Snapshot.restore, snapshot.py:319-396).
@@ -1367,7 +1381,13 @@ class Snapshot:
         all-or-nothing restore or per-leaf ``read_object``).  Filtering
         implies non-strict inflation for the skipped leaves; ``strict``
         still governs whether app_state keys absent from the snapshot
-        raise."""
+        raise.
+
+        ``priority`` (serving): an ordered list of fnmatch globs — reads
+        whose logical path matches an earlier glob execute first
+        (unmatched leaves last), so a server can restore its
+        first-requested layers first and begin serving before the full
+        snapshot lands.  Ordering only; every leaf is still restored."""
         coordinator = self._coordinator
         rank, world = coordinator.rank, coordinator.world_size
         _validate_app_state(app_state)
@@ -1412,7 +1432,7 @@ class Snapshot:
                             self._load_stateful(
                                 key, app_state[key], manifest_for_rank,
                                 storage, strict, rank, paths=paths,
-                                cas_reads=cas_reads,
+                                cas_reads=cas_reads, priority=priority,
                             )
                         if world > 1:
                             coordinator.barrier()
@@ -1455,12 +1475,13 @@ class Snapshot:
         rank: int,
         paths: Optional[Sequence[str]] = None,
         cas_reads: Optional[Tuple[Any, Dict[str, Any]]] = None,
+        priority: Optional[Sequence[str]] = None,
     ) -> None:
         # reference _load_stateful, snapshot.py:727-782
         with obs.span("restore/load_stateful", key=key, rank=rank):
             self._load_stateful_impl(
                 key, stateful, manifest_for_rank, storage, strict, rank,
-                paths=paths, cas_reads=cas_reads,
+                paths=paths, cas_reads=cas_reads, priority=priority,
             )
 
     def _load_stateful_impl(
@@ -1473,6 +1494,7 @@ class Snapshot:
         rank: int,
         paths: Optional[Sequence[str]] = None,
         cas_reads: Optional[Tuple[Any, Dict[str, Any]]] = None,
+        priority: Optional[Sequence[str]] = None,
     ) -> None:
         key_manifest = {
             p: e
@@ -1517,6 +1539,10 @@ class Snapshot:
                     futures[lpath] = fut
                 continue
             reqs, fut = prepare_read(entry, obj_out=targets.get(lpath))
+            if priority:
+                pri = _read_priority_for(lpath, priority)
+                for r in reqs:
+                    r.priority = pri
             read_reqs.extend(reqs)
             futures[lpath] = fut
         if not knobs.is_batching_disabled():
@@ -1672,13 +1698,21 @@ class Snapshot:
         return verify_snapshot(self, deep=deep)
 
     def materialize(
-        self, rank: Optional[int] = None
+        self, rank: Optional[int] = None,
+        priority: Optional[Sequence[str]] = None,
     ) -> Dict[str, Any]:
         """Read one rank's ENTIRE view into a nested state dict of host
         values — no templates, no app_state (beyond-parity; the
         reference's only template-free access is per-leaf read_object,
         snapshot.py:397-501).  Arrays come back as numpy; move them to
         device with ``jax.tree.map(jnp.asarray, ...)``.
+
+        With the MMAP knob on (the default) and a local/cached source,
+        arrays come back as READ-ONLY mmap-backed views — zero heap
+        copies, pages fault in from the page cache on first touch.
+        Call ``np.copy`` on a leaf if you need a private writable
+        buffer.  ``priority`` orders the reads like ``restore``'s
+        (first-matching-glob first).
 
         For inspection, migration and tooling; a training restore should
         keep using ``restore`` (sharded templates, in-place semantics,
@@ -1708,6 +1742,10 @@ class Snapshot:
             for p, e in manifest.items():
                 if not is_container_entry(e):
                     reqs, fut = prepare_read(e, obj_out=None)
+                    if priority:
+                        pri = _read_priority_for(p, priority)
+                        for r in reqs:
+                            r.priority = pri
                     read_reqs.extend(reqs)
                     futures[p] = fut
             if not knobs.is_batching_disabled():

@@ -28,14 +28,35 @@ def url_to_storage_plugin(
     ``fast_url``; optionally ``policy``, ``replica_count``,
     ``peer_fast_urls``, ``verify_fast_reads``) layers a fast local tier
     over the plugin built from ``url_path`` — the url names the DURABLE
-    tier, and the returned plugin is a ``TieredStoragePlugin``."""
+    tier, and the returned plugin is a ``TieredStoragePlugin``.
+
+    The reserved key ``"host_cache"`` (default True) gates the shared-
+    host object cache (storage/hostcache.py): with the
+    TORCHSNAPSHOT_TPU_CACHE_DIR knob set, the built plugin is wrapped so
+    co-located readers fetch each object from it exactly once.  Callers
+    constructing plugins that are themselves local caches — a tier's
+    fast root, peer replica roots — pass False so bytes aren't cached
+    twice on the same host."""
     opts = dict(storage_options or {})
     tier_opts = opts.pop("tier", None)
+    host_cache = opts.pop("host_cache", True)
     if tier_opts is not None:
         from ..tier import build_tiered
 
-        durable = url_to_storage_plugin(url_path, opts or None)
+        durable = url_to_storage_plugin(
+            url_path, dict(opts, host_cache=host_cache)
+        )
         return build_tiered(durable, url_path, **tier_opts)
+
+    def _maybe_cached(plugin: StoragePlugin) -> StoragePlugin:
+        from .. import knobs
+
+        if not host_cache or knobs.get_cache_dir() is None:
+            return plugin
+        from .hostcache import HostCachedStoragePlugin
+
+        return HostCachedStoragePlugin(plugin, url_path)
+
     if "://" in url_path:
         scheme, path = url_path.split("://", 1)
         scheme = scheme or "fs"
@@ -45,19 +66,19 @@ def url_to_storage_plugin(
     if scheme == "fs":
         from .fs import FSStoragePlugin
 
-        return FSStoragePlugin(root=path, **opts)
+        return _maybe_cached(FSStoragePlugin(root=path, **opts))
     if scheme == "memory":
         from .memory import MemoryStoragePlugin
 
-        return MemoryStoragePlugin(namespace=path, **opts)
+        return _maybe_cached(MemoryStoragePlugin(namespace=path, **opts))
     if scheme == "gs":
         from .gcs import GCSStoragePlugin
 
-        return GCSStoragePlugin(path=path, **opts)
+        return _maybe_cached(GCSStoragePlugin(path=path, **opts))
     if scheme == "s3":
         from .s3 import S3StoragePlugin
 
-        return S3StoragePlugin(path=path, **opts)
+        return _maybe_cached(S3StoragePlugin(path=path, **opts))
 
     # entry-point registry (reference storage_plugin.py:56-67).  Only
     # the DISCOVERY is failure-tolerant; a matched plugin's load or
@@ -78,5 +99,5 @@ def url_to_storage_plugin(
         obs.swallowed_exception("storage.entry_point_discovery", e)
     for ep in group:
         if ep.name == scheme:
-            return ep.load()(path, **opts)
+            return _maybe_cached(ep.load()(path, **opts))
     raise RuntimeError(f"no storage plugin registered for scheme {scheme!r}")

@@ -24,7 +24,13 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from .. import knobs, obs
-from ..io_types import ReadIO, StoragePlugin, StripedWriteHandle, WriteIO
+from ..io_types import (
+    ReadIO,
+    StoragePlugin,
+    StripedWriteHandle,
+    WriteIO,
+    resolve_read_destination,
+)
 from ..resilience import classify_fs, get_breaker, retry_call
 from ..resilience.retry import lazy_shared_progress
 from ..resilience.failpoints import failpoint
@@ -43,6 +49,71 @@ def _unlink_quiet(path: str) -> None:
         os.unlink(path)
     except OSError:
         pass
+
+
+def mmap_read(full: str, byte_range, path: str = ""):
+    """Zero-copy read: a READ-ONLY numpy view over a private file-backed
+    mapping of ``full`` (whole file mapped; ``byte_range`` selects a
+    sub-view — mmap offsets must be page-aligned, numpy offsets need
+    not be).  The pages never enter the Python heap: they fault in from
+    the page cache on first touch and the kernel can reclaim them under
+    pressure, which is why the read scheduler admits mmap reads
+    budget-exempt.
+
+    SIGBUS discipline (the madvise/copy-on-verify decision): touching a
+    mapped page past the inode's EOF raises SIGBUS, so a file truncated
+    IN PLACE while mapped would crash the reader.  We deliberately do
+    NOT defensively copy (that would forfeit the whole zero-copy win);
+    instead every writer in this codebase publishes via temp+rename
+    (never truncates a live name) and every eviction path — tier fast
+    GC, cache eviction — UNLINKS (POSIX keeps an unlinked-but-mapped
+    inode's pages valid until the last mapping drops).  So our own
+    lifecycle can never SIGBUS a live mapping; digest verification
+    (tier fast reads, VERIFY_ON_RESTORE) additionally reads through the
+    map immediately after it is created, so an EXTERNALLY truncated or
+    corrupted file fails the checksum inside normal exception handling
+    (→ peer/durable fallback + repair) instead of surfacing later as a
+    mid-consume fault.  The extent check below catches truncation that
+    happened before the map existed.  MADV_WILLNEED kicks off readahead
+    for the mapped span — the common consumer walks it sequentially
+    right away."""
+    import mmap as _mmap
+
+    import numpy as np
+
+    with obs.span("storage/mmap_read", path=path or full):
+        fd = os.open(full, os.O_RDONLY)
+        try:
+            size = os.fstat(fd).st_size
+            if byte_range is None:
+                offset, length = 0, size
+            else:
+                offset, length = byte_range[0], byte_range[1] - byte_range[0]
+            if offset + length > size:
+                # shorter than the manifest says: surface the I/O error
+                # here (errno EIO) rather than SIGBUS at first touch
+                raise OSError(
+                    5,
+                    f"mmap read of [{offset}, {offset + length}) exceeds "
+                    f"file size {size}",
+                    full,
+                )
+            if length == 0:
+                return np.empty(0, dtype=np.uint8)
+            mm = _mmap.mmap(fd, size, access=_mmap.ACCESS_READ)
+        finally:
+            os.close(fd)
+        try:
+            # madvise offsets must be page-aligned; round the span out
+            lo = offset - (offset % _mmap.PAGESIZE)
+            mm.madvise(_mmap.MADV_WILLNEED, lo, length + (offset - lo))
+        except (AttributeError, OSError, ValueError) as e:
+            obs.swallowed_exception("storage.fs.mmap_madvise", e)
+        obs.counter(obs.MMAP_READS).inc()
+        obs.counter(obs.MMAP_BYTES_MAPPED).inc(length)
+        # the array holds the only reference to ``mm`` — the mapping
+        # lives exactly as long as some view of the buffer does
+        return np.frombuffer(mm, dtype=np.uint8, count=length, offset=offset)
 
 
 def _fsync_dir(path: str) -> None:
@@ -241,8 +312,25 @@ class FSStoragePlugin(StoragePlugin):
                 )
         return digests
 
+    supports_mmap_read = True
+    mmap_budget_exempt = True  # every read is a local file: maps never decline
+
     async def read(self, read_io: ReadIO) -> None:
         full = self._full(read_io.path)
+        if read_io.want_mmap and knobs.mmap_enabled():
+            # zero-copy serving path (works on both backends — the map
+            # is pure Python); the mmap_read docstring carries the
+            # SIGBUS/verify contract
+            def mmap_attempt():
+                failpoint("storage.fs.read", path=read_io.path)
+                return mmap_read(full, read_io.byte_range, read_io.path)
+
+            read_io.buf = await self._retry(
+                mmap_attempt,
+                f"read {read_io.path}",
+                executor=self._executor,
+            )
+            return
         if self._lib is not None:
 
             def native_attempt():
@@ -263,10 +351,35 @@ class FSStoragePlugin(StoragePlugin):
             failpoint("storage.fs.read", path=read_io.path)
             async with aiofiles.open(full, "rb") as f:
                 if read_io.byte_range is None:
-                    return await f.read()
-                start, end = read_io.byte_range
-                await f.seek(start)
-                return await f.read(end - start)
+                    start = 0
+                    length = (await f.seek(0, os.SEEK_END)) or 0
+                    await f.seek(0)
+                else:
+                    start, end = read_io.byte_range
+                    length = end - start
+                    await f.seek(start)
+                # honor the destination hint like _native_read does:
+                # one-touch restore (read straight into the template)
+                # must not be a native-ext-only property.  The shared
+                # resolve_read_destination carries the honor contract;
+                # identity tells us whether the hint was usable.
+                if read_io.into is None or not hasattr(f, "readinto"):
+                    return await f.read(length)
+                dst = resolve_read_destination(read_io.into, length)
+                if dst is not read_io.into:
+                    return await f.read(length)  # unusable hint
+                view = memoryview(dst).cast("B")
+                pos = 0
+                while pos < length:
+                    n = await f.readinto(view[pos:])
+                    if not n:
+                        # short read can't satisfy the in-place
+                        # contract; surface it as the I/O error it is
+                        raise OSError(
+                            5, f"short read: {pos} of {length} bytes", full
+                        )
+                    pos += n
+                return read_io.into
 
         read_io.buf = await self._retry(aio_attempt, f"read {read_io.path}")
 

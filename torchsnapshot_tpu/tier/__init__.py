@@ -78,10 +78,10 @@ def build_tiered(
     entry point (storage/__init__.py)."""
     from ..storage import url_to_storage_plugin
 
-    fast = (
-        url_to_storage_plugin(fast_url, fast_storage_options)
-        if fast_storage_options
-        else url_to_storage_plugin(fast_url)
+    # the fast tier IS this host's local copy — routing it through the
+    # shared-host object cache would store every byte twice
+    fast = url_to_storage_plugin(
+        fast_url, dict(fast_storage_options or {}, host_cache=False)
     )
     return TieredStoragePlugin(
         fast=fast,

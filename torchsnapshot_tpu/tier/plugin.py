@@ -40,7 +40,7 @@ import logging
 from typing import Any, Dict, List, Optional
 
 from .. import knobs, obs
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import ReadIO, StoragePlugin, WriteIO, is_mmap_backed
 from ..resilience import get_breaker
 from .promoter import PromotionGroup, get_promoter
 
@@ -117,6 +117,21 @@ class TieredStoragePlugin(StoragePlugin):
         self.supports_fused_digest = bool(
             getattr(auth, "supports_fused_digest", False)
         )
+        # zero-copy serving: reads are fast-first, so the composite can
+        # honor want_mmap whenever the fast tier can (the durable
+        # fallback may still copy — an s3 GET has no pages to map; a
+        # cache-wrapped durable tier maps fine).  Budget exemption is
+        # STRICTER: only when BOTH legs are exempt — a composite that
+        # can decline into a whole-object cloud GET on its degraded
+        # path must keep budgeted, striped reads there (the scheduler
+        # keys on mmap_budget_exempt; see io_types.StoragePlugin).
+        self.supports_mmap_read = bool(
+            getattr(fast, "supports_mmap_read", False)
+        )
+        self.mmap_budget_exempt = bool(
+            getattr(fast, "mmap_budget_exempt", False)
+            and getattr(durable, "mmap_budget_exempt", False)
+        )
         # location → [crc32, adler32, size] primed from committed
         # metadata (Snapshot._prime_tier_digests); gates read-side
         # verification of fast/peer copies
@@ -155,7 +170,13 @@ class TieredStoragePlugin(StoragePlugin):
         if plugin is None:
             from ..storage import url_to_storage_plugin
 
-            plugin = self._peer_plugins[url] = url_to_storage_plugin(url)
+            # peer fast roots are other hosts' local tiers: never layer
+            # the shared-host cache over them (replica probes are
+            # already one-hop local-network reads, and caching a peer's
+            # copy would shadow later repairs)
+            plugin = self._peer_plugins[url] = url_to_storage_plugin(
+                url, {"host_cache": False}
+            )
         return plugin
 
     def _digest_ok(self, path: str, buf: Any) -> bool:
@@ -277,7 +298,18 @@ class TieredStoragePlugin(StoragePlugin):
     async def _read_fast_checked(self, read_io: ReadIO) -> None:
         path = read_io.path
         if self._has_check(path) and path not in self._verified:
-            probe = ReadIO(path=path)
+            # verify-through-the-map (the copy-on-verify decision): a
+            # want_mmap probe maps the fast copy and the digest pass
+            # reads every page through it RIGHT HERE — a file truncated
+            # or corrupted before this point fails the checksum inside
+            # ordinary exception handling (→ _FastTierCorrupt → peer/
+            # durable fallback + repair) instead of a later SIGBUS, and
+            # the verified mapping is then served without any heap copy.
+            # Defensively copying instead would forfeit zero-copy for
+            # every verified read; our own eviction paths unlink (never
+            # truncate), so a mapping that passed this check stays valid
+            # for its lifetime (see storage.fs.mmap_read).
+            probe = ReadIO(path=path, want_mmap=read_io.want_mmap)
             await self.fast.read(probe)
             if not self._digest_ok(path, probe.buf):
                 raise _FastTierCorrupt(path)
@@ -292,7 +324,12 @@ class TieredStoragePlugin(StoragePlugin):
             read_io.buf = buf
         else:
             start, end = read_io.byte_range
-            read_io.buf = bytes(_as_bytes_view(buf)[start:end])
+            view = _as_bytes_view(buf)[start:end]
+            # a ranged serve from an mmap-backed probe stays a view —
+            # pinning the mapping costs address space, not heap; any
+            # other probe buffer is sliced by copy so the served range
+            # doesn't pin the whole object
+            read_io.buf = view if is_mmap_backed(buf) else bytes(view)
 
     async def _fallback_read(self, read_io: ReadIO) -> None:
         path = read_io.path
@@ -326,7 +363,10 @@ class TieredStoragePlugin(StoragePlugin):
         # known from the digest table); otherwise a plain ranged read.
         digest = self._digests.get(path)
         if read_io.byte_range is None or digest is not None:
-            probe = ReadIO(path=path)
+            # forward want_mmap: a cache-wrapped durable tier serves the
+            # probe as a mapping (zero-copy all the way through the
+            # fallback); a cloud plugin ignores the flag and copies
+            probe = ReadIO(path=path, want_mmap=read_io.want_mmap)
             await self.durable.read(probe)
             if not self._digest_ok(path, probe.buf):
                 raise RuntimeError(
@@ -338,7 +378,10 @@ class TieredStoragePlugin(StoragePlugin):
             return
         await self.durable.read(
             inner := ReadIO(
-                path=path, byte_range=read_io.byte_range, into=read_io.into
+                path=path,
+                byte_range=read_io.byte_range,
+                into=read_io.into,
+                want_mmap=read_io.want_mmap,
             )
         )
         read_io.buf = inner.buf

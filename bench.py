@@ -677,6 +677,167 @@ def _serving_probe(
     return out
 
 
+def _fanout_probe(
+    slices: int = 2, ranks_per_slice: int = 3, objects: int = 4,
+    obj_mb: int = 2,
+) -> dict:
+    """Hierarchical multislice checkpointing probe (topology/).
+
+    Read side — SIMULATED N-process restore: S×R FileCoordinator
+    thread-ranks restore one snapshot of K replicated objects with the
+    fan-out ON (explicit topology spec).  The probe counts actual
+    durable-tier GETs for shared objects and asserts the multislice
+    contract: **O(objects) per slice, not O(objects × ranks)** —
+    ``durable_gets`` must equal K × S while a flat restore issues
+    K × R × S.  Also reports peer-served reads, redistributed bytes and
+    wall-clock for the fan-out vs flat legs.
+
+    Write side — per-slice durable egress balance of the topology-aware
+    replicated-write partition (pure planning, zero I/O): max/min
+    per-slice byte load over a skewed item set, topology-aware vs
+    flat."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+    from torchsnapshot_tpu.coordination import FileCoordinator
+    from torchsnapshot_tpu.partitioner import partition_replicated_writes
+    from torchsnapshot_tpu.topology import Topology
+
+    world = slices * ranks_per_slice
+    spec = ",".join(str(r // ranks_per_slice) for r in range(world))
+    root = tempfile.mkdtemp(prefix="tsnp_bench_fanout_")
+    snap = os.path.join(root, "snap")
+    n = obj_mb * (1 << 20) // 4
+    state = {
+        "m": StateDict(
+            **{
+                f"l{i}": np.arange(n, dtype=np.float32) * (i + 1)
+                for i in range(objects)
+            }
+        )
+    }
+    out: dict = {
+        "slices": slices,
+        "ranks_per_slice": ranks_per_slice,
+        "objects": objects,
+        "object_mb": obj_mb,
+    }
+
+    def leg(topology_spec, kv_sub) -> dict:
+        errors: list = []
+
+        def worker(r):
+            try:
+                dest = {
+                    "m": StateDict(
+                        **{
+                            f"l{i}": np.zeros(n, np.float32)
+                            for i in range(objects)
+                        }
+                    )
+                }
+                coord = FileCoordinator(
+                    os.path.join(root, kv_sub), r, world
+                )
+                Snapshot(snap, coordinator=coord).restore(dest)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        c0 = obs.metrics_snapshot()["counters"]
+        threads = [
+            threading.Thread(target=worker, args=(r,))
+            for r in range(world)
+        ]
+        t0 = time.perf_counter()
+        ctx = (
+            knobs.override_topology(topology_spec)
+            if topology_spec
+            else knobs.override_topology("flat")
+        )
+        with ctx:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        c1 = obs.metrics_snapshot()["counters"]
+
+        def d(name):
+            return c1.get(name, 0) - c0.get(name, 0)
+
+        return {
+            "elapsed_s": round(elapsed, 3),
+            "durable_gets": d("topology.fanout_durable_reads"),
+            "gets_saved": d("topology.durable_gets_saved"),
+            "bytes_redistributed": d(
+                "topology.fanout_bytes_redistributed"
+            ),
+            "fallbacks": d("topology.fanout_fallbacks"),
+        }
+
+    try:
+        with knobs.override_disable_batching(True):
+            Snapshot.take(snap, state, replicated=["**"])
+            out["fanout"] = leg(spec, "kv_fan")
+            out["flat"] = leg(None, "kv_flat")
+        # the acceptance inequality: O(objects) per slice, not
+        # O(objects × ranks) — flat-leg GETs are implicit (every rank
+        # reads every object directly; no fan-out counters fire)
+        out["fanout"]["gets_per_slice"] = (
+            out["fanout"]["durable_gets"] / slices
+        )
+        out["flat"]["durable_gets"] = objects * world
+        out["o_objects_not_o_ranks"] = (
+            out["fanout"]["durable_gets"] == objects * slices
+            and out["fanout"]["fallbacks"] == 0
+        )
+        out["get_reduction_factor"] = round(
+            out["flat"]["durable_gets"]
+            / max(1, out["fanout"]["durable_gets"]),
+            2,
+        )
+        # ------- write side: per-slice egress balance (pure planning).
+        # Deliberately UNEVEN slices (most ranks in slice 0): the flat
+        # greedy balances per-rank, which concentrates egress on the
+        # big slice's uplink; the topology-aware greedy balances the
+        # slices themselves.
+        uneven = ",".join(
+            "0" if r < world - max(1, world // 3) else "1"
+            for r in range(world)
+        )
+        topo = Topology.from_spec(uneven, rank=0, world_size=world)
+        out["write_balance_spec"] = uneven
+        items = [
+            (f"w{i}", (1 + (i * 7) % 13) * (1 << 20)) for i in range(24)
+        ]
+        sizes = dict(items)
+
+        def slice_loads(assignment):
+            loads = [0] * topo.num_slices
+            for p, r in assignment.items():
+                loads[topo.slice_of[r]] += sizes[p]
+            return loads
+
+        aware = slice_loads(
+            partition_replicated_writes(items, world, topology=topo)
+        )
+        flat = slice_loads(partition_replicated_writes(items, world))
+        out["write_balance"] = {
+            "per_slice_mb_topology": [round(x / 1e6, 2) for x in aware],
+            "per_slice_mb_flat": [round(x / 1e6, 2) for x in flat],
+            "imbalance_topology": round(max(aware) / max(1, min(aware)), 3),
+            "imbalance_flat": round(max(flat) / max(1, min(flat)), 3),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _codec_probe(payload_mb: int = 128, part_mb: int = 8) -> dict:
     """Compression microbench on a REALISTIC bf16 payload (noisy
     weights — zeros would flatter every codec): per-codec compression
@@ -1316,6 +1477,15 @@ def run_child() -> None:
             result["serving"] = _serving_probe()
         except Exception as e:
             result["serving"] = {"error": f"{e!r}"[:200]}
+        # multislice fan-out: simulated S×R-process restore counting
+        # durable-tier GETs (must be O(objects) per slice, not
+        # O(objects × ranks)) + write-side per-slice egress balance of
+        # the topology-aware partition (host-only, after the metrics
+        # snapshot like the others)
+        try:
+            result["fanout"] = _fanout_probe()
+        except Exception as e:
+            result["fanout"] = {"error": f"{e!r}"[:200]}
         print(json.dumps(result), flush=True)
         # spot-check one leaf round-tripped
         import ml_dtypes

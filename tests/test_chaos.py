@@ -565,6 +565,172 @@ def test_chaos_multirank_restore_peer_fault_aborts_all_ranks(tmp_path):
     assert "rank 1 ORIGIN-RAISED" in results[1][1]
 
 
+# ================================================ fan-out scenarios
+#
+# The fan-out restore's chaos contract (topology/fanout.py): a
+# designated per-slice reader that dies (or whose publications never
+# arrive) degrades its siblings to DIRECT durable reads after the
+# fan-out timeout — the restore completes with correct bytes, the
+# durable GET count stays bounded by objects × ranks (the flat
+# ceiling), and nothing wedges to a barrier timeout.
+
+
+def _fanout_chaos_snapshot(tmp_path, k=3, n=2048):
+    snap_dir = os.path.join(str(tmp_path), "snap")
+    state = {
+        "m": StateDict(
+            **{
+                f"l{i}": np.arange(n, dtype=np.float32) + 10 * i
+                for i in range(k)
+            }
+        )
+    }
+    with knobs.override_disable_batching(True):
+        Snapshot.take(snap_dir, state, replicated=["**"])
+    return snap_dir
+
+
+def test_chaos_fanout_publish_failure_siblings_fall_back_bounded(tmp_path):
+    """Every fan-out publication fails (the designated readers
+    "die mid fan-out" as publishers while their own restores live):
+    siblings time out and fall back to direct durable reads; all ranks
+    complete with correct bytes, no wedge, GET count bounded."""
+    _fanout_chaos_snapshot(tmp_path)
+    body = r"""
+    import json
+    from torchsnapshot_tpu import obs
+    K, N = 3, 2048
+    dest = {"m": StateDict(**{
+        f"l{i}": np.zeros(N, np.float32) for i in range(K)
+    })}
+    Snapshot(snap_dir, coordinator=coord).restore(dest)
+    for i in range(K):
+        np.testing.assert_array_equal(
+            dest["m"][f"l{i}"], np.arange(N, dtype=np.float32) + 10 * i
+        )
+    c = obs.metrics_snapshot()["counters"]
+    print("FANOUT " + json.dumps({
+        "rank": rank,
+        "fallbacks": c.get("topology.fanout_fallbacks", 0),
+        "durable": c.get("topology.fanout_durable_reads", 0),
+        "saved": c.get("topology.durable_gets_saved", 0),
+    }))
+    print(f"rank {rank} CHAOS-OK")
+    """
+    env = {
+        "TORCHSNAPSHOT_TPU_TOPOLOGY": "0,0",
+        "TORCHSNAPSHOT_TPU_DISABLE_BATCHING": "1",
+        "TORCHSNAPSHOT_TPU_FANOUT_TIMEOUT_S": "1",
+        "TORCHSNAPSHOT_TPU_FAILPOINTS": "topology.fanout.publish=io",
+    }
+    t0 = time.monotonic()
+    results = _launch_chaos_workers(tmp_path, body, [env, env], world=2)
+    assert time.monotonic() - t0 < 90, "fallback must be bounded, not a wedge"
+    import json as _json
+
+    fallbacks = durable = saved = 0
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} CHAOS-OK" in out
+        stats = next(
+            _json.loads(line[len("FANOUT "):])
+            for line in out.splitlines()
+            if line.startswith("FANOUT ")
+        )
+        fallbacks += stats["fallbacks"]
+        durable += stats["durable"]
+        saved += stats["saved"]
+    # with publications dead, every non-designated shared read fell back
+    assert fallbacks >= 1
+    assert saved == 0
+    # bounded: at worst the flat ceiling (objects x ranks), never more
+    assert durable <= 3 * 2
+
+
+def test_chaos_fanout_dead_reader_process_siblings_recover(tmp_path):
+    """A designated reader PROCESS dies mid fan-out (after its durable
+    read, before publishing): surviving slice members fall back to
+    direct reads within the fan-out timeout and observe correct bytes.
+    Exercised at the plugin level (no restore barriers, so the dead
+    process stresses exactly the fan-out wait, not the commit
+    protocol)."""
+    store_root = os.path.join(str(tmp_path), "objs")
+    payloads = {
+        f"replicated/l{i}": (np.arange(1024, dtype=np.float32) * (i + 1))
+        for i in range(3)
+    }
+    os.makedirs(store_root, exist_ok=True)
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+    seed_plugin = FSStoragePlugin(root=store_root)
+    for path, arr in payloads.items():
+        seed_plugin.sync_write(WriteIO(path=path, buf=arr.tobytes()))
+    seed_plugin.sync_close()
+
+    body = r"""
+    import json
+    import numpy as _np
+    from torchsnapshot_tpu import obs
+    from torchsnapshot_tpu.io_types import ReadIO
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+    from torchsnapshot_tpu.topology import FanoutReadPlugin, Topology
+    import torchsnapshot_tpu.topology.fanout as fanout_mod
+
+    topo = Topology.from_spec("0,0,0", rank=rank, world_size=world)
+    shared = [f"replicated/l{i}" for i in range(3)]
+    dead = topo.designated_reader("replicated/l0")
+    if rank == dead:
+        async def _die(*a, **k):
+            os._exit(17)
+        fanout_mod.publish_object = _die
+    plugin = FanoutReadPlugin(
+        FSStoragePlugin(root=""" + repr(store_root) + r"""),
+        coord, topo, "fanchaos", shared,
+    )
+    for i, path in enumerate(shared):
+        io = ReadIO(path=path)
+        plugin.sync_read(io)
+        got = _np.frombuffer(bytes(memoryview(io.buf).cast("B")), _np.float32)
+        assert _np.array_equal(
+            got, _np.arange(1024, dtype=_np.float32) * (i + 1)
+        ), path
+    c = obs.metrics_snapshot()["counters"]
+    print("FANOUT " + json.dumps({
+        "rank": rank,
+        "fallbacks": c.get("topology.fanout_fallbacks", 0),
+    }))
+    print(f"rank {rank} CHAOS-OK")
+    """
+    env = {"TORCHSNAPSHOT_TPU_FANOUT_TIMEOUT_S": "1"}
+    t0 = time.monotonic()
+    results = _launch_chaos_workers(
+        tmp_path, body, [env, env, env], world=3
+    )
+    assert time.monotonic() - t0 < 90
+    import json as _json
+
+    from torchsnapshot_tpu.topology import Topology as _Topology
+
+    dead = _Topology.from_spec(
+        "0,0,0", rank=0, world_size=3
+    ).designated_reader("replicated/l0")
+    survivor_fallbacks = 0
+    for r, (rc, out) in enumerate(results):
+        if r == dead:
+            assert rc == 17, f"dead rank exited rc={rc}:\n{out}"
+            continue
+        assert rc == 0, f"survivor rank {r} failed:\n{out}"
+        assert f"rank {r} CHAOS-OK" in out
+        stats = next(
+            _json.loads(line[len("FANOUT "):])
+            for line in out.splitlines()
+            if line.startswith("FANOUT ")
+        )
+        survivor_fallbacks += stats["fallbacks"]
+    # the dead reader's designated objects were re-read directly
+    assert survivor_fallbacks >= 1
+
+
 # ================================================== codec scenarios
 #
 # The codec layer's chaos contract: a transient fault inside the encode

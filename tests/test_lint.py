@@ -1257,6 +1257,60 @@ def test_instrumentation_covers_cas_entry_points():
     } <= MODULE_FUNCTIONS["torchsnapshot_tpu/cas/gc.py"]
 
 
+def test_instrumentation_covers_topology_entry_points():
+    """The multislice subsystem's entry points (topology/) are pinned
+    into the instrumentation coverage map: the placement exchange and
+    the fan-out publish/fetch transport can each stall a whole slice's
+    restore, so dropping their spans in a refactor must fail here."""
+    from tools.lint.passes.instrumentation import MODULE_FUNCTIONS
+
+    assert {"detect_topology"} <= MODULE_FUNCTIONS[
+        "torchsnapshot_tpu/topology/model.py"
+    ]
+    assert {"publish_object", "fetch_published"} <= MODULE_FUNCTIONS[
+        "torchsnapshot_tpu/topology/fanout.py"
+    ]
+
+
+def test_collective_safety_designated_reader_kv_pattern_clean():
+    """The fan-out restore's designated-reader protocol is rank-
+    conditional BY DESIGN — the publisher kv_sets, siblings kv_get —
+    and explicit-key KV ops are the sanctioned asymmetric pattern.
+    The collective-safety pass must accept exactly that shape."""
+    findings = _run(
+        "collective-safety",
+        """
+        def fan_read(coord, topo, path, inner_read, fetch):
+            if topo.designated_reader(path) == coord.rank:
+                inner_read(path)
+                coord.kv_publish_blob("fan/p", b"bytes")
+            else:
+                data = coord.kv_try_get("fan/p/meta")
+            coord.barrier()  # symmetric epilogue stays legal
+        """,
+    )
+    assert findings == []
+
+
+def test_collective_safety_flags_collective_in_designated_branch():
+    """...but an actual COLLECTIVE under the designated-reader branch
+    is the SPMD deadlock the pass exists for: only the designated rank
+    would arrive."""
+    findings = _run(
+        "collective-safety",
+        """
+        def fan_read(coord, topo, path):
+            if topo.designated_reader(path) == coord.rank:
+                coord.kv_exchange("fan/p", "v")
+            else:
+                coord.barrier()
+        """,
+    )
+    assert len(findings) == 2
+    messages = " ".join(f.message for f in findings)
+    assert "kv_exchange" in messages and "barrier" in messages
+
+
 def test_instrumentation_flags_uncovered_goodput_entry_point():
     from tools.lint.passes.instrumentation import check_source
 

@@ -1,0 +1,615 @@
+"""Multislice topology subsystem (topology/): the placement model,
+DCN-aware write partitioning, and the fan-out restore.
+
+The two acceptance invariants (ISSUE 11):
+
+- **write-once-per-fleet**: each replicated object is written by
+  exactly one rank fleet-wide, with writers spread across ≥ 2 slices
+  (per-slice durable egress balance);
+- **read-once-per-slice**: a restore of K shared objects across
+  S slices × R ranks issues exactly K durable GETs per slice
+  (O(objects), not O(objects × ranks)), results bitwise-identical to a
+  flat restore.
+
+Multi-process tests run real FileCoordinator worker processes (the
+same harness shape as the chaos suite)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import types
+import zlib
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+from torchsnapshot_tpu.coordination import FileCoordinator, LocalCoordinator
+from torchsnapshot_tpu.partitioner import partition_replicated_writes
+from torchsnapshot_tpu.preparers.sharded import assign_box_writers
+from torchsnapshot_tpu.topology import (
+    Topology,
+    detect_topology,
+    fanout_enabled,
+    shared_read_locations,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ========================================================== model
+
+
+def test_from_spec_and_dense_normalization():
+    topo = Topology.from_spec("3,3,7,7", rank=2, world_size=4)
+    assert topo.num_slices == 2
+    assert topo.slice_of == (0, 0, 1, 1)  # dense remap
+    assert topo.slice_id == 1
+    assert topo.ranks_in_slice(0) == (0, 1)
+    assert topo.ranks_in_slice(1) == (2, 3)
+    assert topo.explicit and topo.multislice
+
+
+def test_from_spec_with_hosts():
+    topo = Topology.from_spec("0/h0,0/h0,1/h1,1/h2", rank=0, world_size=4)
+    assert topo.co_located(0, 1)
+    assert not topo.co_located(2, 3)
+    assert topo.num_hosts == 3
+
+
+def test_from_spec_wrong_length_raises():
+    with pytest.raises(ValueError):
+        Topology.from_spec("0,0,1", rank=0, world_size=4)
+
+
+def test_flat_topology_is_inert():
+    topo = Topology.flat(0, 4)
+    assert not topo.explicit
+    assert topo.num_slices == 1
+    assert not topo.multislice
+
+
+def test_designated_reader_deterministic_and_in_slice():
+    topo = Topology.from_spec("0,0,0,1,1,1", rank=4, world_size=6)
+    keys = [f"replicated/obj{i}" for i in range(64)]
+    readers = [topo.designated_reader(k) for k in keys]
+    assert readers == [topo.designated_reader(k) for k in keys]
+    # every reader is a member of THIS rank's slice
+    assert set(readers) <= set(topo.ranks_in_slice(1))
+    # consecutive keys spread over the slice, not one hot rank
+    assert len(set(readers)) > 1
+    # the peer slice elects among ITS members for the same keys
+    assert set(
+        topo.designated_reader(k, slice_id=0) for k in keys
+    ) <= set(topo.ranks_in_slice(0))
+
+
+def test_detect_explicit_spec_no_communication(tmp_path):
+    with knobs.override_topology("0,1"):
+        # a 2-rank spec with NO peer process: spec parsing must not
+        # wait on the KV (detection would wedge here if it exchanged)
+        coord = FileCoordinator(str(tmp_path / "kv"), 0, 2)
+        topo = detect_topology(coord)
+    assert topo.explicit and topo.num_slices == 2
+
+
+def test_detect_flat_mode():
+    with knobs.override_topology("flat"):
+        topo = detect_topology(LocalCoordinator())
+    assert not topo.explicit
+
+
+def test_detect_bad_spec_degrades_flat():
+    with knobs.override_topology("0,0,1"):  # wrong length for world 1
+        topo = detect_topology(LocalCoordinator())
+    assert not topo.explicit
+
+
+def test_detect_auto_exchanges_hints(tmp_path):
+    kv = str(tmp_path / "kv")
+    out = {}
+
+    def worker(r, slice_hint, host_hint):
+        coord = FileCoordinator(kv, r, 4)
+        out[r] = detect_topology(
+            coord, exchange_prefix="t0",
+            slice_hint=slice_hint, host_hint=host_hint,
+        )
+
+    hints = [(0, "ha"), (0, "hb"), (1, "hc"), (1, "hc")]
+    threads = [
+        threading.Thread(target=worker, args=(r, s, h))
+        for r, (s, h) in enumerate(hints)
+    ]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    for r in range(4):
+        topo = out[r]
+        assert topo.explicit
+        assert topo.slice_of == (0, 0, 1, 1)
+        assert topo.co_located(2, 3) and not topo.co_located(0, 1)
+
+
+def test_detect_auto_partial_hints_degrade_flat(tmp_path):
+    kv = str(tmp_path / "kv")
+    out = {}
+
+    def worker(r, slice_hint):
+        coord = FileCoordinator(kv, r, 2)
+        out[r] = detect_topology(
+            coord, exchange_prefix="t1",
+            slice_hint=slice_hint, host_hint=f"h{r}",
+        )
+
+    threads = [
+        threading.Thread(target=worker, args=(r, s))
+        for r, s in enumerate([0, None])
+    ]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not out[0].explicit and not out[1].explicit
+
+
+# ===================================================== partitioner
+
+
+def _slice_loads(assignment, items, topo):
+    loads = [0] * topo.num_slices
+    sizes = dict(items)
+    for p, r in assignment.items():
+        loads[topo.slice_of[r]] += sizes[p]
+    return loads
+
+
+def test_partition_topology_spreads_across_slices():
+    topo = Topology.from_spec("0,0,1,1", rank=0, world_size=4)
+    items = [(f"p{i}", 1000) for i in range(8)]
+    assignment = partition_replicated_writes(items, 4, topology=topo)
+    # exactly one writer per object, spread over BOTH slices evenly
+    assert len(assignment) == 8
+    assert _slice_loads(assignment, items, topo) == [4000, 4000]
+
+
+def test_partition_topology_balances_slices_before_ranks():
+    # 3 ranks in slice 0, 1 rank in slice 1: per-slice egress balance
+    # sends half the bytes through the lone slice-1 rank
+    topo = Topology.from_spec("0,0,0,1", rank=0, world_size=4)
+    items = [(f"p{i}", 100) for i in range(12)]
+    assignment = partition_replicated_writes(items, 4, topology=topo)
+    loads = _slice_loads(assignment, items, topo)
+    assert loads == [600, 600]
+
+
+def test_partition_topology_deterministic_and_order_independent():
+    topo = Topology.from_spec("0,0,1,1,2,2", rank=3, world_size=6)
+    items = [(f"p{i}", (i * 37) % 100 + 1) for i in range(50)]
+    a = partition_replicated_writes(items, 6, topology=topo)
+    b = partition_replicated_writes(list(reversed(items)), 6, topology=topo)
+    assert a == b
+
+
+def test_partition_non_explicit_topology_matches_flat():
+    topo = Topology.flat(0, 4)
+    items = [(f"p{i}", 10 + i) for i in range(9)]
+    assert partition_replicated_writes(
+        items, 4, topology=topo
+    ) == partition_replicated_writes(items, 4)
+
+
+def test_partition_topology_composes_with_preloads():
+    # slice 0 already carries heavy per-rank state: replicated writes
+    # shift to slice 1 until the slice loads even out
+    topo = Topology.from_spec("0,0,1,1", rank=0, world_size=4)
+    items = [(f"p{i}", 10) for i in range(10)]
+    assignment = partition_replicated_writes(
+        items, 4, preloads=[1000, 1000, 0, 0], topology=topo
+    )
+    assert set(assignment.values()) <= {2, 3}
+
+
+def test_partition_topology_host_spread_within_slice():
+    # one slice, two hosts with two ranks each: writers spread across
+    # hosts first (per-NIC egress), then ranks
+    topo = Topology.from_spec(
+        "0/h0,0/h0,0/h1,0/h1", rank=0, world_size=4
+    )
+    items = [(f"p{i}", 100) for i in range(8)]
+    assignment = partition_replicated_writes(items, 4, topology=topo)
+    by_host = {0: 0, 1: 0}
+    for p, r in assignment.items():
+        by_host[topo.host_of[r]] += 1
+    assert by_host == {0: 4, 1: 4}
+
+
+class _Dev:
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+
+def test_box_writers_topology_spread():
+    # every box replicated across all 4 processes (2 slices): the
+    # sharded-replica election spreads writers across slices too
+    topo = Topology.from_spec("0,0,1,1", rank=0, world_size=4)
+    boxes = {
+        ((i * 16, 0), (16, 8)): [_Dev(p) for p in range(4)]
+        for i in range(8)
+    }
+    assignment = assign_box_writers(boxes, 4, 4, topology=topo)
+    per_slice = {0: 0, 1: 0}
+    for w in assignment.values():
+        per_slice[topo.slice_of[w]] += 1
+    assert per_slice == {0: 4, 1: 4}
+    # and stays deterministic
+    assert assignment == assign_box_writers(boxes, 4, 4, topology=topo)
+
+
+# ========================================================= fan-out
+
+
+def _entry(replicated, location, chunks=()):
+    return types.SimpleNamespace(
+        replicated=replicated,
+        location=location,
+        chunks=[types.SimpleNamespace(location=c) for c in chunks],
+        shards=[],
+    )
+
+
+def test_shared_read_locations_filters_namespace_and_replication():
+    manifest = {
+        "a": _entry(True, "replicated/a"),
+        "b": _entry(False, "0/b"),  # per-rank: excluded
+        "c": _entry(True, "0/batched.0"),  # slab-resident: excluded
+        "d": _entry(
+            True, None,
+            chunks=["replicated/d/chunk_0", "replicated/d/chunk_1"],
+        ),
+    }
+    assert shared_read_locations(manifest) == {
+        "replicated/a", "replicated/d/chunk_0", "replicated/d/chunk_1",
+    }
+
+
+def test_fanout_enabled_modes():
+    multi = Topology.from_spec("0,0,1,1", rank=0, world_size=4)
+    lonely = Topology.from_spec("0,1,1,1", rank=0, world_size=4)
+    flat = Topology.flat(0, 4)
+    with knobs.override_fanout("off"):
+        assert not fanout_enabled(multi)
+    with knobs.override_fanout("on"):
+        assert fanout_enabled(multi)
+        assert not fanout_enabled(lonely)  # no siblings in my slice
+    with knobs.override_fanout("auto"):
+        assert fanout_enabled(multi)
+        assert not fanout_enabled(flat)  # nothing explicit to act on
+
+
+def test_fanout_auto_skips_single_host_slice_with_cache(tmp_path):
+    # my slice's members all share one host: with the shared-host cache
+    # active the slice already costs one GET per object — auto skips
+    topo = Topology.from_spec(
+        "0/h0,0/h0,1/h1,1/h2", rank=0, world_size=4
+    )
+    with knobs.override_fanout("auto"):
+        assert fanout_enabled(topo)
+        with knobs.override_cache_dir(str(tmp_path / "cache")):
+            assert not fanout_enabled(topo)
+            # multi-host slices keep fanning out even with the cache
+            topo2 = Topology.from_spec(
+                "0/h0,0/h1,1/h2,1/h2", rank=0, world_size=4
+            )
+            assert fanout_enabled(topo2)
+
+
+def test_kv_blob_roundtrip_and_digest_check():
+    coord = LocalCoordinator()
+    payload = np.arange(100_000, dtype=np.uint8).tobytes()
+    n = coord.kv_publish_blob("b0", payload, part_bytes=1 << 14)
+    assert n == len(payload)
+    assert coord.kv_try_fetch_blob("b0") == payload
+    assert coord.kv_try_fetch_blob("never-published") is None
+    # corrupt one part: the fetch must refuse, not return garbage
+    part_key = "b0/p1"
+    coord._kv[part_key] = coord._kv[part_key][:-4] + "AAA="
+    with pytest.raises(ValueError, match="digest"):
+        coord.kv_try_fetch_blob("b0")
+
+
+def test_fanout_blobs_cleaned_up_after_restore(tmp_path):
+    """Restore must not permanently grow the coordination store: the
+    fan-out blob publications (meta + parts) are deleted once every
+    slice member is past its reads."""
+    snap = str(tmp_path / "s")
+    kv = str(tmp_path / "kv")
+    state = {
+        "m": StateDict(
+            **{f"l{i}": np.arange(512, dtype=np.float32) for i in range(3)}
+        )
+    }
+    with knobs.override_disable_batching(True):
+        Snapshot.take(snap, state, replicated=["**"])
+    errs = []
+
+    def worker(r):
+        try:
+            dest = {
+                "m": StateDict(
+                    **{f"l{i}": np.zeros(512, np.float32) for i in range(3)}
+                )
+            }
+            Snapshot(
+                snap, coordinator=FileCoordinator(kv, r, 2)
+            ).restore(dest)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    with knobs.override_topology("0,0"), knobs.override_disable_batching(
+        True
+    ):
+        threads = [
+            threading.Thread(target=worker, args=(r,)) for r in range(2)
+        ]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+    assert errs == []
+    leftover = [
+        name
+        for name in os.listdir(kv)
+        # FileCoordinator flattens '/' to %2F; blob keys carry /fan/
+        if "%2Ffan%2F" in name
+    ]
+    assert leftover == [], leftover
+
+
+# ==================================== multi-process acceptance tests
+
+
+def _launch_workers(tmp_path, body, env_per_rank, world, timeout_s=150):
+    script = os.path.join(str(tmp_path), "topo_worker.py")
+    with open(script, "w") as f:
+        f.write(
+            textwrap.dedent(
+                f"""
+                import json, os, sys, zlib
+                sys.path.insert(0, {_REPO!r})
+                import numpy as np
+                from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+                from torchsnapshot_tpu.coordination import FileCoordinator
+
+                rank = int(sys.argv[1])
+                world = int(sys.argv[2])
+                coord = FileCoordinator({os.path.join(str(tmp_path), "kv")!r}, rank, world)
+                snap_dir = {os.path.join(str(tmp_path), "snap")!r}
+
+                def emit(**extra):
+                    c = obs.metrics_snapshot()["counters"]
+                    topo_counters = {{
+                        k: v for k, v in c.items() if k.startswith("topology.")
+                    }}
+                    print("RESULT " + json.dumps(
+                        {{"rank": rank, "counters": topo_counters, **extra}}
+                    ))
+                """
+            )
+            + textwrap.dedent(body)
+        )
+    base_env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, str(r), str(world)],
+            env={**base_env, **env_per_rank[r]},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout_s)[0].decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise AssertionError(
+            "topology worker wedged past the wall-clock bound"
+        )
+    return [(p.returncode, out) for p, out in zip(procs, outs)]
+
+
+def _parse_result(out):
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(f"no RESULT line in worker output:\n{out}")
+
+
+_K_OBJECTS = 3
+
+
+def _fanout_state(n=4096):
+    return {
+        "m": StateDict(
+            **{
+                f"l{i}": np.arange(n, dtype=np.float32) * (i + 1)
+                for i in range(_K_OBJECTS)
+            }
+        )
+    }
+
+
+def test_multiprocess_fanout_restore_one_get_per_object_per_slice(tmp_path):
+    """THE read-side acceptance test: restore of K shared objects
+    across S=2 slices × R=2 ranks issues exactly K durable GETs per
+    slice, the other reads are served from the designated readers'
+    publications, and every rank's restored bytes are identical to a
+    flat (fan-out-less) restore."""
+    snap_dir = os.path.join(str(tmp_path), "snap")
+    with knobs.override_disable_batching(True):
+        Snapshot.take(snap_dir, _fanout_state(), replicated=["**"])
+    # flat-restore ground truth, computed in-process
+    flat_dest = {
+        "m": StateDict(
+            **{f"l{i}": np.zeros(4096, np.float32) for i in range(_K_OBJECTS)}
+        )
+    }
+    Snapshot(snap_dir).restore(flat_dest)
+    flat_crcs = {
+        f"l{i}": zlib.crc32(np.ascontiguousarray(flat_dest["m"][f"l{i}"]))
+        for i in range(_K_OBJECTS)
+    }
+
+    body = r"""
+    K = 3
+    dest = {"m": StateDict(**{
+        f"l{i}": np.zeros(4096, np.float32) for i in range(K)
+    })}
+    Snapshot(snap_dir, coordinator=coord).restore(dest)
+    crcs = {
+        f"l{i}": zlib.crc32(np.ascontiguousarray(dest["m"][f"l{i}"]))
+        for i in range(K)
+    }
+    emit(crcs=crcs)
+    """
+    env = {
+        "TORCHSNAPSHOT_TPU_TOPOLOGY": "0,0,1,1",
+        "TORCHSNAPSHOT_TPU_DISABLE_BATCHING": "1",
+    }
+    results = _launch_workers(tmp_path, body, [env] * 4, world=4)
+    slice_of = (0, 0, 1, 1)
+    per_slice_gets = {0: 0, 1: 0}
+    total_saved = 0
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        res = _parse_result(out)
+        c = res["counters"]
+        # bitwise-identical to the flat restore on every rank
+        assert {
+            k: int(v) for k, v in res["crcs"].items()
+        } == flat_crcs, f"rank {r} restored different bytes"
+        assert c.get("topology.fanout_fallbacks", 0) == 0, out
+        per_slice_gets[slice_of[r]] += c.get(
+            "topology.fanout_durable_reads", 0
+        )
+        total_saved += c.get("topology.durable_gets_saved", 0)
+    # O(objects) per slice, NOT O(objects × ranks)
+    assert per_slice_gets == {0: _K_OBJECTS, 1: _K_OBJECTS}
+    # every other (rank, object) read was served from a publication
+    assert total_saved == _K_OBJECTS * 2  # (R-1) ranks × K × S slices
+
+
+def test_multiprocess_replicated_write_once_per_fleet_spread(tmp_path):
+    """THE write-side acceptance test: each replicated object is
+    written by exactly one rank fleet-wide, with writers spread across
+    both slices; the committed snapshot round-trips."""
+    body = r"""
+    K = 3
+    state = {"m": StateDict(**{
+        f"l{i}": np.arange(4096, dtype=np.float32) * (i + 1)
+        for i in range(K)
+    })}
+    Snapshot.take(snap_dir, state, replicated=["**"], coordinator=coord)
+    emit()
+    """
+    env = {
+        "TORCHSNAPSHOT_TPU_TOPOLOGY": "0,0,1,1",
+        "TORCHSNAPSHOT_TPU_DISABLE_BATCHING": "1",
+    }
+    results = _launch_workers(tmp_path, body, [env] * 4, world=4)
+    slice_of = (0, 0, 1, 1)
+    written_total = 0
+    slices_writing = set()
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        c = _parse_result(out)["counters"]
+        n = c.get("topology.replicated_objects_written", 0)
+        written_total += n
+        if n:
+            slices_writing.add(slice_of[r])
+    # exactly one writer per replicated object, fleet-wide
+    assert written_total == _K_OBJECTS
+    # writers spread across >= 2 slices
+    assert len(slices_writing) >= 2
+    # and the snapshot is complete + correct
+    snap_dir = os.path.join(str(tmp_path), "snap")
+    dest = {
+        "m": StateDict(
+            **{f"l{i}": np.zeros(4096, np.float32) for i in range(_K_OBJECTS)}
+        )
+    }
+    Snapshot(snap_dir).restore(dest)
+    for i in range(_K_OBJECTS):
+        np.testing.assert_array_equal(
+            dest["m"][f"l{i}"], np.arange(4096, dtype=np.float32) * (i + 1)
+        )
+
+
+# ===================================== flight record / doctor rollup
+
+
+def test_flight_record_topology_rollup_and_doctor_rows(capsys):
+    from torchsnapshot_tpu.__main__ import _render_topology_rollup
+    from torchsnapshot_tpu.obs import aggregate
+
+    payloads = [
+        {
+            "rank": r,
+            "op": "restore",
+            "metrics": {
+                "counters": {
+                    "topology.fanout_durable_reads": 3 if r in (0, 2) else 0,
+                    "topology.durable_gets_saved": 0 if r in (0, 2) else 3,
+                }
+            },
+            "phases": {},
+            "backends": {},
+            "goodput": {},
+            "slow_objects": [],
+            "topology": {"slice": 0 if r < 2 else 1, "num_slices": 2},
+        }
+        for r in range(4)
+    ]
+    record = aggregate.merge_payloads(
+        payloads, op="restore", path="p", world_size=4
+    )
+    topo = record["topology"]
+    assert topo["num_slices"] == 2
+    assert topo["slices"]["0"]["ranks"] == [0, 1]
+    assert topo["slices"]["0"]["durable_reads"] == 3
+    assert topo["slices"]["1"]["durable_gets_saved"] == 3
+    _render_topology_rollup(topo)
+    out = capsys.readouterr().out
+    assert "2 slice(s)" in out and "slice 0" in out and "saved" in out
+
+
+def test_flight_record_without_topology_has_no_rollup():
+    from torchsnapshot_tpu.obs import aggregate
+
+    record = aggregate.merge_payloads(
+        [
+            {
+                "rank": 0, "op": "take", "metrics": {}, "phases": {},
+                "backends": {}, "goodput": {}, "slow_objects": [],
+            }
+        ],
+        op="take", path="p", world_size=1,
+    )
+    assert "topology" not in record
+
+
+def test_single_process_take_restore_unaffected(tmp_path):
+    """Default knobs, no placement info: topology detection runs flat
+    and neither take nor restore behavior changes (the zero-config
+    regression guard)."""
+    path = str(tmp_path / "s")
+    state = {"app": StateDict(w=np.arange(256, dtype=np.float32), step=7)}
+    Snapshot.take(path, state, replicated=["**"])
+    dest = {"app": StateDict(w=np.zeros(256, np.float32), step=-1)}
+    Snapshot(path).restore(dest)
+    np.testing.assert_array_equal(
+        dest["app"]["w"], np.arange(256, dtype=np.float32)
+    )
+    assert dest["app"]["step"] == 7

@@ -113,6 +113,15 @@ MODULE_FUNCTIONS: Dict[str, Set[str]] = {
     "torchsnapshot_tpu/cas/gc.py": {
         "commit_refs", "release_step", "run_gc",
     },
+    # multislice topology (topology/): detection performs the one
+    # per-operation placement exchange over the coordination KV, and
+    # the fan-out publish/fetch pair is the read-once-per-slice
+    # transport — a stall in any of them blocks a whole slice's
+    # restore, so all three must be attributable in traces
+    "torchsnapshot_tpu/topology/model.py": {"detect_topology"},
+    "torchsnapshot_tpu/topology/fanout.py": {
+        "publish_object", "fetch_published",
+    },
 }
 
 _BRACKET_NAMES = {"log_event", "span"}

@@ -267,6 +267,21 @@ def _render_cas_stats(rollup: dict) -> None:
         )
 
 
+def _topology_stats_rollup(path: str) -> dict:
+    """Topology rollup rows for ``stats``, sourced from the snapshot's
+    persisted flight record (the manifest itself is placement-agnostic
+    by design — one writer per replicated object, whoever it was).
+    ``{}`` when no record exists or it predates topology rollups."""
+    from .obs import aggregate
+
+    try:
+        return aggregate.read_obsrecord(path).get("topology") or {}
+    except (FileNotFoundError, RuntimeError):
+        # no record (pre-obsrecord snapshot / failed best-effort write)
+        # or a corrupt one — stats still stands on the manifest alone
+        return {}
+
+
 def _cmd_stats(args) -> int:
     """Per-entry size/dtype/chunk rollups from the manifest (the
     operator's "where did my bytes go" view; machine-readable with
@@ -312,6 +327,7 @@ def _cmd_stats(args) -> int:
         "codec": _codec_rollup(metadata),
         "cas": _cas_stats_rollup(snap),
         "cache": _cache_stats_rollup(),
+        "topology": _topology_stats_rollup(args.path),
     }
     if args.json:
         print(json.dumps(stats, indent=2))
@@ -350,6 +366,7 @@ def _cmd_stats(args) -> int:
             )
     _render_cas_stats(stats["cas"])
     _render_cache_stats(stats["cache"])
+    _render_topology_rollup(stats["topology"])
     print(f"  largest {len(largest)}:")
     width = max((len(p) for p, _ in largest), default=10)
     for p, st in largest:
@@ -419,6 +436,12 @@ def _doctor_counters(record) -> dict:
             "storage.cache.singleflight_waits", 0
         ),
         "mmap_reads": c.get("storage.mmap.reads", 0),
+        "fanout_durable_reads": c.get("topology.fanout_durable_reads", 0),
+        "fanout_gets_saved": c.get("topology.durable_gets_saved", 0),
+        "fanout_bytes_redistributed": c.get(
+            "topology.fanout_bytes_redistributed", 0
+        ),
+        "fanout_fallbacks": c.get("topology.fanout_fallbacks", 0),
         "codec_bytes_in": codec_in,
         "codec_bytes_out": codec_out,
         "codec_ratio": (
@@ -426,6 +449,48 @@ def _doctor_counters(record) -> dict:
         ),
         "exceptions_swallowed": c.get("exceptions.swallowed", 0),
     }
+
+
+def _render_topology_rollup(topo, counters=None) -> None:
+    """Multislice rows from a flight record's topology rollup: slices,
+    ranks per slice, write egress per slice, fan-out savings.  Silent
+    for flat single-slice records with no topology activity."""
+    c = counters or {}
+    if not topo:
+        return
+    rows = (topo.get("slices") or {}).items()
+    active = topo.get("num_slices", 1) > 1 or any(
+        st.get("replicated_objects_written")
+        or st.get("durable_gets_saved")
+        or st.get("fanout_fallbacks")
+        for _s, st in rows
+    )
+    if not active:
+        return
+    print(f"  topology: {topo.get('num_slices', 1)} slice(s)")
+    for s, st in rows:
+        parts = [f"ranks {st.get('ranks', [])}"]
+        if st.get("replicated_objects_written"):
+            parts.append(
+                f"{st['replicated_objects_written']} replicated objects "
+                f"written ({_human(st.get('replicated_bytes_written', 0))})"
+            )
+        if st.get("durable_reads") or st.get("durable_gets_saved"):
+            parts.append(
+                f"{st.get('durable_reads', 0)} durable GETs, "
+                f"{st.get('durable_gets_saved', 0)} saved "
+                f"({_human(st.get('bytes_redistributed', 0))} "
+                f"redistributed)"
+            )
+        if st.get("fanout_fallbacks"):
+            parts.append(f"{st['fanout_fallbacks']} fan-out fallbacks")
+        print(f"    slice {s}: " + ", ".join(parts))
+    if c.get("fanout_fallbacks"):
+        print(
+            "    note: fallbacks mean siblings re-read directly (dead/"
+            "slow designated reader or digest mismatch) — degraded, "
+            "not wedged"
+        )
 
 
 def _render_doctor(record) -> None:
@@ -514,6 +579,7 @@ def _render_doctor(record) -> None:
         )
     if c["mmap_reads"]:
         print(f"  mmap: {c['mmap_reads']} zero-copy reads")
+    _render_topology_rollup(record.get("topology"), c)
     slow = record.get("slow_objects") or []
     if slow:
         print("  slowest objects:")

@@ -260,6 +260,74 @@ class Coordinator(abc.ABC):
             for r in range(self.world_size)
         ]
 
+    def kv_try_delete(self, key: str) -> None:
+        """Best-effort KV key deletion (cleanup of transient
+        publications — fan-out blobs).  Base implementation is a no-op:
+        a backend without deletion merely retains the key until
+        teardown, never fails the caller."""
+
+    def kv_publish_blob(
+        self, prefix: str, data: Any, part_bytes: int = 4 * 1024 * 1024
+    ) -> int:
+        """Publish one binary blob under EXPLICIT keys for asymmetric
+        one-to-many redistribution (the fan-out restore's transport,
+        topology/fanout.py).  The blob is split into ``part_bytes``
+        chunks (``{prefix}/p{i}``, base64) with a ``{prefix}/meta`` key
+        written LAST carrying ``nparts:total:crc32`` — meta presence
+        therefore implies every part is present, and the crc32 lets the
+        fetch side verify the reassembled bytes before trusting them.
+        No barrier, no uid counters: safe from any thread, legal under
+        rank-conditional branches (only the publisher calls this).
+        ``prefix`` must be unique per blob across the job.  Returns the
+        blob's byte length."""
+        import zlib
+
+        view = memoryview(data).cast("B")
+        part = max(1, int(part_bytes))
+        n = view.nbytes
+        nparts = (n + part - 1) // part
+        for i in range(nparts):
+            chunk = view[i * part : min((i + 1) * part, n)]
+            self.kv_set(
+                f"{prefix}/p{i}", b64encode(chunk).decode("ascii")
+            )
+        self.kv_set(f"{prefix}/meta", f"{nparts}:{n}:{zlib.crc32(view)}")
+        return n
+
+    def kv_try_fetch_blob(
+        self, prefix: str, timeout_s: float = _DEFAULT_TIMEOUT_S
+    ) -> Optional[bytes]:
+        """Non-blocking probe + fetch of a blob published by
+        ``kv_publish_blob``: None when ``{prefix}/meta`` is not (yet)
+        present; otherwise the reassembled, crc-verified bytes.  The
+        meta-last publication order makes the part gets below
+        effectively immediate once meta exists.  Raises ``ValueError``
+        on a digest/length mismatch — the caller decides whether to
+        retry or fall back."""
+        import zlib
+
+        raw = self.kv_try_get(f"{prefix}/meta")
+        if raw is None:
+            return None
+        try:
+            nparts_s, total_s, crc_s = raw.split(":")
+            nparts, total, crc = int(nparts_s), int(total_s), int(crc_s)
+        except ValueError as e:
+            raise ValueError(
+                f"malformed blob meta under {prefix!r}: {raw!r}"
+            ) from e
+        buf = bytearray()
+        for i in range(nparts):
+            buf += b64decode(
+                self.kv_get(f"{prefix}/p{i}", timeout_s).encode("ascii")
+            )
+        if len(buf) != total or zlib.crc32(bytes(buf)) != crc:
+            raise ValueError(
+                f"blob under {prefix!r} failed digest verification "
+                f"({len(buf)} of {total} bytes)"
+            )
+        return bytes(buf)
+
     def all_gather_object(self, obj: Any) -> List[Any]:
         """Gather an object from every rank (reference
         pg_wrapper.py all_gather_object)."""
@@ -308,6 +376,9 @@ class LocalCoordinator(Coordinator):
 
     def kv_try_get(self, key: str) -> Optional[str]:
         return self._kv.get(key)
+
+    def kv_try_delete(self, key: str) -> None:
+        self._kv.pop(key, None)
 
     def _barrier_impl(self, name: str, timeout_s: float) -> None:
         pass
@@ -361,6 +432,12 @@ class JaxCoordinator(Coordinator):
         except Exception:
             return None
 
+    def kv_try_delete(self, key: str) -> None:
+        try:
+            self._client.key_value_delete(self._k(key))
+        except Exception as e:  # noqa: BLE001 — cleanup is best-effort
+            obs.swallowed_exception("coordination.kv_try_delete", e)
+
     def _barrier_impl(self, name: str, timeout_s: float) -> None:
         self._client.wait_at_barrier(self._k(name), int(timeout_s * 1000))
 
@@ -411,6 +488,12 @@ class FileCoordinator(Coordinator):
                 return f.read()
         except FileNotFoundError:
             return None
+
+    def kv_try_delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass  # already gone / never set: best-effort by contract
 
     def _barrier_impl(self, name: str, timeout_s: float) -> None:
         # two-phase: everyone arrives, rank 0 releases

@@ -61,6 +61,12 @@ _TIER_VERIFY_FAST_READS = "TIER_VERIFY_FAST_READS"
 _MMAP = "MMAP"
 _CACHE_DIR = "CACHE_DIR"
 _CACHE_MAX_BYTES = "CACHE_MAX_BYTES"
+_TOPOLOGY = "TOPOLOGY"
+_TOPOLOGY_SLICE_ID = "TOPOLOGY_SLICE_ID"
+_TOPOLOGY_HOST_ID = "TOPOLOGY_HOST_ID"
+_FANOUT = "FANOUT"
+_FANOUT_PART_BYTES = "FANOUT_PART_BYTES"
+_FANOUT_TIMEOUT_S = "FANOUT_TIMEOUT_S"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -293,6 +299,39 @@ _DEFAULTS = {
     # never truncate, so live mmaps of evicted objects stay valid).
     # 0 = unbounded.
     _CACHE_MAX_BYTES: 0,
+    # Multislice topology model (topology/): "auto" detects rank → host
+    # → slice placement from per-process hints (TOPOLOGY_SLICE_ID /
+    # TOPOLOGY_HOST_ID knobs, jax device slice_index on real multislice
+    # pods, hostname) exchanged once per operation over the
+    # coordination KV; "flat" disables topology awareness entirely; an
+    # explicit comma-separated per-rank slice list ("0,0,1,1",
+    # identical on every process) pins the mapping for tests and
+    # orchestrators that know their placement.
+    _TOPOLOGY: "auto",
+    # Per-PROCESS slice id hint for auto detection (each process sets
+    # its own; exchanged to build the global rank → slice map).
+    # Empty/unset = probe jax, else single-slice.
+    _TOPOLOGY_SLICE_ID: "",
+    # Per-PROCESS host identity hint for auto detection; empty = the
+    # machine hostname.  Ranks reporting the same host id are treated
+    # as co-located (shared NIC/cache) by the write partitioner and the
+    # fan-out reader election.
+    _TOPOLOGY_HOST_ID: "",
+    # Fan-out restore (topology/fanout.py): per-slice designated reader
+    # ranks pull each replicated object from the durable tier exactly
+    # once and redistribute the bytes to sibling ranks over the
+    # coordination KV (chunked, digest-verified).  "auto" = on when the
+    # detected topology is explicit and this rank's slice has >1 rank
+    # (and not already covered by a same-host shared cache); "1"/"0"
+    # force.
+    _FANOUT: "auto",
+    # Chunk size for the fan-out KV redistribution (bytes per KV value
+    # before base64 expansion).
+    _FANOUT_PART_BYTES: 4 * 1024 * 1024,
+    # How long a sibling rank waits for its designated reader's
+    # publication before falling back to a direct durable read — a dead
+    # reader degrades the slice to direct GETs, never wedges it.
+    _FANOUT_TIMEOUT_S: 60.0,
 }
 
 _OVERRIDES: dict = {}
@@ -600,6 +639,51 @@ def get_cache_max_bytes() -> Optional[int]:
     return v if v > 0 else None
 
 
+def get_topology() -> str:
+    """Topology mode: "auto", "flat", or an explicit comma-separated
+    per-rank slice list ("0,0,1,1")."""
+    return str(_get_raw(_TOPOLOGY)).strip().lower() or "auto"
+
+
+def get_topology_slice_id() -> Optional[int]:
+    """This PROCESS's slice id hint for auto detection, or None when
+    unset (probe jax / fall back to a single slice)."""
+    v = str(_get_raw(_TOPOLOGY_SLICE_ID) or "").strip()
+    return int(v) if v else None
+
+
+def get_topology_host_id() -> Optional[str]:
+    """This PROCESS's host identity hint, or None (use the hostname)."""
+    v = str(_get_raw(_TOPOLOGY_HOST_ID) or "").strip()
+    return v or None
+
+
+def get_fanout() -> str:
+    """Fan-out restore mode: "on" | "off" | "auto" (see _FANOUT above).
+    Unrecognized values degrade to "auto" with a warning — fan-out is a
+    bandwidth optimization resolved mid-restore, never worth aborting
+    a restore over a typo'd env var."""
+    v = str(_get_raw(_FANOUT)).strip().lower()
+    if v in ("1", "true", "on"):
+        return "on"
+    if v in ("0", "false", "off"):
+        return "off"
+    if v != "auto":
+        _logger.warning(
+            "TORCHSNAPSHOT_TPU_FANOUT=%r is not auto/on/off; treating "
+            "as auto", v,
+        )
+    return "auto"
+
+
+def get_fanout_part_bytes() -> int:
+    return max(4096, _get_int(_FANOUT_PART_BYTES))
+
+
+def get_fanout_timeout_s() -> float:
+    return max(0.0, float(_get_raw(_FANOUT_TIMEOUT_S)))
+
+
 def restore_donation() -> str:
     """One of "on" | "off" | "auto" (see _RESTORE_DONATE above).
 
@@ -795,6 +879,32 @@ def override_cache_dir(value):
 
 def override_cache_max_bytes(value: int):
     return _override(_CACHE_MAX_BYTES, value)
+
+
+def override_topology(value):
+    return _override(_TOPOLOGY, value or "auto")
+
+
+def override_topology_slice_id(value):
+    return _override(
+        _TOPOLOGY_SLICE_ID, "" if value is None else str(value)
+    )
+
+
+def override_topology_host_id(value):
+    return _override(_TOPOLOGY_HOST_ID, value or "")
+
+
+def override_fanout(value):
+    return _override(_FANOUT, value)
+
+
+def override_fanout_part_bytes(value: int):
+    return _override(_FANOUT_PART_BYTES, value)
+
+
+def override_fanout_timeout_s(value: float):
+    return _override(_FANOUT_TIMEOUT_S, value)
 
 
 def override_failpoint_seed(value: int):

@@ -91,6 +91,14 @@ from .metrics import (  # noqa: F401
     TIER_FAST_MISSES,
     TIER_FAST_REPAIRS,
     TIER_PEER_HITS,
+    TOPOLOGY_SLICES,
+    TOPOLOGY_REPLICATED_OBJECTS_WRITTEN,
+    TOPOLOGY_REPLICATED_BYTES_WRITTEN,
+    FANOUT_DURABLE_READS,
+    FANOUT_DURABLE_GETS_SAVED,
+    FANOUT_BYTES_REDISTRIBUTED,
+    FANOUT_PUBLISHES,
+    FANOUT_FALLBACKS,
     MetricsRegistry,
     counter,
     gauge,

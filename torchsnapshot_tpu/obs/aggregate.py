@@ -180,6 +180,63 @@ def _slow_objects_from_tracer() -> List[Dict[str, Any]]:
     ]
 
 
+def _topology_stamp() -> Optional[Dict[str, Any]]:
+    """This process's last-detected topology placement, or None (never
+    raises — the stamp is flight-record garnish)."""
+    try:
+        from ..topology import current_topology_info
+
+        return current_topology_info()
+    except Exception as e:  # noqa: BLE001 — telemetry never fails the op
+        from .. import obs
+
+        obs.swallowed_exception("obs.aggregate.topology_stamp", e)
+        return None
+
+
+# counter name → per-slice rollup field for the topology record rows
+_TOPOLOGY_SLICE_COUNTERS = (
+    ("topology.replicated_objects_written", "replicated_objects_written"),
+    ("topology.replicated_bytes_written", "replicated_bytes_written"),
+    ("topology.fanout_durable_reads", "durable_reads"),
+    ("topology.durable_gets_saved", "durable_gets_saved"),
+    ("topology.fanout_bytes_redistributed", "bytes_redistributed"),
+    ("topology.fanout_fallbacks", "fanout_fallbacks"),
+)
+
+
+def _topology_rollup(
+    payloads: Sequence[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """Per-slice rows (ranks, write egress, fan-out savings) from the
+    payloads' topology stamps + counter deltas; None when no rank
+    reported a placement."""
+    stamped = [
+        p for p in payloads if isinstance(p.get("topology"), dict)
+    ]
+    if not stamped:
+        return None
+    slices: Dict[str, Dict[str, Any]] = {}
+    for p in stamped:
+        s = str(p["topology"].get("slice", 0))
+        row = slices.setdefault(
+            s,
+            {"ranks": [], **{field: 0 for _, field in _TOPOLOGY_SLICE_COUNTERS}},
+        )
+        row["ranks"].append(int(p["rank"]))
+        counters = (p.get("metrics") or {}).get("counters") or {}
+        for name, field in _TOPOLOGY_SLICE_COUNTERS:
+            row[field] += int(counters.get(name, 0))
+    for row in slices.values():
+        row["ranks"].sort()
+    return {
+        "num_slices": max(
+            int(p["topology"].get("num_slices", 1)) for p in stamped
+        ),
+        "slices": dict(sorted(slices.items(), key=lambda kv: int(kv[0]))),
+    }
+
+
 def rank_payload(
     rank: int, op: str, before: Dict[str, Any]
 ) -> Dict[str, Any]:
@@ -190,7 +247,7 @@ def rank_payload(
     failed rollup degrades to a minimal payload noting the error."""
     try:
         m = delta(before, metrics_snapshot())
-        return {
+        out = {
             "rank": rank,
             "op": op,
             "metrics": m,
@@ -199,6 +256,13 @@ def rank_payload(
             "goodput": goodput_mod.block(),
             "slow_objects": _slow_objects_from_tracer(),
         }
+        # topology stamp (topology/): the rank's slice/host placement
+        # lets rank 0 roll per-slice write-egress and fan-out-savings
+        # rows without a second exchange
+        tinfo = _topology_stamp()
+        if tinfo is not None:
+            out["topology"] = tinfo
+        return out
     except Exception as e:  # noqa: BLE001 — telemetry never fails the op
         from .. import obs
 
@@ -328,7 +392,7 @@ def merge_payloads(
         ]
         # the fleet unblocks when the SLOWEST rank does
         merged_goodput[key] = round(max(vals), 6) if vals else None
-    return {
+    record = {
         "record": "tsnp-obsrecord",
         "version": RECORD_VERSION,
         "op": op,
@@ -348,6 +412,10 @@ def merge_payloads(
         "goodput": merged_goodput,
         "slow_objects": slow,
     }
+    topology = _topology_rollup(payloads)
+    if topology is not None:
+        record["topology"] = topology
+    return record
 
 
 # ------------------------------------------------------ KV publication

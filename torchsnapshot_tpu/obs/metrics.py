@@ -147,6 +147,26 @@ CAS_BYTES_SHARED = "cas.bytes_shared"
 CAS_CHUNKS_SWEPT = "cas.chunks_swept"
 CAS_BYTES_SWEPT = "cas.bytes_swept"
 CAS_FSCKS = "cas.fscks"
+# Multislice topology (topology/): write-side replicated objects/bytes
+# this rank wrote under the topology-aware partition (explicit
+# topologies only; a chunk-split object counts once per rank carrying
+# any of its chunks — per-slice rollups come from grouping ranks by
+# their flight-record slice id), and the
+# fan-out restore's ledger — inner durable-tier GETs issued for shared
+# (replicated) objects by this rank (designated reads + fallbacks; the
+# per-slice sum is the bounded quantity: O(objects), not
+# O(objects × ranks)), reads served from a sibling's publication
+# instead of the durable tier, bytes redistributed over the
+# coordination KV, publications performed, and timeouts/digest
+# mismatches that degraded a read to a direct durable GET.
+TOPOLOGY_SLICES = "topology.slices"
+TOPOLOGY_REPLICATED_OBJECTS_WRITTEN = "topology.replicated_objects_written"
+TOPOLOGY_REPLICATED_BYTES_WRITTEN = "topology.replicated_bytes_written"
+FANOUT_DURABLE_READS = "topology.fanout_durable_reads"
+FANOUT_DURABLE_GETS_SAVED = "topology.durable_gets_saved"
+FANOUT_BYTES_REDISTRIBUTED = "topology.fanout_bytes_redistributed"
+FANOUT_PUBLISHES = "topology.fanout_publishes"
+FANOUT_FALLBACKS = "topology.fanout_fallbacks"
 # Resilience (resilience/): transient-error retries (total, plus
 # per-backend twins named resilience.<backend>.retries), cross-rank
 # aborts initiated via the poison protocol, deterministic failpoint

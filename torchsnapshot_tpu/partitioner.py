@@ -1,4 +1,4 @@
-"""Replicated-write load balancing across ranks.
+"""Replicated-write load balancing across ranks, slices and hosts.
 
 Reference: torchsnapshot/partitioner.py:67-213.  The reference all_gathers
 entry metadata, has rank 0 compute a greedy partition, and broadcasts the
@@ -9,23 +9,63 @@ communication needed is one small all_gather of per-rank pre-load bytes
 (non-replicated write volume), matching the reference's pre-load counting
 (partitioner.py:266-270).
 
+Topology awareness (topology/): with a ``Topology`` descriptor (itself
+identical on every process — detect_topology exchanges hints once per
+operation), the greedy choice balances hierarchically: least-loaded
+SLICE first (per-slice durable egress rides the slice's DCN uplink —
+the scarce resource at multislice scale), least-loaded HOST within it
+(per-NIC egress), then least-loaded rank, ties by rank for
+determinism.  Each replicated object is still written exactly once per
+FLEET; the hierarchy only decides by whom.  Without a topology (or
+with a non-explicit one) the flat greedy is byte-identical to the
+pre-topology behavior.
+
 Note: sharded jax.Arrays (including fully-replicated ones) never reach this
 partitioner — their dedup+balance happens in the sharded preparer from the
 globally-known sharding metadata with zero communication
-(preparers/sharded.py).  This module only balances *host-side* replicated
+(preparers/sharded.py, whose ``assign_box_writers`` applies the same
+hierarchical tie-break).  This module only balances *host-side* replicated
 state: numpy arrays, objects, chunked host arrays declared replicated via
 glob patterns.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _topology_chooser(topology, loads: List[int]):
+    """A candidate-rank chooser balancing (slice, host, rank) loads.
+    Slice/host loads are maintained incrementally from the SAME load
+    vector greedy updates mutate, so the hierarchy composes with
+    preloads and with earlier assignments."""
+    slice_loads = [0] * topology.num_slices
+    host_loads = [0] * topology.num_hosts
+    for r, load in enumerate(loads):
+        slice_loads[topology.slice_of[r]] += load
+        host_loads[topology.host_of[r]] += load
+
+    def key(r: int):
+        return (
+            slice_loads[topology.slice_of[r]],
+            host_loads[topology.host_of[r]],
+            loads[r],
+            r,
+        )
+
+    def charge(r: int, nbytes: int) -> None:
+        loads[r] += nbytes
+        slice_loads[topology.slice_of[r]] += nbytes
+        host_loads[topology.host_of[r]] += nbytes
+
+    return key, charge
 
 
 def partition_replicated_writes(
     items: Sequence[Tuple[str, int]],
     world_size: int,
     preloads: Sequence[int] = (),
+    topology: Optional[object] = None,
 ) -> Dict[str, int]:
     """Assign each replicated logical path to exactly one writer rank.
 
@@ -33,13 +73,26 @@ def partition_replicated_writes(
     (replication is the caller's invariant).  ``preloads``: per-rank bytes
     already being written for non-replicated state.  Greedy: largest item
     first to the least-loaded rank; ties broken by rank for determinism.
+    ``topology``: an optional ``topology.Topology`` (identical on every
+    rank) switching the least-loaded choice to the hierarchical
+    slice → host → rank ordering described in the module docstring;
+    non-explicit topologies fall back to the flat choice.
     """
     loads: List[int] = list(preloads) if preloads else [0] * world_size
     if len(loads) != world_size:
         raise ValueError(f"preloads len {len(loads)} != world_size {world_size}")
     assignment: Dict[str, int] = {}
+    if topology is not None and getattr(topology, "explicit", False):
+        key, charge = _topology_chooser(topology, loads)
+    else:
+        def key(r: int):
+            return (loads[r], r)
+
+        def charge(r: int, nbytes: int) -> None:
+            loads[r] += nbytes
+
     for path, nbytes in sorted(items, key=lambda kv: (-kv[1], kv[0])):
-        writer = min(range(world_size), key=lambda r: (loads[r], r))
+        writer = min(range(world_size), key=key)
         assignment[path] = writer
-        loads[writer] += nbytes
+        charge(writer, nbytes)
     return assignment

@@ -65,6 +65,7 @@ def prepare_write(
     process_count: int = 1,
     writer_loads: Optional[List[int]] = None,
     chunk_size_bytes: Optional[int] = None,
+    topology: Optional[Any] = None,
 ) -> Tuple[Entry, List[WriteReq]]:
     """Plan the write of one leaf (reference io_preparer.py:82-147).
 
@@ -74,6 +75,10 @@ def prepare_write(
 
     ``writer_loads``: shared per-process load vector for the sharded-box
     balancer (see assign_box_writers); identical across controllers.
+
+    ``topology``: optional ``topology.Topology`` (identical across
+    controllers) so sharded-replica box writers spread across slices
+    and hosts, not just ranks.
     """
     if is_primitive_type(obj):
         return PrimitiveEntry.from_object(obj, replicated=replicated), []
@@ -85,6 +90,7 @@ def prepare_write(
             process_index=process_index,
             process_count=process_count,
             writer_loads=writer_loads,
+            topology=topology,
         )
 
     if is_array_like(obj):

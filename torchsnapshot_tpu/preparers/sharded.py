@@ -137,6 +137,7 @@ def assign_box_writers(
     itemsize: int,
     process_count: int,
     preloads: Optional[List[int]] = None,
+    topology: Optional[Any] = None,
 ) -> Dict[Box, int]:
     """Deterministic greedy balance: every process computes the identical
     assignment from the (global) sharding metadata. Largest box first, to
@@ -148,17 +149,35 @@ def assign_box_writers(
     (reference partitioner.py:266-270 counts non-replicated bytes as
     pre-load).  MUTATED IN PLACE so one vector composes across every
     sharded leaf of a take; callers must pass an identical vector on
-    every controller (it feeds a collective-free assignment)."""
+    every controller (it feeds a collective-free assignment).
+
+    ``topology``: optional ``topology.Topology`` (identical on every
+    controller) — a box whose replica group spans several slices elects
+    its writer by least-loaded slice → host → rank, so sharded-replica
+    writes spread across slices like replicated host state does
+    (partitioner.partition_replicated_writes).  The flat behavior is
+    unchanged when omitted or non-explicit."""
     loads = preloads if preloads is not None else [0] * max(1, process_count)
     assignment: Dict[Box, int] = {}
+    if topology is not None and getattr(topology, "explicit", False):
+        from ..partitioner import _topology_chooser
+
+        choose_key, charge = _topology_chooser(topology, loads)
+    else:
+        def choose_key(p: int):
+            return (loads[p], p)
+
+        def charge(p: int, nbytes: int) -> None:
+            loads[p] += nbytes
+
     ordered = sorted(
         boxes.keys(), key=lambda b: (-box_nelems(b), b[0])
     )
     for box in ordered:
         candidates = sorted({d.process_index for d in boxes[box]})
-        writer = min(candidates, key=lambda p: (loads[p], p))
+        writer = min(candidates, key=choose_key)
         assignment[box] = writer
-        loads[writer] += box_nelems(box) * itemsize
+        charge(writer, box_nelems(box) * itemsize)
     return assignment
 
 
@@ -170,12 +189,14 @@ class ShardedArrayIOPreparer:
         process_index: int,
         process_count: int,
         writer_loads: Optional[List[int]] = None,
+        topology: Optional[Any] = None,
     ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
         shape = tuple(int(s) for s in obj.shape)
         itemsize = np.dtype(obj.dtype).itemsize
         boxes = _unique_boxes(obj.sharding, shape)
         assignment = assign_box_writers(
-            boxes, itemsize, process_count, preloads=writer_loads
+            boxes, itemsize, process_count, preloads=writer_loads,
+            topology=topology,
         )
 
         # device -> local shard data for this process

@@ -1314,6 +1314,7 @@ def sync_execute_read_reqs(
     rank: int,
     codec_tables: Optional[dict] = None,
     cas_reads: Optional[tuple] = None,
+    publish_first: Optional[set] = None,
 ) -> None:
     """Execute read requests under the memory budget (reference
     sync_execute_read_reqs, scheduler.py:449-463).
@@ -1326,15 +1327,28 @@ def sync_execute_read_reqs(
     ``cas_reads``: ``(ChunkStore, {location → chunk table})`` for
     chunk-ref'd objects (SnapshotMetadata.cas); reads of those
     locations assemble from the shared chunk pool instead of the
-    snapshot's own storage — equally transparent."""
+    snapshot's own storage — equally transparent.
+
+    ``publish_first``: locations this rank redistributes to fan-out
+    siblings (topology/fanout.py) — within each priority class those
+    reads execute FIRST, so every sibling's wait for this rank's
+    publications is bounded by the designated reads' latency, not by
+    wherever they happened to land in the queue."""
     executor = ThreadPoolExecutor(
         max_workers=knobs.get_staging_threads(), thread_name_prefix="tsnp-consume"
     )
     # Restore prioritization (ReadReq.priority): stable sort, so a
     # server's first-requested layers head the admission queue and can
     # start serving before the full snapshot lands.  The common case
-    # (all priorities 0) keeps its original order untouched.
-    if any(rr.priority for rr in read_reqs):
+    # (all priorities 0, no fan-out) keeps its original order untouched.
+    if publish_first:
+        read_reqs = sorted(
+            read_reqs,
+            key=lambda rr: (
+                rr.priority, 0 if rr.path in publish_first else 1
+            ),
+        )
+    elif any(rr.priority for rr in read_reqs):
         read_reqs = sorted(read_reqs, key=lambda rr: rr.priority)
     pipelines = [_ReadPipeline(rr) for rr in read_reqs]
     budget = _Budget(memory_budget_bytes)

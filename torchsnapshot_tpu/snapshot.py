@@ -70,6 +70,7 @@ from .stateful import (
     unwrap,
 )
 from .storage import url_to_storage_plugin
+from . import topology as topology_mod
 
 logger = logging.getLogger(__name__)
 
@@ -965,6 +966,15 @@ class Snapshot:
             if world > 1
             else [host_est]
         )
+        # rank → host → slice placement (topology/): identical on every
+        # rank (explicit spec, or one kv_exchange of per-process hints
+        # under the commit uid), so the topology-aware writer elections
+        # below stay pure deterministic functions — replicated state is
+        # written once per FLEET with writers spread across slices and
+        # hosts to balance per-slice durable egress
+        topo = topology_mod.detect_topology(
+            coordinator, exchange_prefix=f"{commit_uid}/topo"
+        )
         # resolve the chunking knob ONCE for the whole take and pass it
         # down: one env resolution instead of one per leaf (measurable
         # in the blocked window at tens of thousands of leaves), a
@@ -988,6 +998,7 @@ class Snapshot:
                     process_count=world,
                     writer_loads=writer_loads,
                     chunk_size_bytes=chunk_size_bytes,
+                    topology=topo,
                 )
                 entries[lpath] = entry
                 cost = sum(
@@ -1018,10 +1029,30 @@ class Snapshot:
                 if world > 1
                 else [local_bytes]
             )
-            assignment = partition_replicated_writes(repl_items, world, preloads)
+            assignment = partition_replicated_writes(
+                repl_items, world, preloads, topology=topo
+            )
+            # per-slice egress attribution: each writer rank counts the
+            # replicated write units/bytes it carries; the flight
+            # record groups ranks by slice for the doctor rollup.
+            # Explicit topologies only — a flat job ran the flat
+            # greedy, and stamping topology.* counters on it would
+            # make doctor/stats render a topology section nobody
+            # configured.
+            cost_of = dict(repl_items)
+            count_writers = topo.explicit
+            m_repl_objs = obs.counter(
+                obs.TOPOLOGY_REPLICATED_OBJECTS_WRITTEN
+            )
+            m_repl_bytes = obs.counter(
+                obs.TOPOLOGY_REPLICATED_BYTES_WRITTEN
+            )
             for lpath, reqs in repl_reqs.items():
                 if assignment[lpath] == rank:
                     write_reqs.extend(reqs)
+                    if count_writers:
+                        m_repl_objs.inc()
+                        m_repl_bytes.inc(cost_of[lpath])
                 else:
                     # Only the writer keeps the entry: batching may re-point
                     # the writer's entry at a slab location, and the global
@@ -1029,12 +1060,21 @@ class Snapshot:
                     # (consolidation dedups replicated entries to one rank).
                     del entries[lpath]
             writes_chunk_of: Dict[str, bool] = {}
+            counted_chunk_parents: set = set()
             for k, req in repl_chunk_reqs.items():
                 lp = chunk_parent[k]
                 mine = assignment[k] == rank
                 writes_chunk_of[lp] = writes_chunk_of.get(lp, False) or mine
                 if mine:
                     write_reqs.append(req)
+                    if count_writers:
+                        # bytes per chunk, but the OBJECT counts once
+                        # per rank carrying any of its chunks — the
+                        # doctor row says "objects", not chunks
+                        m_repl_bytes.inc(cost_of[k])
+                        if lp not in counted_chunk_parents:
+                            counted_chunk_parents.add(lp)
+                            m_repl_objs.inc()
             for lp, any_mine in writes_chunk_of.items():
                 if any_mine:
                     # every chunk-writing rank carries an IDENTICAL copy
@@ -1413,6 +1453,28 @@ class Snapshot:
                     storage = _storage_for(self.path, self._storage_options)
                     self._prime_tier_digests(storage)
                     cas_reads = self._cas_reads()
+                    # fan-out restore (topology/fanout.py): per-slice
+                    # designated readers pull each replicated object
+                    # from the durable tier exactly once and
+                    # redistribute over the coordination KV — restore
+                    # cost O(objects) per slice, not O(objects × ranks).
+                    # The wrapper goes OUTSIDE any host cache, so the
+                    # one GET per slice is itself host-deduped; all
+                    # ranks must call restore with rank-agreed
+                    # paths/priority arguments (the same SPMD contract
+                    # every other restore collective already assumes).
+                    topo = topology_mod.detect_topology(
+                        coordinator, exchange_prefix=f"{abort_uid}/topo"
+                    )
+                    if topology_mod.fanout_enabled(topo):
+                        shared = topology_mod.shared_read_locations(
+                            metadata.manifest
+                        )
+                        if shared:
+                            storage = topology_mod.FanoutReadPlugin(
+                                storage, coordinator, topo,
+                                f"{abort_uid}/fan", shared,
+                            )
                     local_keys = sorted(app_state.keys())
                     if world > 1:
                         global_keys = sorted(
@@ -1436,6 +1498,14 @@ class Snapshot:
                             )
                         if world > 1:
                             coordinator.barrier()
+                    # fan-out blob cleanup: the per-key barriers above
+                    # prove every rank is past its reads, so the
+                    # transient KV publications can be reclaimed (a
+                    # restore must not permanently grow the
+                    # coordination service's store)
+                    cleanup = getattr(storage, "cleanup_published", None)
+                    if cleanup is not None:
+                        cleanup()
                     # restore flight record: cross-rank merge only (no
                     # persistence — the snapshot may live on read-only
                     # storage); rank 0 keeps the merged record
@@ -1553,6 +1623,9 @@ class Snapshot:
                 read_reqs, storage, budget, rank,
                 codec_tables=self._codec_tables(),
                 cas_reads=cas_reads,
+                # fan-out: front-load the reads THIS rank must publish
+                # for its slice siblings, so their waits are minimal
+                publish_first=getattr(storage, "local_publish_paths", None),
             )
             restored = {lpath: fut.obj for lpath, fut in futures.items()}
             state_dict = inflate(

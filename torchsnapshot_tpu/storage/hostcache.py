@@ -57,6 +57,15 @@ _LOCKS_SUBDIR = "locks"
 _LOCK_POLL_S = 0.025
 
 
+def host_cache_active() -> bool:
+    """Whether durable reads on this host route through the shared
+    cache (the CACHE_DIR knob is set).  The fan-out restore
+    (topology/fanout.py) consults this to compose rather than compete:
+    a single-host slice with the cache active already costs one durable
+    GET per object, so the KV redistribution hop is skipped there."""
+    return knobs.get_cache_dir() is not None
+
+
 def _cacheable(path: str) -> bool:
     # commit markers (.snapshot_metadata, .snapshot_obsrecord) are the
     # mutable absent→present reads; everything else in a snapshot is

@@ -1,0 +1,41 @@
+"""Hierarchical multislice topology: the rank → host → slice model,
+DCN-aware write partitioning hooks, and the fan-out restore.
+
+- ``model.py`` — the ``Topology`` descriptor and ``detect_topology``
+  (explicit spec / per-process hints / jax multislice probe, exchanged
+  once per operation over the coordination KV).
+- ``fanout.py`` — read-once-per-slice restore: designated per-slice
+  reader ranks pull each replicated object from the durable tier
+  exactly once and redistribute the bytes to siblings over the
+  coordination layer (chunked KV blobs, digest-verified, direct-read
+  fallback on reader death).
+
+The write-side half lives in ``partitioner.py`` /
+``preparers/sharded.py``, which accept a ``Topology`` to spread
+replicated and sharded-replica writers across slices and hosts.
+See docs/multislice.md.
+"""
+
+from .fanout import (  # noqa: F401
+    FanoutReadPlugin,
+    fanout_enabled,
+    fetch_published,
+    publish_object,
+    shared_read_locations,
+)
+from .model import (  # noqa: F401
+    Topology,
+    current_topology_info,
+    detect_topology,
+)
+
+__all__ = [
+    "Topology",
+    "detect_topology",
+    "current_topology_info",
+    "FanoutReadPlugin",
+    "fanout_enabled",
+    "shared_read_locations",
+    "publish_object",
+    "fetch_published",
+]

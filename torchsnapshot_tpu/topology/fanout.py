@@ -1,0 +1,302 @@
+"""Fan-out restore: read each replicated object once per SLICE, then
+redistribute the bytes to sibling ranks over the coordination layer.
+
+A flat restore has every rank GET every replicated object from the
+durable tier — O(objects × ranks) GETs, a self-inflicted DDoS on the
+bucket at multislice scale.  The shared-host cache
+(storage/hostcache.py) already collapses that to once per HOST for
+co-located processes; this module is the cross-host generalization:
+for each shared object a deterministic **designated reader** rank per
+slice (Topology.designated_reader — spread across the slice's hosts)
+performs the one durable GET and publishes the bytes over the
+coordination KV (``Coordinator.kv_publish_blob``: chunked, crc32
+digest-verified, meta-key-last so presence implies completeness);
+sibling ranks poll for the publication and consume it instead of
+issuing their own GET.
+
+Failure semantics — a dead reader degrades, never wedges: a sibling
+that sees no publication within ``FANOUT_TIMEOUT_S`` (or a digest
+mismatch, or any delivery error) falls back to a DIRECT durable read
+and counts a ``topology.fanout_fallbacks``.  Publication itself is
+best-effort: a publish failure costs peers their savings, not the
+restore.
+
+Composition: the wrapper goes OUTSIDE the shared-host cache, so the
+designated reader's one GET is itself host-deduped — per slice the
+durable tier sees exactly one GET per object, regardless of how many
+hosts or processes the slice spans.  A slice whose members all share
+one host with the cache active skips fan-out entirely (the cache
+already covers it; the KV hop would be pure overhead).
+
+Scope: only storage locations under ``replicated/`` that every rank
+reads (``shared_read_locations``) participate — per-rank and sharded
+objects have per-rank readers, and slab-batched objects live under a
+rank namespace; both take the direct path unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+from typing import Any, Dict, Iterable, Optional, Set
+
+from .. import knobs, obs
+from ..io_types import (
+    ReadIO,
+    StoragePlugin,
+    WriteIO,
+    resolve_read_destination,
+)
+from ..resilience.failpoints import failpoint
+from ..storage.hostcache import host_cache_active
+from .model import Topology
+
+logger = logging.getLogger(__name__)
+
+_SHARED_PREFIX = "replicated/"
+# how often a sibling re-probes the KV for its designated reader's
+# publication (one kv_try_get per tick)
+_FETCH_POLL_S = 0.025
+
+
+def fanout_enabled(topology: Topology) -> bool:
+    """Whether this rank's restore should fan out (see module
+    docstring).  "on" forces it whenever the slice has siblings; "auto"
+    additionally requires an explicit topology and skips slices already
+    covered by a same-host shared cache."""
+    mode = knobs.get_fanout()
+    if mode == "off":
+        return False
+    members = topology.ranks_in_slice(topology.slice_id)
+    if len(members) < 2:
+        return False
+    if mode == "on":
+        return True
+    if not topology.explicit:
+        return False
+    if host_cache_active() and len(
+        {topology.host_of[r] for r in members}
+    ) == 1:
+        # single-host slice with the shared cache active: the flock
+        # single-flight already makes the slice cost one GET per object
+        return False
+    return True
+
+
+def shared_read_locations(manifest: Dict[str, Any]) -> Set[str]:
+    """Storage locations every rank reads during a full restore: the
+    ``replicated/``-namespaced extents of replicated entries (whole
+    objects plus chunk pieces).  Slab-batched replicated leaves live
+    under a rank namespace and are deliberately excluded — their slab
+    mixes per-rank members whose ranges only one rank reads, and a
+    designated reader would never publish those."""
+    out: Set[str] = set()
+    for entry in manifest.values():
+        if not getattr(entry, "replicated", False):
+            continue
+        loc = getattr(entry, "location", None)
+        if isinstance(loc, str) and loc.startswith(_SHARED_PREFIX):
+            out.add(loc)
+        for attr in ("shards", "chunks"):
+            for piece in getattr(entry, attr, None) or ():
+                ploc = getattr(piece, "location", None)
+                if isinstance(ploc, str) and ploc.startswith(_SHARED_PREFIX):
+                    out.add(ploc)
+    return out
+
+
+def _blob_prefix(uid: str, slice_id: int, path: str, byte_range: Any) -> str:
+    """KV prefix for one (object, byte range) publication — hashed so
+    arbitrary object paths never collide with the KV key grammar; the
+    byte range is part of the identity because striped/codec reads of
+    one object fan out as multiple ranged reads (identically planned on
+    every rank)."""
+    h = hashlib.sha256()
+    h.update(path.encode())
+    if byte_range is not None:
+        h.update(f"|{byte_range[0]}-{byte_range[1]}".encode())
+    return f"{uid}/s{slice_id}/{h.hexdigest()[:32]}"
+
+
+async def publish_object(
+    coordinator: Any, prefix: str, buf: Any, path: str
+) -> int:
+    """Best-effort publication of one read's bytes for this slice's
+    siblings; returns the number of KV parts written (0 on failure —
+    the caller's cleanup ledger).  Never raises: the designated
+    reader's own restore must not fail because a publication could not
+    be made — peers fall back to direct reads and the failure stays
+    visible as their ``fanout_fallbacks``."""
+    with obs.span("fanout/publish", path=path):
+        try:
+            failpoint("topology.fanout.publish", path=path)
+            part = knobs.get_fanout_part_bytes()
+            loop = asyncio.get_running_loop()
+            n = await loop.run_in_executor(
+                None, coordinator.kv_publish_blob, prefix, buf, part
+            )
+            obs.counter(obs.FANOUT_PUBLISHES).inc()
+            obs.counter(obs.FANOUT_BYTES_REDISTRIBUTED).inc(n)
+            return max(1, (n + part - 1) // part)
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            obs.swallowed_exception("topology.fanout.publish", e)
+            return 0
+
+
+async def fetch_published(
+    coordinator: Any, prefix: str, path: str, timeout_s: float
+) -> Optional[bytes]:
+    """Poll for the designated reader's publication of ``path``; the
+    verified bytes, or None when the deadline passes or verification
+    fails (the caller falls back to a direct durable read).  Polling
+    runs from the event loop (one non-blocking probe per tick) so a
+    host full of waiting siblings never parks scheduler threads."""
+    with obs.span("fanout/fetch", path=path):
+        loop = asyncio.get_running_loop()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                data = await loop.run_in_executor(
+                    None, coordinator.kv_try_fetch_blob, prefix
+                )
+            except ValueError as e:
+                # digest/length mismatch: the publication cannot be
+                # trusted — direct read, never corrupt bytes
+                logger.warning(
+                    "fan-out publication for %r failed verification "
+                    "(%s); falling back to a direct read", path, e,
+                )
+                return None
+            if data is not None:
+                return data
+            if time.monotonic() >= deadline:
+                return None
+            await asyncio.sleep(_FETCH_POLL_S)
+
+
+class FanoutReadPlugin(StoragePlugin):
+    """Per-restore storage wrapper implementing the read-once-per-slice
+    protocol over ``inner`` (see module docstring).  Reads of shared
+    locations route through the designated-reader election; everything
+    else (per-rank objects, markers, writes, deletes) passes straight
+    through."""
+
+    def __init__(
+        self,
+        inner: StoragePlugin,
+        coordinator: Any,
+        topology: Topology,
+        uid: str,
+        shared_paths: Iterable[str],
+    ) -> None:
+        self.inner = inner
+        self.coordinator = coordinator
+        self.topology = topology
+        self.uid = uid
+        self.shared_paths = set(shared_paths)
+        # capability delegation: non-shared reads (per-rank/sharded
+        # state — usually the bulk) keep the inner plugin's zero-copy
+        # mmap path and budget exemption.  Shared reads are still
+        # planned identically on every rank (same want_mmap branch);
+        # a sibling served from a publication hands back heap bytes,
+        # which the read scheduler's existing declined-mmap handling
+        # debits against the budget.
+        self.supports_mmap_read = bool(
+            getattr(inner, "supports_mmap_read", False)
+        )
+        self.mmap_budget_exempt = bool(
+            getattr(inner, "mmap_budget_exempt", False)
+        )
+        self.supports_striped_write = bool(
+            getattr(inner, "supports_striped_write", False)
+        )
+        self.supports_fused_digest = bool(
+            getattr(inner, "supports_fused_digest", False)
+        )
+        # (prefix, nparts) of this rank's successful publications, so
+        # cleanup_published can reclaim the transient KV blobs after
+        # every slice member is past its reads
+        self._published: list = []
+        # the shared locations THIS rank is the designated reader for:
+        # the scheduler front-loads these so siblings wait the minimum
+        # (scheduler.sync_execute_read_reqs publish_first ordering)
+        self.local_publish_paths = {
+            p
+            for p in self.shared_paths
+            if topology.designated_reader(p) == coordinator.rank
+        }
+        m = obs.REGISTRY
+        self._m_durable = m.counter(obs.FANOUT_DURABLE_READS)
+        self._m_saved = m.counter(obs.FANOUT_DURABLE_GETS_SAVED)
+        self._m_fallbacks = m.counter(obs.FANOUT_FALLBACKS)
+
+    async def read(self, read_io: ReadIO) -> None:
+        path = read_io.path
+        if path not in self.shared_paths:
+            await self.inner.read(read_io)
+            return
+        prefix = _blob_prefix(
+            self.uid, self.topology.slice_id, path, read_io.byte_range
+        )
+        if path in self.local_publish_paths:
+            await self.inner.read(read_io)
+            self._m_durable.inc()
+            nparts = await publish_object(
+                self.coordinator, prefix, read_io.buf, path
+            )
+            if nparts:
+                self._published.append((prefix, nparts))
+            return
+        data = await fetch_published(
+            self.coordinator, prefix, path, knobs.get_fanout_timeout_s()
+        )
+        if data is not None:
+            try:
+                out = resolve_read_destination(read_io.into, len(data))
+                memoryview(out).cast("B")[:] = data
+                read_io.buf = out
+                self._m_saved.inc()
+                return
+            except Exception as e:  # noqa: BLE001 — delivery mismatch:
+                # e.g. an ``into`` destination sized for a different
+                # extent; the direct read below is always correct
+                obs.swallowed_exception("topology.fanout.deliver", e)
+        self._m_fallbacks.inc()
+        self._m_durable.inc()
+        await self.inner.read(read_io)
+
+    def cleanup_published(self) -> None:
+        """Delete this rank's blob publications from the coordination
+        KV (meta key first, so a straggler's poll sees clean absence
+        and takes the normal timeout-fallback path).  Called by restore
+        strictly AFTER the last cross-rank barrier — every slice member
+        is past its reads by then, so nothing can still be consuming a
+        blob.  Best-effort: a failed delete leaks one restore's blobs
+        until job teardown, never fails the restore."""
+        for prefix, nparts in self._published:
+            try:
+                self.coordinator.kv_try_delete(f"{prefix}/meta")
+                for i in range(nparts):
+                    self.coordinator.kv_try_delete(f"{prefix}/p{i}")
+            except Exception as e:  # noqa: BLE001 — best-effort cleanup
+                obs.swallowed_exception("topology.fanout.cleanup", e)
+        self._published = []
+
+    # ------------------------------------------------- pass-throughs
+
+    async def write(self, write_io: WriteIO) -> None:
+        await self.inner.write(write_io)
+
+    async def delete(self, path: str) -> None:
+        await self.inner.delete(path)
+
+    async def stat(self, path: str) -> int:
+        return await self.inner.stat(path)
+
+    async def link_from(self, base_url: str, path: str) -> None:
+        await self.inner.link_from(base_url, path)
+
+    async def close(self) -> None:
+        await self.inner.close()

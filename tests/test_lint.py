@@ -1,14 +1,16 @@
 """Tier-1 wiring for the snaplint suite (tools/lint): the repo must be
-clean under all five passes (modulo the reviewed allowlist and the
+clean under all ten passes (modulo the reviewed allowlist and the
 baseline ratchet), each pass must actually detect its bug class (a
 checker that can't fail is no check), and the allowlist/baseline
 machinery must enforce its contracts (written justifications; finding
-counts only ratchet down)."""
+counts only ratchet down).  The CFG substrate the four flow-sensitive
+passes ride on has its own edge-exactness suite in test_lint_cfg.py."""
 
 import json
 import os
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -43,21 +45,44 @@ def _run(pass_id, src, filename="torchsnapshot_tpu/example.py"):
 
 
 def test_repo_is_clean():
-    """THE gate: zero unbaselined findings repo-wide.  New findings must
+    """THE gate: zero unbaselined findings repo-wide under ALL ten
+    passes — the four flow-sensitive ones included.  New findings must
     be fixed or allowlisted with a written justification — see
-    docs/static_analysis.md."""
+    docs/static_analysis.md.  Also the wall-time budget: the full-repo
+    run (CFG construction included) must stay under 10s, or the lint
+    stops being something every test run can afford."""
+    t0 = time.monotonic()
     result = run_repo(
         _REPO_ROOT,
         ALL_PASSES,
         allowlist=ALLOWLIST,
         baseline=load_baseline(DEFAULT_BASELINE),
     )
+    elapsed = time.monotonic() - t0
     assert result.files_scanned > 50  # the scan actually covered the repo
     assert [f.render() for f in result.unbaselined] == []
     # every allowlist entry still matches something (no stale entries)
     assert [
         f"{a.pass_id}:{a.file}:{a.context}" for a in result.unused_allows
     ] == []
+    assert elapsed < 10.0, f"full-repo lint took {elapsed:.1f}s (budget 10s)"
+
+
+def test_all_four_flow_sensitive_passes_registered():
+    """The CFG passes are wired into the one pass tuple the repo gate,
+    the CLI and the bench rollup all share — dropping one in a refactor
+    must fail here, not silently shrink coverage."""
+    ids = {p.pass_id for p in ALL_PASSES}
+    assert {
+        "async-blocking",
+        "resource-pairing",
+        "kv-hygiene",
+        "metric-registry",
+    } <= ids
+    assert len(ALL_PASSES) == 10
+    # and the bench.py "lint" rollup (repo_summary) reports the roster
+    s = repo_summary(_REPO_ROOT)
+    assert set(s["passes"]) == ids
 
 
 def test_cli_main_clean_and_json(capsys):
@@ -1329,3 +1354,791 @@ def test_instrumentation_flags_uncovered_goodput_entry_point():
         bracketed, {}, "torchsnapshot_tpu/obs/goodput.py",
         module_functions={"take_begin"},
     ) == []
+
+
+# ------------------------------------------------------- async-blocking
+
+
+def test_async_blocking_open_flagged():
+    findings = _run(
+        "async-blocking",
+        """
+        async def fill(path):
+            with open(path, "wb") as f:
+                f.write(b"x")
+        """,
+    )
+    assert len(findings) == 1
+    assert "open" in findings[0].message
+
+
+def test_async_blocking_time_sleep_and_from_import_flagged():
+    findings = _run(
+        "async-blocking",
+        """
+        import time
+        from time import sleep
+
+        async def a():
+            time.sleep(1)
+
+        async def b():
+            sleep(1)
+        """,
+    )
+    assert len(findings) == 2
+
+
+def test_async_blocking_asyncio_and_aiofiles_clean():
+    findings = _run(
+        "async-blocking",
+        """
+        import asyncio
+
+        async def f(path):
+            await asyncio.sleep(0.1)
+            async with aiofiles.open(path, "rb") as f:
+                return await f.read()
+        """,
+    )
+    assert findings == []
+
+
+def test_async_blocking_sync_kv_wait_flagged():
+    findings = _run(
+        "async-blocking",
+        """
+        async def wait_peers(coord, uid):
+            coord.kv_get(f"{uid}/depart")
+            coord.barrier()
+        """,
+    )
+    assert len(findings) == 2
+
+
+def test_async_blocking_executor_dispatch_clean():
+    # the callable is passed as a REFERENCE — structurally exempt, no
+    # suppression comment needed
+    findings = _run(
+        "async-blocking",
+        """
+        async def wait_peers(coord, uid, loop):
+            await loop.run_in_executor(None, coord.kv_get, f"{uid}/depart")
+            await asyncio.to_thread(coord.barrier)
+        """,
+    )
+    assert findings == []
+
+
+def test_async_blocking_result_and_thread_join_flagged():
+    findings = _run(
+        "async-blocking",
+        """
+        async def f(fut, thread):
+            x = fut.result()
+            thread.join(5.0)
+            return x
+        """,
+    )
+    assert len(findings) == 2
+
+
+def test_async_blocking_str_and_path_join_clean():
+    findings = _run(
+        "async-blocking",
+        """
+        async def f(parts, base, os):
+            a = ",".join(parts)
+            b = os.path.join(base, "x")
+            return a + b
+        """,
+    )
+    assert findings == []
+
+
+def test_async_blocking_flock_and_subprocess_flagged():
+    findings = _run(
+        "async-blocking",
+        """
+        import fcntl, subprocess
+
+        async def f(fd):
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            subprocess.check_output(["ls"])
+        """,
+    )
+    assert len(findings) == 2
+
+
+def test_async_blocking_indirect_helper_chain_flagged():
+    """A blocking call hidden one hop away in a module-local sync
+    helper is reachable from the event loop all the same — the call
+    graph (FileUnit.callers/local_defs) carries the check through."""
+    findings = _run(
+        "async-blocking",
+        """
+        import time
+
+        def backoff():
+            time.sleep(1)
+
+        def helper():
+            backoff()
+
+        async def drive():
+            helper()
+        """,
+    )
+    assert len(findings) == 1
+    assert "helper" in findings[0].message
+    assert findings[0].context == "drive"
+
+
+def test_async_blocking_nested_def_and_sync_fn_clean():
+    # a nested def's body runs when called (possibly on an executor);
+    # blocking calls in plain sync functions are their callers' concern
+    findings = _run(
+        "async-blocking",
+        """
+        async def f(loop, path):
+            def work():
+                with open(path) as fh:
+                    return fh.read()
+            return await loop.run_in_executor(None, work)
+
+        def sync_helper(path):
+            return open(path).read()
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------ resource-pairing
+
+
+def test_resource_pairing_gate_leak_flagged():
+    findings = _run(
+        "resource-pairing",
+        """
+        async def one(gate, span):
+            await gate.acquire(span)
+            piece = stage(span)
+            write(piece)
+            gate.release(span)
+        """,
+    )
+    assert len(findings) == 1
+    assert "byte-gate" in findings[0].message
+
+
+def test_resource_pairing_gate_finally_clean():
+    findings = _run(
+        "resource-pairing",
+        """
+        async def one(gate, span):
+            await gate.acquire(span)
+            try:
+                piece = stage(span)
+                write(piece)
+            finally:
+                gate.release(span)
+        """,
+    )
+    assert findings == []
+
+
+def test_resource_pairing_with_item_sanctioned():
+    findings = _run(
+        "resource-pairing",
+        """
+        async def one(window, span):
+            async with window.acquire(span):
+                write(stage(span))
+        """,
+    )
+    assert findings == []
+
+
+def test_resource_pairing_partial_release_still_needs_total():
+    # an early partial release on one branch does not discharge the
+    # obligation — only the finally does
+    findings = _run(
+        "resource-pairing",
+        """
+        async def one(gate, held):
+            await gate.acquire(held)
+            frame = encode()
+            early = held - len(frame)
+            if early:
+                gate.release(early)
+            write(frame)
+            gate.release(held)
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_resource_pairing_budget_debit_credit():
+    flagged = _run(
+        "resource-pairing",
+        """
+        def admit(budget, p):
+            budget.debit(p.cost)
+            launch(p)
+        """,
+    )
+    assert len(flagged) == 1 and "budget" in flagged[0].message
+    clean = _run(
+        "resource-pairing",
+        """
+        def admit(budget, p):
+            budget.debit(p.cost)
+            try:
+                launch(p)
+            except BaseException:
+                budget.credit(p.cost)
+                raise
+            budget.credit(p.cost)
+        """,
+    )
+    assert clean == []
+
+
+def test_resource_pairing_breaker_probe():
+    """The tier plugin's shape: allow() in the if-test claims the probe
+    slot on the TRUE branch only; every route out of it must record an
+    outcome (the false branch owes nothing)."""
+    clean = _run(
+        "resource-pairing",
+        """
+        async def read(self, io):
+            if self._breaker.allow():
+                try:
+                    await self._fast_read(io)
+                    self._breaker.record_success()
+                    return
+                except OSError:
+                    self._breaker.record_failure()
+                except BaseException:
+                    self._breaker.release_probe()
+                    raise
+            await self._fallback(io)
+        """,
+    )
+    assert clean == []
+    flagged = _run(
+        "resource-pairing",
+        """
+        async def read(self, io):
+            if self._breaker.allow():
+                await self._fast_read(io)
+                self._breaker.record_success()
+                return
+            await self._fallback(io)
+        """,
+    )
+    # _fast_read can raise past record_success: probe slot wedges
+    assert len(flagged) == 1 and "breaker" in flagged[0].message
+
+
+def test_resource_pairing_striped_handle():
+    flagged = _run(
+        "resource-pairing",
+        """
+        async def put(storage, path, view):
+            handle = await storage.begin_striped_write(path, len(view))
+            await handle.write_part(0, 0, view)
+            await handle.complete()
+        """,
+    )
+    assert len(flagged) == 1
+    assert "striped-handle" in flagged[0].message
+    clean = _run(
+        "resource-pairing",
+        """
+        async def put(storage, path, view):
+            handle = await storage.begin_striped_write(path, len(view))
+            try:
+                await handle.write_part(0, 0, view)
+            except BaseException:
+                await handle.abort()
+                raise
+            await handle.complete()
+        """,
+    )
+    assert clean == []
+
+
+def test_resource_pairing_handle_handoff_counts_as_release():
+    # handing the handle to a helper (the _abort_quiet shape) moves
+    # ownership; returning it does too
+    findings = _run(
+        "resource-pairing",
+        """
+        async def put(storage, path, view):
+            handle = await storage.begin_striped_write(path, len(view))
+            try:
+                await handle.write_part(0, 0, view)
+            except BaseException:
+                await shielded_abort(handle)
+                raise
+            await handle.complete()
+
+        async def open_only(storage, path, size):
+            handle = await storage.begin_striped_write(path, size)
+            return handle
+        """,
+    )
+    assert findings == []
+
+
+def test_resource_pairing_lock_receivers_left_to_lock_discipline():
+    findings = _run(
+        "resource-pairing",
+        """
+        def f(self):
+            self._lock.acquire()
+            work()
+        """,
+    )
+    assert findings == []  # lock-discipline owns this shape
+
+
+# ---------------------------------------------------------- kv-hygiene
+
+
+def test_kv_hygiene_literal_key_flagged():
+    findings = _run(
+        "kv-hygiene",
+        """
+        def commit(coord):
+            coord.kv_set("done", "1")
+        """,
+    )
+    assert len(findings) == 1
+    assert "namespaced" in findings[0].message
+
+
+def test_kv_hygiene_literal_headed_fstring_flagged():
+    findings = _run(
+        "kv-hygiene",
+        """
+        def publish(coord, rank):
+            coord.kv_set(f"fan/{rank}", "payload")
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_kv_hygiene_uid_headed_keys_clean():
+    findings = _run(
+        "kv-hygiene",
+        """
+        def commit(coord, uid, rank):
+            coord.kv_set(f"{uid}/arrive/{rank}", "ok")
+            coord.kv_set(key_helper(uid, rank), "ok")
+        """,
+    )
+    assert findings == []
+
+
+def test_kv_hygiene_publish_without_delete_flagged():
+    findings = _run(
+        "kv-hygiene",
+        """
+        def publish(coord, prefix, buf):
+            coord.kv_publish_blob(f"{prefix}/blob", buf)
+        """,
+    )
+    assert len(findings) == 1
+    assert "kv_try_delete" in findings[0].message
+
+
+def test_kv_hygiene_publish_with_module_delete_clean():
+    findings = _run(
+        "kv-hygiene",
+        """
+        def publish(coord, prefix, buf):
+            coord.kv_publish_blob(f"{prefix}/blob", buf)
+
+        def cleanup(coord, prefix, nparts):
+            coord.kv_try_delete(f"{prefix}/meta")
+            for i in range(nparts):
+                coord.kv_try_delete(f"{prefix}/p{i}")
+        """,
+    )
+    assert findings == []
+
+
+def test_kv_hygiene_scoped_to_package():
+    findings = _run(
+        "kv-hygiene",
+        """
+        def commit(coord):
+            coord.kv_set("done", "1")
+        """,
+        filename="tools/bench_watch.py",
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------ metric-registry
+
+
+def test_metric_registry_unknown_instrument_flagged():
+    findings = _run(
+        "metric-registry",
+        """
+        def f(obs):
+            obs.counter("tier.bogus_metric").inc()
+        """,
+    )
+    assert len(findings) == 1
+    assert "gen_metric_registry" in findings[0].message
+
+
+def test_metric_registry_known_names_and_families_clean():
+    findings = _run(
+        "metric-registry",
+        """
+        def f(obs, backend):
+            obs.counter("tier.fast_hits").inc()
+            obs.histogram(f"storage.{backend}.write_latency_s").observe(1)
+            obs.gauge("goodput.overhead_fraction").set(0.1)
+        """,
+    )
+    assert findings == []
+
+
+def test_metric_registry_unknown_dynamic_family_flagged():
+    findings = _run(
+        "metric-registry",
+        """
+        def f(obs, backend):
+            obs.counter(f"storage.{backend}.novel_thing").inc()
+        """,
+    )
+    assert len(findings) == 1
+    assert "DYNAMIC_FAMILIES" in findings[0].message
+
+
+def test_metric_registry_reference_drift_flagged():
+    # the doctor-CLI shape: reading a rollup by a name no instrument
+    # registers reads 0 forever
+    findings = _run(
+        "metric-registry",
+        """
+        def rollup(counters):
+            return counters.get("tier.fast_hitz", 0)
+        """,
+    )
+    assert len(findings) == 1
+    assert "tier.fast_hitz" in findings[0].message
+
+
+def test_metric_registry_failpoint_sites_excluded():
+    # failpoint SITE names share the dotted namespace by design
+    findings = _run(
+        "metric-registry",
+        """
+        def promote(group):
+            failpoint("tier.promote.data", durable=group.url)
+            obs.swallowed_exception("tier.plugin_close", None)
+        """,
+    )
+    assert findings == []
+
+
+def test_metric_registry_staleness_detected():
+    findings = _run(
+        "metric-registry",
+        """
+        NEW_METRIC = "tier.not_yet_registered"
+        """,
+        filename="torchsnapshot_tpu/obs/metrics.py",
+    )
+    msgs = " ".join(f.message for f in findings)
+    assert "tier.not_yet_registered" in msgs  # missing from registry
+    assert "no longer defined" in msgs  # registry names absent here
+
+
+def test_metric_registry_generated_file_in_sync():
+    """Regeneration must be a no-op: the committed registry matches
+    what gen_metric_registry derives from obs/metrics.py right now."""
+    from tools.lint.gen_metric_registry import derive_names
+    from tools.lint.metric_registry_data import KNOWN_METRIC_NAMES
+
+    assert derive_names(_REPO_ROOT) == set(KNOWN_METRIC_NAMES)
+
+
+def test_metric_registry_real_metrics_source_clean():
+    with open(
+        os.path.join(_REPO_ROOT, "torchsnapshot_tpu", "obs", "metrics.py"),
+        encoding="utf-8",
+    ) as f:
+        src = f.read()
+    findings = run_source(
+        src, "torchsnapshot_tpu/obs/metrics.py",
+        [_BY_ID["metric-registry"]],
+    )
+    assert findings == []
+
+
+# ------------------------------------ satellites: strengthened passes
+
+
+def test_exception_hygiene_tuple_handler_flagged():
+    findings = _run(
+        "exception-hygiene",
+        """
+        def f():
+            try:
+                work()
+            except (Exception, OSError):
+                pass
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_exception_hygiene_bound_but_ignored_flagged():
+    findings = _run(
+        "exception-hygiene",
+        """
+        def f(self):
+            try:
+                work()
+            except Exception as e:
+                self.status = "failed"
+        """,
+    )
+    assert len(findings) == 1
+    assert "neither uses nor re-raises" in findings[0].message
+
+
+def test_exception_hygiene_bound_and_used_clean():
+    findings = _run(
+        "exception-hygiene",
+        """
+        def f(self):
+            try:
+                work()
+            except Exception as e:
+                self.status = f"failed: {e}"
+        """,
+    )
+    assert findings == []
+
+
+def test_knob_registry_membership_read_flagged():
+    findings = _run(
+        "knob-registry",
+        """
+        import os
+
+        def f():
+            return "TORCHSNAPSHOT_TPU_TRACE" in os.environ
+
+        def g():
+            if "TSNP_S3_ENDPOINT_URL" not in os.environ:
+                return None
+        """,
+    )
+    assert len(findings) == 2
+
+
+def test_knob_registry_unrelated_membership_clean():
+    findings = _run(
+        "knob-registry",
+        """
+        import os
+
+        def f():
+            return "JAX_PLATFORMS" in os.environ
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------- driver + CLI satellites
+
+
+def test_syntax_error_becomes_driver_parse_error_finding(tmp_path):
+    """A broken file must surface as one actionable finding, not kill
+    the run: the rest of the tree still gets linted."""
+    pkg = tmp_path / "torchsnapshot_tpu"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def f(:\n")
+    (pkg / "ok.py").write_text(
+        "def g():\n    try:\n        w()\n    except Exception:\n"
+        "        pass\n"
+    )
+    result = run_repo(str(tmp_path), ALL_PASSES)
+    by_pass = {}
+    for f in result.unbaselined:
+        by_pass.setdefault(f.pass_id, []).append(f)
+    assert len(by_pass["driver-parse-error"]) == 1
+    assert by_pass["driver-parse-error"][0].file == (
+        "torchsnapshot_tpu/broken.py"
+    )
+    # the healthy sibling was still scanned
+    assert len(by_pass["exception-hygiene"]) == 1
+
+
+def test_github_format_annotations(tmp_path, capsys):
+    pkg = tmp_path / "torchsnapshot_tpu"
+    pkg.mkdir()
+    (pkg / "x.py").write_text(
+        "def f(coord):\n    coord.kv_set('done%', '1')\n"
+    )
+    assert main([str(tmp_path), "--format", "github"]) == 1
+    out = capsys.readouterr().out
+    assert "::error file=torchsnapshot_tpu/x.py,line=2," in out
+    assert "title=snaplint kv-hygiene::" in out
+    assert "%25" in out  # workflow-command escaping of the literal %
+    assert "::notice title=snaplint::" in out
+    # clean repo: notice only, exit 0
+    assert main(["--format", "github"]) == 0
+    out = capsys.readouterr().out
+    assert "::error" not in out
+
+
+def test_format_json_alias_and_conflict(capsys):
+    assert main(["--format", "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["ok"] is True
+    assert main(["--json", "--format", "github"]) == 2
+
+
+def test_async_blocking_depth_cutoff_does_not_poison_memo():
+    """Regression: exploring a helper at the depth cutoff must not
+    cache a truncation-dependent None — a shallower caller of the same
+    helper still owns its genuine blocking chain."""
+    findings = _run(
+        "async-blocking",
+        """
+        import time
+
+        def e():
+            time.sleep(1)
+
+        def d():
+            e()
+
+        def c():
+            d()
+
+        def b():
+            c()
+
+        def a():
+            b()
+
+        async def deep():
+            a()  # e sits past the chain-depth cutoff from here
+
+        async def shallow():
+            d()  # but d -> e -> time.sleep is two hops: must flag
+        """,
+    )
+    assert [f.context for f in findings] == ["shallow"]
+
+
+def test_resource_pairing_except_exception_is_not_catch_all():
+    """`except Exception` misses CancelledError/KeyboardInterrupt: a
+    release that lives only in that handler (plus the happy path) still
+    leaks on the cancellation route — flagged.  The BaseException form
+    of the same cleanup is airtight — clean."""
+    flagged = _run(
+        "resource-pairing",
+        """
+        async def one(gate, n):
+            await gate.acquire(n)
+            try:
+                await stage()
+            except Exception:
+                gate.release(n)
+                raise
+            gate.release(n)
+        """,
+    )
+    assert len(flagged) == 1 and "exceptional path" in flagged[0].message
+    clean = _run(
+        "resource-pairing",
+        """
+        async def one(gate, n):
+            await gate.acquire(n)
+            try:
+                await stage()
+            except BaseException:
+                gate.release(n)
+                raise
+            gate.release(n)
+        """,
+    )
+    assert clean == []
+
+
+def test_async_blocking_result_timeout_form_flagged():
+    findings = _run(
+        "async-blocking",
+        """
+        async def f(fut):
+            return fut.result(5.0)
+        """,
+    )
+    assert len(findings) == 1
+    assert ".result()" in findings[0].message
+
+
+def test_resource_pairing_return_acquire_is_a_handoff():
+    # a thin delegating wrapper returns the acquire itself: the caller
+    # owns the release obligation
+    findings = _run(
+        "resource-pairing",
+        """
+        def reserve(self, n):
+            return self._gate.acquire(n)
+        """,
+    )
+    assert findings == []
+
+
+def test_resource_pairing_result_assignment_is_not_a_handoff():
+    """Regression: `etag = handle.write_part(...)` merely mentions the
+    handle — the close obligation stays here, and the missing abort on
+    the exceptional path must still be flagged.  Returning or storing
+    the handle ITSELF remains a sanctioned transfer."""
+    flagged = _run(
+        "resource-pairing",
+        """
+        async def put(storage, path, view):
+            handle = await storage.begin_striped_write(path, len(view))
+            etag = await handle.write_part(0, 0, view)
+            await handle.complete()
+            return etag
+        """,
+    )
+    assert len(flagged) == 1 and "striped-handle" in flagged[0].message
+    clean = _run(
+        "resource-pairing",
+        """
+        async def adopt(self, storage, path, size):
+            handle = await storage.begin_striped_write(path, size)
+            self._handle = handle
+            return None
+        """,
+    )
+    assert clean == []
+
+
+def test_resource_pairing_return_of_derived_value_not_a_handoff():
+    findings = _run(
+        "resource-pairing",
+        """
+        def probe(self, n):
+            self._gate.acquire(n)
+            return self._gate.held()
+        """,
+    )
+    assert len(findings) == 1  # the reservation still leaks

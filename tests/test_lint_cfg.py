@@ -1,0 +1,359 @@
+"""Edge-exactness tests for the snaplint CFG builder (tools/lint/cfg.py)
+and the FileUnit flow-sensitive substrate (cfg()/functions()/callers()).
+
+The CFG is the foundation all four flow-sensitive passes stand on; a
+missing exception edge silently turns "leak on the exceptional path"
+findings into false negatives repo-wide.  These fixtures pin the exact
+labeled edge set for each control shape the passes rely on:
+try/finally conduits, nested with / async-with transparency, loop back
+edges, early return, and bare-raise re-raise propagation."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.lint import cfg as cfgmod  # noqa: E402
+from tools.lint.core import FileUnit  # noqa: E402
+
+
+def _cfg(src):
+    unit = FileUnit("torchsnapshot_tpu/example.py", textwrap.dedent(src))
+    return unit, unit.cfg(unit.tree.body[0])
+
+
+def _edges(src):
+    return _cfg(src)[1].edges()
+
+
+# ------------------------------------------------------- edge exactness
+
+
+def test_try_finally_edges_exact():
+    """The finally conduit: the body's normal completion AND its
+    exception route both thread through <finally>; the finally body
+    then continues normally and resumes propagation."""
+    edges = _edges(
+        """
+        def f(gate):
+            gate.acquire(1)
+            try:
+                work()
+            finally:
+                gate.release(1)
+        """
+    )
+    assert edges == {
+        ("<entry>", "Expr@3", "next"),
+        ("Expr@3", "Expr@5", "next"),
+        ("Expr@3", "<raise>", "exc"),
+        ("Expr@5", "<finally>@7", "next"),
+        ("Expr@5", "<finally>@7", "exc"),
+        ("<finally>@7", "Expr@7", "next"),
+        ("Expr@7", "<exit>", "next"),
+        ("Expr@7", "<raise>", "exc"),
+    }
+
+
+def test_nested_with_edges_exact():
+    """with/async with are exception-transparent containers: the header
+    may raise, body exceptions pass straight through both layers."""
+    edges = _edges(
+        """
+        def f(a, b):
+            with a:
+                with b:
+                    touch()
+            done()
+        """
+    )
+    assert edges == {
+        ("<entry>", "With@3", "next"),
+        ("With@3", "With@4", "next"),
+        ("With@3", "<raise>", "exc"),
+        ("With@4", "Expr@5", "next"),
+        ("With@4", "<raise>", "exc"),
+        ("Expr@5", "Expr@6", "next"),
+        ("Expr@5", "<raise>", "exc"),
+        ("Expr@6", "<exit>", "next"),
+        ("Expr@6", "<raise>", "exc"),
+    }
+
+
+def test_async_with_edges_exact():
+    edges = _edges(
+        """
+        async def f(lock, storage):
+            async with lock:
+                await storage.read()
+            return True
+        """
+    )
+    assert edges == {
+        ("<entry>", "AsyncWith@3", "next"),
+        ("AsyncWith@3", "Expr@4", "next"),
+        ("AsyncWith@3", "<raise>", "exc"),
+        ("Expr@4", "Return@5", "next"),
+        ("Expr@4", "<raise>", "exc"),
+        ("Return@5", "<exit>", "next"),
+    }
+
+
+def test_early_return_edges_exact():
+    """A name-only test raises nothing; each return edges to <exit>
+    directly, and the fall-through arm carries the `false` label."""
+    edges = _edges(
+        """
+        def f(x):
+            if x:
+                return 1
+            cleanup()
+            return 2
+        """
+    )
+    assert edges == {
+        ("<entry>", "If@3", "next"),
+        ("If@3", "Return@4", "true"),
+        ("If@3", "Expr@5", "false"),
+        ("Return@4", "<exit>", "next"),
+        ("Expr@5", "Return@6", "next"),
+        ("Expr@5", "<raise>", "exc"),
+        ("Return@6", "<exit>", "next"),
+    }
+
+
+def test_bare_raise_reraise_edges_exact():
+    """A bare raise in a handler resumes propagation: its only edge is
+    exc -> <raise>.  The non-matching-exception route (OSError is not a
+    catch-all) keeps its own body -> <raise> edge."""
+    edges = _edges(
+        """
+        def f():
+            try:
+                work()
+            except OSError:
+                note()
+                raise
+            return True
+        """
+    )
+    assert edges == {
+        ("<entry>", "Expr@4", "next"),
+        ("Expr@4", "Return@8", "next"),
+        ("Expr@4", "ExceptHandler@5", "exc"),
+        ("Expr@4", "<raise>", "exc"),
+        ("ExceptHandler@5", "Expr@6", "next"),
+        ("Expr@6", "Raise@7", "next"),
+        ("Expr@6", "<raise>", "exc"),
+        ("Raise@7", "<raise>", "exc"),
+        ("Return@8", "<exit>", "next"),
+    }
+
+
+def test_catch_all_handler_removes_uncaught_route():
+    """Only bare/`BaseException` handlers stop propagation.  `except
+    Exception` does NOT: CancelledError/KeyboardInterrupt bypass it,
+    and the async-cancellation path is where resource leaks hide — so
+    the body keeps its direct route to <raise>."""
+    edges = _edges(
+        """
+        def f():
+            try:
+                work()
+            except BaseException:
+                note()
+        """
+    )
+    assert ("Expr@4", "<raise>", "exc") not in edges
+    assert ("Expr@4", "ExceptHandler@5", "exc") in edges
+    edges = _edges(
+        """
+        def f():
+            try:
+                work()
+            except Exception:
+                note()
+        """
+    )
+    assert ("Expr@4", "<raise>", "exc") in edges
+    assert ("Expr@4", "ExceptHandler@5", "exc") in edges
+
+
+def test_loop_back_edges_exact():
+    """while True: no false exit — the loop leaves only via break; the
+    body end carries the back edge."""
+    edges = _edges(
+        """
+        def f(q):
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+            drain()
+        """
+    )
+    assert edges == {
+        ("<entry>", "While@3", "next"),
+        ("While@3", "Assign@4", "true"),
+        ("Assign@4", "If@5", "next"),
+        ("Assign@4", "<raise>", "exc"),
+        ("If@5", "Break@6", "true"),
+        ("If@5", "While@3", "back"),
+        ("Break@6", "Expr@7", "next"),
+        ("Expr@7", "<exit>", "next"),
+        ("Expr@7", "<raise>", "exc"),
+    }
+
+
+def test_for_loop_false_edge_and_back_edge():
+    edges = _edges(
+        """
+        def f(items):
+            for it in items:
+                use(it)
+            done()
+        """
+    )
+    assert ("For@3", "Expr@4", "true") in edges
+    assert ("For@3", "Expr@5", "false") in edges
+    assert ("Expr@4", "For@3", "back") in edges
+    assert ("For@3", "<raise>", "exc") in edges  # iterator may raise
+
+
+def test_return_routes_through_finally():
+    edges = _edges(
+        """
+        def f(gate):
+            try:
+                return compute()
+            finally:
+                gate.release(1)
+        """
+    )
+    # the return enters the conduit, and the finally body carries the
+    # continuation to <exit>; there is no direct Return -> <exit> edge
+    assert ("Return@4", "<finally>@6", "next") in edges
+    assert ("Expr@6", "<exit>", "next") in edges
+    assert ("Return@4", "<exit>", "next") not in edges
+
+
+def test_break_through_finally_reaches_loop_exit():
+    unit, g = _cfg(
+        """
+        def f(items):
+            while True:
+                try:
+                    step()
+                    break
+                finally:
+                    cleanup()
+            after()
+        """
+    )
+    edges = g.edges()
+    assert ("Break@6", "<finally>@8", "next") in edges
+    assert ("Expr@8", "Expr@9", "next") in edges  # cleanup -> after()
+
+
+# --------------------------------------------------------- reach() law
+
+
+def test_reach_barrier_blocks_paths_through_release():
+    unit, g = _cfg(
+        """
+        def f(gate):
+            gate.acquire(1)
+            try:
+                work()
+            finally:
+                gate.release(1)
+        """
+    )
+    acquire = unit.tree.body[0].body[0]
+    release = unit.tree.body[0].body[1].finalbody[0]
+    starts = g.successors(g.index_of[acquire], labels=("next",))
+    seen = g.reach(starts, barriers={g.index_of[release]})
+    assert cfgmod.EXIT not in seen and cfgmod.RAISE not in seen
+
+
+def test_reach_finds_leak_without_finally():
+    unit, g = _cfg(
+        """
+        def f(gate):
+            gate.acquire(1)
+            work()
+            gate.release(1)
+        """
+    )
+    fn = unit.tree.body[0]
+    acquire, work, release = fn.body
+    starts = g.successors(g.index_of[acquire], labels=("next",))
+    seen = g.reach(starts, barriers={g.index_of[release]})
+    # work() may raise past the release: the leak is visible
+    assert cfgmod.RAISE in seen and cfgmod.EXIT not in seen
+
+
+# ----------------------------------------- functions()/callers() API
+
+
+def test_functions_qualnames_cover_methods_and_nested():
+    unit = FileUnit(
+        "torchsnapshot_tpu/example.py",
+        textwrap.dedent(
+            """
+            def top():
+                def inner():
+                    pass
+                return inner
+
+            class C:
+                def method(self):
+                    pass
+            """
+        ),
+    )
+    names = {qn for qn, _ in unit.functions()}
+    assert names == {"top", "top.inner", "C.method"}
+
+
+def test_callers_resolves_by_trailing_name():
+    unit = FileUnit(
+        "torchsnapshot_tpu/example.py",
+        textwrap.dedent(
+            """
+            def helper():
+                pass
+
+            def a():
+                helper()
+
+            def b(self):
+                self.helper()
+
+            def c():
+                def nested():
+                    helper()  # nested scope: attributed to nested
+                return nested
+            """
+        ),
+    )
+    callers = unit.callers("helper")
+    caller_names = sorted(
+        getattr(scope, "name", "<module>") for scope, _ in callers
+    )
+    assert caller_names == ["a", "b", "nested"]
+    assert unit.callers("nonexistent") == []
+    assert [n.name for n in unit.local_defs("helper")] == ["helper"]
+
+
+def test_cfg_memoized_per_unit():
+    unit = FileUnit(
+        "torchsnapshot_tpu/example.py", "def f():\n    return 1\n"
+    )
+    fn = unit.tree.body[0]
+    assert unit.cfg(fn) is unit.cfg(fn)

@@ -59,6 +59,83 @@ ALLOWLIST: Tuple[Allow, ...] = (
         ),
     ),
     Allow(
+        pass_id="resource-pairing",
+        file="torchsnapshot_tpu/scheduler.py",
+        context="_execute_write_pipelines.dispatch_staging",
+        justification=(
+            "Admission debits here transfer ownership to the pipeline "
+            "task launched in the same scan (_launch); the credit is "
+            "issued by the executor loop when that task completes (or "
+            "by its teardown path), so the pairing is a cross-task "
+            "handoff the per-function CFG cannot see.  The budget-"
+            "balance invariants are asserted end-to-end in "
+            "tests/test_take_invariants.py."
+        ),
+    ),
+    Allow(
+        pass_id="resource-pairing",
+        file="torchsnapshot_tpu/scheduler.py",
+        context="_execute_read_pipelines",
+        justification=(
+            "Read-side admission debits hand the pipeline to read_one "
+            "tasks; the matching credit fires at consume completion in "
+            "a later iteration of the same executor loop (or its "
+            "cancellation sweep).  Same cross-task ownership handoff "
+            "as the write executor, covered by the scheduler fuzz and "
+            "take-invariant suites."
+        ),
+    ),
+    Allow(
+        pass_id="resource-pairing",
+        file="torchsnapshot_tpu/scheduler.py",
+        context="_execute_read_pipelines._read_one_inner",
+        justification=(
+            "The mmap-declined post-read debit re-enters heap bytes "
+            "into budget accounting after the plugin fell back to a "
+            "copying read; the credit is issued when consume_one "
+            "releases the buffer — deliberately NOT in this function, "
+            "because the bytes stay alive until the consumer runs."
+        ),
+    ),
+    Allow(
+        pass_id="resource-pairing",
+        file="torchsnapshot_tpu/storage/stripe.py",
+        context="striped_write",
+        justification=(
+            "The abort handler increments STRIPE_ABORTS before the "
+            "shielded _abort_quiet(handle) so a second cancellation "
+            "arriving during the shield cannot lose the count of an "
+            "abort that actually ran.  The CFG's conservative "
+            "exception edge out of the increment is vacuous: "
+            "Counter.inc is a lock-protected integer add that cannot "
+            "raise, so no real path reaches exit without the abort."
+        ),
+    ),
+    Allow(
+        pass_id="async-blocking",
+        file="torchsnapshot_tpu/scheduler.py",
+        context="_execute_write_pipelines",
+        justification=(
+            "task.result() here is asyncio.Task.result() on members of "
+            "the `done` set returned by asyncio.wait — a completed-"
+            "future accessor that returns (or re-raises) immediately, "
+            "not a concurrent.futures blocking wait.  The lexical "
+            "shape is indistinguishable, so the sanctioned idiom is "
+            "recorded here."
+        ),
+    ),
+    Allow(
+        pass_id="async-blocking",
+        file="torchsnapshot_tpu/scheduler.py",
+        context="_execute_read_pipelines",
+        justification=(
+            "Same asyncio.wait done-set accessor idiom as the write "
+            "executor: task.result() on tasks asyncio.wait already "
+            "reported complete returns immediately and never parks the "
+            "event loop."
+        ),
+    ),
+    Allow(
         pass_id="exception-hygiene",
         file="bench.py",
         context="run_child",

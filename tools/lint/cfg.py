@@ -1,0 +1,559 @@
+"""Per-function control-flow graphs for flow-sensitive snaplint passes.
+
+The lexical passes (collective-safety, lock-discipline, …) answer "does
+this shape appear in this body".  The scheduler-DAG refactor churns
+invariants those walks cannot see: an acquire whose release sits on the
+happy path only, a blocking call that is fine in a helper but fatal once
+the helper is awaited from the event loop.  This module gives passes the
+missing substrate: a conservative, statement-granular CFG per function
+plus an intra-module call graph, both exposed through ``FileUnit``
+(``unit.cfg(func)`` / ``unit.callers(name)``).
+
+Shape of the graph
+------------------
+
+One node per *statement* (compound statements contribute their header —
+the ``If``/``While`` node is the test evaluation, the ``For`` node the
+iterator protocol, the ``With`` node the context-manager entry), plus
+synthetic nodes:
+
+- ``ENTRY`` (0)  — before the first statement;
+- ``EXIT``  (1)  — normal completion (``return`` / falling off the end);
+- ``RAISE`` (2)  — exceptional completion (an uncaught exception);
+- one ``<finally>`` marker per ``try``-with-``finally`` (the conduit
+  every route out of the protected region threads through).
+
+Edges carry a label:
+
+- ``next``  — sequential flow / normal completion;
+- ``true``  — branch taken (``if``/``while`` test true, loop iterates);
+- ``false`` — branch not taken (``else`` arm, loop exhausts);
+- ``back``  — loop back edge (body end → loop header);
+- ``exc``   — exceptional flow out of a statement that may raise.
+
+Conservatism, stated once
+-------------------------
+
+- Every statement that *may* raise (``_can_raise``) gets an ``exc`` edge
+  to the innermost enclosing handler set; trivially-safe statements
+  (``pass``, ``break``, assignments of names/constants/arithmetic) do
+  not, so ``held = hi - lo`` between an acquire and its ``try`` does not
+  manufacture a leak path.
+- Exception *types* are not evaluated: an exception edge goes to every
+  handler of the enclosing ``try``; the uncaught route (to ``finally``
+  and outward) is added unless some handler is a true catch-all (bare
+  or ``BaseException``).  ``except Exception`` deliberately does NOT
+  count: it misses ``CancelledError``/``KeyboardInterrupt``, and the
+  async-cancellation path is exactly where resource leaks hide.
+- ``finally`` bodies are built once and shared by every route through
+  them (normal, exceptional, ``return``/``break``/``continue``).  The
+  merge can create paths that mix an entry kind with another entry's
+  continuation; for the reachability questions the passes ask ("is there
+  a route to EXIT/RAISE that skips every release") this only errs toward
+  reporting, never toward silence.
+- ``with``/``async with`` are exception-transparent containers: the
+  header may raise, the body's exceptions propagate past it.  The
+  ``__exit__``-runs-on-unwind guarantee is a *pass-level* fact (an
+  acquire inside a ``with`` item is the sanctioned pairing form), not a
+  CFG edge.
+- Nested ``def``/``class``/``lambda`` bodies are opaque single
+  statements: their bodies run when called, under a different CFG.
+
+Like the rest of the driver this is stdlib-only and import-light.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+ENTRY = 0
+EXIT = 1
+RAISE = 2
+
+# statement headers whose own evaluation is the node's "work"
+_DEF_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def _expr_may_raise(e: Optional[ast.expr]) -> bool:
+    """Conservatively: may evaluating ``e`` raise?  Names, constants and
+    arithmetic/boolean compositions of them are treated as safe;
+    anything involving a call, subscript, await, comprehension or
+    unknown node may raise.  Attribute *loads* are treated as safe —
+    the repo's hot paths hang releases off ``self._gate``-style
+    receivers, and flagging every attribute access would bury the
+    passes in arithmetic noise."""
+    if e is None:
+        return False
+    if isinstance(e, (ast.Name, ast.Constant)):
+        return False
+    if isinstance(e, ast.Attribute):
+        return _expr_may_raise(e.value)
+    if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+        return any(_expr_may_raise(x) for x in e.elts)
+    if isinstance(e, ast.Dict):
+        return any(_expr_may_raise(x) for x in e.keys if x is not None) or any(
+            _expr_may_raise(x) for x in e.values
+        )
+    if isinstance(e, ast.UnaryOp):
+        return _expr_may_raise(e.operand)
+    if isinstance(e, ast.BinOp):
+        return _expr_may_raise(e.left) or _expr_may_raise(e.right)
+    if isinstance(e, ast.BoolOp):
+        return any(_expr_may_raise(v) for v in e.values)
+    if isinstance(e, ast.Compare):
+        return _expr_may_raise(e.left) or any(
+            _expr_may_raise(c) for c in e.comparators
+        )
+    if isinstance(e, ast.IfExp):
+        return (
+            _expr_may_raise(e.test)
+            or _expr_may_raise(e.body)
+            or _expr_may_raise(e.orelse)
+        )
+    if isinstance(e, ast.JoinedStr):
+        return any(_expr_may_raise(v) for v in e.values)
+    if isinstance(e, ast.FormattedValue):
+        return _expr_may_raise(e.value)
+    if isinstance(e, ast.Starred):
+        return _expr_may_raise(e.value)
+    if isinstance(e, ast.Lambda):
+        return False  # building the closure cannot raise
+    return True  # Call/Subscript/Await/Yield/comprehensions/unknown
+
+
+def _can_raise(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Break, ast.Continue, ast.Global,
+                         ast.Nonlocal)):
+        return False
+    if isinstance(stmt, _DEF_NODES):
+        return False  # defining is safe; the body runs elsewhere
+    if isinstance(stmt, ast.Expr):
+        return _expr_may_raise(stmt.value)
+    if isinstance(stmt, ast.Assign):
+        return any(_expr_may_raise(t) for t in stmt.targets) or _expr_may_raise(
+            stmt.value
+        )
+    if isinstance(stmt, ast.AnnAssign):
+        return _expr_may_raise(stmt.target) or _expr_may_raise(stmt.value)
+    if isinstance(stmt, ast.AugAssign):
+        return _expr_may_raise(stmt.target) or _expr_may_raise(stmt.value)
+    if isinstance(stmt, ast.Return):
+        return _expr_may_raise(stmt.value)
+    if isinstance(stmt, ast.If) or isinstance(stmt, ast.While):
+        return _expr_may_raise(stmt.test)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return True  # iterator protocol: __iter__/__next__ may raise
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return True  # context-manager construction + __enter__
+    return True  # Assert/Delete/Import/Raise-adjacent/unknown
+
+
+class _Finally:
+    """One try-statement's ``finally`` conduit while its protected
+    region is being built: the marker node everything routes into, and
+    the continuations to wire up once the finalbody subgraph exists."""
+
+    __slots__ = ("marker", "conts")
+
+    def __init__(self, marker: int) -> None:
+        self.marker = marker
+        # each continuation is ("exit",)/("raise",)/("node", idx)/
+        # ("break", loop)/("continue", loop)
+        self.conts: List[Tuple] = []
+
+    def add_cont(self, cont: Tuple) -> None:
+        if cont not in self.conts:
+            self.conts.append(cont)
+
+
+class _Loop:
+    __slots__ = ("head", "breaks")
+
+    def __init__(self, head: int) -> None:
+        self.head = head
+        # dangling (node, label) edges that jump past the loop
+        self.breaks: List[Tuple[int, str]] = []
+
+
+class _Handlers:
+    """The except clauses guarding the try *body* currently being
+    built."""
+
+    __slots__ = ("entries", "catch_all")
+
+    def __init__(self, entries: Sequence[int], catch_all: bool) -> None:
+        self.entries = tuple(entries)
+        self.catch_all = catch_all
+
+
+class CFG:
+    """A built control-flow graph.  ``nodes[i]`` is the AST statement at
+    index ``i`` (or a string label for synthetic nodes); ``succs[i]`` is
+    the labeled out-edge list."""
+
+    def __init__(self, func: ast.AST) -> None:
+        self.func = func
+        self.nodes: List[object] = ["<entry>", "<exit>", "<raise>"]
+        self.succs: Dict[int, List[Tuple[int, str]]] = {}
+        self.index_of: Dict[ast.stmt, int] = {}
+
+    # ------------------------------------------------------ construction
+
+    def _new(self, node: object) -> int:
+        idx = len(self.nodes)
+        self.nodes.append(node)
+        if isinstance(node, ast.stmt):
+            self.index_of[node] = idx
+        return idx
+
+    def _edge(self, src: int, dst: int, label: str) -> None:
+        lst = self.succs.setdefault(src, [])
+        if (dst, label) not in lst:
+            lst.append((dst, label))
+
+    # --------------------------------------------------------- queries
+
+    def label(self, idx: int) -> str:
+        """Stable human-readable name for tests/messages:
+        ``<entry>``/``<exit>``/``<raise>``, ``<finally>@line`` or
+        ``{NodeType}@{lineno}``."""
+        node = self.nodes[idx]
+        if isinstance(node, str):
+            return node
+        return f"{type(node).__name__}@{getattr(node, 'lineno', '?')}"
+
+    def edges(self) -> Set[Tuple[str, str, str]]:
+        """The full labeled edge set as readable triples — the
+        edge-exactness fixture surface."""
+        out: Set[Tuple[str, str, str]] = set()
+        for src, lst in self.succs.items():
+            for dst, lab in lst:
+                out.add((self.label(src), self.label(dst), lab))
+        return out
+
+    def successors(
+        self, idx: int, *, labels: Optional[Sequence[str]] = None
+    ) -> List[int]:
+        return [
+            dst
+            for dst, lab in self.succs.get(idx, [])
+            if labels is None or lab in labels
+        ]
+
+    def reach(
+        self,
+        starts: Iterable[int],
+        *,
+        barriers: Iterable[int] = (),
+    ) -> Set[int]:
+        """Every node reachable from ``starts`` along any edge without
+        *passing through* a barrier node (a barrier is reached but not
+        expanded).  The resource-pairing question — "can control leave
+        the function without releasing" — is ``EXIT in reach(...)`` or
+        ``RAISE in reach(...)`` with the release statements as
+        barriers."""
+        blocked = set(barriers)
+        seen: Set[int] = set()
+        stack = [s for s in starts]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            if cur in blocked:
+                continue
+            for dst, _lab in self.succs.get(cur, []):
+                if dst not in seen:
+                    stack.append(dst)
+        return seen
+
+
+class _Builder:
+    def __init__(self, cfg: CFG) -> None:
+        self.cfg = cfg
+        self.frames: List[object] = []  # innermost last
+
+    # ---- frame walks -------------------------------------------------
+
+    def _exc_targets(self) -> List[int]:
+        """Where an exception raised at the current position flows:
+        handler entries of the enclosing try (all of them — types are
+        not evaluated), then — unless a catch-all stops propagation —
+        the enclosing ``finally`` conduit (registering the
+        keep-propagating continuation) or ``RAISE``."""
+        targets: List[int] = []
+        for i in range(len(self.frames) - 1, -1, -1):
+            frame = self.frames[i]
+            if isinstance(frame, _Handlers):
+                targets.extend(frame.entries)
+                if frame.catch_all:
+                    return targets
+            elif isinstance(frame, _Finally):
+                targets.append(frame.marker)
+                frame.add_cont(("raise-from", i))
+                return targets
+        targets.append(RAISE)
+        return targets
+
+    def _route_jump(self, src: int, kind: str) -> None:
+        """Wire a ``return``/``break``/``continue`` at node ``src``
+        through every intervening ``finally`` to its ultimate target."""
+        chain: List[_Finally] = []
+        loop: Optional[_Loop] = None
+        for i in range(len(self.frames) - 1, -1, -1):
+            frame = self.frames[i]
+            if isinstance(frame, _Finally):
+                chain.append(frame)
+            elif isinstance(frame, _Loop) and kind in ("break", "continue"):
+                loop = frame
+                break
+        if kind == "return":
+            final_cont: Tuple = ("exit",)
+        elif kind == "break":
+            final_cont = ("break", loop)
+        else:
+            final_cont = ("continue", loop)
+        if not chain:
+            self._apply_cont(src, "next", final_cont)
+            return
+        self.cfg._edge(src, chain[0].marker, "next")
+        for a, b in zip(chain, chain[1:]):
+            a.add_cont(("node", b.marker))
+        chain[-1].add_cont(final_cont)
+
+    def _apply_cont(self, src: int, label: str, cont: Tuple) -> None:
+        if cont[0] == "exit":
+            self.cfg._edge(src, EXIT, label)
+        elif cont[0] == "node":
+            self.cfg._edge(src, cont[1], label)
+        elif cont[0] in ("break", "continue"):
+            loop = cont[1]
+            if loop is None:
+                # break/continue outside any loop: syntactically
+                # invalid; degrade to EXIT rather than crash
+                self.cfg._edge(src, EXIT, label)
+            elif cont[0] == "continue":
+                self.cfg._edge(src, loop.head, "back")
+            else:
+                loop.breaks.append((src, label))
+        # ("raise-from", i) handled at finally-resolution time only
+
+    # ---- statement building -----------------------------------------
+
+    def build_body(
+        self, stmts: Sequence[ast.stmt], incoming: List[Tuple[int, str]]
+    ) -> List[Tuple[int, str]]:
+        """Build a statement sequence; ``incoming`` are dangling
+        (node, label) edges to wire into the first statement.  Returns
+        the dangling exits of the sequence."""
+        return self.build_body_entry(stmts, incoming)[1]
+
+    def build_body_entry(
+        self, stmts: Sequence[ast.stmt], incoming: List[Tuple[int, str]]
+    ) -> Tuple[Optional[int], List[Tuple[int, str]]]:
+        dangling = incoming
+        first: Optional[int] = None
+        for stmt in stmts:
+            entry, out = self.build_stmt(stmt)
+            if first is None:
+                first = entry
+            for src, lab in dangling:
+                self.cfg._edge(src, entry, lab)
+            dangling = out
+        return first, dangling
+
+    def build_stmt(
+        self, stmt: ast.stmt
+    ) -> Tuple[int, List[Tuple[int, str]]]:
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            n = cfg._new(stmt)
+            self._maybe_exc(n, stmt)
+            body_out = self.build_body(stmt.body, [(n, "true")])
+            if stmt.orelse:
+                else_out = self.build_body(stmt.orelse, [(n, "false")])
+                return n, body_out + else_out
+            return n, body_out + [(n, "false")]
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            n = cfg._new(stmt)
+            self._maybe_exc(n, stmt)
+            loop = _Loop(n)
+            self.frames.append(loop)
+            body_out = self.build_body(stmt.body, [(n, "true")])
+            for src, _lab in body_out:
+                cfg._edge(src, n, "back")
+            self.frames.pop()
+            infinite = (
+                isinstance(stmt, ast.While)
+                and isinstance(stmt.test, ast.Constant)
+                and bool(stmt.test.value)
+            )
+            out: List[Tuple[int, str]] = []
+            if not infinite:
+                if stmt.orelse:
+                    out += self.build_body(stmt.orelse, [(n, "false")])
+                else:
+                    out.append((n, "false"))
+            out += loop.breaks
+            return n, out
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            n = cfg._new(stmt)
+            self._maybe_exc(n, stmt)
+            return n, self.build_body(stmt.body, [(n, "next")])
+
+        if isinstance(stmt, ast.Try):
+            return self._build_try(stmt)
+
+        if isinstance(stmt, ast.Return):
+            n = cfg._new(stmt)
+            self._maybe_exc(n, stmt)
+            self._route_jump(n, "return")
+            return n, []
+
+        if isinstance(stmt, ast.Break):
+            n = cfg._new(stmt)
+            self._route_jump(n, "break")
+            return n, []
+
+        if isinstance(stmt, ast.Continue):
+            n = cfg._new(stmt)
+            self._route_jump(n, "continue")
+            return n, []
+
+        if isinstance(stmt, ast.Raise):
+            n = cfg._new(stmt)
+            for t in self._exc_targets():
+                cfg._edge(n, t, "exc")
+            return n, []
+
+        # simple (or unmodeled-compound) statement: one node, linear
+        n = cfg._new(stmt)
+        self._maybe_exc(n, stmt)
+        return n, [(n, "next")]
+
+    def _maybe_exc(self, idx: int, stmt: ast.stmt) -> None:
+        if _can_raise(stmt):
+            for t in self._exc_targets():
+                self.cfg._edge(idx, t, "exc")
+
+    def _build_try(
+        self, stmt: ast.Try
+    ) -> Tuple[int, List[Tuple[int, str]]]:
+        cfg = self.cfg
+        fin: Optional[_Finally] = None
+        if stmt.finalbody:
+            marker = cfg._new(f"<finally>@{stmt.finalbody[0].lineno}")
+            fin = _Finally(marker)
+            self.frames.append(fin)
+
+        # handler dispatch nodes exist before the body is built so the
+        # body's exc edges have somewhere to land.  Only bare/
+        # BaseException handlers stop propagation: `except Exception`
+        # does NOT catch CancelledError/KeyboardInterrupt, and the
+        # async-cancellation path is exactly where resource leaks hide
+        # — modeling Exception as a catch-all would err toward silence.
+        handler_nodes = [cfg._new(h) for h in stmt.handlers]
+        catch_all = any(
+            h.type is None
+            or (
+                isinstance(h.type, ast.Name)
+                and h.type.id == "BaseException"
+            )
+            or (
+                isinstance(h.type, ast.Tuple)
+                and any(
+                    isinstance(e, ast.Name) and e.id == "BaseException"
+                    for e in h.type.elts
+                )
+            )
+            for h in stmt.handlers
+        )
+        handlers_frame = _Handlers(handler_nodes, catch_all)
+
+        self.frames.append(handlers_frame)
+        # the try statement contributes no node of its own: control
+        # enters the first body statement directly
+        body_entry, body_out = self.build_body_entry(stmt.body, [])
+        if body_entry is None:
+            body_entry = EXIT  # empty body: syntactically impossible
+        self.frames.pop()  # handlers no longer guard
+
+        out: List[Tuple[int, str]] = []
+        if stmt.orelse:
+            out += self.build_body(stmt.orelse, body_out)
+        else:
+            out += body_out
+
+        for h, hn in zip(stmt.handlers, handler_nodes):
+            out += self.build_body(h.body, [(hn, "next")])
+
+        if fin is not None:
+            self.frames.pop()
+            # every normal completion threads through the conduit
+            had_normal = bool(out)
+            for src, lab in out:
+                cfg._edge(src, fin.marker, lab)
+            fin_out = self.build_body(
+                stmt.finalbody, [(fin.marker, "next")]
+            )
+            # the finally's fall-through is a *normal* continuation only
+            # if some route entered it normally; a protected region
+            # that always jumps (return/break/raise) exits solely via
+            # the registered continuations
+            out = [(src, "next") for src, _ in fin_out] if had_normal else []
+            # wire the registered continuations off the finally's exits
+            for cont in fin.conts:
+                if cont[0] == "raise-from":
+                    # resume exception propagation from OUTSIDE this
+                    # finally's frame position
+                    saved = self.frames
+                    self.frames = self.frames[: cont[1]]
+                    targets = self._exc_targets()
+                    self.frames = saved
+                    for src, _ in fin_out:
+                        for t in targets:
+                            cfg._edge(src, t, "exc")
+                else:
+                    for src, _ in fin_out:
+                        self._apply_cont(src, "next", cont)
+        return body_entry, out
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """Build the CFG for one ``FunctionDef``/``AsyncFunctionDef`` (or a
+    module — any node with a ``body`` list of statements)."""
+    cfg = CFG(func)
+    builder = _Builder(cfg)
+    body = getattr(func, "body", None) or []
+    out = builder.build_body(list(body), [(ENTRY, "next")])
+    for src, lab in out:
+        cfg._edge(src, EXIT, lab)
+    return cfg
+
+
+# ------------------------------------------------------ call graph
+
+
+def function_defs(
+    tree: ast.AST,
+) -> List[Tuple[str, ast.AST]]:
+    """Every def in the module as (qualname, node) — methods as
+    ``Class.method``, nested defs as ``outer.inner``."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                out.append((qn, child))
+                visit(child, qn + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out

@@ -45,12 +45,21 @@ def repo_summary(root: str = _REPO_ROOT) -> dict:
         by_pass[f.pass_id] = by_pass.get(f.pass_id, 0) + 1
     return {
         **result.summary(),
+        "passes": [p.pass_id for p in ALL_PASSES],
         "unbaselined_by_pass": by_pass,
         "unused_allows": [
             f"{a.pass_id}:{a.file}:{a.context}"
             for a in result.unused_allows
         ],
     }
+
+
+def _github_escape(text: str) -> str:
+    """Workflow-command data escaping: %, CR and LF are the three
+    characters the runner's parser consumes."""
+    return (
+        text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    )
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -66,7 +75,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="repo root to scan (default: this checkout)",
     )
     parser.add_argument(
-        "--json", action="store_true", help="machine-readable output"
+        "--json", action="store_true",
+        help="machine-readable output (alias for --format json)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "github"), default=None,
+        help="output format: text (default), json, or github "
+        "workflow-command annotations (::error file=...,line=...:: "
+        "per unbaselined finding — CI surfaces them inline on the PR "
+        "diff)",
     )
     parser.add_argument(
         "--baseline", default=DEFAULT_BASELINE,
@@ -95,6 +112,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="list registered passes and exit",
     )
     args = parser.parse_args(argv)
+    if args.format is None:
+        args.format = "json" if args.json else "text"
+    elif args.json and args.format != "json":
+        print(
+            "error: --json conflicts with --format "
+            f"{args.format}", file=sys.stderr,
+        )
+        return 2
 
     if args.list_passes:
         for p in ALL_PASSES:
@@ -190,7 +215,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 0
 
-    if args.json:
+    if args.format == "github":
+        # one workflow-command annotation per actionable finding; stale
+        # allowlist entries surface as warnings pinned to the allowlist
+        for f in result.unbaselined:
+            print(
+                f"::error file={f.file},line={f.line},"
+                f"title=snaplint {f.pass_id}::"
+                f"{_github_escape(f.message)}"
+            )
+        for a in unused_allows:
+            print(
+                f"::warning file=tools/lint/allowlists.py,"
+                f"title=snaplint stale-allow::"
+                f"{_github_escape(f'{a.pass_id}:{a.file}:{a.context} matches nothing')}"
+            )
+        s = result.summary()
+        print(
+            f"::notice title=snaplint::{s['files_scanned']} files, "
+            f"{len(passes)} passes, {s['unbaselined']} actionable"
+        )
+    elif args.format == "json":
         print(
             json.dumps(
                 {

@@ -66,13 +66,26 @@ class LintConfigError(RuntimeError):
 
 
 class FileUnit:
-    """One parsed file shared by every pass: AST, parent links, source."""
+    """One parsed file shared by every pass: AST, parent links, source —
+    plus, built lazily, the flow-sensitive substrate (per-function CFGs
+    and the intra-module call graph, tools/lint/cfg.py)."""
 
-    def __init__(self, relpath: str, source: str) -> None:
+    def __init__(
+        self, relpath: str, source: str, root: Optional[str] = None
+    ) -> None:
         self.relpath = relpath.replace(os.sep, "/")
         self.source = source
+        # repo root for passes that need to consult sibling files (doc
+        # cross-checks); None for in-memory fixture units, so fixtures
+        # stay hermetic
+        self.root = root
         self.tree = ast.parse(source, self.relpath)
         self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self._cfgs: Dict[ast.AST, "object"] = {}
+        self._functions: Optional[List[Tuple[str, ast.AST]]] = None
+        self._callers: Optional[Dict[str, List[Tuple[ast.AST, ast.Call]]]] = (
+            None
+        )
 
     @property
     def parents(self) -> Dict[ast.AST, ast.AST]:
@@ -109,6 +122,50 @@ class FileUnit:
                 names.append(anc.name)
         return ".".join(reversed(names)) or "<module>"
 
+    # ------------------------------------------- flow-sensitive substrate
+
+    def cfg(self, func: ast.AST):
+        """The control-flow graph of one def (memoized per unit) —
+        see tools/lint/cfg.py for the node/edge model."""
+        g = self._cfgs.get(func)
+        if g is None:
+            from . import cfg as _cfg
+
+            g = self._cfgs[func] = _cfg.build_cfg(func)
+        return g
+
+    def functions(self) -> List[Tuple[str, ast.AST]]:
+        """Every def in the file as (qualname, node), methods included."""
+        if self._functions is None:
+            from . import cfg as _cfg
+
+            self._functions = _cfg.function_defs(self.tree)
+        return self._functions
+
+    def local_defs(self, name: str) -> List[ast.AST]:
+        """Defs in this module whose bare name is ``name`` — the
+        resolution the intra-module call graph uses (``self.f()`` and
+        ``f()`` both resolve by trailing name; cross-module calls
+        resolve to nothing and are out of scope by design)."""
+        return [n for qn, n in self.functions() if n.name == name]
+
+    def callers(self, name: str) -> List[Tuple[ast.AST, ast.Call]]:
+        """Call sites of trailing name ``name`` across the module:
+        (enclosing def — or the module node for top-level code, call
+        node) pairs.  Built once per unit."""
+        if self._callers is None:
+            idx: Dict[str, List[Tuple[ast.AST, ast.Call]]] = {}
+            scopes: List[ast.AST] = [self.tree] + [
+                n for _qn, n in self.functions()
+            ]
+            for scope in scopes:
+                for call in calls_in_body(scope):
+                    nm = call_name(call)
+                    if nm:
+                        idx.setdefault(nm, []).append((scope, call))
+            self._callers = idx
+        return self._callers.get(name, [])
+
 
 class LintPass:
     """Base class: subclasses set ``pass_id``/``description`` and
@@ -142,6 +199,20 @@ def call_name(node: ast.Call) -> str:
         return func.id
     if isinstance(func, ast.Attribute):
         return func.attr
+    return ""
+
+
+def receiver_name(func: ast.Attribute) -> str:
+    """Trailing name of a method call's receiver:
+    ``self._fast_breaker.allow`` → "_fast_breaker", ``gate.release`` →
+    "gate".  The shared receiver-identity notion for the flow-sensitive
+    passes — one definition, so what two passes consider "the same
+    receiver" cannot skew."""
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute):
+        return v.attr
     return ""
 
 
@@ -345,11 +416,14 @@ def run_repo(
         with open(os.path.join(root, rel), encoding="utf-8") as f:
             src = f.read()
         try:
-            unit = FileUnit(rel, src)
+            unit = FileUnit(rel, src, root=root)
         except SyntaxError as e:
+            # a broken file must surface as ONE actionable finding, not
+            # kill the whole run: the other 100+ files' findings are
+            # exactly what a mid-refactor lint exists to report
             findings.append(
                 Finding(
-                    pass_id="parse-error",
+                    pass_id="driver-parse-error",
                     file=rel.replace(os.sep, "/"),
                     line=e.lineno or 0,
                     message=f"cannot parse: {e.msg}",

@@ -1,16 +1,26 @@
 """The registered snaplint passes.  Order here is presentation order in
-``--list-passes``; findings are sorted by location regardless."""
+``--list-passes``; findings are sorted by location regardless.
+
+The first six are lexical single-function walks.  Of the last four,
+resource-pairing rides the per-function CFGs (``FileUnit.cfg`` +
+``cfg.reach``) and async-blocking the intra-module call graph
+(``FileUnit.local_defs``/``callers``); kv-hygiene and metric-registry
+are module-level hygiene sweeps that shipped with the substrate."""
 
 from __future__ import annotations
 
 from typing import Tuple
 
 from ..core import LintPass
+from .async_blocking import AsyncBlockingPass
 from .collective_safety import CollectiveSafetyPass
 from .exception_hygiene import ExceptionHygienePass
 from .instrumentation import InstrumentationPass
 from .knob_registry import KnobRegistryPass
+from .kv_hygiene import KvHygienePass
 from .lock_discipline import LockDisciplinePass
+from .metric_registry import MetricRegistryPass
+from .resource_pairing import ResourcePairingPass
 from .retry_discipline import RetryDisciplinePass
 
 ALL_PASSES: Tuple[LintPass, ...] = (
@@ -20,4 +30,8 @@ ALL_PASSES: Tuple[LintPass, ...] = (
     KnobRegistryPass(),
     RetryDisciplinePass(),
     InstrumentationPass(),
+    AsyncBlockingPass(),
+    ResourcePairingPass(),
+    KvHygienePass(),
+    MetricRegistryPass(),
 )

@@ -1,0 +1,239 @@
+"""async-blocking: no blocking calls on the event loop.
+
+The scheduler's pipelines, the stripe/stream engines, the host-cache
+fill and the fan-out transport all run as coroutines on one event loop;
+a single synchronous ``open``/``flock``/``kv_get`` there stalls every
+in-flight pipeline at once — the exact starvation class the serving PR
+fixed by converting the single-flight flock wait into a polled
+non-blocking acquire.  This pass makes that class structural instead of
+review-dependent.
+
+What is flagged — a *direct call* to a blocking operation executing as
+part of an ``async def``'s own body (nested def/lambda bodies excluded;
+they run under their own CFG):
+
+- ``open(...)`` (the builtin — ``aiofiles.open``/other attribute forms
+  are not the builtin and are not flagged);
+- ``time.sleep(...)`` (including a bare ``sleep`` *imported from*
+  ``time``; ``asyncio.sleep`` is fine);
+- ``fcntl.flock``/``fcntl.lockf``;
+- synchronous coordination waits: ``.kv_get``/``.barrier``/
+  ``.kv_exchange``/``.kv_publish_blob``/``.kv_try_fetch_blob`` (the
+  bounded try-ops ``kv_try_get``/``kv_try_delete``/``kv_set`` are
+  single round-trips, not waits, and stay unflagged);
+- ``.result()`` / ``.join()`` (concurrent.futures / thread waits; the
+  str/os.path ``join`` shapes are recognized and skipped);
+- ``subprocess.run/call/check_call/check_output/Popen`` and
+  ``os.system``.
+
+Indirect reachability: a call from an async body to a *module-local
+synchronous* helper is followed through the intra-module call graph
+(``FileUnit.callers``/``local_defs``) — if the helper (transitively)
+performs a blocking operation, the *await-side call site* is flagged,
+naming the chain.  Handing the callable to an executor
+(``loop.run_in_executor(None, fn, ...)`` / ``asyncio.to_thread(fn)``)
+passes a reference, not a call, so dispatched work is structurally
+exempt — no suppression comment needed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import (
+    FileUnit,
+    Finding,
+    LintPass,
+    call_name,
+    calls_in_body,
+    receiver_name,
+)
+
+_SYNC_KV_WAITS = frozenset(
+    {
+        "kv_get",
+        "barrier",
+        "kv_exchange",
+        "kv_publish_blob",
+        "kv_try_fetch_blob",
+        "all_gather_object",
+        "gather_object",
+        "broadcast_object",
+    }
+)
+_SUBPROCESS_CALLS = frozenset(
+    {"run", "call", "check_call", "check_output", "Popen"}
+)
+_PATHLIKE_RECEIVERS = frozenset({"os", "path", "posixpath", "ntpath"})
+_MAX_CHAIN_DEPTH = 4
+
+
+def _time_imported_names(tree: ast.AST) -> Set[str]:
+    """Local names bound to ``time.sleep`` via ``from time import
+    sleep [as s]``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    out.add(alias.asname or "sleep")
+    return out
+
+
+def blocking_reason(call: ast.Call, sleep_names: Set[str]) -> Optional[str]:
+    """Why ``call`` blocks, or None."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id == "open":
+            return "open() performs synchronous file I/O"
+        if func.id in sleep_names:
+            return "time.sleep() blocks the loop outright"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    name = func.attr
+    recv = receiver_name(func)
+    if name == "sleep" and recv == "time":
+        return "time.sleep() blocks the loop outright"
+    if name in ("flock", "lockf") and recv == "fcntl":
+        return f"fcntl.{name}() waits on a file lock"
+    if name in _SYNC_KV_WAITS:
+        return (
+            f".{name}() is a synchronous coordination wait "
+            f"(blocking KV/barrier round-trip)"
+        )
+    if name in _SUBPROCESS_CALLS and recv == "subprocess":
+        return f"subprocess.{name}() waits on a child process"
+    if name == "system" and recv == "os":
+        return "os.system() waits on a shell"
+    if name == "result" and (
+        not call.args
+        or (
+            len(call.args) == 1
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, (int, float))
+        )
+    ):
+        # concurrent.futures Future.result() / .result(timeout) — the
+        # timeout form parks the loop for up to the timeout
+        return (
+            ".result() waits on a future (asyncio results should be "
+            "awaited)"
+        )
+    if name == "join":
+        # str.join always takes one iterable positional; path joins
+        # hang off os/os.path — everything else zero-arg is a thread/
+        # process join
+        if recv in _PATHLIKE_RECEIVERS:
+            return None
+        if isinstance(func.value, ast.Constant):
+            return None  # "sep".join(...)
+        if not call.args and not call.keywords:
+            return ".join() waits on a thread/process"
+        if (
+            len(call.args) == 1
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, (int, float))
+        ):
+            return ".join(timeout) waits on a thread/process"
+        if not call.args and any(
+            kw.arg == "timeout" for kw in call.keywords
+        ):
+            return ".join(timeout=...) waits on a thread/process"
+        return None
+    return None
+
+
+class AsyncBlockingPass(LintPass):
+    pass_id = "async-blocking"
+    description = (
+        "no blocking calls (open/sleep/flock/sync KV/result/join/"
+        "subprocess) on the event loop; executor dispatch is the "
+        "sanctioned form"
+    )
+
+    def run(self, unit: FileUnit) -> Iterable[Finding]:
+        out: List[Finding] = []
+        sleep_names = _time_imported_names(unit.tree)
+
+        # memo: def node -> first blocking chain found inside it
+        # (transitively), as a list of "name:line reason" strings.
+        # Entries are recorded only for COMPLETE explorations — a None
+        # computed under a depth/cycle cutoff is truncation-dependent
+        # and caching it would suppress real chains that a shallower
+        # caller could still reach.
+        memo: Dict[ast.AST, Optional[List[str]]] = {}
+
+        def chain_of(
+            fn: ast.AST, depth: int, seen: Set[ast.AST]
+        ) -> Tuple[Optional[List[str]], bool]:
+            """(chain, complete): ``complete`` is False when a cutoff
+            limited the search and the (None) answer is not cacheable."""
+            if fn in memo:
+                return memo[fn], True
+            if depth > _MAX_CHAIN_DEPTH or fn in seen:
+                return None, False
+            seen = seen | {fn}
+            result: Optional[List[str]] = None
+            complete = True
+            for call in calls_in_body(fn):
+                reason = blocking_reason(call, sleep_names)
+                if reason is not None:
+                    result = [f"{call_name(call)}() at line {call.lineno}: "
+                              f"{reason}"]
+                    break
+                for target in unit.local_defs(call_name(call)):
+                    if isinstance(target, ast.AsyncFunctionDef):
+                        continue  # awaited elsewhere; checked itself
+                    sub, sub_complete = chain_of(target, depth + 1, seen)
+                    complete = complete and sub_complete
+                    if sub is not None:
+                        result = [
+                            f"{call_name(call)}() at line {call.lineno}"
+                        ] + sub
+                        break
+                if result is not None:
+                    break
+            if result is not None or complete:
+                memo[fn] = result
+            return result, complete
+
+        for _qn, fn in unit.functions():
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for call in calls_in_body(fn):
+                reason = blocking_reason(call, sleep_names)
+                if reason is not None:
+                    out.append(
+                        self.finding(
+                            unit,
+                            call,
+                            f"blocking call in async def "
+                            f"{fn.name}: {reason} — dispatch via "
+                            f"run_in_executor/to_thread or use the "
+                            f"async form",
+                        )
+                    )
+                    continue
+                # indirect: a direct call to a module-local sync helper
+                # that (transitively) blocks
+                for target in unit.local_defs(call_name(call)):
+                    if isinstance(target, ast.AsyncFunctionDef):
+                        continue
+                    sub, _complete = chain_of(target, 1, {fn})
+                    if sub is not None:
+                        chain = " -> ".join(sub)
+                        out.append(
+                            self.finding(
+                                unit,
+                                call,
+                                f"async def {fn.name} calls module-"
+                                f"local helper {call_name(call)}() "
+                                f"which blocks: {chain} — dispatch "
+                                f"the helper via run_in_executor/"
+                                f"to_thread",
+                            )
+                        )
+                        break
+        return out

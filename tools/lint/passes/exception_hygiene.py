@@ -11,8 +11,13 @@ What is flagged:
 
 - ``except:`` (bare) and ``except BaseException`` (alone or in a
   tuple) handlers with no recognized escape;
-- ``except Exception`` handlers whose body is ONLY ``pass`` (the pure
-  silent swallow — generic catch, zero trace).
+- ``except Exception`` handlers — alone or as a tuple member
+  (``except (Exception, OSError):`` is exactly as broad as
+  ``except Exception:``) — whose body is ONLY ``pass`` (the pure
+  silent swallow — generic catch, zero trace);
+- ``except Exception as e`` handlers whose body neither references
+  ``e`` nor escapes: binding the exception and then ignoring it is the
+  ``pass`` swallow wearing a seatbelt it never buckles.
 
 Recognized escapes (any one suffices):
 
@@ -100,6 +105,19 @@ def _is_pass_only(handler: ast.ExceptHandler) -> bool:
     return len(handler.body) == 1 and isinstance(handler.body[0], ast.Pass)
 
 
+def _references_bound(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body reference its ``as e`` name at all?  (The
+    stricter escape analysis is _has_escape; this is the cheaper
+    question for the bound-but-ignored rule.)"""
+    bound = handler.name
+    if not bound:
+        return False
+    for node in walk_skipping_nested_defs(handler):
+        if isinstance(node, ast.Name) and node.id == bound:
+            return True
+    return False
+
+
 class ExceptionHygienePass(LintPass):
     pass_id = "exception-hygiene"
     description = (
@@ -131,15 +149,39 @@ class ExceptionHygienePass(LintPass):
                     )
                 )
             elif "Exception" in caught and _is_pass_only(node):
+                what = (
+                    "`except (Exception, ...): pass`"
+                    if len(caught) > 1
+                    else "`except Exception: pass`"
+                )
                 out.append(
                     self.finding(
                         unit,
                         node,
-                        "`except Exception: pass` is a silent swallow "
-                        "— narrow the exception type, log it, or "
-                        "record it via obs.swallowed_exception() "
-                        "(allowlist with justification if the silence "
-                        "is truly the contract)",
+                        f"{what} is a silent swallow "
+                        f"— narrow the exception type, log it, or "
+                        f"record it via obs.swallowed_exception() "
+                        f"(allowlist with justification if the silence "
+                        f"is truly the contract)",
+                    )
+                )
+            elif (
+                "Exception" in caught
+                and node.name
+                and not _references_bound(node)
+                and not _has_escape(node)
+            ):
+                out.append(
+                    self.finding(
+                        unit,
+                        node,
+                        f"`except Exception as {node.name}:` binds the "
+                        f"exception and then neither uses nor re-raises "
+                        f"it — the body runs but the failure leaves no "
+                        f"trace; log it, record it via "
+                        f"obs.swallowed_exception('<site>', "
+                        f"{node.name}), or drop the binding and narrow "
+                        f"the type",
                     )
                 )
         return out

@@ -8,7 +8,8 @@ elsewhere forks the source of truth — the knob silently stops honoring
 ``knobs.override_*`` in tests and disappears from the docs.
 
 Flagged env-read forms (``os.environ.get``/``[...]``/``setdefault``/
-``pop``, ``os.getenv``) with a string-literal key:
+``pop``, ``os.getenv``, and the membership test
+``"KEY" in os.environ``) with a string-literal key:
 
 - keys starting with ``TORCHSNAPSHOT_TPU_`` anywhere except
   ``torchsnapshot_tpu/knobs.py``;
@@ -33,14 +34,16 @@ _PKG_PREFIX = "torchsnapshot_tpu/"
 _ENV_METHODS = frozenset({"get", "setdefault", "pop", "getenv"})
 
 
-def _literal_key(call_or_sub: ast.AST) -> Optional[str]:
+def _literal_key(node: ast.AST) -> Optional[str]:
     """The string-literal env key of an environ access, else None."""
-    if isinstance(call_or_sub, ast.Call):
-        if not call_or_sub.args:
+    if isinstance(node, ast.Call):
+        if not node.args:
             return None
-        arg = call_or_sub.args[0]
-    elif isinstance(call_or_sub, ast.Subscript):
-        arg = call_or_sub.slice
+        arg = node.args[0]
+    elif isinstance(node, ast.Subscript):
+        arg = node.slice
+    elif isinstance(node, ast.Compare):
+        arg = node.left
     else:
         return None
     if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
@@ -48,14 +51,28 @@ def _literal_key(call_or_sub: ast.AST) -> Optional[str]:
     return None
 
 
+def _is_environ_expr(e: ast.AST) -> bool:
+    """Is ``e`` the environ mapping itself (``os.environ`` or a bare
+    ``environ`` import)?"""
+    return (isinstance(e, ast.Attribute) and e.attr == "environ") or (
+        isinstance(e, ast.Name) and e.id == "environ"
+    )
+
+
 def _is_environ_access(node: ast.AST) -> bool:
     """``os.environ.get/.setdefault/.pop``, ``os.environ[...]``,
-    ``environ.get``, ``os.getenv``."""
+    ``environ.get``, ``os.getenv``, ``"KEY" in os.environ``."""
     if isinstance(node, ast.Subscript):
-        target = node.value
-        return isinstance(target, ast.Attribute) and (
-            target.attr == "environ"
-        ) or (isinstance(target, ast.Name) and target.id == "environ")
+        return _is_environ_expr(node.value)
+    if isinstance(node, ast.Compare):
+        # `"KEY" in os.environ` / `"KEY" not in os.environ` — an env
+        # READ like any other (presence gates a code path)
+        return (
+            len(node.ops) == 1
+            and isinstance(node.ops[0], (ast.In, ast.NotIn))
+            and len(node.comparators) == 1
+            and _is_environ_expr(node.comparators[0])
+        )
     if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
         # `from os import getenv; getenv(...)` — bare-name form
         return node.func.id == "getenv"
@@ -63,16 +80,7 @@ def _is_environ_access(node: ast.AST) -> bool:
         func = node.func
         if func.attr == "getenv":
             return True
-        if func.attr in _ENV_METHODS and (
-            (
-                isinstance(func.value, ast.Attribute)
-                and func.value.attr == "environ"
-            )
-            or (
-                isinstance(func.value, ast.Name)
-                and func.value.id == "environ"
-            )
-        ):
+        if func.attr in _ENV_METHODS and _is_environ_expr(func.value):
             return True
     return False
 

@@ -1,0 +1,110 @@
+"""kv-hygiene: coordination-KV keys are namespaced; transient blobs die.
+
+The coordination KV store is shared, global, and (on JAX) lives in the
+coordination service for the life of the job.  Two invariant classes
+guard it:
+
+1. **Namespacing** — every ``kv_set``/``kv_publish_blob`` key must be
+   namespaced under a per-operation uid (the ``f"{uid}/arrive/{rank}"``
+   shape).  A literal-headed key (``"done"``, ``f"fan/{rank}"``)
+   collides across concurrent/successive operations: the second take's
+   barrier reads the first take's keys and the protocol silently skews.
+   Keys built from a variable or helper call can't be checked lexically
+   and pass (the uid-prefix convention is enforced where keys are
+   *literal*).
+
+2. **Transience** — ``kv_publish_blob`` publishes chunked payloads
+   (fan-out redistribution) that the store never garbage-collects;
+   every module that publishes must also contain the paired
+   ``kv_try_delete`` cleanup (the multislice PR's delete-after-final-
+   barrier protocol), or repeated restores grow the coordination store
+   without bound.
+
+Scope: the ``torchsnapshot_tpu`` package.  ``coordination.py`` itself
+is the primitive layer — its keys are built from caller-supplied
+uids/prefixes and it *defines* the publish/delete pair — and is exempt
+from the pairing rule (not from namespacing: its literal keys, if any,
+collide like anyone else's).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import FileUnit, Finding, LintPass, call_name
+
+_PKG_PREFIX = "torchsnapshot_tpu/"
+_PRIMITIVE_FILE = "torchsnapshot_tpu/coordination.py"
+_WRITE_OPS = frozenset({"kv_set", "kv_publish_blob"})
+
+
+def _literal_head(key: ast.expr) -> Optional[str]:
+    """The literal leading text of a key expression, or None when the
+    key starts with a runtime value (sanctioned: uid-headed)."""
+    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+        return key.value
+    if isinstance(key, ast.JoinedStr) and key.values:
+        first = key.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+        return None  # f"{uid}/..." — runtime-headed
+    if isinstance(key, ast.BinOp) and isinstance(key.op, ast.Add):
+        return _literal_head(key.left)
+    return None
+
+
+class KvHygienePass(LintPass):
+    pass_id = "kv-hygiene"
+    description = (
+        "KV writes use uid-namespaced keys; kv_publish_blob has a "
+        "paired kv_try_delete in the module"
+    )
+
+    def run(self, unit: FileUnit) -> Iterable[Finding]:
+        if not unit.relpath.startswith(_PKG_PREFIX):
+            return []
+        out: List[Finding] = []
+        publishes: List[ast.Call] = []
+        has_delete = False
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "kv_try_delete":
+                has_delete = True
+            if name not in _WRITE_OPS or not node.args:
+                continue
+            if name == "kv_publish_blob":
+                publishes.append(node)
+            head = _literal_head(node.args[0])
+            if head is not None:
+                out.append(
+                    self.finding(
+                        unit,
+                        node,
+                        f"{name}() key starts with the literal "
+                        f"{head!r} — coordination keys must be "
+                        f"namespaced under a per-operation uid "
+                        f"(f\"{{uid}}/...\") or successive operations "
+                        f"collide in the shared KV store",
+                    )
+                )
+        if (
+            publishes
+            and not has_delete
+            and unit.relpath != _PRIMITIVE_FILE
+        ):
+            for node in publishes:
+                out.append(
+                    self.finding(
+                        unit,
+                        node,
+                        "kv_publish_blob() without a reachable "
+                        "kv_try_delete in this module — published "
+                        "blobs are transient by contract (the store "
+                        "never GCs them); delete after the final "
+                        "barrier like topology/fanout.py does",
+                    )
+                )
+        return out

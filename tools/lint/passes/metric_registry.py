@@ -1,0 +1,280 @@
+"""metric-registry: metric names are registry-checked, everywhere.
+
+``obs/metrics.py``'s module constants are the single source of truth
+for instrument names; ``tools/lint/metric_registry_data.py`` is the
+generated registry derived from them (plus the declared dynamic
+f-string families).  Three drift classes fail the lint:
+
+1. **Unregistered instruments** — a string (or f-string) literal passed
+   to ``counter(...)``/``gauge(...)``/``histogram(...)`` that is not a
+   registered name/family: the counter increments but no dashboard,
+   doctor row, or docs table will ever show it.  Fix: add the constant
+   to obs/metrics.py and regenerate.
+2. **Reference drift** — a metric-shaped string literal in scanned code
+   (the doctor CLI's ``counters.get("tier.fast_hits")`` rows, bench
+   rollups) whose name no instrument registers: a typo'd or renamed
+   metric silently reads 0 forever.  Checked for literals whose first
+   dotted segment is a registered family; failpoint site names (also
+   dotted) are excluded by their call context.
+3. **Stale registry** — obs/metrics.py and the generated file disagree
+   (constant added/removed without regenerating), and — on repo runs —
+   docs/observability.md naming a metric the registry doesn't know.
+
+Regenerate with ``python -m tools.lint.gen_metric_registry``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from typing import Iterable, List, Optional, Set
+
+from ..core import FileUnit, Finding, LintPass, call_name
+from ..gen_metric_registry import (
+    METRICS_SOURCE,
+    NAME_RE,
+    derive_names_from_source,
+)
+from ..metric_registry_data import (
+    KNOWN_METRIC_NAMES,
+    KNOWN_METRIC_PATTERNS,
+)
+
+_INSTRUMENT_CALLS = frozenset({"counter", "gauge", "histogram"})
+# dotted names only participate in reference checking (rule 2); flat
+# names like "bytes_staged" are too common as ordinary identifiers
+_DOTTED_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+_FAMILIES = frozenset(
+    n.split(".", 1)[0] for n in KNOWN_METRIC_NAMES if "." in n
+)
+# docs metric tokens: `tier.fast_hits`, `storage.<backend>.write_bytes`
+_DOC_TOKEN_RE = re.compile(r"`([a-z][a-z0-9_.<>{}]*)`")
+_DOCS_FILE = "docs/observability.md"
+
+
+def _known(name: str) -> bool:
+    if name in KNOWN_METRIC_NAMES:
+        return True
+    return any(fnmatch.fnmatch(name, p) for p in KNOWN_METRIC_PATTERNS)
+
+
+def _glob_known(glob: str) -> bool:
+    """A wildcard-bearing name (from an f-string or a docs ``<x>``
+    placeholder) is known when some registered pattern covers it:
+    substitute a dummy segment for each ``*`` and fnmatch."""
+    if glob in KNOWN_METRIC_PATTERNS:
+        return True
+    probe = glob.replace("*", "zzz")
+    return any(fnmatch.fnmatch(probe, p) for p in KNOWN_METRIC_PATTERNS)
+
+
+def _fstring_glob(node: ast.JoinedStr) -> Optional[str]:
+    """f"storage.{b}.{op}_bytes" -> "storage.*.*_bytes"; None when a
+    part is neither literal nor a formatted value."""
+    parts: List[str] = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        elif isinstance(v, ast.FormattedValue):
+            parts.append("*")
+        else:
+            return None
+    return "".join(parts)
+
+
+def _call_context_names(unit: FileUnit, node: ast.AST) -> Set[str]:
+    """Trailing names of every call whose argument list (transitively)
+    contains ``node`` — the failpoint-site exclusion."""
+    out: Set[str] = set()
+    cur: ast.AST = node
+    for anc in unit.ancestors(node):
+        if isinstance(anc, ast.Call) and cur is not anc.func:
+            out.add(call_name(anc))
+        cur = anc
+    return out
+
+
+class MetricRegistryPass(LintPass):
+    pass_id = "metric-registry"
+    description = (
+        "metric names in instruments, doctor/bench references and docs "
+        "must match the generated registry"
+    )
+
+    def run(self, unit: FileUnit) -> Iterable[Finding]:
+        out: List[Finding] = []
+        out.extend(self._check_instruments(unit))
+        out.extend(self._check_references(unit))
+        if unit.relpath == METRICS_SOURCE:
+            out.extend(self._check_registry_fresh(unit))
+            out.extend(self._check_docs(unit))
+        return out
+
+    # ------------------------------------------------- rule 1: creates
+
+    def _check_instruments(self, unit: FileUnit) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = call_name(node)
+            if name not in _INSTRUMENT_CALLS:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if NAME_RE.match(arg.value) and not _known(arg.value):
+                    out.append(
+                        self.finding(
+                            unit,
+                            node,
+                            f"{name}({arg.value!r}) is not in the "
+                            f"metric registry — add the constant to "
+                            f"{METRICS_SOURCE} and run `python -m "
+                            f"tools.lint.gen_metric_registry`, or the "
+                            f"instrument updates but never reaches "
+                            f"doctor/docs/bench",
+                        )
+                    )
+            elif isinstance(arg, ast.JoinedStr):
+                glob = _fstring_glob(arg)
+                if glob is not None and not _glob_known(glob):
+                    out.append(
+                        self.finding(
+                            unit,
+                            node,
+                            f"{name}(f\"...\") builds dynamic metric "
+                            f"family {glob!r} which no registered "
+                            f"pattern covers — declare the family in "
+                            f"tools/lint/gen_metric_registry.py's "
+                            f"DYNAMIC_FAMILIES and regenerate",
+                        )
+                    )
+        return out
+
+    # ---------------------------------------------- rule 2: references
+
+    def _check_references(self, unit: FileUnit) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(unit.tree):
+            if not (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+            ):
+                continue
+            value = node.value
+            if not _DOTTED_RE.match(value):
+                continue
+            if value.split(".", 1)[0] not in _FAMILIES:
+                continue
+            if _known(value):
+                continue
+            ctx = _call_context_names(unit, node)
+            if any("failpoint" in c for c in ctx):
+                continue  # failpoint SITE names share the dotted space
+            if "swallowed_exception" in ctx or "span" in ctx:
+                continue  # swallow-site / span names, not metrics
+            if _INSTRUMENT_CALLS & ctx:
+                continue  # rule 1 already reported it
+            out.append(
+                self.finding(
+                    unit,
+                    node,
+                    f"metric reference {value!r} matches no registered "
+                    f"metric — a renamed/typo'd name here reads 0 "
+                    f"forever (registry: {METRICS_SOURCE} + "
+                    f"gen_metric_registry DYNAMIC_FAMILIES)",
+                )
+            )
+        return out
+
+    # ---------------------------------------------- rule 3: freshness
+
+    def _check_registry_fresh(self, unit: FileUnit) -> List[Finding]:
+        out: List[Finding] = []
+        current = derive_names_from_source(unit.source)
+        missing = sorted(current - KNOWN_METRIC_NAMES)
+        for name in missing:
+            out.append(
+                self.finding(
+                    unit,
+                    unit.tree,
+                    f"metrics constant {name!r} is missing from the "
+                    f"generated registry — run `python -m "
+                    f"tools.lint.gen_metric_registry`",
+                )
+            )
+        stale = sorted(KNOWN_METRIC_NAMES - current)
+        if stale:
+            out.append(
+                self.finding(
+                    unit,
+                    unit.tree,
+                    f"{len(stale)} registry name(s) no longer defined "
+                    f"by metrics.py (e.g. {stale[0]!r}) — run "
+                    f"`python -m tools.lint.gen_metric_registry`",
+                )
+            )
+        return out
+
+    def _check_docs(self, unit: FileUnit) -> List[Finding]:
+        """Docs drift — repo runs only (unit.root is None for in-memory
+        fixtures, keeping them hermetic)."""
+        if unit.root is None:
+            return []
+        path = os.path.join(unit.root, _DOCS_FILE)
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            return []
+        out: List[Finding] = []
+        seen: Set[str] = set()
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            for token in _DOC_TOKEN_RE.findall(line):
+                norm = re.sub(r"<[^<>]*>|\{[^{}]*\}", "*", token)
+                if "*" in norm:
+                    head = norm.split(".", 1)[0]
+                    if "." not in norm or head not in _FAMILIES:
+                        continue
+                    if norm in seen:
+                        continue
+                    seen.add(norm)
+                    if not _glob_known(norm):
+                        out.append(
+                            Finding(
+                                pass_id=self.pass_id,
+                                file=_DOCS_FILE,
+                                line=lineno,
+                                message=(
+                                    f"docs name dynamic metric family "
+                                    f"{token!r} which no registered "
+                                    f"pattern covers"
+                                ),
+                                context="<module>",
+                            )
+                        )
+                    continue
+                if not _DOTTED_RE.match(norm):
+                    continue
+                if norm.split(".", 1)[0] not in _FAMILIES:
+                    continue
+                if norm in seen:
+                    continue
+                seen.add(norm)
+                if not _known(norm):
+                    out.append(
+                        Finding(
+                            pass_id=self.pass_id,
+                            file=_DOCS_FILE,
+                            line=lineno,
+                            message=(
+                                f"docs reference metric {token!r} "
+                                f"which the registry doesn't know — "
+                                f"renamed without updating the table?"
+                            ),
+                            context="<module>",
+                        )
+                    )
+        return out

@@ -1,0 +1,377 @@
+"""resource-pairing: every acquire reaches a release on ALL CFG paths.
+
+The paper's pipeline runs under a strict host-memory budget; a byte
+reservation (or admission window, breaker probe slot, open multipart
+handle) that leaks on an exception path doesn't crash anything — it
+silently shrinks the budget until the pipeline wedges, which is the
+worst failure mode a checkpointing system can have mid-refactor.  The
+lexical lock-discipline pass can only ask "is there a release somewhere
+in this function"; this pass asks the real question on the function's
+CFG (``FileUnit.cfg``): *can control reach EXIT or the raise-exit from
+the acquire without passing a release?*  ``finally`` blocks and context
+managers are exactly the shapes that make the answer "no".
+
+Tracked resources (method-name + receiver-shape matched — receivers
+whose name contains ``lock`` belong to lock-discipline and are skipped
+here):
+
+- **byte/credit gates** — ``.acquire(n)``/``.reserve(n)`` on a
+  ``*gate*``/``*window*`` receiver must reach ``.release(...)`` on the
+  same receiver (the stripe stream's ``_ByteGate`` discipline);
+- **budget admission** — ``.debit(...)`` on a ``*budget*`` receiver
+  must reach ``.credit(...)``;
+- **breaker probes** — ``.allow()``/``.check()`` on a ``*breaker*``
+  receiver claims the half-open probe slot; every path out of the
+  *taken* branch must reach ``record_success``/``record_failure``/
+  ``release_probe`` (or hand the breaker off);
+- **striped handles** — ``h = [await] storage.begin_striped_write(...)``
+  must reach ``h.complete()``/``h.abort()`` on every path.
+
+Sanctioned escapes (counted as releases):
+
+- the acquire sits in a ``with``/``async with`` item — ``__exit__``
+  releases on unwind by construction;
+- the resource is handed off: passed as a *call argument* (e.g.
+  ``_abort_quiet(handle)``, ``retry_impl(..., breaker)``), returned, or
+  stored on an attribute/container — ownership moved to code with its
+  own CFG.
+
+The defining modules (``resilience/breaker.py``, the ``_ByteGate``
+internals) manage their own state and are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from .. import cfg as cfgmod
+from ..core import (
+    FileUnit,
+    Finding,
+    LintPass,
+    call_name,
+    calls_in_body,
+    receiver_name,
+)
+
+_EXEMPT_FILES = frozenset(
+    {
+        "torchsnapshot_tpu/resilience/breaker.py",
+    }
+)
+_EXEMPT_CLASSES = frozenset({"_ByteGate"})
+
+
+class _Spec:
+    __slots__ = ("kind", "acquires", "releases", "receiver_re", "advice")
+
+    def __init__(self, kind, acquires, releases, receiver_re, advice):
+        self.kind = kind
+        self.acquires = frozenset(acquires)
+        self.releases = frozenset(releases)
+        self.receiver_re = re.compile(receiver_re)
+        self.advice = advice
+
+
+SPECS: Tuple[_Spec, ...] = (
+    _Spec(
+        "byte-gate",
+        ("acquire", "reserve"),
+        ("release",),
+        r"(?i)(gate|window)",
+        "release in a finally (or restructure as a context manager)",
+    ),
+    _Spec(
+        "budget",
+        ("debit",),
+        ("credit",),
+        r"(?i)budget",
+        "credit in a finally, or hand the debited pipeline to an owner "
+        "that credits on completion",
+    ),
+    _Spec(
+        "breaker",
+        ("allow", "check"),
+        ("record_success", "record_failure", "release_probe"),
+        r"(?i)breaker",
+        "record an outcome (or release_probe) on every path, including "
+        "the exceptional ones",
+    ),
+)
+
+
+def _stmt_of(unit: FileUnit, node: ast.AST, func: ast.AST) -> Optional[ast.stmt]:
+    """The nearest enclosing statement of ``node`` — the CFG node whose
+    evaluation contains it.  Every statement kind gets a CFG node
+    except the ``try`` header (which owns no expressions), so the
+    nearest statement is the right granularity for start/barrier
+    resolution."""
+    if isinstance(node, ast.stmt):
+        return node
+    for anc in unit.ancestors(node):
+        if anc is func:
+            return None
+        if isinstance(anc, ast.stmt):
+            return anc
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    }
+
+
+def _is_resource_value(expr: Optional[ast.expr], root: str) -> bool:
+    """Is ``expr`` the resource ITSELF (``handle``, ``self._gate``, or
+    a tuple/list carrying one) — as opposed to an expression that
+    merely mentions it (``handle.write_part(...)``,
+    ``gate.held()``)?  Only the former transfers ownership; counting
+    any mention would silently disable the leak check for ordinary
+    result assignments."""
+    if expr is None:
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id == root
+    if isinstance(expr, ast.Attribute):
+        return expr.attr == root
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(_is_resource_value(e, root) for e in expr.elts)
+    return False
+
+
+def _in_with_item(unit: FileUnit, call: ast.Call) -> bool:
+    cur: ast.AST = call
+    for anc in unit.ancestors(call):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if cur is item.context_expr or any(
+                    n is call for n in ast.walk(item.context_expr)
+                ):
+                    return True
+        if isinstance(anc, ast.stmt):
+            # only the immediate with-statement's items count
+            if not isinstance(anc, (ast.With, ast.AsyncWith)):
+                return False
+        cur = anc
+    return False
+
+
+def _start_nodes(
+    g: "cfgmod.CFG", stmt: ast.stmt, call: ast.Call
+) -> List[int]:
+    """Where the acquired state first exists: the acquire statement's
+    non-exceptional successors.  For an acquire inside an ``if`` test
+    (the ``breaker.allow()`` idiom) only the *true* branch holds the
+    probe slot."""
+    idx = g.index_of.get(stmt)
+    if idx is None:
+        return []
+    if isinstance(stmt, ast.If) and any(
+        n is call for n in ast.walk(stmt.test)
+    ):
+        return g.successors(idx, labels=("true",))
+    return g.successors(idx, labels=("next", "true", "false", "back"))
+
+
+class ResourcePairingPass(LintPass):
+    pass_id = "resource-pairing"
+    description = (
+        "budget/window/breaker/handle acquires must reach a release on "
+        "every CFG path, exceptional paths included"
+    )
+
+    def run(self, unit: FileUnit) -> Iterable[Finding]:
+        if unit.relpath in _EXEMPT_FILES:
+            return []
+        out: List[Finding] = []
+        for qualname, fn in unit.functions():
+            if any(part in _EXEMPT_CLASSES for part in qualname.split(".")):
+                continue
+            out.extend(self._check_function(unit, fn))
+        return out
+
+    # ---------------------------------------------------------------
+
+    def _check_function(
+        self, unit: FileUnit, fn: ast.AST
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        body_calls = list(calls_in_body(fn))
+        g = None  # built on first demand
+
+        for spec in SPECS:
+            acquires = []
+            for call in body_calls:
+                func = call.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                if func.attr not in spec.acquires:
+                    continue
+                root = receiver_name(func)
+                if "lock" in root.lower():
+                    continue  # lock-discipline territory
+                if not spec.receiver_re.search(root):
+                    continue
+                acquires.append((call, root))
+            if not acquires:
+                continue
+            if g is None:
+                g = unit.cfg(fn)
+            # barrier statements: releases on the same receiver root,
+            # or statements that hand the receiver off
+            for call, root in acquires:
+                if _in_with_item(unit, call):
+                    continue
+                stmt = _stmt_of(unit, call, fn)
+                if stmt is None:
+                    continue
+                if isinstance(stmt, ast.Return):
+                    # `return gate.acquire(n)` — a thin delegating
+                    # wrapper hands the obligation to its caller
+                    continue
+                barriers = self._release_barriers(
+                    g, body_calls, unit, fn, spec.releases, root
+                )
+                starts = _start_nodes(g, stmt, call)
+                seen = g.reach(starts, barriers=barriers)
+                if cfgmod.EXIT in seen or cfgmod.RAISE in seen:
+                    leak = (
+                        "an exceptional path"
+                        if cfgmod.RAISE in seen and cfgmod.EXIT not in seen
+                        else "a path"
+                    )
+                    out.append(
+                        self.finding(
+                            unit,
+                            call,
+                            f"{spec.kind}: {root}.{call.func.attr}() can "
+                            f"reach function exit via {leak} that never "
+                            f"{'/'.join(sorted(spec.releases))}s — "
+                            f"{spec.advice}",
+                        )
+                    )
+
+        out.extend(self._check_striped_handles(unit, fn, body_calls))
+        return out
+
+    def _release_barriers(
+        self,
+        g: "cfgmod.CFG",
+        body_calls: Sequence[ast.Call],
+        unit: FileUnit,
+        fn: ast.AST,
+        releases: frozenset,
+        root: str,
+    ) -> Set[int]:
+        barriers: Set[int] = set()
+        for call in body_calls:
+            func = call.func
+            is_release = (
+                isinstance(func, ast.Attribute)
+                and func.attr in releases
+                and receiver_name(func) == root
+            )
+            # handoff: the receiver appears as an argument to any call
+            handoff = any(
+                isinstance(a, (ast.Name, ast.Attribute))
+                and (
+                    (isinstance(a, ast.Name) and a.id == root)
+                    or (isinstance(a, ast.Attribute) and a.attr == root)
+                )
+                for a in [
+                    *call.args,
+                    *(kw.value for kw in call.keywords),
+                ]
+            )
+            if not (is_release or handoff):
+                continue
+            stmt = _stmt_of(unit, call, fn)
+            if stmt is not None and stmt in g.index_of:
+                barriers.add(g.index_of[stmt])
+        # returning the resource ITSELF is a handoff too (returning a
+        # value that merely mentions it — `return gate.held()` — is
+        # not: the reservation stays this function's obligation)
+        for idx, node in enumerate(g.nodes):
+            if isinstance(node, ast.Return) and _is_resource_value(
+                node.value, root
+            ):
+                barriers.add(idx)
+        return barriers
+
+    # ------------------------------------------------- striped handles
+
+    def _check_striped_handles(
+        self, unit: FileUnit, fn: ast.AST, body_calls: Sequence[ast.Call]
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        # find `h = [await] <storage>.begin_striped_write(...)`
+        opens: List[Tuple[ast.stmt, str, ast.Call]] = []
+        for node in calls_in_body(fn):
+            if call_name(node) != "begin_striped_write":
+                continue
+            stmt = _stmt_of(unit, node, fn)
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if len(stmt.targets) == 1 and isinstance(
+                stmt.targets[0], ast.Name
+            ):
+                opens.append((stmt, stmt.targets[0].id, node))
+        if not opens:
+            return out
+        g = unit.cfg(fn)
+        for stmt, hname, call in opens:
+            barriers: Set[int] = set()
+            for c in body_calls:
+                func = c.func
+                closes = (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in ("complete", "abort")
+                    and receiver_name(func) == hname
+                )
+                handoff = any(
+                    isinstance(a, ast.Name) and a.id == hname
+                    for a in [*c.args, *(kw.value for kw in c.keywords)]
+                )
+                if not (closes or handoff):
+                    continue
+                cstmt = _stmt_of(unit, c, fn)
+                if cstmt is not None and cstmt in g.index_of:
+                    barriers.add(g.index_of[cstmt])
+            for idx, node in enumerate(g.nodes):
+                # `return handle` / `self._h = handle` transfer the
+                # handle itself; `etag = handle.write_part(...)` does
+                # NOT — it is an ordinary result assignment and the
+                # close obligation stays here
+                if (
+                    isinstance(node, ast.Return)
+                    and _is_resource_value(node.value, hname)
+                ) or (
+                    isinstance(node, ast.Assign)
+                    and node is not stmt
+                    and _is_resource_value(node.value, hname)
+                ):
+                    barriers.add(idx)  # returned or re-stored: handoff
+            sidx = g.index_of.get(stmt)
+            if sidx is None:
+                continue
+            starts = g.successors(
+                sidx, labels=("next", "true", "false", "back")
+            )
+            seen = g.reach(starts, barriers=barriers)
+            if cfgmod.EXIT in seen or cfgmod.RAISE in seen:
+                out.append(
+                    self.finding(
+                        unit,
+                        call,
+                        f"striped-handle: {hname} = begin_striped_write"
+                        f"(...) can reach function exit without "
+                        f"{hname}.complete()/{hname}.abort() — an "
+                        f"unaborted multipart upload bills storage "
+                        f"forever; close the handle on every path "
+                        f"(abort under except/finally)",
+                    )
+                )
+        return out

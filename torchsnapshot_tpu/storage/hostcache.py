@@ -130,6 +130,20 @@ def _read_local(cfile: str, read_io: ReadIO) -> Any:
         return out
 
 
+def _close_abandoned_open(fut: Any) -> None:
+    """Done-callback for an executor ``open`` whose awaiter was
+    cancelled: the fd exists only inside the dropped future, so close
+    it here or it pins the (already-unlinked) temp inode until GC."""
+    try:
+        fobj = fut.result()
+    except (OSError, asyncio.CancelledError):
+        return  # open itself failed/was cancelled: nothing to close
+    try:
+        fobj.close()
+    except OSError:
+        pass
+
+
 async def _fill_from_inner(
     plugin: "HostCachedStoragePlugin", path: str, cfile: str
 ) -> int:
@@ -165,7 +179,22 @@ async def _fill_from_inner(
             await loop.run_in_executor(None, publish_whole)
         else:
             buf = np.empty(part, dtype=np.uint8)
-            with open(tmp, "wb") as f:
+            # open()/close() are synchronous metadata syscalls — on a
+            # contended or networked cache filesystem they stall the
+            # loop just like the writes would, so all three run on the
+            # executor (the writes always did).  Each await is a new
+            # cancellation point the synchronous form didn't have: a
+            # cancel landing mid-open would drop the worker thread's
+            # fd on the floor (pinning the unlinked tmp inode), so the
+            # abandoned result is closed via a done-callback, and the
+            # close is shielded so the fd never outlives the fill.
+            open_fut = loop.run_in_executor(None, open, tmp, "wb")
+            try:
+                f = await asyncio.shield(open_fut)
+            except asyncio.CancelledError:
+                open_fut.add_done_callback(_close_abandoned_open)
+                raise
+            try:
                 for lo in range(0, size, part):
                     hi = min(lo + part, size)
                     span_io = ReadIO(
@@ -177,6 +206,12 @@ async def _fill_from_inner(
                     view = memoryview(span_io.buf).cast("B")
                     await loop.run_in_executor(None, f.write, view)
                     total += view.nbytes
+            finally:
+                # shield: the close itself always completes in the
+                # worker thread even if this await is cancelled
+                await asyncio.shield(
+                    loop.run_in_executor(None, f.close)
+                )
         os.replace(tmp, cfile)
     except BaseException:
         _unlink_quiet(tmp)

@@ -162,7 +162,10 @@ async def striped_write(
             # the gather without an errs entry, and MUST still abort —
             # an unaborted S3 multipart upload bills storage forever.
             # shield: the abort must survive the cancellation that
-            # triggered it.
+            # triggered it.  The counter increments BEFORE the shielded
+            # await on purpose: a second cancellation landing during
+            # the shield re-raises past anything after it, and an abort
+            # that actually ran must not vanish from the metric.
             obs.counter(obs.STRIPE_ABORTS).inc()
             await asyncio.shield(_abort_quiet(handle))
             raise
@@ -468,7 +471,8 @@ async def streamed_part_write(
             if errs:
                 raise errs[0]
         except BaseException:
-            # outer cancellation must abort too (see striped_write)
+            # outer cancellation must abort too (see striped_write,
+            # including why the counter precedes the shielded await)
             obs.counter(obs.STRIPE_ABORTS).inc()
             await asyncio.shield(_abort_quiet(handle))
             raise

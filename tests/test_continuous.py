@@ -1,0 +1,634 @@
+"""Continuous per-step checkpointing (continuous/): delta replication,
+marker-last loss bounds, recovery source ladder, durable promotion,
+retention, preemption drain, and topology-aware peer choice."""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import (
+    ContinuousCheckpointer,
+    StateDict,
+    knobs,
+    obs,
+    recover_state,
+)
+from torchsnapshot_tpu.cas.store import chunk_location
+from torchsnapshot_tpu.continuous import ContinuousStore
+from torchsnapshot_tpu.resilience import preemption
+from torchsnapshot_tpu.tier.promoter import drain_promotions
+from torchsnapshot_tpu.topology import Topology
+
+CHUNK = 4096
+N = 4096  # floats -> 4 chunks per leaf at CHUNK
+
+
+def _state(seed=0.0):
+    return {
+        "app": StateDict(
+            w=np.arange(N, dtype=np.float32) + seed,
+            meta={"lr": 0.1, "name": "run7"},
+        )
+    }
+
+
+def _dest():
+    return {
+        "app": StateDict(
+            w=np.zeros(N, np.float32), meta={"lr": 0.0, "name": ""}
+        )
+    }
+
+
+def _cc(tmp_path, **kw):
+    kw.setdefault("replica_roots", [str(tmp_path / "peer")])
+    kw.setdefault("chunk_size_bytes", CHUNK)
+    return ContinuousCheckpointer(str(tmp_path / "local"), **kw)
+
+
+def _counter(name):
+    return obs.counter(name).value
+
+
+def test_step_and_recover_roundtrip_from_peer(tmp_path):
+    cc = _cc(tmp_path)
+    state = _state()
+    try:
+        for s in range(1, 4):
+            state["app"]["w"][s] += 1.0
+            assert cc.step(state, s)
+        cc.drain()
+        assert cc.last_step() == 3
+        assert cc.last_peer_step() == 3
+    finally:
+        cc.close()
+    dest = _dest()
+    res = recover_state(dest, peers=[str(tmp_path / "peer" / "r0")])
+    assert res is not None and res["step"] == 3 and res["source"] == "peer"
+    np.testing.assert_array_equal(dest["app"]["w"], state["app"]["w"])
+    assert dest["app"]["meta"] == {"lr": 0.1, "name": "run7"}
+    assert res["seconds"] < 30
+
+
+def test_delta_replication_moves_only_changed_chunks(tmp_path):
+    cc = _cc(tmp_path)
+    state = _state()
+    try:
+        cc.step(state, 1)
+        cc.drain()
+        rep0 = _counter(obs.CONTINUOUS_BYTES_REPLICATED)
+        skip0 = _counter(obs.CONTINUOUS_BYTES_SKIPPED)
+        # touch ONE chunk's worth of the tensor
+        state["app"]["w"][0] += 1.0
+        cc.step(state, 2)
+        cc.drain()
+        moved = _counter(obs.CONTINUOUS_BYTES_REPLICATED) - rep0
+        skipped = _counter(obs.CONTINUOUS_BYTES_SKIPPED) - skip0
+        # 2 targets (local+peer) x 1 changed 4KB chunk (+ small meta
+        # leaf) — far below the 16KB tensor x 2 a full copy would be
+        assert moved < 2 * state["app"]["w"].nbytes
+        assert skipped > 0
+    finally:
+        cc.close()
+
+
+def test_failed_replication_keeps_previous_step_then_heals(tmp_path):
+    """Marker-last: a target whose replication fails stays at its
+    previous COMPLETE step (never torn), training continues, and the
+    next successful step heals the target."""
+    cc = _cc(tmp_path)
+    state = _state()
+    peer_store = str(tmp_path / "peer" / "r0")
+    try:
+        cc.step(state, 1)
+        cc.drain()
+        e0 = _counter(obs.CONTINUOUS_REPLICATION_ERRORS)
+        with knobs.override_failpoints("continuous.replicate=io"):
+            state["app"]["w"][0] += 1.0
+            assert cc.step(state, 2)  # step() itself must not raise
+            cc.drain()
+        assert _counter(obs.CONTINUOUS_REPLICATION_ERRORS) > e0
+        dest = _dest()
+        res = recover_state(dest, peers=[peer_store])
+        assert res["step"] == 1  # previous complete step, not a torn 2
+        state["app"]["w"][1] += 1.0
+        cc.step(state, 3)
+        cc.drain()
+        res = recover_state(_dest(), peers=[peer_store])
+        assert res["step"] == 3
+    finally:
+        cc.close()
+
+
+def test_recover_source_ladder_local_peer_durable(tmp_path):
+    cc = _cc(tmp_path, durable_root=str(tmp_path / "durable"),
+             promote_every_n=1)
+    state = _state()
+    try:
+        cc.step(state, 1)
+        cc.drain()
+        drain_promotions()
+    finally:
+        cc.close()
+    local = str(tmp_path / "local" / "r0")
+    peer = str(tmp_path / "peer" / "r0")
+    durable = str(tmp_path / "durable" / "r0")
+    l0 = _counter(obs.CONTINUOUS_RESTORES_FROM_LOCAL)
+    res = recover_state(_dest(), local=local, peers=[peer], durable=durable)
+    assert res["source"] == "local"
+    assert _counter(obs.CONTINUOUS_RESTORES_FROM_LOCAL) == l0 + 1
+    # local wiped -> peer
+    import shutil
+
+    shutil.rmtree(local)
+    res = recover_state(_dest(), local=local, peers=[peer], durable=durable)
+    assert res["source"] == "peer"
+    # peer wiped too -> durable (the both-dead degradation)
+    shutil.rmtree(peer)
+    dest = _dest()
+    res = recover_state(dest, local=local, peers=[peer], durable=durable)
+    assert res["source"] == "durable" and res["step"] == 1
+    np.testing.assert_array_equal(dest["app"]["w"], state["app"]["w"])
+    # everything gone -> clean cold start, no wedge
+    shutil.rmtree(durable)
+    assert recover_state(
+        _dest(), local=local, peers=[peer], durable=durable
+    ) is None
+
+
+def test_promotion_pins_head_and_survives_both_dead(tmp_path):
+    """Every-N promotion through the tier promoter: the durable mirror
+    commits the HEAD as of enqueue time (pinned marker), and a
+    both-dead recovery restores the last PROMOTED step."""
+    cc = _cc(tmp_path, durable_root=str(tmp_path / "durable"),
+             promote_every_n=2)
+    state = _state()
+    try:
+        for s in range(1, 6):  # promotions at steps 1, 3, 5
+            state["app"]["w"][s] += 1.0
+            cc.step(state, s)
+        cc.drain()
+        drain_promotions()
+        cc._sweep_promotions()
+        assert cc.last_durable_step() == 5
+        summary = cc.summary()
+        assert summary["last_durable_step"] == 5
+        assert summary["last_peer_step"] == 5
+    finally:
+        cc.close()
+    durable = str(tmp_path / "durable" / "r0")
+    head = ContinuousStore(durable).read_head()
+    assert head is not None and head["step"] == 5
+    dest = _dest()
+    res = recover_state(dest, durable=durable)
+    assert res["step"] == 5 and res["source"] == "durable"
+    np.testing.assert_array_equal(dest["app"]["w"], state["app"]["w"])
+
+
+def test_kill_switch_knob_disables_step(tmp_path):
+    cc = _cc(tmp_path)
+    try:
+        with knobs.override_continuous(False):
+            assert cc.step(_state(), 1) is False
+        assert cc.last_step() is None
+    finally:
+        cc.close()
+
+
+def test_retention_prunes_old_steps_but_head_restorable(tmp_path):
+    cc = _cc(tmp_path, retain_steps=2)
+    state = _state()
+    try:
+        for s in range(1, 6):
+            state["app"]["w"][:] += 1.0  # every chunk changes
+            cc.step(state, s)
+        cc.drain()
+    finally:
+        cc.close()
+    steps_dir = tmp_path / "peer" / "r0" / "steps"
+    resident = sorted(os.listdir(steps_dir))
+    assert len(resident) <= 2, resident
+    res = recover_state(_dest(), peers=[str(tmp_path / "peer" / "r0")])
+    assert res["step"] == 5
+
+
+def test_corrupt_peer_chunk_fails_closed_to_next_source(tmp_path):
+    cc = _cc(tmp_path, durable_root=str(tmp_path / "durable"),
+             promote_every_n=1)
+    state = _state()
+    try:
+        cc.step(state, 1)
+        cc.drain()
+        drain_promotions()
+    finally:
+        cc.close()
+    peer = str(tmp_path / "peer" / "r0")
+    # flip bytes in one replicated chunk: content key check must reject
+    head = ContinuousStore(peer).read_head()
+    manifest = ContinuousStore(peer).read_step_manifest(head["manifest"])
+    key = manifest["leaves"]["app/w"]["keys"][0]
+    victim = os.path.join(peer, chunk_location(key))
+    with open(victim, "r+b") as f:
+        f.write(b"\xff\xff\xff\xff")
+    dest = _dest()
+    res = recover_state(
+        dest, peers=[peer], durable=str(tmp_path / "durable" / "r0")
+    )
+    assert res["source"] == "durable"
+    np.testing.assert_array_equal(dest["app"]["w"], state["app"]["w"])
+
+
+def test_recover_strict_missing_leaves(tmp_path):
+    cc = _cc(tmp_path)
+    try:
+        cc.step({"app": StateDict(w=np.ones(8, np.float32))}, 1)
+        cc.drain()
+    finally:
+        cc.close()
+    peer = str(tmp_path / "peer" / "r0")
+    grown = {
+        "app": StateDict(
+            w=np.zeros(8, np.float32), extra=np.ones(4, np.float32)
+        )
+    }
+    with pytest.raises(KeyError):
+        recover_state(grown, peers=[peer], strict=True)
+    res = recover_state(grown, peers=[peer], strict=False)
+    assert res["step"] == 1
+    np.testing.assert_array_equal(
+        grown["app"]["w"], np.ones(8, np.float32)
+    )
+    # the template's own value survives for the missing leaf
+    np.testing.assert_array_equal(
+        grown["app"]["extra"], np.ones(4, np.float32)
+    )
+
+
+def test_preemption_drain_finishes_inflight_replication(tmp_path):
+    cc = _cc(tmp_path)
+    state = _state()
+    try:
+        d0 = _counter(obs.CONTINUOUS_PREEMPTION_DRAINS)
+        # slow the replication so the drain has something in flight
+        with knobs.override_failpoints("continuous.replicate=delay50"):
+            cc.step(state, 1)
+            completed = preemption.notify_preemption(grace_s=30.0)
+        assert completed >= 1
+        assert _counter(obs.CONTINUOUS_PREEMPTION_DRAINS) > d0
+        # the drained step is fully on the peer
+        res = recover_state(
+            _dest(), peers=[str(tmp_path / "peer" / "r0")]
+        )
+        assert res["step"] == 1
+    finally:
+        cc.close()
+
+
+def test_heartbeat_published_and_cleared(tmp_path):
+    from torchsnapshot_tpu import LocalCoordinator
+
+    coord = LocalCoordinator()
+    cc = _cc(tmp_path, coordinator=coord)
+    try:
+        cc.step(_state(), 1)
+        cc.drain()
+        hb = cc.heartbeats()
+        assert hb == {0: 1}
+    finally:
+        cc.close()
+    # publish paired with delete: close() cleared the key
+    assert not any("/hb/" in k for k in coord._kv)
+
+
+def test_summary_block_reports_active_loop(tmp_path):
+    from torchsnapshot_tpu.continuous import summary_block
+
+    cc = _cc(tmp_path)
+    try:
+        cc.step(_state(), 7)
+        cc.drain()
+        block = summary_block()
+        assert block is not None
+        assert block["last_step"] == 7
+        assert block["peer_targets"] == 1
+    finally:
+        cc.close()
+
+
+def test_asymmetric_target_failure_heals_completely(tmp_path):
+    """Review regression: when only the PEER's replication fails while
+    the local store advances, later steps must re-send every chunk the
+    peer is missing — a peer HEAD may never reference chunks that were
+    skipped from staging because the LOCAL store held them (delta
+    staging skips on the intersection of holds, not the union)."""
+    peer_ns = f"ccpeer_{os.getpid()}"
+    # local on fs, peer on memory:// so a memory-only failpoint hits
+    # exactly one target
+    cc = ContinuousCheckpointer(
+        str(tmp_path / "local"),
+        replica_roots=[f"memory://{peer_ns}"],
+        chunk_size_bytes=CHUNK,
+    )
+    state = _state()
+    try:
+        cc.step(state, 1)
+        cc.drain()
+        with knobs.override_failpoints("storage.memory.write=io"):
+            state["app"]["w"][0] += 1.0  # one chunk changes
+            cc.step(state, 2)
+            cc.drain()
+        # peer stayed at step 1 (its previous complete step)
+        assert cc.summary()["target_heads"][f"memory://{peer_ns}/r0"] == 1
+        # fault clears; step 3 changes a DIFFERENT chunk — the peer
+        # must still receive step 2's chunk it missed
+        state["app"]["w"][CHUNK // 4 + 1] += 1.0
+        cc.step(state, 3)
+        cc.drain()
+        dest = _dest()
+        res = recover_state(dest, peers=[f"memory://{peer_ns}/r0"])
+        assert res is not None and res["step"] == 3, res
+        np.testing.assert_array_equal(dest["app"]["w"], state["app"]["w"])
+    finally:
+        cc.close()
+        from torchsnapshot_tpu.storage.memory import reset_namespace
+
+        reset_namespace(peer_ns)
+
+
+def test_retention_never_prunes_a_lagging_targets_head(tmp_path):
+    """Review regression: a peer stuck at an old step (replication
+    failing) keeps that step's chunks and manifest through the other
+    targets' retention sweeps — pruning would destroy the only replica
+    the peer holds while it is lagging."""
+    peer_ns = f"cclag_{os.getpid()}"
+    cc = ContinuousCheckpointer(
+        str(tmp_path / "local"),
+        replica_roots=[f"memory://{peer_ns}"],
+        chunk_size_bytes=CHUNK,
+        retain_steps=2,
+    )
+    state = _state()
+    try:
+        cc.step(state, 1)
+        cc.drain()
+        with knobs.override_failpoints("storage.memory.write=io"):
+            for s in range(2, 6):  # far past retain_steps
+                state["app"]["w"][:] += 1.0
+                cc.step(state, s)
+            cc.drain()
+            # mid-outage: the lagging peer still serves its step 1
+            dest = _dest()
+            res = recover_state(dest, peers=[f"memory://{peer_ns}/r0"])
+            assert res is not None and res["step"] == 1, res
+    finally:
+        cc.close()
+        from torchsnapshot_tpu.storage.memory import reset_namespace
+
+        reset_namespace(peer_ns)
+
+
+def test_recover_prefers_freshest_source_over_ladder_order(tmp_path):
+    """Review regression: a LAGGING local store (its replication
+    failed some steps ago) must not win over a fresher peer just by
+    ladder position — recovery probes HEADs and restores the newest."""
+    local_ns = f"cclocal_{os.getpid()}"
+    # local on memory:// so a memory-only failpoint lags exactly it
+    cc = ContinuousCheckpointer(
+        f"memory://{local_ns}",
+        replica_roots=[str(tmp_path / "peer")],
+        chunk_size_bytes=CHUNK,
+    )
+    state = _state()
+    try:
+        cc.step(state, 1)
+        cc.drain()
+        with knobs.override_failpoints("storage.memory.write=io"):
+            state["app"]["w"][0] += 1.0
+            cc.step(state, 2)
+            cc.drain()
+        # local lags at 1, peer advanced to 2
+        assert cc.summary()["target_heads"][f"memory://{local_ns}/r0"] == 1
+        dest = _dest()
+        res = recover_state(
+            dest,
+            local=f"memory://{local_ns}/r0",
+            peers=[str(tmp_path / "peer" / "r0")],
+        )
+        assert res["step"] == 2 and res["source"] == "peer", res
+        np.testing.assert_array_equal(dest["app"]["w"], state["app"]["w"])
+        # equal freshness: ladder order (local first) breaks the tie
+        state["app"]["w"][1] += 1.0
+        cc.step(state, 3)
+        cc.drain()
+        res = recover_state(
+            _dest(),
+            local=f"memory://{local_ns}/r0",
+            peers=[str(tmp_path / "peer" / "r0")],
+        )
+        assert res["step"] == 3 and res["source"] == "local", res
+    finally:
+        cc.close()
+        from torchsnapshot_tpu.storage.memory import reset_namespace
+
+        reset_namespace(local_ns)
+
+
+def test_durable_manifest_retention(tmp_path):
+    """Review regression: superseded durable step manifests are GC'd —
+    a long promoting run must not accrete one manifest per promotion
+    in the durable tier."""
+    cc = _cc(tmp_path, durable_root=str(tmp_path / "durable"),
+             promote_every_n=1)
+    state = _state()
+    try:
+        for s in range(1, 5):
+            state["app"]["w"][:] += 1.0
+            cc.step(state, s)
+            cc.drain()
+            drain_promotions()
+        cc.step(state, 5)
+        cc.drain()
+        drain_promotions()
+        assert cc.last_durable_step() == 5  # sweeps + prunes
+    finally:
+        cc.close()
+    steps_dir = tmp_path / "durable" / "r0" / "steps"
+    resident = sorted(os.listdir(steps_dir))
+    assert resident == ["0000000005.json"], resident
+    res = recover_state(_dest(), durable=str(tmp_path / "durable" / "r0"))
+    assert res["step"] == 5
+
+
+def test_retention_defers_manifest_gc_for_pending_promotions(tmp_path):
+    """Review regression: a promoter lagging more than retain_steps
+    must still find every queued step manifest in the local store —
+    retention defers manifest GC for steps with a pending promotion."""
+    from torchsnapshot_tpu.tier.promoter import get_promoter
+
+    promoter = get_promoter()
+    cc = _cc(tmp_path, durable_root=str(tmp_path / "durable"),
+             promote_every_n=1, retain_steps=2)
+    state = _state()
+    promoter.pause()
+    try:
+        for s in range(1, 5):  # every step promotes; promoter stalled
+            state["app"]["w"][:] += 1.0
+            cc.step(state, s)
+        cc.drain()
+        promoter.resume()
+        drain_promotions()  # raises if any queued job hit a FNF
+        assert cc.last_durable_step() == 4
+    finally:
+        promoter.resume()
+        cc.close()
+    dest = _dest()
+    res = recover_state(dest, durable=str(tmp_path / "durable" / "r0"))
+    assert res["step"] == 4
+    np.testing.assert_array_equal(dest["app"]["w"], state["app"]["w"])
+
+
+def test_promotion_self_sufficient_after_earlier_group_fails(tmp_path):
+    """Review regression: a later promotion's delta is computed against
+    CONFIRMED durable residency only, so an earlier queued promotion
+    failing mid-copy can never produce a committed durable HEAD that
+    references chunks nobody promoted."""
+    from torchsnapshot_tpu.tier.promoter import get_promoter
+
+    promoter = get_promoter()
+    cc = _cc(tmp_path, durable_root=str(tmp_path / "durable"),
+             promote_every_n=1)
+    state = _state()
+    promoter.pause()
+    try:
+        cc.step(state, 1)
+        cc.drain()
+        state["app"]["w"][0] += 1.0
+        cc.step(state, 2)
+        cc.drain()
+        # both promotions queued; the FIRST data job dies
+        with knobs.override_failpoints("tier.promote.data=runtime:1:1"):
+            promoter.resume()
+            with pytest.raises(RuntimeError):
+                drain_promotions()
+        assert cc.last_durable_step() == 2
+    finally:
+        promoter.resume()
+        cc.close()
+    # the surviving promotion's durable store is COMPLETE at step 2
+    dest = _dest()
+    res = recover_state(dest, durable=str(tmp_path / "durable" / "r0"))
+    assert res is not None and res["step"] == 2, res
+    np.testing.assert_array_equal(dest["app"]["w"], state["app"]["w"])
+
+
+def test_flight_record_and_doctor_carry_continuous_rollup(tmp_path, capsys):
+    """rank_payload stamps the active loop's summary; merge_payloads
+    rolls fleet floors; doctor renders the residency rows."""
+    from torchsnapshot_tpu.obs import aggregate
+    from torchsnapshot_tpu.__main__ import _render_doctor
+
+    cc = _cc(tmp_path)
+    try:
+        cc.step(_state(), 12)
+        cc.drain()
+        payload = aggregate.rank_payload(0, "take", aggregate.capture())
+        assert payload["continuous"]["last_step"] == 12
+        rec = aggregate.merge_payloads([payload], "take", str(tmp_path), 1)
+        assert rec["continuous"]["last_peer_step_floor"] == 12
+        _render_doctor(rec)
+        out = capsys.readouterr().out
+        assert "continuous: peer-step floor 12" in out
+        assert "rank 0: step 12" in out
+    finally:
+        cc.close()
+
+
+def test_stats_cli_continuous_rollup(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    cc = _cc(tmp_path)
+    try:
+        cc.step(_state(), 3)
+        cc.drain()
+    finally:
+        cc.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "torchsnapshot_tpu", "stats",
+            str(tmp_path / "peer"), "--json",
+        ],
+        capture_output=True, text=True, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    rollup = json.loads(out.stdout)
+    assert rollup["stores"]["r0"]["head_step"] == 3
+    assert rollup["stores"]["r0"]["pool_chunks"] > 0
+
+
+# ------------------------- topology-aware peer selection (tier + loop)
+
+
+def test_topology_replica_preference_prefers_other_slice():
+    """Uneven-slice regression (ROADMAP item 1 follow-up): on a 0,0,0,1
+    topology the lone rank of slice 1 is every slice-0 rank's FIRST
+    replica choice — a slice-0 preemption must not take both copies."""
+    topo = Topology.from_spec("0,0,0,1", rank=0, world_size=4)
+    pref = topo.replica_preference(0)
+    assert pref[0] == 3  # the different-slice rank leads
+    assert set(pref) == {1, 2, 3}
+    # and rank 3's own preference spreads into slice 0
+    assert Topology.from_spec("0,0,0,1", rank=3, world_size=4)
+    assert topo.replica_preference(3)[0] in (1, 2, 0)
+    assert topo.slice_of[topo.replica_preference(3)[0]] == 0
+
+
+def test_tier_pick_replica_targets_topology_aware():
+    from torchsnapshot_tpu.tier.plugin import TieredStoragePlugin
+
+    peers = [f"/fast/{r}" for r in range(4)]
+    plugin = TieredStoragePlugin.__new__(TieredStoragePlugin)
+    plugin.fast_url = peers[0]
+    plugin.replica_count = 1
+    topo = Topology.from_spec("0,0,0,1", rank=0, world_size=4)
+    assert plugin._pick_replica_targets(peers, 0, topo) == ["/fast/3"]
+    # flat/unknown topology: byte-identical to the old successor ring
+    assert plugin._pick_replica_targets(peers, 0, None) == ["/fast/1"]
+    flat = Topology.flat(0, 4)
+    assert plugin._pick_replica_targets(peers, 0, flat) == ["/fast/1"]
+
+
+def test_continuous_picks_different_slice_peer(tmp_path):
+    """The loop's peer choice rides the same preference: with an
+    explicit uneven topology, rank 0 mirrors to the slice-1 host."""
+    from torchsnapshot_tpu import LocalCoordinator
+
+    roots = [str(tmp_path / f"h{r}") for r in range(4)]
+
+    class _FourRankCoord(LocalCoordinator):
+        @property
+        def world_size(self):
+            return 4
+
+    coord = _FourRankCoord()
+    topo = Topology.from_spec("0,0,0,1", rank=0, world_size=4)
+    cc = ContinuousCheckpointer(
+        roots[0],
+        coordinator=coord,
+        peer_roots=roots,
+        replica_count=1,
+        topology=topo,
+        chunk_size_bytes=CHUNK,
+    )
+    try:
+        targets = cc._ensure_targets()
+        assert targets == [
+            f"{roots[0]}/r0",  # local first
+            f"{roots[3]}/r0",  # then the different-slice peer
+        ]
+    finally:
+        cc.close()

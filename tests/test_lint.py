@@ -1297,6 +1297,34 @@ def test_instrumentation_covers_topology_entry_points():
     ]
 
 
+def test_instrumentation_covers_continuous_entry_points():
+    """The continuous checkpoint loop's transitions (step / drain /
+    close / promote / restore_latest via the class check), the recovery
+    entry point (the measured RTO), the store's verified chunk fan-in,
+    and the SIGTERM drain are pinned into the instrumentation coverage
+    map — a preemption incident review reconstructs exactly these."""
+    from tools.lint.passes.instrumentation import MODULE_FUNCTIONS, TARGETS
+
+    cc_allow = TARGETS["torchsnapshot_tpu/continuous/loop.py"][
+        "ContinuousCheckpointer"
+    ]
+    # the loss-bounding transitions must NOT be allowlisted away
+    assert not {
+        "step", "drain", "close", "promote", "restore_latest"
+    } & cc_allow
+    assert {"read_state", "read_chunks"} & set(
+        TARGETS["torchsnapshot_tpu/continuous/store.py"][
+            "ContinuousStore"
+        ]
+    ) == set()
+    assert {"recover_state"} <= MODULE_FUNCTIONS[
+        "torchsnapshot_tpu/continuous/recover.py"
+    ]
+    assert {"notify_preemption"} <= MODULE_FUNCTIONS[
+        "torchsnapshot_tpu/resilience/preemption.py"
+    ]
+
+
 def test_collective_safety_designated_reader_kv_pattern_clean():
     """The fan-out restore's designated-reader protocol is rank-
     conditional BY DESIGN — the publisher kv_sets, siblings kv_get —
@@ -1770,6 +1798,50 @@ def test_kv_hygiene_publish_with_module_delete_clean():
     assert findings == []
 
 
+def test_kv_hygiene_heartbeat_without_delete_flagged():
+    """Liveness keys (the /hb/ segment — continuous/heartbeat.py's
+    convention) are publish-paired-with-delete like fan-out blobs: a
+    stale heartbeat reads as a live-but-stalled rank forever."""
+    findings = _run(
+        "kv-hygiene",
+        """
+        def beat(coord, ns, rank, step):
+            coord.kv_set(f"{ns}/hb/{rank}", str(step))
+        """,
+    )
+    assert len(findings) == 1
+    assert "heartbeat" in findings[0].message
+    assert "kv_try_delete" in findings[0].message
+
+
+def test_kv_hygiene_heartbeat_with_module_delete_clean():
+    findings = _run(
+        "kv-hygiene",
+        """
+        def beat(coord, ns, rank, step):
+            coord.kv_set(f"{ns}/hb/{rank}", str(step))
+
+        def clear(coord, ns, rank):
+            coord.kv_try_delete(f"{ns}/hb/{rank}")
+        """,
+    )
+    assert findings == []
+
+
+def test_kv_hygiene_plain_uid_kv_set_needs_no_delete():
+    """Only heartbeat-segment keys trigger the pairing rule — ordinary
+    uid-namespaced control keys (done-keys, arrive-keys) are consumed
+    by waiters and stay exempt."""
+    findings = _run(
+        "kv-hygiene",
+        """
+        def done(coord, uid, rank):
+            coord.kv_set(f"{uid}/tierdone/{rank}", "ok")
+        """,
+    )
+    assert findings == []
+
+
 def test_kv_hygiene_scoped_to_package():
     findings = _run(
         "kv-hygiene",
@@ -1847,6 +1919,29 @@ def test_metric_registry_failpoint_sites_excluded():
         """,
     )
     assert findings == []
+
+
+def test_metric_registry_failpoint_site_kwarg_excluded():
+    """A site literal handed through a ``failpoint_site=`` parameter
+    (the budgeted-write engine's pass-through, used by the continuous
+    loop) is a failpoint name, not a metric reference."""
+    findings = _run(
+        "metric-registry",
+        """
+        def replicate(items, storage, writer):
+            writer(items, storage, failpoint_site="continuous.replicate")
+        """,
+    )
+    assert findings == []
+    # ...but the same literal in a non-failpoint keyword still drifts
+    findings = _run(
+        "metric-registry",
+        """
+        def replicate(items, storage, writer):
+            writer(items, storage, label="continuous.bogus_name")
+        """,
+    )
+    assert len(findings) == 1
 
 
 def test_metric_registry_staleness_detected():

@@ -51,6 +51,32 @@ TARGETS: Dict[str, Dict[str, Set[str]]] = {
         # effect — bracketing them would record noise, not signal
         "Promoter": {"pause", "resume"},
     },
+    "torchsnapshot_tpu/continuous/loop.py": {
+        # the continuous checkpoint loop runs once per TRAINING STEP —
+        # step/drain/close/promote/restore_latest are the transitions a
+        # preemption incident review reconstructs and must stay span-
+        # covered; the allowlisted names are pure accessors over
+        # already-tracked state (step numbers, target heads) with no
+        # I/O
+        "ContinuousCheckpointer": {
+            "rank", "local_store_root", "durable_store_root",
+            "promote_every_n", "last_step", "last_peer_step",
+            "last_durable_step", "heartbeats", "summary",
+        },
+    },
+    "torchsnapshot_tpu/continuous/store.py": {
+        # read_state/read_chunks (the verified recovery fan-in — the
+        # RTO's I/O half) carry spans and are enforced; the allowlisted
+        # names are single-op delegations to sync storage calls whose
+        # latency is already attributed per backend by
+        # obs.instrument_storage — a second bracket per per-step write
+        # would double-record every HEAD flip
+        "ContinuousStore": {
+            "storage", "read_head", "read_step_manifest",
+            "write_manifest", "write_head", "delete_quiet",
+            "sync_close",
+        },
+    },
 }
 
 # file (repo-relative) -> module-level functions that MUST be bracketed
@@ -122,6 +148,14 @@ MODULE_FUNCTIONS: Dict[str, Set[str]] = {
     "torchsnapshot_tpu/topology/fanout.py": {
         "publish_object", "fetch_published",
     },
+    # continuous checkpointing (continuous/): recovery is THE
+    # preemption-incident operation (its wall time is the measured
+    # RTO), and the store's verified chunk fan-in is where a slow peer
+    # link would hide; both must be attributable in traces.  The
+    # preemption drain runs inside a SIGTERM grace window — a stalled
+    # drain burning the window must be visible post-hoc.
+    "torchsnapshot_tpu/continuous/recover.py": {"recover_state"},
+    "torchsnapshot_tpu/resilience/preemption.py": {"notify_preemption"},
 }
 
 _BRACKET_NAMES = {"log_event", "span"}

@@ -18,7 +18,13 @@ guard it:
    every module that publishes must also contain the paired
    ``kv_try_delete`` cleanup (the multislice PR's delete-after-final-
    barrier protocol), or repeated restores grow the coordination store
-   without bound.
+   without bound.  The same pairing rule covers HEARTBEAT/liveness
+   keys (any ``kv_set`` whose key carries a ``/hb/`` segment — the
+   continuous checkpoint loop's convention): a liveness key left
+   behind by a finished job reads as a live-but-stalled rank forever,
+   so a module that publishes heartbeats must also contain the
+   ``kv_try_delete`` that clears them at clean shutdown
+   (continuous/heartbeat.py).
 
 Scope: the ``torchsnapshot_tpu`` package.  ``coordination.py`` itself
 is the primitive layer — its keys are built from caller-supplied
@@ -54,6 +60,23 @@ def _literal_head(key: ast.expr) -> Optional[str]:
     return None
 
 
+def _key_literal_text(key: ast.expr) -> str:
+    """Every literal fragment of a key expression, concatenated —
+    enough to recognize conventional segments (``/hb/``) inside
+    f-strings and concatenations without evaluating runtime parts."""
+    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+        return key.value
+    if isinstance(key, ast.JoinedStr):
+        return "".join(
+            v.value
+            for v in key.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)
+        )
+    if isinstance(key, ast.BinOp) and isinstance(key.op, ast.Add):
+        return _key_literal_text(key.left) + _key_literal_text(key.right)
+    return ""
+
+
 class KvHygienePass(LintPass):
     pass_id = "kv-hygiene"
     description = (
@@ -66,6 +89,7 @@ class KvHygienePass(LintPass):
             return []
         out: List[Finding] = []
         publishes: List[ast.Call] = []
+        heartbeats: List[ast.Call] = []
         has_delete = False
         for node in ast.walk(unit.tree):
             if not isinstance(node, ast.Call):
@@ -77,6 +101,8 @@ class KvHygienePass(LintPass):
                 continue
             if name == "kv_publish_blob":
                 publishes.append(node)
+            elif "/hb/" in _key_literal_text(node.args[0]):
+                heartbeats.append(node)
             head = _literal_head(node.args[0])
             if head is not None:
                 out.append(
@@ -105,6 +131,24 @@ class KvHygienePass(LintPass):
                         "blobs are transient by contract (the store "
                         "never GCs them); delete after the final "
                         "barrier like topology/fanout.py does",
+                    )
+                )
+        if (
+            heartbeats
+            and not has_delete
+            and unit.relpath != _PRIMITIVE_FILE
+        ):
+            for node in heartbeats:
+                out.append(
+                    self.finding(
+                        unit,
+                        node,
+                        "kv_set() of a heartbeat/liveness key (/hb/) "
+                        "without a reachable kv_try_delete in this "
+                        "module — a stale liveness key reads as a "
+                        "live-but-stalled rank forever; clear it at "
+                        "clean shutdown like continuous/heartbeat.py "
+                        "does",
                     )
                 )
         return out

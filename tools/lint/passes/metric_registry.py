@@ -86,12 +86,21 @@ def _fstring_glob(node: ast.JoinedStr) -> Optional[str]:
 
 def _call_context_names(unit: FileUnit, node: ast.AST) -> Set[str]:
     """Trailing names of every call whose argument list (transitively)
-    contains ``node`` — the failpoint-site exclusion."""
+    contains ``node``, plus the KEYWORD name the literal is bound to —
+    the failpoint-site exclusion covers both ``failpoint("site")`` and
+    site strings handed through a ``failpoint_site=`` parameter (the
+    budgeted-write engine's pass-through)."""
     out: Set[str] = set()
     cur: ast.AST = node
     for anc in unit.ancestors(node):
         if isinstance(anc, ast.Call) and cur is not anc.func:
             out.add(call_name(anc))
+            for kw in anc.keywords:
+                # cur is the keyword node itself when the literal came
+                # through kw.value (ancestry walks Constant → keyword →
+                # Call)
+                if (kw is cur or kw.value is cur) and kw.arg:
+                    out.add(kw.arg)
         cur = anc
     return out
 

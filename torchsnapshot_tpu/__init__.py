@@ -23,6 +23,10 @@ from .coordination import (  # noqa: F401
     LocalCoordinator,
     get_default_coordinator,
 )
+from .continuous import (  # noqa: F401
+    ContinuousCheckpointer,
+    recover_state,
+)
 from .event import Event  # noqa: F401
 from .event_handlers import register_event_handler, unregister_event_handler  # noqa: F401
 from .manager import SnapshotManager, delete_snapshot  # noqa: F401
@@ -52,6 +56,8 @@ __all__ = [
     "TierConfig",
     "TieredStoragePlugin",
     "drain_promotions",
+    "ContinuousCheckpointer",
+    "recover_state",
     "SnapshotAbortedError",
     "VerifyResult",
     "verify_snapshot",

@@ -282,13 +282,145 @@ def _topology_stats_rollup(path: str) -> dict:
         return {}
 
 
+def _continuous_store_rollup(root: str) -> Optional[dict]:
+    """One continuous store's residency rollup, or None when ``root``
+    is not a continuous store (no decodable continuous HEAD).  Local
+    roots only: continuous stores live on host RAM/disk (and their
+    durable mirrors are operator-known paths); probing every REMOTE
+    stats target would add a full metadata GET to ordinary cloud
+    snapshot stats."""
+    import os
+
+    from .continuous import ContinuousStore
+
+    if "://" in root and not root.startswith("file://"):
+        return None
+    # cheap structural sniff before any read: every continuous store
+    # has a steps/ directory; an ordinary snapshot never does — this
+    # keeps stats on a plain snapshot from reading (and then
+    # re-reading) its whole metadata file just to rule continuous out
+    probe_base = root.split("://", 1)[-1]
+    if not os.path.isdir(os.path.join(probe_base, "steps")):
+        return None
+    store = ContinuousStore(root)
+    try:
+        try:
+            head = store.read_head()
+        except Exception:  # noqa: BLE001 — not a continuous store (a
+            # snapshot marker or garbage lands here); the caller falls
+            # through to the snapshot stats path
+            return None
+        if head is None:
+            return None
+        out: dict = {"root": root, "head_step": int(head["step"])}
+        try:
+            manifest = store.read_step_manifest(str(head["manifest"]))
+            keys = {
+                k
+                for rec in manifest["leaves"].values()
+                for k in rec["keys"]
+            }
+            from .cas.store import key_size
+
+            out["leaves"] = len(manifest["leaves"])
+            out["head_chunks"] = len(keys)
+            out["head_bytes"] = sum(key_size(k) for k in keys)
+            out["chunk_size"] = int(manifest["chunk_size"])
+        except Exception as e:  # noqa: BLE001 — torn mid-prune store:
+            # report the HEAD we could verify rather than failing stats
+            out["manifest_error"] = f"{e!r}"[:200]
+        # probe_base established above (local fs with a steps/ dir)
+        base = probe_base
+        if os.path.isdir(os.path.join(base, "steps")):
+            out["steps_resident"] = sorted(
+                int(n.split(".")[0])
+                for n in os.listdir(os.path.join(base, "steps"))
+                if n.endswith(".json") and n.split(".")[0].isdigit()
+            )
+            pool_bytes = 0
+            pool_chunks = 0
+            # the pool shares the CAS layout: objects/<kk>/<key>
+            chunks_dir = os.path.join(base, "objects")
+            for dirpath, _dirs, files in os.walk(chunks_dir):
+                for f in files:
+                    try:
+                        pool_bytes += os.path.getsize(
+                            os.path.join(dirpath, f)
+                        )
+                        pool_chunks += 1
+                    except OSError:
+                        pass  # racing the live loop's chunk pruning
+            out["pool_chunks"] = pool_chunks
+            out["pool_bytes"] = pool_bytes
+        return out
+    finally:
+        store.sync_close()
+
+
+def _continuous_stats(path: str) -> Optional[dict]:
+    """Stats rollup for a continuous root: either one store, or a host
+    root holding per-rank ``r<k>`` stores.  None when ``path`` is
+    neither (the snapshot stats path takes over)."""
+    import os
+    import re
+
+    one = _continuous_store_rollup(path)
+    if one is not None:
+        return {"path": path, "stores": {"": one}}
+    base = path.split("://", 1)[-1]
+    if "://" in path and not path.startswith("file://"):
+        return None
+    if not os.path.isdir(base):
+        return None
+    stores = {}
+    for name in sorted(os.listdir(base)):
+        if re.fullmatch(r"r\d+", name):
+            roll = _continuous_store_rollup(os.path.join(base, name))
+            if roll is not None:
+                stores[name] = roll
+    if not stores:
+        return None
+    return {"path": path, "stores": stores}
+
+
+def _render_continuous_stats(stats: dict) -> None:
+    print(f"{stats['path']}  [continuous store]")
+    for name, st in stats["stores"].items():
+        label = f"  {name or '.'}: "
+        line = f"{label}head step {st.get('head_step')}"
+        if "head_chunks" in st:
+            line += (
+                f", {st['leaves']} leaves, {st['head_chunks']} chunks "
+                f"({_human(st['head_bytes'])}) at "
+                f"{_human(st.get('chunk_size', 0))} granularity"
+            )
+        print(line)
+        if "steps_resident" in st:
+            print(
+                f"    steps resident: {st['steps_resident']}, pool "
+                f"{st.get('pool_chunks', 0)} chunks "
+                f"({_human(st.get('pool_bytes', 0))})"
+            )
+        if st.get("manifest_error"):
+            print(f"    WARNING: manifest unreadable: {st['manifest_error']}")
+
+
 def _cmd_stats(args) -> int:
     """Per-entry size/dtype/chunk rollups from the manifest (the
     operator's "where did my bytes go" view; machine-readable with
-    --json for dashboards)."""
+    --json for dashboards).  Continuous-store roots (continuous/) get a
+    residency rollup instead: head step, chunk pool footprint, steps
+    resident — per rank when pointed at a host root."""
     from .manifest import is_container_entry
     from .snapshot import Snapshot
 
+    cont = _continuous_stats(args.path)
+    if cont is not None:
+        if args.json:
+            print(json.dumps(cont, indent=2))
+        else:
+            _render_continuous_stats(cont)
+        return 0
     snap = Snapshot(args.path)
     metadata = snap.metadata
     entries = {
@@ -447,8 +579,64 @@ def _doctor_counters(record) -> dict:
         "codec_ratio": (
             round(codec_in / codec_out, 3) if codec_out else None
         ),
+        "continuous_steps": c.get("continuous.steps", 0),
+        "continuous_bytes_replicated": c.get(
+            "continuous.bytes_replicated", 0
+        ),
+        "continuous_bytes_skipped": c.get("continuous.bytes_skipped", 0),
+        "continuous_replication_errors": c.get(
+            "continuous.replication_errors", 0
+        ),
+        "continuous_preemption_drains": c.get(
+            "continuous.preemption_drains", 0
+        ),
         "exceptions_swallowed": c.get("exceptions.swallowed", 0),
     }
+
+
+def _render_continuous_rollup(cont, counters=None) -> None:
+    """Preemption-readiness rows from a flight record's continuous
+    rollup: per-rank replica residency (last trained vs last-peer vs
+    last-durable step), the fleet floors, and the per-step replication
+    economics.  Silent for records with no continuous loop."""
+    c = counters or {}
+    if not cont:
+        return
+    floor_peer = cont.get("last_peer_step_floor")
+    floor_dur = cont.get("last_durable_step_floor")
+    lag = cont.get("max_replication_lag_steps")
+    print(
+        "  continuous: peer-step floor "
+        f"{floor_peer if floor_peer is not None else '-'}, "
+        f"durable-step floor {floor_dur if floor_dur is not None else '-'}"
+        + (f", max replication lag {lag} step(s)" if lag is not None else "")
+    )
+    for rank, row in sorted(
+        (cont.get("by_rank") or {}).items(), key=lambda kv: int(kv[0])
+    ):
+        print(
+            f"    rank {rank}: step {row.get('last_step')}"
+            f" | peers hold {row.get('last_peer_step')}"
+            f" ({row.get('peer_targets', 0)} target(s))"
+            f" | durable {row.get('last_durable_step')}"
+        )
+    if c.get("continuous_bytes_replicated") or c.get(
+        "continuous_bytes_skipped"
+    ):
+        rep = c.get("continuous_bytes_replicated", 0)
+        skip = c.get("continuous_bytes_skipped", 0)
+        total = rep + skip
+        print(
+            f"    delta economics: {_human(rep)} replicated, "
+            f"{_human(skip)} skipped"
+            + (f" ({skip / total:.0%} unchanged)" if total else "")
+        )
+    if c.get("continuous_replication_errors"):
+        print(
+            f"    WARNING: {c['continuous_replication_errors']} "
+            "replication error(s) — affected targets held their "
+            "previous step (degraded, not torn)"
+        )
 
 
 def _render_topology_rollup(topo, counters=None) -> None:
@@ -580,6 +768,7 @@ def _render_doctor(record) -> None:
     if c["mmap_reads"]:
         print(f"  mmap: {c['mmap_reads']} zero-copy reads")
     _render_topology_rollup(record.get("topology"), c)
+    _render_continuous_rollup(record.get("continuous"), c)
     slow = record.get("slow_objects") or []
     if slow:
         print("  slowest objects:")

@@ -1,0 +1,60 @@
+"""Peer liveness over the coordination KV: step-stamped heartbeats.
+
+The coordination KV has no TTLs, so liveness is expressed as PROGRESS:
+each rank republishes one key per completed replication
+(``{ns}/hb/{rank}`` → the step its peers now hold for it; ``-1`` when
+peers exist but none holds a complete replica yet — never an
+optimistic claim), and a reader compares peers' stamps against its
+own step.  A rank whose
+stamp stops advancing is dead or wedged — which is exactly the signal
+the doctor rows and a replacement-host recovery want ("how stale is
+the state I'm about to restore?"), without inventing a second liveness
+channel beside the one the checkpoint loop already exercises.
+
+KV hygiene: ``ns`` is a per-checkpointer uid exchanged once at loop
+start (uid-namespaced keys, never literal-headed), and every publisher
+deletes its own key at clean shutdown (``clear``) so long-lived
+coordination services don't accrete one key per finished job.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from .. import obs
+
+logger = logging.getLogger(__name__)
+
+
+def publish(coordinator: Any, ns: str, rank: int, step: int) -> None:
+    """Best-effort heartbeat: never raises — liveness telemetry must
+    not fail the replication it reports on."""
+    try:
+        coordinator.kv_set(f"{ns}/hb/{rank}", str(int(step)))
+    except Exception as e:  # noqa: BLE001 — heartbeat is best-effort
+        obs.swallowed_exception("continuous.heartbeat_publish", e)
+
+
+def read_all(
+    coordinator: Any, ns: str, world_size: int
+) -> Dict[int, Optional[int]]:
+    """Every rank's last heartbeat step (None = never published or
+    already cleared)."""
+    out: Dict[int, Optional[int]] = {}
+    for r in range(world_size):
+        raw = coordinator.kv_try_get(f"{ns}/hb/{r}")
+        try:
+            out[r] = int(raw) if raw is not None else None
+        except ValueError:
+            logger.warning(
+                "malformed heartbeat for rank %d under %r: %r", r, ns, raw
+            )
+            out[r] = None
+    return out
+
+
+def clear(coordinator: Any, ns: str, rank: int) -> None:
+    """Publish-paired cleanup: drop this rank's heartbeat key at clean
+    shutdown (kv_try_delete is best-effort by contract)."""
+    coordinator.kv_try_delete(f"{ns}/hb/{rank}")

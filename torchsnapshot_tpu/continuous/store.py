@@ -1,0 +1,306 @@
+"""The continuous-checkpoint store: a tiny content-addressed,
+marker-last state mirror.
+
+One store holds ONE rank's training state as it evolves step over step
+(the checkpointer namespaces ranks by giving each its own store root —
+``<host-root>/r<rank>``), in three pieces:
+
+- ``objects/<kk>/<crc>-<adler>-<size>`` — the content-addressed chunk
+  pool (the CAS pool layout and the same ``(crc32, adler32,
+  exact-size)`` content key the CAS subsystem trusts, cas/store.py):
+  an unchanged span of a mutated tensor keeps its key across steps, so
+  per-step replication moves only the delta.
+- ``steps/<step>.json`` — the per-step manifest: every logical leaf of
+  the flattened state tree with its dtype/shape (or serialization tag)
+  and ordered chunk-key list.  Self-CRC'd (utils/selfcrc.py).
+- ``.snapshot_metadata`` — the HEAD marker naming the newest COMPLETE
+  step.  Written strictly last (chunks → manifest → HEAD), so a store
+  whose writer died mid-step still reads as the previous step, never a
+  torn one — the repo-wide "no marker == aborted" contract, which is
+  also what lets tier/promoter.py commit a durable mirror of this store
+  with its existing marker-last machinery.
+
+Everything here is format + verified I/O; policy (what to replicate
+where, when to promote) lives in loop.py, and recovery source ordering
+in recover.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import knobs, obs
+from ..cas.store import chunk_key, chunk_location, key_size
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..serialization import deserialize_object, serialize_object
+from ..utils.checksums import adler32_fast, crc32_fast
+from ..utils.selfcrc import append_crc_trailer, strip_crc_trailer
+
+logger = logging.getLogger(__name__)
+
+# HEAD deliberately shares the snapshot marker name: "marker present ==
+# store complete" stays one repo-wide contract, and the write-back
+# promoter's marker-last commit job works on this store unchanged.  The
+# payload is continuous-format JSON (``format`` field below), which no
+# SnapshotMetadata parser accepts — a continuous root can never be
+# mistaken for a committed snapshot by discovery code.
+HEAD_FNAME = ".snapshot_metadata"
+STEP_FORMAT = "tsnp-continuous-step"
+HEAD_FORMAT = "tsnp-continuous-head"
+_CRC_MARKER = "\n# tsnp-continuous-crc32: "
+
+
+def step_manifest_path(step: int) -> str:
+    return f"steps/{int(step):010d}.json"
+
+
+def _encode_doc(doc: Dict[str, Any]) -> bytes:
+    body = json.dumps(doc, sort_keys=True)
+    return append_crc_trailer(body, _CRC_MARKER).encode()
+
+
+def _decode_doc(data: Any, label: str, fname: str) -> Dict[str, Any]:
+    text = bytes(memoryview(data).cast("B")).decode()
+    body, had = strip_crc_trailer(text, _CRC_MARKER, label, fname)
+    if not had:
+        raise RuntimeError(
+            f"{label} {fname!r} has no integrity trailer — not a "
+            f"continuous-store document"
+        )
+    return json.loads(body)
+
+
+def encode_head(step: int) -> bytes:
+    return _encode_doc(
+        {
+            "format": HEAD_FORMAT,
+            "version": 1,
+            "step": int(step),
+            "manifest": step_manifest_path(step),
+        }
+    )
+
+
+def encode_step_manifest(
+    step: int, chunk_size: int, leaves: Dict[str, Dict[str, Any]]
+) -> bytes:
+    return _encode_doc(
+        {
+            "format": STEP_FORMAT,
+            "version": 1,
+            "step": int(step),
+            "chunk_size": int(chunk_size),
+            "leaves": leaves,
+        }
+    )
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # ml_dtypes families (bfloat16, float8_*) register as attribute
+        # dtypes, not numpy-name-resolvable ones
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_leaf(leaf: Any) -> Tuple[Dict[str, Any], memoryview]:
+    """One flattened leaf → (manifest record sans keys, byte view).
+    Arrays (numpy, jax, anything ``np.asarray`` accepts as typed data)
+    keep dtype/shape; everything else rides the safe object codec."""
+    if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        view = memoryview(arr.reshape(-1).view(np.uint8)).cast("B")
+        return (
+            {
+                "kind": "array",
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+                "size": arr.nbytes,
+            },
+            view,
+        )
+    payload, tag = serialize_object(leaf)
+    view = memoryview(payload).cast("B")
+    return (
+        {"kind": "object", "tag": tag, "size": view.nbytes},
+        view,
+    )
+
+
+def decode_leaf(rec: Dict[str, Any], data: bytes) -> Any:
+    if rec.get("kind") == "array":
+        dtype = _resolve_dtype(str(rec["dtype"]))
+        arr = np.frombuffer(data, dtype=dtype).reshape(rec["shape"])
+        # a writable copy: recovered state goes straight back into a
+        # training loop that mutates it in place
+        return arr.copy()
+    return deserialize_object(bytes(data), str(rec["tag"]))
+
+
+class ContinuousStore:
+    """Verified I/O against one continuous store root (any storage
+    URL).  Thin by design — the loop owns delta policy, this owns paths
+    and integrity."""
+
+    def __init__(
+        self, root: str, storage: Optional[StoragePlugin] = None
+    ) -> None:
+        self.root = root.rstrip("/")
+        self._storage = storage
+
+    @property
+    def storage(self) -> StoragePlugin:
+        if self._storage is None:
+            from ..storage import url_to_storage_plugin
+
+            # a peer's RAM root is a one-hop local-network read; the
+            # shared-host cache would store every replicated byte twice
+            self._storage = url_to_storage_plugin(
+                self.root, {"host_cache": False}
+            )
+        return self._storage
+
+    # ------------------------------------------------------------- read
+
+    def read_head(self) -> Optional[Dict[str, Any]]:
+        """The verified HEAD document, or None when the store has no
+        marker (empty / mid-first-step / wiped).  Corruption raises —
+        callers treat any raise as "this source is unusable"."""
+        try:
+            io = ReadIO(path=HEAD_FNAME)
+            self.storage.sync_read(io)
+        except FileNotFoundError:
+            return None
+        doc = _decode_doc(io.buf, "continuous HEAD", HEAD_FNAME)
+        if doc.get("format") != HEAD_FORMAT:
+            raise RuntimeError(
+                f"{self.root}/{HEAD_FNAME} is not a continuous-store "
+                f"HEAD (format={doc.get('format')!r})"
+            )
+        return doc
+
+    def read_step_manifest(self, path: str) -> Dict[str, Any]:
+        io = ReadIO(path=path)
+        self.storage.sync_read(io)
+        doc = _decode_doc(io.buf, "continuous step manifest", path)
+        if doc.get("format") != STEP_FORMAT:
+            raise RuntimeError(
+                f"{self.root}/{path} is not a continuous step manifest"
+            )
+        return doc
+
+    def read_chunks(self, keys: List[str]) -> Dict[str, bytes]:
+        """Fetch + content-verify the named chunks (parallel ranged-free
+        reads; each payload must match the crc32/adler32/size embedded
+        in its own key — a torn or stale peer copy fails closed)."""
+        unique = sorted(set(keys))
+        out: Dict[str, bytes] = {}
+        sem_n = knobs.get_max_per_rank_io_concurrency()
+
+        async def _one(sem: asyncio.Semaphore, key: str) -> None:
+            async with sem:
+                io = ReadIO(path=chunk_location(key))
+                await self.storage.read(io)
+            view = memoryview(io.buf).cast("B")
+            if (
+                view.nbytes != key_size(key)
+                or chunk_key(
+                    (crc32_fast(view), adler32_fast(view), view.nbytes)
+                )
+                != key
+            ):
+                raise IOError(
+                    f"chunk {key} under {self.root!r} failed its "
+                    f"content check ({view.nbytes} bytes)"
+                )
+            out[key] = bytes(view)
+
+        async def _all() -> None:
+            sem = asyncio.Semaphore(sem_n)
+            # return_exceptions so sibling failures are RETRIEVED (an
+            # unusable source fails many chunks at once — the ladder's
+            # normal degradation must not spray "exception was never
+            # retrieved" logs), then surface the first
+            results = await asyncio.gather(
+                *(_one(sem, k) for k in unique), return_exceptions=True
+            )
+            errs = [r for r in results if isinstance(r, BaseException)]
+            if errs:
+                raise errs[0]
+
+        with obs.span(
+            "continuous/read_chunks", root=self.root, chunks=len(unique)
+        ):
+            loop = asyncio.new_event_loop()
+            try:
+                loop.run_until_complete(_all())
+            finally:
+                loop.close()
+        return out
+
+    def read_state(
+        self, head: Optional[Dict[str, Any]] = None
+    ) -> Tuple[int, Dict[str, Any]]:
+        """Materialize the HEAD step: ``(step, {logical_path: leaf})``.
+        Raises when the store is empty or any piece fails verification
+        — recovery treats that as "try the next source"."""
+        with obs.span("continuous/read_state", root=self.root):
+            head = head if head is not None else self.read_head()
+            if head is None:
+                raise FileNotFoundError(
+                    f"continuous store {self.root!r} has no HEAD"
+                )
+            manifest = self.read_step_manifest(str(head["manifest"]))
+            keys = [
+                k
+                for rec in manifest["leaves"].values()
+                for k in rec["keys"]
+            ]
+            chunks = self.read_chunks(keys)
+            leaves: Dict[str, Any] = {}
+            for path, rec in manifest["leaves"].items():
+                data = b"".join(chunks[k] for k in rec["keys"])
+                if len(data) != int(rec["size"]):
+                    raise IOError(
+                        f"leaf {path!r}: assembled {len(data)} bytes, "
+                        f"manifest says {rec['size']}"
+                    )
+                leaves[path] = decode_leaf(rec, data)
+            return int(manifest["step"]), leaves
+
+    # ------------------------------------------------------------ write
+
+    def write_manifest(self, step: int, payload: bytes) -> None:
+        self.storage.sync_write(
+            WriteIO(path=step_manifest_path(step), buf=payload)
+        )
+
+    def write_head(self, payload: bytes) -> None:
+        # durable=True: fs roots fsync the marker — the one file whose
+        # loss downgrades the whole store to the previous step
+        self.storage.sync_write(
+            WriteIO(path=HEAD_FNAME, buf=payload, durable=True)
+        )
+
+    def delete_quiet(self, path: str) -> bool:
+        try:
+            self.storage.sync_delete(path)
+            return True
+        except FileNotFoundError:
+            return False
+        except Exception as e:  # noqa: BLE001 — pruning is best-effort
+            obs.swallowed_exception("continuous.store_prune", e)
+            return False
+
+    def sync_close(self) -> None:
+        if self._storage is not None:
+            self._storage.sync_close()
+            self._storage = None

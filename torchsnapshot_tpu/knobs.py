@@ -67,6 +67,9 @@ _TOPOLOGY_HOST_ID = "TOPOLOGY_HOST_ID"
 _FANOUT = "FANOUT"
 _FANOUT_PART_BYTES = "FANOUT_PART_BYTES"
 _FANOUT_TIMEOUT_S = "FANOUT_TIMEOUT_S"
+_CONTINUOUS = "CONTINUOUS"
+_CONTINUOUS_PROMOTE_EVERY_N = "CONTINUOUS_PROMOTE_EVERY_N"
+_CONTINUOUS_GRACE_S = "CONTINUOUS_GRACE_S"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -332,6 +335,23 @@ _DEFAULTS = {
     # publication before falling back to a direct durable read — a dead
     # reader degrades the slice to direct GETs, never wedges it.
     _FANOUT_TIMEOUT_S: 60.0,
+    # Continuous per-step checkpointing (continuous/): the fleet
+    # kill-switch for already-constructed ContinuousCheckpointers.
+    # 1 (default) = checkpointers run as constructed; 0 = step() becomes
+    # a no-op everywhere — the escape hatch when replication itself is
+    # suspected of perturbing a production run.
+    _CONTINUOUS: 1,
+    # Promote the in-RAM continuous store to the durable tier every N
+    # steps (the write-back promotion cadence: peer RAM absorbs every
+    # step, the durable tier absorbs every Nth).  0 = never promote
+    # (peer-only; an explicit promote() still works).
+    _CONTINUOUS_PROMOTE_EVERY_N: 16,
+    # Preemption grace window: how long the SIGTERM preemption-notice
+    # hook (resilience/preemption.py) lets registered drains finish the
+    # in-flight step replication before the process re-delivers the
+    # signal and exits.  Size it under your orchestrator's kill grace
+    # (GCE spot gives 30s; leave headroom for the exit itself).
+    _CONTINUOUS_GRACE_S: 10.0,
 }
 
 _OVERRIDES: dict = {}
@@ -684,6 +704,22 @@ def get_fanout_timeout_s() -> float:
     return max(0.0, float(_get_raw(_FANOUT_TIMEOUT_S)))
 
 
+def continuous_enabled() -> bool:
+    """Fleet kill-switch for continuous per-step checkpointing: when
+    off, every ``ContinuousCheckpointer.step`` is a no-op (see
+    _CONTINUOUS above)."""
+    return bool(_get_int(_CONTINUOUS))
+
+
+def get_continuous_promote_every_n() -> int:
+    """Durable-promotion cadence in steps; 0 = never auto-promote."""
+    return max(0, _get_int(_CONTINUOUS_PROMOTE_EVERY_N))
+
+
+def get_continuous_grace_s() -> float:
+    return max(0.0, float(_get_raw(_CONTINUOUS_GRACE_S)))
+
+
 def restore_donation() -> str:
     """One of "on" | "off" | "auto" (see _RESTORE_DONATE above).
 
@@ -905,6 +941,18 @@ def override_fanout_part_bytes(value: int):
 
 def override_fanout_timeout_s(value: float):
     return _override(_FANOUT_TIMEOUT_S, value)
+
+
+def override_continuous(value: bool):
+    return _override(_CONTINUOUS, int(value))
+
+
+def override_continuous_promote_every_n(value: int):
+    return _override(_CONTINUOUS_PROMOTE_EVERY_N, value)
+
+
+def override_continuous_grace_s(value: float):
+    return _override(_CONTINUOUS_GRACE_S, value)
 
 
 def override_failpoint_seed(value: int):

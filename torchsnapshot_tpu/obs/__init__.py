@@ -62,6 +62,21 @@ from .metrics import (  # noqa: F401
     CAS_CHUNKS_SWEPT,
     CAS_CHUNKS_WRITTEN,
     CAS_FSCKS,
+    CONTINUOUS_BYTES_REPLICATED,
+    CONTINUOUS_BYTES_SKIPPED,
+    CONTINUOUS_CHUNKS_REPLICATED,
+    CONTINUOUS_CHUNKS_SKIPPED,
+    CONTINUOUS_PREEMPTION_DRAINS,
+    CONTINUOUS_PROMOTIONS,
+    CONTINUOUS_REPLICATION_ERRORS,
+    CONTINUOUS_REPLICATION_LAG_S,
+    CONTINUOUS_REPLICATION_LAG_STEPS,
+    CONTINUOUS_RESTORE_S,
+    CONTINUOUS_RESTORES_FROM_DURABLE,
+    CONTINUOUS_RESTORES_FROM_LOCAL,
+    CONTINUOUS_RESTORES_FROM_PEER,
+    CONTINUOUS_STEP_OVERHEAD_S,
+    CONTINUOUS_STEPS,
     EVENT_HANDLER_ERRORS,
     EXCEPTIONS_SWALLOWED,
     GC_BYTES_RECLAIMED,

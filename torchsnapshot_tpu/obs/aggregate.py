@@ -237,6 +237,21 @@ def _topology_rollup(
     }
 
 
+def _continuous_stamp() -> Optional[Dict[str, Any]]:
+    """The active continuous checkpointer's rollup (continuous/loop.py
+    summary_block), or None — never raises (flight-record garnish, same
+    contract as the topology stamp)."""
+    try:
+        from ..continuous import summary_block
+
+        return summary_block()
+    except Exception as e:  # noqa: BLE001 — telemetry never fails the op
+        from .. import obs
+
+        obs.swallowed_exception("obs.aggregate.continuous_stamp", e)
+        return None
+
+
 def rank_payload(
     rank: int, op: str, before: Dict[str, Any]
 ) -> Dict[str, Any]:
@@ -262,6 +277,11 @@ def rank_payload(
         tinfo = _topology_stamp()
         if tinfo is not None:
             out["topology"] = tinfo
+        # continuous-loop stamp (continuous/): replica residency +
+        # replication lag for the doctor's preemption-readiness rows
+        cinfo = _continuous_stamp()
+        if cinfo is not None:
+            out["continuous"] = cinfo
         return out
     except Exception as e:  # noqa: BLE001 — telemetry never fails the op
         from .. import obs
@@ -415,7 +435,45 @@ def merge_payloads(
     topology = _topology_rollup(payloads)
     if topology is not None:
         record["topology"] = topology
+    continuous = _continuous_rollup(payloads)
+    if continuous is not None:
+        record["continuous"] = continuous
     return record
+
+
+def _continuous_rollup(
+    payloads: Sequence[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """Fleet continuous-checkpoint rows: per-rank residency plus the
+    fleet's weakest guarantees (the MIN over ranks of last-peer and
+    last-durable steps — a preemption can hit any host, so the floor is
+    what matters); None when no rank runs a continuous loop."""
+    stamped = [
+        p for p in payloads if isinstance(p.get("continuous"), dict)
+    ]
+    if not stamped:
+        return None
+    by_rank = {str(p["rank"]): p["continuous"] for p in stamped}
+
+    def _floor(key: str) -> Optional[int]:
+        vals = [
+            c.get(key)
+            for c in by_rank.values()
+            if isinstance(c.get(key), int)
+        ]
+        return min(vals) if vals else None
+
+    lags = [
+        c.get("replication_lag_steps")
+        for c in by_rank.values()
+        if isinstance(c.get("replication_lag_steps"), int)
+    ]
+    return {
+        "by_rank": by_rank,
+        "last_peer_step_floor": _floor("last_peer_step"),
+        "last_durable_step_floor": _floor("last_durable_step"),
+        "max_replication_lag_steps": max(lags) if lags else None,
+    }
 
 
 # ------------------------------------------------------ KV publication

@@ -167,6 +167,35 @@ FANOUT_DURABLE_GETS_SAVED = "topology.durable_gets_saved"
 FANOUT_BYTES_REDISTRIBUTED = "topology.fanout_bytes_redistributed"
 FANOUT_PUBLISHES = "topology.fanout_publishes"
 FANOUT_FALLBACKS = "topology.fanout_fallbacks"
+# Continuous per-step checkpointing (continuous/): every training
+# step's changed chunks replicate to a peer host's RAM.  steps counts
+# step() calls that ran; bytes/chunks replicated vs skipped is the
+# per-step delta win (skipped = content the targets already held);
+# step_overhead_s is the BLOCKED window inside step() (digest + delta
+# staging — the seconds the training loop actually lost, also folded
+# into goodput.overhead_fraction); replication_lag_s is step-begin →
+# all-targets-complete (the at-risk window: a host killed inside it
+# loses that one step); replication_lag_steps gauges how far the
+# background writer trails the training loop; replication_errors
+# counts steps whose replication failed (training continues — the peer
+# simply keeps the previous step); restore_s is the measured
+# recovery-time objective of recover(), per source; preemption_drains
+# counts SIGTERM grace-window drains that completed.
+CONTINUOUS_STEPS = "continuous.steps"
+CONTINUOUS_BYTES_REPLICATED = "continuous.bytes_replicated"
+CONTINUOUS_BYTES_SKIPPED = "continuous.bytes_skipped"
+CONTINUOUS_CHUNKS_REPLICATED = "continuous.chunks_replicated"
+CONTINUOUS_CHUNKS_SKIPPED = "continuous.chunks_skipped"
+CONTINUOUS_STEP_OVERHEAD_S = "continuous.step_overhead_s"
+CONTINUOUS_REPLICATION_LAG_S = "continuous.replication_lag_s"
+CONTINUOUS_REPLICATION_LAG_STEPS = "continuous.replication_lag_steps"
+CONTINUOUS_REPLICATION_ERRORS = "continuous.replication_errors"
+CONTINUOUS_PROMOTIONS = "continuous.promotions"
+CONTINUOUS_RESTORES_FROM_LOCAL = "continuous.restores_from_local"
+CONTINUOUS_RESTORES_FROM_PEER = "continuous.restores_from_peer"
+CONTINUOUS_RESTORES_FROM_DURABLE = "continuous.restores_from_durable"
+CONTINUOUS_RESTORE_S = "continuous.restore_s"
+CONTINUOUS_PREEMPTION_DRAINS = "continuous.preemption_drains"
 # Resilience (resilience/): transient-error retries (total, plus
 # per-backend twins named resilience.<backend>.retries), cross-rank
 # aborts initiated via the poison protocol, deterministic failpoint

@@ -19,6 +19,10 @@ Four cooperating pieces (docs/resilience.md):
 - **breaker** — per-backend consecutive-failure circuit breakers:
   tripped writes fail fast (``CircuitOpenError``), tiered reads route
   to the replica/durable fallback, half-open probes re-close.
+- **preemption** — the SIGTERM preemption-notice hook: registered
+  drains (the continuous checkpoint loop's in-flight replication)
+  finish inside a bounded grace window before the signal is
+  re-delivered and the process exits as before.
 
 Everything emits obs metrics (``resilience.retries``,
 ``resilience.aborts``, ``resilience.failpoints_fired``,
@@ -47,6 +51,12 @@ from .failpoints import (  # noqa: F401
     parse_failpoints,
     refresh_from_knobs as refresh_failpoints,
 )
+from .preemption import (  # noqa: F401
+    notify_preemption,
+    on_preemption,
+    preemption_requested,
+    remove_handler as remove_preemption_handler,
+)
 from .retry import (  # noqa: F401
     FATAL,
     MISSING,
@@ -71,6 +81,10 @@ __all__ = [
     "get_breaker",
     "reset_breakers",
     "InjectedClientError",
+    "on_preemption",
+    "notify_preemption",
+    "preemption_requested",
+    "remove_preemption_handler",
     "failpoint",
     "parse_failpoints",
     "refresh_failpoints",

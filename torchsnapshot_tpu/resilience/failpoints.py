@@ -29,7 +29,8 @@ over-fire a bounded spec.
 Instrumented sites (kept in sync with docs/resilience.md):
 ``storage.{fs,s3,gcs,memory}.{write,read}``, ``storage.fs.write.sync``,
 ``scheduler.{stage,write,read}``, ``coord.{kv_set,kv_get,barrier}``,
-``tier.promote.{data,commit}``, ``obs.publish``.
+``tier.promote.{data,commit}``, ``obs.publish``,
+``continuous.replicate``.
 
 Beyond the raising kinds, ``delay<ms>`` (e.g. ``delay250``) SLEEPS at
 the site instead of raising — deterministic injected slowness for

@@ -1,0 +1,168 @@
+"""Preemption-notice hook: SIGTERM → bounded drain → exit as before.
+
+Spot/preemptible fleets deliver a termination notice (SIGTERM on GCE,
+the same convention on most orchestrators) a short grace window before
+the kill.  Everything this library keeps only in RAM at that moment —
+the in-flight continuous-checkpoint replication of the current step
+(continuous/loop.py) — is exactly one bounded flush away from being
+safe on a peer host, so the hook's contract is narrow on purpose:
+
+1. ``on_preemption(drain)`` registers a drain callback
+   (``drain(deadline_monotonic) -> None``) and installs a process
+   SIGTERM handler on first use (main thread only — Python refuses
+   signal handlers elsewhere; registration still works from any thread
+   and ``notify_preemption()`` runs the same drains without a signal,
+   for tests and orchestrators that deliver notices over an API).
+2. On SIGTERM, every registered drain runs under ONE shared deadline
+   (``TORCHSNAPSHOT_TPU_CONTINUOUS_GRACE_S`` from now) — a drain that
+   overruns forfeits the remainder, it cannot eat a sibling's window.
+   Drain errors are swallowed and counted: a telemetry-grade bug in a
+   drain must not turn a clean preemption into a hang.
+3. The signal is then RE-DELIVERED through whatever handler was
+   installed before ours (default disposition included), so the
+   process still dies a normal SIGTERM death and the orchestrator's
+   accounting sees exactly what it expects.
+
+The hook never *prevents* the exit — it spends the grace window the
+platform already granted finishing the one replication that turns
+"lost the last N minutes" into "lost at most one step".
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .. import knobs, obs
+
+logger = logging.getLogger(__name__)
+
+# reentrant: the SIGTERM handler runs ON the main thread and may land
+# while main-thread code (on_preemption/remove_handler/uninstall/close
+# paths) already holds the lock — a plain Lock would deadlock the
+# handler against its own thread
+_LOCK = threading.RLock()
+_DRAINS: Dict[int, Callable[[float], None]] = {}
+_IDS = itertools.count(1)
+_PREV_HANDLER: Optional[object] = None
+_INSTALLED = False
+_REQUESTED = threading.Event()
+
+
+def preemption_requested() -> bool:
+    """True once a preemption notice has been observed in this process
+    (training loops can poll this to stop scheduling new steps)."""
+    return _REQUESTED.is_set()
+
+
+def on_preemption(drain: Callable[[float], None]) -> int:
+    """Register ``drain(deadline)`` to run inside the SIGTERM grace
+    window; returns a handle for ``remove_handler``.  Installs the
+    process signal handler on first call when possible (main thread);
+    otherwise registration still takes effect for
+    ``notify_preemption()`` and a warning is logged."""
+    global _INSTALLED, _PREV_HANDLER
+    with _LOCK:
+        handle = next(_IDS)
+        _DRAINS[handle] = drain
+        need_install = not _INSTALLED
+    if need_install:
+        try:
+            prev = signal.signal(signal.SIGTERM, _sigterm_handler)
+            with _LOCK:
+                _PREV_HANDLER = prev
+                _INSTALLED = True
+        except ValueError as e:
+            # not the main thread: the drains still run via
+            # notify_preemption; say so rather than silently shrinking
+            # the preemption story
+            logger.warning(
+                "cannot install SIGTERM preemption handler off the "
+                "main thread (%r); call notify_preemption() from your "
+                "own notice watcher", e,
+            )
+    return handle
+
+
+def remove_handler(handle: int) -> None:
+    with _LOCK:
+        _DRAINS.pop(handle, None)
+
+
+def uninstall() -> None:
+    """Restore the pre-hook SIGTERM disposition and drop every
+    registered drain (tests)."""
+    global _INSTALLED, _PREV_HANDLER
+    with _LOCK:
+        prev = _PREV_HANDLER
+        installed = _INSTALLED
+        _DRAINS.clear()
+        _PREV_HANDLER = None
+        _INSTALLED = False
+        _REQUESTED.clear()
+    if installed:
+        try:
+            signal.signal(
+                signal.SIGTERM,
+                prev if prev is not None else signal.SIG_DFL,
+            )
+        except (ValueError, TypeError) as e:
+            logger.warning("could not restore SIGTERM handler: %r", e)
+
+
+def notify_preemption(grace_s: Optional[float] = None) -> int:
+    """Run every registered drain under one shared grace deadline (the
+    signal-free entry point: tests, and orchestrators that deliver
+    preemption notices via an API instead of SIGTERM).  Returns the
+    number of drains that completed without raising."""
+    _REQUESTED.set()
+    grace = (
+        knobs.get_continuous_grace_s() if grace_s is None else grace_s
+    )
+    deadline = time.monotonic() + grace
+    with _LOCK:
+        drains = list(_DRAINS.values())
+    completed = 0
+    with obs.span(
+        "resilience/preemption_drain", drains=len(drains), grace_s=grace
+    ):
+        for drain in drains:
+            try:
+                drain(deadline)
+                completed += 1
+            except Exception as e:  # noqa: BLE001 — a drain bug must
+                # not turn a clean preemption into a hang or a crash
+                # loop inside a signal handler
+                obs.swallowed_exception("resilience.preemption_drain", e)
+    if completed:
+        obs.counter(obs.CONTINUOUS_PREEMPTION_DRAINS).inc(completed)
+    return completed
+
+
+def _sigterm_handler(signum, frame) -> None:
+    logger.warning(
+        "SIGTERM preemption notice: draining in-flight work inside a "
+        "%.1fs grace window", knobs.get_continuous_grace_s(),
+    )
+    notify_preemption()
+    # re-deliver through the pre-hook disposition so the process still
+    # dies a normal SIGTERM death (orchestrator accounting intact)
+    with _LOCK:
+        prev = _PREV_HANDLER
+    if callable(prev):
+        prev(signum, frame)
+        return
+    if prev is signal.SIG_IGN:
+        return
+    try:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    except ValueError:
+        # delivered on a non-main thread (embedders): exit explicitly
+        # with the conventional SIGTERM status instead
+        os._exit(128 + int(signum))
+    os.kill(os.getpid(), signal.SIGTERM)

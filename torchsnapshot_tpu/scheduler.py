@@ -37,7 +37,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Awaitable, Callable, List, Optional
+from typing import Any, Awaitable, Callable, List, Optional, Tuple
 
 import psutil
 
@@ -958,6 +958,92 @@ async def _execute_copy_pipelines(
 
     copied = await asyncio.gather(*(one(p) for p in paths))
     return sum(copied)
+
+
+async def _execute_buffer_writes(
+    items: List[Tuple[str, Any]],
+    dst_storage: StoragePlugin,
+    budget: _Budget,
+    io_concurrency: int,
+    counter_name: str,
+    failpoint_site: Optional[str] = None,
+    span_label: str = "scheduler/buffer_write",
+) -> int:
+    """Write already-staged ``(path, buf)`` pairs to ``dst_storage``,
+    admitted under the host-memory budget: the buffers exist either
+    way, but admission bounds how many a retrying/backpressured target
+    can hold IN FLIGHT at once (each queued write can buffer its
+    payload again inside the plugin — temp copies, retry bodies), with
+    the same oversized-item progress rule as the copy pipeline."""
+    m_written = obs_metrics.counter(counter_name)
+    sem = asyncio.Semaphore(io_concurrency)
+    cond = asyncio.Condition()
+    in_use = 0
+
+    async def one(path: str, buf: Any) -> int:
+        nonlocal in_use
+        nbytes = memoryview(buf).cast("B").nbytes
+        async with cond:
+            await cond.wait_for(
+                lambda: in_use == 0 or in_use + nbytes <= budget.total
+            )
+            in_use += nbytes
+        try:
+            if failpoint_site is not None:
+                failpoint(failpoint_site, path=path)
+            async with sem:
+                with obs_tracer.span(span_label, path=path, bytes=nbytes):
+                    await dst_storage.write(WriteIO(path=path, buf=buf))
+            m_written.inc(nbytes)
+            return nbytes
+        finally:
+            async with cond:
+                in_use -= nbytes
+                cond.notify_all()
+
+    written = await asyncio.gather(*(one(p, b) for p, b in items))
+    return sum(written)
+
+
+def sync_execute_buffer_writes(
+    items: List[Tuple[str, Any]],
+    dst_storage: StoragePlugin,
+    memory_budget_bytes: int,
+    counter_name: str,
+    failpoint_site: Optional[str] = None,
+    span_label: str = "scheduler/buffer_write",
+    loop_thread: Optional[_LoopThread] = None,
+) -> int:
+    """Write staged ``(path, buf)`` pairs concurrently under the staging
+    memory budget; returns bytes written.  This is the continuous
+    checkpoint loop's replication engine (continuous/loop.py): per-step
+    delta chunks ride this to the local and peer fast roots as budgeted
+    background work, so replication can never out-buffer the budget a
+    host sized for takes (the same admission discipline as staging and
+    tier promotion).  ``loop_thread`` lets a per-step caller reuse ONE
+    long-lived event-loop thread (it stays alive after the call)
+    instead of paying thread+loop churn on every training step; omitted,
+    a private one is created and torn down like the copy engine's."""
+    if not items:
+        return 0
+    budget = _Budget(memory_budget_bytes)
+    own_loop = loop_thread is None
+    lt = loop_thread or _LoopThread(name="tsnp-continuous-loop")
+    try:
+        return lt.submit(
+            _execute_buffer_writes(
+                items,
+                dst_storage,
+                budget,
+                knobs.get_max_per_rank_io_concurrency(),
+                counter_name,
+                failpoint_site,
+                span_label,
+            )
+        ).result()
+    finally:
+        if own_loop:
+            lt.shutdown()
 
 
 def sync_execute_copy_reqs(

@@ -393,8 +393,10 @@ class TieredStoragePlugin(StoragePlugin):
         — so on a large job the writer-derived candidates usually hit
         before any of the world_size-2 dead probes.  Ordering only (the
         full list remains the tail): peer lists are not guaranteed
-        rank-indexed when hand-configured, so pruning could miss a
-        replica that ordering cannot."""
+        rank-indexed when hand-configured, and topology-aware placement
+        (_pick_replica_targets) may have put the replica on a
+        different-slice rank instead of a successor — pruning could
+        miss a replica that mere ordering cannot."""
         peers = [u for u in (self._peer_urls or ()) if u != self.fast_url]
         if len(peers) < 2:
             return peers
@@ -501,8 +503,16 @@ class TieredStoragePlugin(StoragePlugin):
                     if self.fast_url in peers
                     else coordinator.rank
                 )
+                # deliberately detected EVERY take, not memoized here:
+                # detect_topology's publish-always contract (each rank
+                # kv_sets its hint under this op's prefix even on its
+                # own cache hits) is what keeps the exchange symmetric
+                # when one rank's earlier detection failed — a
+                # per-plugin memo would leave that rank waiting on keys
+                # cached peers never publish.  The O(world) gather is
+                # already memoized inside detect_topology.
                 self._replica_target_urls = self._pick_replica_targets(
-                    peers, rank
+                    peers, rank, self._detect_topology(coordinator, uid)
                 )
                 try:
                     self._replicate_group(self._replica_target_urls)
@@ -517,15 +527,45 @@ class TieredStoragePlugin(StoragePlugin):
             group.uid = uid
             get_promoter().enqueue_data(group)
 
+    @staticmethod
+    def _detect_topology(coordinator: Any, uid: str) -> Any:
+        """Best-effort rank→slice placement for replica target choice.
+        Symmetric: every rank with replica_count > 0 reaches this from
+        finalize_take, so the one-per-op placement exchange
+        (kv_exchange under explicit keys) is background-thread-legal
+        and never one-sided.  Any failure degrades to the plain ring
+        placement — topology is an optimization, never a take
+        blocker."""
+        try:
+            from ..topology import detect_topology
+
+            return detect_topology(
+                coordinator, exchange_prefix=f"{uid}/tiertopo"
+            )
+        except Exception as e:  # noqa: BLE001 — degrade to ring order
+            obs.swallowed_exception("tier.topology_detect", e)
+            return None
+
     def _pick_replica_targets(
-        self, peers: List[str], rank: int
+        self, peers: List[str], rank: int, topology: Any = None
     ) -> List[str]:
+        """The ``replica_count`` peer fast roots this rank mirrors its
+        payloads to.  With an explicit topology, candidates are ordered
+        by ``Topology.replica_preference`` — DIFFERENT-slice peers
+        first, so a whole-slice preemption can never take out both the
+        primary and its replica; flat/unknown topologies keep the
+        successor-ring placement (byte-identical to the pre-topology
+        behavior)."""
+        from ..topology import replica_candidate_order
+
+        order = [
+            peers[c]
+            for c in replica_candidate_order(topology, rank, len(peers))
+        ]
         targets: List[str] = []
-        n = len(peers)
-        for d in range(1, n):
+        for cand in order:
             if len(targets) >= self.replica_count:
                 break
-            cand = peers[(rank + d) % n]
             if cand != self.fast_url and cand not in targets:
                 targets.append(cand)
         return targets

@@ -66,6 +66,17 @@ class PromotionGroup:
         # multi-host recovery can never fabricate a committed-but-
         # incomplete durable snapshot)
         self.recovery = False
+        # pinned commit-marker bytes (continuous/loop.py): when set, the
+        # commit job writes THESE bytes as the durable marker instead of
+        # copying the fast root's live marker file.  The continuous
+        # store's HEAD keeps advancing while its promotion drains in
+        # this queue — copying the live file would commit a HEAD whose
+        # newer chunks were never part of this group's data job.
+        self.marker_payload: Optional[bytes] = None
+        # set by the worker when the commit job finished (marker
+        # durably written): enqueuers that track durable residency
+        # (continuous/loop.py) poll this instead of blocking on drain()
+        self.completed = False
 
 
 class Promoter:
@@ -229,32 +240,41 @@ class Promoter:
                         )
                 from ..io_types import ReadIO, WriteIO
 
-                # flight-record sidecar first, best-effort: the durable
-                # tier keeps the record-lands-before-marker ordering,
-                # and a missing/unreadable record never blocks the
-                # durable commit (it is telemetry, not payload — the
-                # tier plugin deliberately keeps it out of group.paths)
-                try:
-                    rec_io = ReadIO(path=_OBSRECORD_FNAME)
-                    src.sync_read(rec_io)
-                    dst.sync_write(
-                        WriteIO(
-                            path=_OBSRECORD_FNAME,
-                            buf=bytes(memoryview(rec_io.buf).cast("B")),
+                if group.marker_payload is not None:
+                    # pinned marker (continuous promotion): commit the
+                    # HEAD as of enqueue time, not whatever the still-
+                    # advancing fast root says now; such groups have no
+                    # flight-record sidecar
+                    marker = group.marker_payload
+                else:
+                    # flight-record sidecar first, best-effort: the
+                    # durable tier keeps the record-lands-before-marker
+                    # ordering, and a missing/unreadable record never
+                    # blocks the durable commit (it is telemetry, not
+                    # payload — the tier plugin deliberately keeps it
+                    # out of group.paths)
+                    try:
+                        rec_io = ReadIO(path=_OBSRECORD_FNAME)
+                        src.sync_read(rec_io)
+                        dst.sync_write(
+                            WriteIO(
+                                path=_OBSRECORD_FNAME,
+                                buf=bytes(
+                                    memoryview(rec_io.buf).cast("B")
+                                ),
+                            )
                         )
-                    )
-                except Exception as e:  # noqa: BLE001 — best-effort
-                    obs.swallowed_exception("tier.promote.obsrecord", e)
-
-                read_io = ReadIO(path=_METADATA_FNAME)
-                src.sync_read(read_io)
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        obs.swallowed_exception("tier.promote.obsrecord", e)
+                    read_io = ReadIO(path=_METADATA_FNAME)
+                    src.sync_read(read_io)
+                    marker = bytes(memoryview(read_io.buf).cast("B"))
                 dst.sync_write(
                     WriteIO(
-                        path=_METADATA_FNAME,
-                        buf=bytes(memoryview(read_io.buf).cast("B")),
-                        durable=True,
+                        path=_METADATA_FNAME, buf=marker, durable=True
                     )
                 )
+            group.completed = True
             if group.commit_enqueued_ts is not None:
                 obs.histogram(obs.PROMOTION_LAG_S).observe(
                     time.monotonic() - group.commit_enqueued_ts

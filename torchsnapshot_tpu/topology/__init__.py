@@ -27,12 +27,14 @@ from .model import (  # noqa: F401
     Topology,
     current_topology_info,
     detect_topology,
+    replica_candidate_order,
 )
 
 __all__ = [
     "Topology",
     "detect_topology",
     "current_topology_info",
+    "replica_candidate_order",
     "FanoutReadPlugin",
     "fanout_enabled",
     "shared_read_locations",

@@ -185,6 +185,30 @@ class Topology:
         ordered = sorted(members, key=lambda r: (self.host_of[r], r))
         return ordered[zlib.crc32(key.encode()) % len(ordered)]
 
+    def replica_preference(self, rank: Optional[int] = None) -> Tuple[int, ...]:
+        """Every OTHER rank, ordered best-replica-target-first for
+        ``rank`` (default: this rank): different-SLICE ranks before
+        same-slice ones, different-HOST before co-hosted within each
+        group, ring distance as the deterministic tiebreak.  A slice
+        preemption takes out every host in the slice at once, so a
+        replica that survives it must live across the slice boundary —
+        same-slice (and worst, same-host) targets are kept only as the
+        tail so a single-slice job still gets its ring placement.
+        Pure and identical on every process (same inputs), like every
+        other Topology assignment."""
+        r = self.rank if rank is None else rank
+        n = self.world_size
+        return tuple(
+            sorted(
+                (c for c in range(n) if c != r),
+                key=lambda c: (
+                    self.slice_of[c] == self.slice_of[r],
+                    self.host_of[c] == self.host_of[r],
+                    (c - r) % n,
+                ),
+            )
+        )
+
     def describe(self) -> Dict[str, Any]:
         """Small JSON-safe summary for flight records / logs."""
         return {
@@ -194,6 +218,25 @@ class Topology:
             "num_hosts": self.num_hosts,
             "explicit": self.explicit,
         }
+
+
+def replica_candidate_order(
+    topology: Optional["Topology"], rank: int, n: int
+) -> Tuple[int, ...]:
+    """The ONE candidate ordering every replica-placement site uses
+    (tier/plugin.py targets, the continuous loop's peer choice and its
+    recovery probe order): ``Topology.replica_preference`` when the
+    topology is explicit AND sized for the peer list, else the
+    successor ring — byte-identical to the pre-topology placement.
+    Centralized so write-side placement and read-side probing can
+    never diverge on the rule."""
+    if (
+        topology is not None
+        and getattr(topology, "explicit", False)
+        and topology.world_size == n
+    ):
+        return topology.replica_preference(rank)
+    return tuple((rank + d) % n for d in range(1, n))
 
 
 def current_topology_info() -> Optional[Dict[str, Any]]:

@@ -1,11 +1,13 @@
 """snaplint — pass-based AST static analysis for this repo.
 
-``python -m tools.lint`` runs six passes repo-wide (collective-safety,
-lock-discipline, exception-hygiene, knob-registry, retry-discipline,
-instrumentation)
-with a per-pass allowlist requiring written justifications and a
+``python -m tools.lint`` runs thirteen passes repo-wide — six lexical
+walks, four on the flow-sensitive CFG substrate, and three
+interprocedural passes over the package-wide call graph and effect
+summaries (protocol-lockstep, kv-matching, effect-escape) — with a
+per-pass allowlist requiring written justifications and a
 ``baseline.json`` ratchet (legacy finding counts may only decrease).
-See docs/static_analysis.md and tools/lint/core.py.
+``--changed [REF]`` is the pre-commit mode.  See
+docs/static_analysis.md and tools/lint/core.py.
 """
 
 from __future__ import annotations
@@ -19,8 +21,10 @@ from .core import (  # noqa: F401
     LintConfigError,
     LintPass,
     LintResult,
+    ProjectPass,
     check_ratchet,
     load_baseline,
+    run_project_sources,
     run_repo,
     run_source,
     save_baseline,

@@ -58,20 +58,15 @@ ALLOWLIST: Tuple[Allow, ...] = (
             "rank), not a failure to retry harder."
         ),
     ),
-    Allow(
-        pass_id="resource-pairing",
-        file="torchsnapshot_tpu/scheduler.py",
-        context="_execute_write_pipelines.dispatch_staging",
-        justification=(
-            "Admission debits here transfer ownership to the pipeline "
-            "task launched in the same scan (_launch); the credit is "
-            "issued by the executor loop when that task completes (or "
-            "by its teardown path), so the pairing is a cross-task "
-            "handoff the per-function CFG cannot see.  The budget-"
-            "balance invariants are asserted end-to-end in "
-            "tests/test_take_invariants.py."
-        ),
-    ),
+    # The dispatch_staging and _read_one_inner entries that used to sit
+    # here are RETIRED: the executor cross-task handoff their prose
+    # asserted is now machine-checked every run by the interprocedural
+    # closure-domain sanction (summaries.closure_sanction via the
+    # resource-pairing summary hook) — a debit in a pipeline closure is
+    # accepted only while the enclosing executor's domain provably
+    # contains the matching credit on the same receiver, so the rename
+    # that would have silently invalidated these justifications now
+    # fails the lint instead.
     Allow(
         pass_id="resource-pairing",
         file="torchsnapshot_tpu/scheduler.py",
@@ -80,21 +75,17 @@ ALLOWLIST: Tuple[Allow, ...] = (
             "Read-side admission debits hand the pipeline to read_one "
             "tasks; the matching credit fires at consume completion in "
             "a later iteration of the same executor loop (or its "
-            "cancellation sweep).  Same cross-task ownership handoff "
-            "as the write executor, covered by the scheduler fuzz and "
-            "take-invariant suites."
-        ),
-    ),
-    Allow(
-        pass_id="resource-pairing",
-        file="torchsnapshot_tpu/scheduler.py",
-        context="_execute_read_pipelines._read_one_inner",
-        justification=(
-            "The mmap-declined post-read debit re-enters heap bytes "
-            "into budget accounting after the plugin fell back to a "
-            "copying read; the credit is issued when consume_one "
-            "releases the buffer — deliberately NOT in this function, "
-            "because the bytes stay alive until the consumer runs."
+            "cancellation sweep) — a cross-ITERATION pairing inside "
+            "one function body, which stays outside the closure-domain "
+            "sanction (that proof covers debits in NESTED defs; these "
+            "sit in the executor body itself).  Interprocedural "
+            "evidence bounding the risk: the effect-escape pass "
+            "verifies the budget verb family is two-sided package-wide "
+            "and that this function's own summary carries both "
+            "debit and credit effects on the same `budget` receiver "
+            "(tools/lint/summaries.py res effects); path-exactness "
+            "across loop iterations is asserted end-to-end by the "
+            "scheduler fuzz and take-invariant suites."
         ),
     ),
     Allow(

@@ -33,7 +33,10 @@ DEFAULT_BASELINE = os.path.join(
 
 def repo_summary(root: str = _REPO_ROOT) -> dict:
     """One-call repo lint rollup for dashboards/BENCH records: finding
-    counts by disposition plus the per-pass unbaselined breakdown."""
+    counts by disposition, the per-pass unbaselined breakdown, per-pass
+    wall time and the summary-cache hit/miss split — so the BENCH
+    "lint" block shows both the hygiene trajectory AND what thirteen
+    passes cost (and how much the cache buys back)."""
     result = run_repo(
         root,
         ALL_PASSES,
@@ -47,11 +50,70 @@ def repo_summary(root: str = _REPO_ROOT) -> dict:
         **result.summary(),
         "passes": [p.pass_id for p in ALL_PASSES],
         "unbaselined_by_pass": by_pass,
+        "timings_ms": {
+            pid: round(t * 1000.0, 2)
+            for pid, t in result.timings.items()
+        },
+        "summary_cache": dict(result.summary_cache),
         "unused_allows": [
             f"{a.pass_id}:{a.file}:{a.context}"
             for a in result.unused_allows
         ],
     }
+
+
+def changed_files(root: str, ref: str) -> Optional[set]:
+    """Files changed vs ``ref`` (worktree + index, plus untracked) —
+    the ``--changed`` scope, as paths relative to ``root``.  None when
+    git is unavailable or ``root`` is not a checkout (the caller falls
+    back to a full run rather than silently linting nothing).
+
+    ``git diff --name-only`` emits toplevel-relative paths while the
+    scanner's relpaths are root-relative; when ``root`` sits below the
+    toplevel (a vendored tree in a monorepo), diff paths are filtered
+    to the subtree and re-based via ``rev-parse --show-prefix`` —
+    without that, every diff path would miss every unit and the run
+    would silently lint nothing."""
+    import subprocess
+
+    def run(args):
+        try:
+            proc = subprocess.run(
+                args, capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return proc.stdout if proc.returncode == 0 else None
+
+    # -c core.quotepath=off: with git's default quoting, a non-ASCII
+    # filename comes back escaped-and-quoted, matches no unit relpath
+    # and would be silently skipped
+    git = ["git", "-C", root, "-c", "core.quotepath=off"]
+    prefix_out = run([*git, "rev-parse", "--show-prefix"])
+    if prefix_out is None:
+        return None
+    prefix = prefix_out.strip()
+    out: set = set()
+    diff = run([*git, "diff", "--name-only", ref, "--"])
+    if diff is None:
+        return None
+    for line in diff.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if prefix:
+            if not line.startswith(prefix):
+                continue  # changed outside the scanned subtree
+            line = line[len(prefix):]
+        out.add(line)
+    # untracked: ls-files paths are already relative to the -C dir
+    untracked = run([*git, "ls-files", "--others", "--exclude-standard"])
+    if untracked is None:
+        return None
+    out.update(
+        line.strip() for line in untracked.splitlines() if line.strip()
+    )
+    return out
 
 
 def _github_escape(text: str) -> str:
@@ -108,6 +170,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="run only the named pass(es); repeatable",
     )
     parser.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None,
+        metavar="REF",
+        help="pre-commit mode: per-file passes report only on files "
+        "changed vs REF (default HEAD; worktree+index+untracked).  "
+        "Every file is still parsed and the interprocedural passes "
+        "still run package-wide — reusing the summary cache for "
+        "unchanged dependencies — because a rename in a changed file "
+        "can orphan a consumer in an unchanged one",
+    )
+    parser.add_argument(
         "--list-passes", action="store_true",
         help="list registered passes and exit",
     )
@@ -125,6 +197,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         for p in ALL_PASSES:
             print(f"{p.pass_id:<20} {p.description}")
         return 0
+
+    if args.update_baseline and args.changed is not None:
+        # a changed-subset rewrite would erase every fingerprint owed
+        # by the unchanged files — same partial-scope hazard as --pass
+        print(
+            "error: --update-baseline and --changed conflict "
+            "(the rewrite must come from a full-scope run)",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.update_baseline and args.no_baseline:
         # --no-baseline would make the rewrite ratchet against an
@@ -152,21 +234,35 @@ def main(argv: Optional[List[str]] = None) -> int:
             p for p in ALL_PASSES if p.pass_id in set(args.passes)
         )
 
+    only_files = None
+    if args.changed is not None:
+        only_files = changed_files(args.root, args.changed)
+        if only_files is None:
+            print(
+                f"warning: cannot resolve changed files vs "
+                f"{args.changed!r} (not a git checkout?); running the "
+                f"full scan",
+                file=sys.stderr,
+            )
+
     try:
         baseline = (
             {} if args.no_baseline else load_baseline(args.baseline)
         )
         result = run_repo(
-            args.root, passes, allowlist=ALLOWLIST, baseline=baseline
+            args.root, passes, allowlist=ALLOWLIST, baseline=baseline,
+            only_files=only_files,
         )
     except LintConfigError as e:
         print(f"lint configuration error: {e}", file=sys.stderr)
         return 2
 
-    # staleness is only decidable on a FULL run: a --pass subset never
-    # matches the skipped passes' allowlist entries, and reporting them
-    # as stale would invite deleting entries the full run still needs
-    unused_allows = [] if args.passes else result.unused_allows
+    # staleness is only decidable on a FULL run: a --pass or --changed
+    # subset never matches the skipped scope's allowlist entries, and
+    # reporting them as stale would invite deleting entries the full
+    # run still needs
+    partial = bool(args.passes) or only_files is not None
+    unused_allows = [] if partial else result.unused_allows
 
     if args.update_baseline:
         # a rewrite must come from a FULL-scope run: findings from a

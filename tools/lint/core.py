@@ -79,6 +79,11 @@ class FileUnit:
         # cross-checks); None for in-memory fixture units, so fixtures
         # stay hermetic
         self.root = root
+        # the interprocedural Project this unit belongs to (set by
+        # Project.__init__); None for standalone fixture units, which
+        # is how passes with summary hooks tell "whole-package run"
+        # (hook active) from "single-file fixture" (hook inert)
+        self.project = None
         self.tree = ast.parse(source, self.relpath)
         self._parents: Optional[Dict[ast.AST, ast.AST]] = None
         self._cfgs: Dict[ast.AST, "object"] = {}
@@ -186,6 +191,35 @@ class LintPass:
             line=getattr(node, "lineno", 0),
             message=message,
             context=unit.context_of(node),
+        )
+
+
+class ProjectPass(LintPass):
+    """An interprocedural pass: runs ONCE per project (all units, the
+    call graph and the summary table in scope) instead of once per
+    file.  ``run`` is inert — per-unit iteration would multiply the
+    package-wide findings by the file count."""
+
+    def run(self, unit: FileUnit) -> Iterable[Finding]:
+        return []
+
+    def run_project(self, project) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding_at(
+        self, relpath: str, lineno: int, context: str, message: str
+    ) -> Finding:
+        """Findings from summary data carry their location explicitly
+        (the summary may have come from the cache, so there is no AST
+        node in hand); ``context`` is the enclosing def qualname —
+        exactly what ``FileUnit.context_of`` would have produced, so
+        allowlist/baseline fingerprints stay stable either way."""
+        return Finding(
+            pass_id=self.pass_id,
+            file=relpath,
+            line=lineno,
+            message=message,
+            context=context,
         )
 
 
@@ -349,6 +383,12 @@ class LintResult:
     unbaselined: List[Finding]       # actionable: these fail the run
     unused_allows: List[Allow]       # stale entries (warned, not fatal)
     files_scanned: int = 0
+    # per-pass wall time (seconds) and the summary-cache hit/miss
+    # counts — the BENCH "lint" block's cost attribution
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
+    summary_cache: Dict[str, int] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def ok(self) -> bool:
@@ -385,6 +425,30 @@ def run_source(
     return run_passes_on_unit(FileUnit(filename, source), passes)
 
 
+def run_project_sources(
+    sources: Dict[str, str],
+    passes: Sequence[LintPass],
+) -> List[Finding]:
+    """Run ``passes`` over an in-memory multi-file project — the
+    fixture entry point for the interprocedural passes.  ``sources``
+    maps repo-relative paths to source text; a Project (call graph +
+    summaries, no on-disk cache) is built over all of them, per-unit
+    passes run per file and ProjectPasses once."""
+    from .interproc import Project
+
+    units = [FileUnit(path, src) for path, src in sources.items()]
+    Project(units)  # attaches itself as unit.project
+    findings: List[Finding] = []
+    for p in passes:
+        if isinstance(p, ProjectPass):
+            findings.extend(p.run_project(units[0].project))
+        else:
+            for unit in units:
+                findings.extend(p.run(unit))
+    findings.sort(key=lambda f: (f.file, f.line, f.pass_id))
+    return findings
+
+
 def iter_scan_files(root: str) -> Iterable[str]:
     for rel in SCAN_FILES:
         if os.path.isfile(os.path.join(root, rel)):
@@ -407,16 +471,33 @@ def run_repo(
     passes: Sequence[LintPass],
     allowlist: Sequence[Allow] = (),
     baseline: Optional[Dict[str, int]] = None,
+    only_files: Optional[Iterable[str]] = None,
 ) -> LintResult:
+    """Lint the tree at ``root``.
+
+    ``only_files`` (the ``--changed`` mode) restricts which files the
+    per-unit passes REPORT on; every file is still parsed and fed to
+    the Project, because the interprocedural passes need the whole
+    package — an orphaned KV consumer caused by a rename in a changed
+    file may sit in an unchanged one, so ProjectPass findings are
+    never filtered.
+    """
+    import time as _time
+
     validate_allowlist(allowlist)
     findings: List[Finding] = []
+    units: List[FileUnit] = []
+    only = (
+        None if only_files is None
+        else {f.replace(os.sep, "/") for f in only_files}
+    )
     n_files = 0
     for rel in iter_scan_files(root):
         n_files += 1
         with open(os.path.join(root, rel), encoding="utf-8") as f:
             src = f.read()
         try:
-            unit = FileUnit(rel, src, root=root)
+            units.append(FileUnit(rel, src, root=root))
         except SyntaxError as e:
             # a broken file must surface as ONE actionable finding, not
             # kill the whole run: the other 100+ files' findings are
@@ -431,7 +512,39 @@ def run_repo(
                 )
             )
             continue
-        findings.extend(run_passes_on_unit(unit, passes))
+    from .interproc import Project
+
+    project = Project(units, root=root)
+    timings: Dict[str, float] = {}
+    if any(isinstance(p, ProjectPass) for p in passes):
+        # build the shared substrate (call graph, Tarjan SCCs, summary
+        # extraction + bottom-up closures) under its own timing key —
+        # lazily it would all be charged to whichever ProjectPass runs
+        # first, misdirecting the BENCH cost attribution this exists
+        # for
+        t0 = _time.monotonic()
+        project.summaries
+        timings["interproc-substrate"] = _time.monotonic() - t0
+    for p in passes:
+        t0 = _time.monotonic()
+        if isinstance(p, ProjectPass):
+            findings.extend(p.run_project(project))
+        else:
+            for unit in units:
+                if only is not None and unit.relpath not in only:
+                    continue
+                findings.extend(p.run(unit))
+        timings[p.pass_id] = (
+            timings.get(p.pass_id, 0.0) + _time.monotonic() - t0
+        )
+    summary_cache = (
+        {
+            "hits": project.summaries.cache_hits,
+            "misses": project.summaries.cache_misses,
+        }
+        if project._summaries is not None
+        else {"hits": 0, "misses": 0}
+    )
     findings.sort(key=lambda f: (f.file, f.line, f.pass_id))
 
     allowlisted: List[Finding] = []
@@ -463,4 +576,6 @@ def run_repo(
         unbaselined=unbaselined,
         unused_allows=[a for i, a in enumerate(allowlist) if not used[i]],
         files_scanned=n_files,
+        timings=timings,
+        summary_cache=summary_cache,
     )

@@ -1,0 +1,573 @@
+"""Package-wide call graph for the interprocedural snaplint passes.
+
+The CFG framework (cfg.py) stops at function and module boundaries by
+design — and the invariants the scheduler-DAG refactor will churn are
+exactly the ones that cross them: a barrier reachable through a helper
+called under a rank guard, a KV key produced in ``topology/fanout.py``
+and consumed in ``continuous/recover.py``, a budget debit whose credit
+lives in a sibling closure of the same executor.  This module gives
+passes the missing substrate: a ``Project`` over every scanned
+``FileUnit`` with
+
+- **module resolution** — repo-relative paths become dotted module
+  names (``torchsnapshot_tpu/topology/fanout.py`` →
+  ``torchsnapshot_tpu.topology.fanout``), absolute and relative imports
+  resolve against the project's own module set;
+- **a function index** — every def in every unit keyed by
+  ``(relpath, qualname)`` (an ``FKey``), methods and nested defs
+  included;
+- **call resolution** — ``helper()`` through local scope then
+  from-imports, ``mod.helper()`` through module imports,
+  ``self.m()``/``cls.m()`` through the enclosing class's attribute
+  table and its package-local bases, and — bounded — ``obj.m()``
+  through a package-wide unique-method table (at most
+  ``MAX_METHOD_CANDIDATES`` defining classes, else unresolved: beyond
+  that the name is too generic for attribute-table dispatch to mean
+  anything);
+- **the call graph and its SCCs** (Tarjan, emitted in reverse
+  topological order — callees before callers — the order the
+  bottom-up summary computation in summaries.py consumes).
+
+Resolution is *bounded closure*, stated once: a call that resolves to
+nothing (external library, dynamic dispatch past the candidate bound,
+getattr tricks) contributes no edge — the analyses built on top treat
+unresolved calls as effect-free, which errs toward silence for
+may-block/resource questions and toward silence for protocol
+questions.  That is the same trade the intra-module call graph already
+made; the passes' fixture suites pin the shapes that must resolve.
+
+Like the rest of the driver this is stdlib-only and import-light.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FileUnit, call_name, calls_in_body, receiver_name
+
+FKey = Tuple[str, str]  # (relpath, function qualname)
+
+# `obj.m()` resolves through the package-wide method table only when
+# exactly this many classes define `m` — i.e. the name is UNIQUE to
+# one class.  Two candidates already poisoned real chains in testing
+# (`plugin._run` is an executor dispatch on the S3 plugin but a
+# blocking thread loop on the Promoter); attribute-table dispatch is
+# only evidence when it cannot be wrong.
+MAX_METHOD_CANDIDATES = 1
+
+# Names the method-table fallback must never dispatch on: anything a
+# builtin container/file-ish object also answers.  `self._cache.get(k)`
+# is a dict call no matter how many project classes define `get`, and
+# one wrong hop poisons every chain built above it.  Built from the
+# builtin types themselves so new Python versions stay covered.
+GENERIC_METHOD_NAMES = frozenset(
+    n
+    for t in (dict, list, set, frozenset, tuple, str, bytes, bytearray)
+    for n in dir(t)
+    if not n.startswith("_")
+) | frozenset(
+    {
+        "close", "open", "read", "write", "readline", "readlines",
+        "seek", "tell", "flush", "fileno", "run", "start", "cancel",
+        "put", "get_nowait", "put_nowait", "task_done", "send",
+        "recv", "submit", "shutdown", "wait", "set", "clear",
+        "notify", "notify_all",
+        # stdlib serialization/loader verbs: `ep.load()` is importlib
+        # EntryPoint.load, `json.load`… — never a project method
+        "load", "loads", "dump", "dumps",
+    }
+)
+
+# The SPMD collective verbs.  Defined HERE — the substrate both the
+# lexical collective-safety pass and the interprocedural summaries
+# ride — so what two passes consider "a collective" cannot skew
+# (collective_safety imports this set; this module must not import
+# the pass package, or registry import would cycle).
+COLLECTIVE_NAMES = frozenset(
+    {
+        "barrier",
+        "kv_exchange",
+        "all_gather_object",
+        "broadcast_object",
+        "gather_object",
+    }
+)
+
+# Names that are *effects*, not calls to follow: the coordination
+# primitives' bodies (arrive/depart loops over raw KV) must not be
+# inlined into protocol projections — a `barrier()` call IS one
+# synchronization op.  Shared with summaries.py.
+KV_OP_NAMES = frozenset(
+    {
+        "kv_set",
+        "kv_get",
+        "kv_try_get",
+        "kv_try_delete",
+        "kv_publish_blob",
+        "kv_try_fetch_blob",
+    }
+)
+EFFECT_CALL_NAMES = COLLECTIVE_NAMES | KV_OP_NAMES
+
+
+def module_name(relpath: str) -> str:
+    """``a/b/c.py`` → ``a.b.c``; ``a/b/__init__.py`` → ``a.b``."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else (
+        relpath.split("/")
+    )
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _ClassInfo:
+    __slots__ = ("qualname", "methods", "bases")
+
+    def __init__(self, qualname: str) -> None:
+        self.qualname = qualname
+        self.methods: Dict[str, str] = {}  # method name -> def qualname
+        self.bases: List[str] = []  # base-class trailing names
+
+
+class _ModuleInfo:
+    """Per-unit resolution tables, built in one cheap top-level walk."""
+
+    __slots__ = ("unit", "imports", "classes", "top_defs", "fn_index")
+
+    def __init__(self, unit: FileUnit) -> None:
+        self.unit = unit
+        # local name -> ("module", dotted) | ("symbol", dotted, name)
+        self.imports: Dict[str, Tuple] = {}
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.top_defs: Dict[str, str] = {}  # bare name -> qualname
+        # every def qualname -> node (unit.functions() as a dict)
+        self.fn_index: Dict[str, ast.AST] = dict(unit.functions())
+        self._build()
+
+    def _build(self) -> None:
+        mod = module_name(self.unit.relpath)
+        pkg_parts = mod.split(".")
+        if not self.unit.relpath.endswith("/__init__.py"):
+            pkg_parts = pkg_parts[:-1]
+
+        def record(node: ast.AST, top_level: bool) -> None:
+            # module-level bindings take priority: a lazy
+            # function-local `from .y import helper` must not clobber
+            # the module-level `from .x import helper` that every
+            # OTHER function's calls resolve through (nested imports
+            # still bind names nothing at top level claimed)
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else (
+                        alias.name.split(".")[0]
+                    )
+                    if top_level or local not in self.imports:
+                        self.imports[local] = ("module", target)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    src = ".".join(base + (
+                        node.module.split(".") if node.module else []
+                    ))
+                else:
+                    src = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    if top_level or local not in self.imports:
+                        self.imports[local] = ("symbol", src, alias.name)
+
+        top = set()
+        for child in ast.iter_child_nodes(self.unit.tree):
+            top.add(id(child))
+            record(child, True)
+        for node in ast.walk(self.unit.tree):
+            if id(node) not in top:
+                record(node, False)
+        for child in ast.iter_child_nodes(self.unit.tree):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.top_defs[child.name] = child.name
+            elif isinstance(child, ast.ClassDef):
+                info = _ClassInfo(child.name)
+                for b in child.bases:
+                    if isinstance(b, ast.Name):
+                        info.bases.append(b.id)
+                    elif isinstance(b, ast.Attribute):
+                        info.bases.append(b.attr)
+                for m in ast.iter_child_nodes(child):
+                    if isinstance(
+                        m, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        info.methods[m.name] = f"{child.name}.{m.name}"
+                self.classes[child.name] = info
+
+
+class Project:
+    """Every scanned unit plus the cross-module resolution tables.
+
+    Construction is cheap (one top-level walk per unit); the call
+    graph, SCCs and summaries are built lazily on first demand and
+    memoized for the run.
+    """
+
+    def __init__(
+        self,
+        units: Sequence[FileUnit],
+        root: Optional[str] = None,
+        cache_path: Optional[str] = None,
+    ) -> None:
+        self.units: List[FileUnit] = list(units)
+        self.root = root
+        self.cache_path = cache_path
+        self.by_path: Dict[str, FileUnit] = {
+            u.relpath: u for u in self.units
+        }
+        self.by_module: Dict[str, FileUnit] = {
+            module_name(u.relpath): u for u in self.units
+        }
+        self._mods: Dict[str, _ModuleInfo] = {}
+        # resolve_call memo: the graph build and the summary table
+        # resolve the same call records; one computation serves both
+        self._resolve_memo: Dict[Tuple, List[FKey]] = {}
+        # method name -> [(relpath, def qualname)] across all classes
+        self._method_index: Optional[Dict[str, List[FKey]]] = None
+        self._graph: Optional[Dict[FKey, List[FKey]]] = None
+        self._rgraph: Optional[Dict[FKey, List[FKey]]] = None
+        self._sccs: Optional[List[List[FKey]]] = None
+        self._summaries = None  # summaries.SummaryTable, built lazily
+        for u in self.units:
+            u.project = self
+
+    # ------------------------------------------------------ tables
+
+    def mod_info(self, unit: FileUnit) -> _ModuleInfo:
+        mi = self._mods.get(unit.relpath)
+        if mi is None:
+            mi = self._mods[unit.relpath] = _ModuleInfo(unit)
+        return mi
+
+    @property
+    def method_index(self) -> Dict[str, List[FKey]]:
+        if self._method_index is None:
+            idx: Dict[str, List[FKey]] = {}
+            for u in self.units:
+                mi = self.mod_info(u)
+                for cls in mi.classes.values():
+                    for name, qn in cls.methods.items():
+                        idx.setdefault(name, []).append((u.relpath, qn))
+            self._method_index = idx
+        return self._method_index
+
+    def functions(self) -> Iterable[Tuple[FKey, ast.AST, FileUnit]]:
+        for u in self.units:
+            for qn, fn in u.functions():
+                yield (u.relpath, qn), fn, u
+
+    def function_node(self, key: FKey) -> Optional[ast.AST]:
+        unit = self.by_path.get(key[0])
+        if unit is None:
+            return None
+        return self.mod_info(unit).fn_index.get(key[1])
+
+    # -------------------------------------------------- resolution
+
+    def _resolve_in_module(
+        self, target_mod: str, name: str,
+        _seen: Optional[Set[Tuple[str, str]]] = None,
+    ) -> List[FKey]:
+        seen = _seen if _seen is not None else set()
+        if (target_mod, name) in seen:
+            return []  # cyclic re-export (stale refactor leftover)
+        seen.add((target_mod, name))
+        unit = self.by_module.get(target_mod)
+        if unit is None:
+            return []
+        mi = self.mod_info(unit)
+        if name in mi.top_defs:
+            return [(unit.relpath, mi.top_defs[name])]
+        # re-export: `from .impl import helper` in the target's
+        # __init__ — follow symbol hops, cycle-guarded
+        bound = mi.imports.get(name)
+        if bound is not None and bound[0] == "symbol":
+            return self._resolve_in_module(bound[1], bound[2], seen)
+        return []
+
+    def _enclosing_class(
+        self, mi: _ModuleInfo, caller_qualname: str
+    ) -> Optional[_ClassInfo]:
+        parts = caller_qualname.split(".")
+        for p in parts:
+            if p in mi.classes:
+                return mi.classes[p]
+        return None
+
+    def _resolve_method(
+        self, mi: _ModuleInfo, cls: _ClassInfo, name: str,
+        _seen: Optional[Set[str]] = None,
+    ) -> List[FKey]:
+        seen = _seen or set()
+        if cls.qualname in seen:
+            return []
+        seen.add(cls.qualname)
+        if name in cls.methods:
+            return [(mi.unit.relpath, cls.methods[name])]
+        for base in cls.bases:
+            # package-local base in the same module…
+            if base in mi.classes:
+                got = self._resolve_method(
+                    mi, mi.classes[base], name, seen
+                )
+                if got:
+                    return got
+            # …or imported from a sibling module
+            bound = mi.imports.get(base)
+            if bound is not None and bound[0] == "symbol":
+                bunit = self.by_module.get(bound[1])
+                if bunit is not None:
+                    bmi = self.mod_info(bunit)
+                    bcls = bmi.classes.get(bound[2])
+                    if bcls is not None:
+                        got = self._resolve_method(bmi, bcls, name, seen)
+                        if got:
+                            return got
+        return []
+
+    def resolve_call(
+        self,
+        unit: FileUnit,
+        caller_qualname: str,
+        shape: Tuple,
+    ) -> List[FKey]:
+        """Resolve one call record to its possible in-project targets.
+
+        ``shape`` is ``("name", f)`` for a bare call or
+        ``("attr", receiver_trailing_name, m)`` for a method call —
+        the serialized form the summary cache stores, so resolution
+        works identically from a fresh AST walk and a cache hit.
+        """
+        memo_key = (unit.relpath, caller_qualname, shape)
+        got = self._resolve_memo.get(memo_key)
+        if got is not None:
+            return got
+        out = self._resolve_call_uncached(unit, caller_qualname, shape)
+        self._resolve_memo[memo_key] = out
+        return out
+
+    def _resolve_call_uncached(
+        self,
+        unit: FileUnit,
+        caller_qualname: str,
+        shape: Tuple,
+    ) -> List[FKey]:
+        mi = self.mod_info(unit)
+        if shape[0] == "name":
+            name = shape[1]
+            if name in EFFECT_CALL_NAMES:
+                return []
+            # nested def visible from the caller's scope chain —
+            # FUNCTION scopes only: class bodies are not enclosing
+            # scopes in Python, so a bare `helper()` inside a method
+            # binds the module-level function, never a same-named
+            # sibling method
+            prefix = caller_qualname
+            while prefix:
+                if prefix in mi.fn_index:
+                    qn = f"{prefix}.{name}"
+                    if qn in mi.fn_index:
+                        return [(unit.relpath, qn)]
+                prefix = prefix.rpartition(".")[0]
+            if name in mi.top_defs:
+                return [(unit.relpath, mi.top_defs[name])]
+            bound = mi.imports.get(name)
+            if bound is not None and bound[0] == "symbol":
+                return self._resolve_in_module(bound[1], bound[2])
+            return []
+        # ("attr", recv, name) — recv may be a dotted path
+        _tag, recv, name = shape
+        if name in EFFECT_CALL_NAMES:
+            return []
+        head, _dot, tail = recv.partition(".")
+        bound = mi.imports.get(head)
+        if bound is not None:
+            if bound[0] == "module":
+                # `import pkg.sub; pkg.sub.f()` — the receiver path
+                # past the bound head names submodules.  The head is
+                # KNOWN to be a module either way, so a failed lookup
+                # is an external call, never method-table material
+                # (`os.path.realpath` must not resolve to a project
+                # class that happens to define `realpath`)
+                mod = bound[1] if not tail else f"{bound[1]}.{tail}"
+                return self._resolve_in_module(mod, name)
+            if bound[0] == "symbol" and tail:
+                # `from pkg import sub; sub.inner.f()` — try the
+                # nested module path; the receiver is rooted in a
+                # known import either way, so no fallthrough
+                return self._resolve_in_module(
+                    f"{bound[1]}.{bound[2]}.{tail}", name
+                )
+            if bound[0] == "symbol" and not tail:
+                # `from pkg import mod; mod.f()` — the symbol may BE a
+                # submodule of the source package
+                got = self._resolve_in_module(
+                    f"{bound[1]}.{bound[2]}", name
+                )
+                if got:
+                    return got
+                # …or a class: `Coordinator.kv_get` style — method on
+                # the imported class
+                sunit = self.by_module.get(bound[1])
+                if sunit is not None:
+                    smi = self.mod_info(sunit)
+                    scls = smi.classes.get(bound[2])
+                    if scls is not None:
+                        return self._resolve_method(smi, scls, name)
+                return []
+        if recv in ("self", "cls"):
+            cls = self._enclosing_class(mi, caller_qualname)
+            if cls is not None:
+                # the receiver's class IS known: a miss means the
+                # attribute is dynamic or inherited from outside the
+                # package — the method table would only guess
+                return self._resolve_method(mi, cls, name)
+        if name in GENERIC_METHOD_NAMES:
+            return []
+        # uniqueness counts (relpath, qualname) candidates, NOT bare
+        # class names: two same-named classes in different modules are
+        # two owners, and resolving to both would be a guess
+        candidates = self.method_index.get(name, [])
+        if 0 < len(candidates) <= MAX_METHOD_CANDIDATES:
+            return list(candidates)
+        return []
+
+    @staticmethod
+    def call_shape(call: ast.Call) -> Optional[Tuple]:
+        """The serializable resolution shape of a call node.  A
+        receiver that is a pure dotted Name/Attribute chain keeps the
+        full path (``pkg.sub.f()`` needs it to find the submodule);
+        anything else degrades to the trailing name, which is all the
+        method table wants."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return ("name", func.id)
+        if isinstance(func, ast.Attribute):
+            parts: List[str] = []
+            cur = func.value
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                parts.append(cur.id)
+                recv = ".".join(reversed(parts))
+            else:
+                recv = receiver_name(func)
+            return ("attr", recv, func.attr)
+        return None
+
+    # -------------------------------------------------- call graph
+
+    @property
+    def graph(self) -> Dict[FKey, List[FKey]]:
+        """fkey → resolved callee fkeys (deduped, insertion order)."""
+        if self._graph is None:
+            g: Dict[FKey, List[FKey]] = {}
+            for key, _fn, unit in self.functions():
+                g[key] = []
+            for key, fn, unit in self.functions():
+                seen: Set[FKey] = set()
+                for call in calls_in_body(fn):
+                    shape = self.call_shape(call)
+                    if shape is None:
+                        continue
+                    for tgt in self.resolve_call(unit, key[1], shape):
+                        if tgt not in seen and tgt in g:
+                            seen.add(tgt)
+                            g[key].append(tgt)
+            self._graph = g
+        return self._graph
+
+    @property
+    def rgraph(self) -> Dict[FKey, List[FKey]]:
+        """Reverse edges: fkey → callers."""
+        if self._rgraph is None:
+            r: Dict[FKey, List[FKey]] = {k: [] for k in self.graph}
+            for src, dsts in self.graph.items():
+                for d in dsts:
+                    r[d].append(src)
+            self._rgraph = r
+        return self._rgraph
+
+    def sccs(self) -> List[List[FKey]]:
+        """Strongly connected components in reverse topological order
+        (every edge leaves a later component for an earlier one), i.e.
+        callees first — the bottom-up summary order."""
+        if self._sccs is not None:
+            return self._sccs
+        graph = self.graph
+        index: Dict[FKey, int] = {}
+        low: Dict[FKey, int] = {}
+        on_stack: Set[FKey] = set()
+        stack: List[FKey] = []
+        out: List[List[FKey]] = []
+        counter = [0]
+
+        # iterative Tarjan: recursion depth would track call-chain
+        # depth, which real code exceeds
+        for root in graph:
+            if root in index:
+                continue
+            work: List[Tuple[FKey, int]] = [(root, 0)]
+            while work:
+                node, ei = work.pop()
+                if ei == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                recurse = False
+                edges = graph[node]
+                while ei < len(edges):
+                    dst = edges[ei]
+                    ei += 1
+                    if dst not in index:
+                        work.append((node, ei))
+                        work.append((dst, 0))
+                        recurse = True
+                        break
+                    if dst in on_stack:
+                        low[node] = min(low[node], index[dst])
+                if recurse:
+                    continue
+                if low[node] == index[node]:
+                    comp: List[FKey] = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    out.append(comp)
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+        self._sccs = out
+        return out
+
+    def scc_of(self) -> Dict[FKey, int]:
+        return {
+            k: i for i, comp in enumerate(self.sccs()) for k in comp
+        }
+
+    # --------------------------------------------------- summaries
+
+    @property
+    def summaries(self):
+        """The package summary table (summaries.SummaryTable), built
+        bottom-up over the SCCs on first demand."""
+        if self._summaries is None:
+            from . import summaries as _summaries
+
+            self._summaries = _summaries.SummaryTable(self)
+        return self._summaries

@@ -1,11 +1,18 @@
 """The registered snaplint passes.  Order here is presentation order in
 ``--list-passes``; findings are sorted by location regardless.
 
-The first six are lexical single-function walks.  Of the last four,
-resource-pairing rides the per-function CFGs (``FileUnit.cfg`` +
-``cfg.reach``) and async-blocking the intra-module call graph
-(``FileUnit.local_defs``/``callers``); kv-hygiene and metric-registry
-are module-level hygiene sweeps that shipped with the substrate."""
+The first six are lexical single-function walks.  The next four ride
+the flow-sensitive substrate — resource-pairing the per-function CFGs
+(``FileUnit.cfg`` + ``cfg.reach``), async-blocking the intra-module
+call graph (``FileUnit.local_defs``/``callers``); kv-hygiene and
+metric-registry are module-level hygiene sweeps that shipped with it.
+The last three are **interprocedural** (``ProjectPass``): they run
+once per project over the package-wide call graph and the summary
+table (tools/lint/interproc.py, tools/lint/summaries.py) instead of
+once per file — protocol-lockstep for cross-call SPMD collective
+discipline, kv-matching for producer/consumer key-shape pairing,
+effect-escape for resource handoffs and cross-module blocking chains.
+"""
 
 from __future__ import annotations
 
@@ -14,12 +21,15 @@ from typing import Tuple
 from ..core import LintPass
 from .async_blocking import AsyncBlockingPass
 from .collective_safety import CollectiveSafetyPass
+from .effect_escape import EffectEscapePass
 from .exception_hygiene import ExceptionHygienePass
 from .instrumentation import InstrumentationPass
 from .knob_registry import KnobRegistryPass
 from .kv_hygiene import KvHygienePass
+from .kv_matching import KvMatchingPass
 from .lock_discipline import LockDisciplinePass
 from .metric_registry import MetricRegistryPass
+from .protocol_lockstep import ProtocolLockstepPass
 from .resource_pairing import ResourcePairingPass
 from .retry_discipline import RetryDisciplinePass
 
@@ -34,4 +44,7 @@ ALL_PASSES: Tuple[LintPass, ...] = (
     ResourcePairingPass(),
     KvHygienePass(),
     MetricRegistryPass(),
+    ProtocolLockstepPass(),
+    KvMatchingPass(),
+    EffectEscapePass(),
 )

@@ -44,15 +44,11 @@ from ..core import (
     calls_in_body,
 )
 
-COLLECTIVE_NAMES = frozenset(
-    {
-        "barrier",
-        "kv_exchange",
-        "all_gather_object",
-        "broadcast_object",
-        "gather_object",
-    }
-)
+# The collective verb set is owned by the interprocedural substrate
+# (tools/lint/interproc.py) so this pass and the summary-based
+# protocol-lockstep pass can never disagree about what "a collective"
+# is; re-exported here for the existing import surface.
+from ..interproc import COLLECTIVE_NAMES  # noqa: E402,F401
 
 
 def _mentions_rank(test: ast.expr) -> bool:

@@ -1,0 +1,237 @@
+"""effect-escape: resource and blocking effects that cross function
+boundaries, proven (or flagged) through summaries.
+
+The per-function passes stop where ownership moves: resource-pairing
+counts "handed the receiver to a call" as a release, async-blocking
+follows helpers only inside one module.  Both cutoffs are exactly
+where a refactor hides regressions — the callee that used to credit
+gets renamed and the handoff now leads nowhere; a blocking helper
+moves to another module and the event loop stalls with no finding.
+This pass closes both gaps with the summary table:
+
+1. **Cross-module blocking chains** — an ``async def`` calling (not
+   dispatching: executor/to_thread hand a *reference* and stay
+   structurally exempt) a sync function whose package-wide transitive
+   summary blocks.  Module-local chains within the lexical pass's
+   depth bound stay its finding — this pass reports only what it
+   cannot see: a chain that leaves the module, or one deeper than
+   its cutoff.
+
+2. **Handoff into the void** — a function debits/acquires a tracked
+   resource (budget / byte-gate / breaker, the resource-pairing
+   taxonomy) and discharges the obligation by passing the receiver to
+   a callee — but the callee's transitive closure contains NO
+   release-family verb of that kind.  The intraprocedural pass
+   sanctioned the handoff on faith; the summary makes it checkable.
+   Unresolvable callees stay on-faith (external code may well
+   release), so this errs toward silence, not noise.
+
+3. **One-sided verb families** — some function acquires a kind
+   (debits a budget, reserves a gate) but NO function in the whole
+   scan set releases that kind.  The whole family is then leaking by
+   construction — the classic symptom of renaming ``credit`` during
+   a refactor.  Reported once per acquire site.
+
+The same summary machinery also powers the resource-pairing pass's
+*closure-domain sanction* (summaries.closure_sanction): a debit in a
+pipeline closure whose enclosing executor function provably contains
+the matching credit no longer needs an allowlist entry — see the
+resource-pairing docstring.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, ProjectPass
+from ..interproc import FKey, Project
+
+# chains the lexical async-blocking pass already reports: same-module,
+# within its depth cutoff — imported, not re-typed, so tuning the
+# lexical bound cannot open a gap (or an overlap) between the passes
+from .async_blocking import _MAX_CHAIN_DEPTH as _LEXICAL_DEPTH
+
+# The deliberate-blocking-source exemption lives in summaries.py
+# (chain SELECTION there must prefer a non-exempt chain, so the set
+# is substrate knowledge); imported, not re-typed, so the two can
+# never skew.
+from ..summaries import BLOCKING_SOURCE_EXEMPT as _BLOCKING_SOURCE_EXEMPT
+
+_RELEASE = "release"
+_ACQUIRE = "acquire"
+
+
+class EffectEscapePass(ProjectPass):
+    pass_id = "effect-escape"
+    description = (
+        "async defs must not reach blocking ops through cross-module "
+        "chains; resource handoffs must lead to a releasing callee; "
+        "acquire families must have release sites"
+    )
+
+    def run_project(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        out.extend(self._check_async_chains(project))
+        out.extend(self._check_handoffs(project))
+        out.extend(self._check_families(project))
+        out.sort(key=lambda f: (f.file, f.line))
+        return out
+
+    # -------------------------------------------- async chains (1)
+
+    def _check_async_chains(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        table = project.summaries
+        for key, summ in table.locals.items():
+            node = project.function_node(key)
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for idx, (shape, lineno, _roots) in enumerate(summ.calls):
+                for tgt in table.targets(key, idx):
+                    if isinstance(
+                        project.function_node(tgt),
+                        ast.AsyncFunctionDef,
+                    ):
+                        continue  # awaited coroutine: checked itself
+                    chain = table.may_block_chain(tgt)
+                    if not chain:
+                        continue
+                    if chain[-1][0] in _BLOCKING_SOURCE_EXEMPT:
+                        continue  # deliberate blocking source
+                    cross_module = tgt[0] != key[0] or any(
+                        rel != key[0] for rel, _desc in chain
+                    )
+                    if not cross_module and len(chain) <= (
+                        _LEXICAL_DEPTH
+                    ):
+                        continue  # the lexical pass's finding
+                    rendered = " -> ".join(
+                        d if rel == key[0] else f"{d} [{rel}]"
+                        for rel, d in chain
+                    )
+                    out.append(
+                        self.finding_at(
+                            key[0], lineno, key[1],
+                            f"async def {key[1]} calls {shape[-1]}() "
+                            f"which blocks through a package-local "
+                            f"chain: {shape[-1]}() -> {rendered} — "
+                            f"one synchronous wait here stalls every "
+                            f"in-flight pipeline; dispatch via "
+                            f"run_in_executor/to_thread or use the "
+                            f"async form",
+                        )
+                    )
+                    break  # one finding per call site
+        return out
+
+    # ------------------------------------------------ handoffs (2)
+
+    def _check_handoffs(self, project: Project) -> List[Finding]:
+        from .resource_pairing import SPECS
+
+        out: List[Finding] = []
+        table = project.summaries
+        for key, summ in table.locals.items():
+            if not summ.res:
+                continue
+            acquired: Dict[str, List[Tuple[str, str, int]]] = {}
+            released: set = set()
+            for family, kind, verb, root, lineno in summ.res:
+                if family == _ACQUIRE:
+                    acquired.setdefault(root, []).append(
+                        (kind, verb, lineno)
+                    )
+                else:
+                    released.add((kind, root))
+            # a function that releases LOCALLY discharges its own
+            # obligation — the CFG pass is the path-sensitive
+            # authority there, and an incidental `_log(budget)` call
+            # is not a handoff; this check covers only the case where
+            # the call WAS the discharge
+            acquired = {
+                root: [
+                    (kind, verb, ln) for kind, verb, ln in items
+                    if (kind, root) not in released
+                ]
+                for root, items in acquired.items()
+            }
+            acquired = {r: it for r, it in acquired.items() if it}
+            if not acquired:
+                continue
+            for idx, (shape, lineno, argroots) in enumerate(
+                summ.calls
+            ):
+                roots_here = [r for r in argroots if r in acquired]
+                if not roots_here:
+                    continue
+                targets = table.targets(key, idx)
+                if not targets:
+                    continue  # unresolved: stays on faith by design
+                for root in roots_here:
+                    for kind, verb, _al in acquired[root]:
+                        if any(
+                            (_RELEASE, kind) in table.res_closure(t)
+                            for t in targets
+                        ):
+                            continue
+                        spec = next(
+                            (s for s in SPECS if s.kind == kind), None
+                        )
+                        rel_names = (
+                            "/".join(sorted(spec.releases))
+                            if spec else "release"
+                        )
+                        out.append(
+                            self.finding_at(
+                                key[0], lineno, key[1],
+                                f"{kind}: {root} (held via "
+                                f"{root}.{verb}()) is handed to "
+                                f"{shape[-1]}() -> {targets[0][1]} "
+                                f"({targets[0][0]}), but that "
+                                f"callee's transitive closure never "
+                                f"{rel_names}s — the handoff leads "
+                                f"nowhere and the resource leaks; "
+                                f"release in the callee or stop "
+                                f"treating this call as the "
+                                f"discharge",
+                            )
+                        )
+        return out
+
+    # ------------------------------------------- verb families (3)
+
+    def _check_families(self, project: Project) -> List[Finding]:
+        from .resource_pairing import SPECS
+
+        table = project.summaries
+        acquires: Dict[str, List[Tuple[FKey, str, str, int]]] = {}
+        released: Set[str] = set()
+        for key, summ in table.locals.items():
+            for family, kind, verb, root, lineno in summ.res:
+                if family == _ACQUIRE:
+                    acquires.setdefault(kind, []).append(
+                        (key, verb, root, lineno)
+                    )
+                else:
+                    released.add(kind)
+        out: List[Finding] = []
+        for kind, sites in acquires.items():
+            if kind in released:
+                continue
+            spec = next((s for s in SPECS if s.kind == kind), None)
+            rel_names = (
+                "/".join(sorted(spec.releases)) if spec else "release"
+            )
+            for key, verb, root, lineno in sites:
+                out.append(
+                    self.finding_at(
+                        key[0], lineno, key[1],
+                        f"{kind}: {root}.{verb}() has NO matching "
+                        f"{rel_names} anywhere in the scan set — the "
+                        f"whole verb family is one-sided, so every "
+                        f"acquire leaks by construction (was the "
+                        f"release renamed?)",
+                    )
+                )
+        return out

@@ -1,0 +1,138 @@
+"""kv-matching: every KV consumer has a producer somewhere in the
+package — and vice versa — by key-shape unification.
+
+kv-hygiene checks each module's keys in isolation (namespacing,
+publish/delete pairing).  What it cannot see is the *cross-module
+protocol*: ``topology/fanout.py`` publishes blobs that
+``continuous/recover.py`` fetches, the promoter sets done-keys the
+snapshot layer waits on.  Rename one side — or change its key layout —
+and nothing fails until a multi-process test happens to cross the
+stale pair; a reader then blocks on a key nobody will ever write.
+
+This pass collects every KV effect from the package summaries with
+its **namespace shape** (literal fragments segmented on ``/``,
+runtime values as holes — ``f"{uid}/arrive/{rank}"`` unifies with
+``f"{op}/arrive/{r}"`` but not with ``f"{uid}/depart"``) and checks
+both directions:
+
+- **orphaned consumer** — a ``kv_get``/``kv_try_get`` shape no
+  ``kv_set`` can produce, or a ``kv_try_fetch_blob`` shape no
+  ``kv_publish_blob`` can produce (blobs are chunked under their
+  prefix; the two blob verbs pair only with each other);
+- **orphaned producer** — a ``kv_set``/``kv_publish_blob`` shape
+  nothing consumes.  A shape whose only match is a ``kv_try_delete``
+  is still orphaned: cleanup of a key nobody reads is dead protocol.
+
+Scope: the ``torchsnapshot_tpu`` package.  ``coordination.py`` is
+exempt (the primitive layer builds keys from caller-supplied
+prefixes: its shapes are intentionally universal).  Fully-dynamic
+shapes (a bare ``*``) unify with everything and can neither be
+orphaned nor orphan anything — the uid-prefix convention means real
+protocol keys always carry at least one literal segment, and keys
+built entirely by helpers are out of lexical reach by design
+(conservative toward silence, same trade as kv-hygiene).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from ..core import Finding, ProjectPass
+from ..interproc import FKey, Project
+
+_PKG_PREFIX = "torchsnapshot_tpu/"
+_PRIMITIVE_FILE = "torchsnapshot_tpu/coordination.py"
+
+# pairing axes: consumer verb -> producer verbs that satisfy it
+_PRODUCERS_FOR = {
+    "kv_get": ("kv_set",),
+    "kv_try_get": ("kv_set",),
+    "kv_try_fetch_blob": ("kv_publish_blob",),
+}
+_CONSUMERS_FOR = {
+    "kv_set": ("kv_get", "kv_try_get"),
+    "kv_publish_blob": ("kv_try_fetch_blob",),
+}
+
+
+def _is_universal(shape: Sequence[Sequence]) -> bool:
+    """A shape with no literal anywhere (``*``) matches everything —
+    useless as evidence in either direction."""
+    return all(
+        all(chunk is None for chunk in seg) for seg in shape
+    )
+
+
+class KvMatchingPass(ProjectPass):
+    pass_id = "kv-matching"
+    description = (
+        "every KV consumer key shape has a unifiable producer in the "
+        "package, and every producer a consumer (rename-orphan check)"
+    )
+
+    def run_project(self, project: Project) -> Iterable[Finding]:
+        from .. import summaries as summ_mod
+
+        table = project.summaries
+        # (fkey, op, shape, lineno) for every in-scope KV effect
+        sites: List[Tuple[FKey, str, list, int]] = []
+        for key, summ in table.locals.items():
+            if not key[0].startswith(_PKG_PREFIX):
+                continue
+            if key[0] == _PRIMITIVE_FILE:
+                continue
+            for op, shape, lineno in summ.kv:
+                sites.append((key, op, shape, lineno))
+
+        by_op: Dict[str, List[Tuple[FKey, list, int]]] = {}
+        for key, op, shape, lineno in sites:
+            by_op.setdefault(op, []).append((key, shape, lineno))
+
+        out: List[Finding] = []
+        for key, op, shape, lineno in sites:
+            if _is_universal(shape):
+                continue
+            rendered = summ_mod.render_shape(shape)
+            if op in _PRODUCERS_FOR:
+                if not self._any_match(
+                    summ_mod, shape, by_op, _PRODUCERS_FOR[op]
+                ):
+                    out.append(
+                        self.finding_at(
+                            key[0], lineno, key[1],
+                            f"{op}() of key shape '{rendered}' has "
+                            f"no unifiable "
+                            f"{'/'.join(_PRODUCERS_FOR[op])} anywhere "
+                            f"in the package — an orphaned consumer "
+                            f"blocks (or silently reads nothing) "
+                            f"forever; the producer was likely "
+                            f"renamed or its key layout changed",
+                        )
+                    )
+            elif op in _CONSUMERS_FOR:
+                if self._any_match(
+                    summ_mod, shape, by_op, _CONSUMERS_FOR[op]
+                ):
+                    continue
+                out.append(
+                    self.finding_at(
+                        key[0], lineno, key[1],
+                        f"{op}() of key shape '{rendered}' has no "
+                        f"unifiable {'/'.join(_CONSUMERS_FOR[op])} "
+                        f"anywhere in the package — an orphaned "
+                        f"producer is dead protocol (and for blobs, "
+                        f"an unconsumed payload parked in the "
+                        f"coordination store); the consumer was "
+                        f"likely renamed or its key layout changed",
+                    )
+                )
+        out.sort(key=lambda f: (f.file, f.line))
+        return out
+
+    @staticmethod
+    def _any_match(summ_mod, shape, by_op, verbs) -> bool:
+        for verb in verbs:
+            for _key, other, _lineno in by_op.get(verb, []):
+                if summ_mod.shapes_unify(shape, other):
+                    return True
+        return False

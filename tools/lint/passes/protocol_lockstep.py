@@ -1,0 +1,243 @@
+"""protocol-lockstep: collective sequences stay identical across ranks
+— checked THROUGH calls, package-wide.
+
+The lexical collective-safety pass flags a collective written directly
+inside a rank-conditional branch.  The deadlocks the scheduler-DAG
+refactor will actually create are one hop removed: a rank-guarded
+branch calls a *helper* that barriers three modules away, or a
+rank-gated early return is followed by a call whose callee runs a
+``kv_exchange``.  Every rank must reach the same collective sequence
+in the same order; the summary table's flattened collective
+projections (summaries.collective_seq) make that checkable for every
+public entry point by composition — if every function is lockstep-
+consistent given its callees' summaries, every entry point's
+projection is.
+
+Three rules, all summary-based:
+
+1. **Divergent rank branches** — an ``if``/``else`` whose test
+   mentions a rank and whose two arms project DIFFERENT collective
+   sequences once callee summaries are spliced in.  Only divergence
+   *contributed by calls* is reported here: direct collectives in a
+   rank branch are the lexical pass's finding (every one is flagged
+   there already), so the two passes never double-report one site.
+   Matching sequences through calls are legal — ``if rank == 0:
+   lead() else: follow()`` where both barrier once is lockstep.
+
+2. **Collective after a rank-guarded early exit, via a call** — after
+   ``if <rank test>: return/raise``, a call to a callee that
+   (transitively) runs collectives: the filtered ranks never arrive.
+   Again the direct-collective form belongs to the lexical pass.
+
+3. **Marker-before-sync** — the durable commit marker
+   (``sync_write`` of ``SNAPSHOT_METADATA_FNAME``) reachable from an
+   entry point with NO synchronization point before it (a collective,
+   or a blocking ``kv_get`` — the async commit's arrive-key reads).
+   The manifest-last discipline: a marker that can land before every
+   rank's data is known complete durably commits a torn snapshot.
+   Checked at the call graph's roots (functions no in-package caller
+   reaches — the true entry points), anchored at the marker write.
+
+Scope: the ``torchsnapshot_tpu`` package (rules 1–2; the primitive
+layer ``coordination.py`` is exempt — its rank-asymmetric KV protocol
+is the implementation OF the collectives).  Rule 3 walks from roots
+anywhere in the scan set, since tools/benchmarks drive the package's
+entry points.
+
+Unresolved calls contribute no collectives — dynamic dispatch past
+the method-table bound errs toward silence; the fixture suite pins
+the shapes that must resolve.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import Finding, ProjectPass
+from ..interproc import FKey, Project
+
+_PKG_PREFIX = "torchsnapshot_tpu/"
+_PRIMITIVE_FILE = "torchsnapshot_tpu/coordination.py"
+
+
+def _render_seq(seq: Tuple, limit: int = 6) -> str:
+    out: List[str] = []
+
+    def go(s: Tuple) -> None:
+        for item in s:
+            if len(out) >= limit:
+                return
+            if isinstance(item, str):
+                out.append(item)
+            elif item[0] == "alt":
+                out.append("(…|…)")
+            elif item[0] == "loop":
+                out.append("(…)*")
+
+    go(seq)
+    return " → ".join(out[:limit]) + ("…" if len(out) >= limit else "") \
+        if out else "∅"
+
+
+class ProtocolLockstepPass(ProjectPass):
+    pass_id = "protocol-lockstep"
+    description = (
+        "interprocedural SPMD lockstep: rank branches project equal "
+        "collective sequences, no collective after a rank exit via "
+        "calls, commit marker only after a sync point"
+    )
+
+    def run_project(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        table = project.summaries
+        for key, summ in table.locals.items():
+            relpath, qualname = key
+            if not relpath.startswith(_PKG_PREFIX):
+                continue
+            if relpath == _PRIMITIVE_FILE:
+                continue
+            self._check_term(
+                project, table, key, summ, summ.term, False, out
+            )
+        out.extend(self._check_markers(project))
+        # multiple rank-branches can reach one callee; report each
+        # SITE once
+        seen: Set[Tuple] = set()
+        deduped = []
+        for f in out:
+            k = (f.pass_id, f.file, f.line, f.message)
+            if k not in seen:
+                seen.add(k)
+                deduped.append(f)
+        deduped.sort(key=lambda f: (f.file, f.line))
+        return deduped
+
+    # ------------------------------------------------- rules 1 + 2
+
+    def _check_term(
+        self, project, table, key: FKey, summ, term,
+        diverged: bool, out: List[Finding],
+    ) -> bool:
+        """Walk one term tracking rank divergence; returns the state
+        at the end (a rank-guarded exit in a branch taints everything
+        after the join, like the lexical pass's divergence levels)."""
+        for step in term:
+            tag = step[0]
+            if tag == "call":
+                if diverged:
+                    self._flag_call_after_exit(
+                        project, table, key, summ, step, out
+                    )
+            elif tag in ("alt", "rankalt"):
+                sub_a = self._check_term(
+                    project, table, key, summ, step[1], diverged, out
+                )
+                sub_b = self._check_term(
+                    project, table, key, summ, step[2], diverged, out
+                )
+                if tag == "rankalt" and not diverged:
+                    self._check_lockstep(
+                        project, table, key, summ, step, out
+                    )
+                    if self._branch_exits(step[1]) != self._branch_exits(
+                        step[2]
+                    ):
+                        diverged = True
+                # a rank-guarded exit nested inside EITHER arm (of a
+                # rank or plain if) means some ranks may have left by
+                # the join point — divergence propagates outward
+                diverged = diverged or sub_a or sub_b
+            elif tag == "loop":
+                diverged = self._check_term(
+                    project, table, key, summ, step[1], diverged, out
+                ) or diverged
+        return diverged
+
+    @staticmethod
+    def _branch_exits(term) -> bool:
+        return bool(term) and term[-1][0] == "exit"
+
+    def _check_lockstep(
+        self, project, table, key: FKey, summ, step, out: List[Finding]
+    ) -> None:
+        full_a = table._seq_of_term(key, summ, step[1], {key})
+        full_b = table._seq_of_term(key, summ, step[2], {key})
+        if full_a == full_b:
+            return
+        local_a = table.local_collective_seq(summ, step[1])
+        local_b = table.local_collective_seq(summ, step[2])
+        if local_a != local_b:
+            return  # direct divergence: the lexical pass owns it
+        out.append(
+            self.finding_at(
+                key[0],
+                step[3],
+                key[1],
+                f"rank-conditional branches project divergent "
+                f"collective sequences through their callees "
+                f"({_render_seq(full_a)} vs {_render_seq(full_b)}) — "
+                f"ranks taking different arms deadlock the fleet; "
+                f"make both arms reach the same collective sequence "
+                f"or hoist the collectives above the branch",
+            )
+        )
+
+    def _flag_call_after_exit(
+        self, project, table, key: FKey, summ, step, out: List[Finding]
+    ) -> None:
+        idx, lineno = step[1], step[2]
+        for tgt in table.targets(key, idx):
+            if table.has_collectives(tgt):
+                name = summ.calls[idx][0][-1]
+                out.append(
+                    self.finding_at(
+                        key[0],
+                        lineno,
+                        key[1],
+                        f"call to {name}() sits after a rank-"
+                        f"conditional early exit and its callee "
+                        f"{tgt[1]} ({tgt[0]}) reaches a collective — "
+                        f"the filtered ranks never arrive and the "
+                        f"rest deadlock; move the gate below the "
+                        f"call or the collective above the gate",
+                    )
+                )
+                return
+
+    # ----------------------------------------------------- rule 3
+
+    def _check_markers(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        table = project.summaries
+        rgraph = project.rgraph
+        scc_of = project.scc_of()
+        reported: Set[Tuple[str, str, int]] = set()
+        for key in table.locals:
+            # a root is a function no caller OUTSIDE its own SCC
+            # reaches: a self-recursive take() must still be checked —
+            # its only "caller" is itself, and skipping it would skip
+            # the whole cycle
+            if any(
+                scc_of.get(c) != scc_of.get(key)
+                for c in rgraph.get(key, [])
+            ):
+                continue  # reached from a caller: checked at the root
+            exposed, _ensures = table.marker_exposure(key)
+            if exposed is None or exposed in reported:
+                continue
+            reported.add(exposed)
+            relpath, context, lineno = exposed
+            out.append(
+                self.finding_at(
+                    relpath,
+                    lineno,
+                    context,
+                    f"commit-marker write (SNAPSHOT_METADATA_FNAME) "
+                    f"is reachable from entry point {key[1]} with no "
+                    f"preceding synchronization point (collective or "
+                    f"blocking kv_get) — the manifest-last "
+                    f"discipline requires every rank's data to be "
+                    f"known complete before the marker lands",
+                )
+            )
+        return out

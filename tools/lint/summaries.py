@@ -1,0 +1,1027 @@
+"""Per-function effect summaries, computed bottom-up over the call
+graph's SCCs — the currency of the interprocedural snaplint passes.
+
+Each function gets a **local summary** extracted in one AST walk:
+
+- a *protocol term* — the ordered collective-op sequence with branch
+  alternatives (``rankalt`` when the branch test mentions a rank),
+  loop markers, early-exit markers, commit-marker writes, blocking
+  KV-get sync points, and indexed call steps;
+- the *KV effects* — every ``kv_set``/``kv_get``/``kv_try_get``/
+  ``kv_publish_blob``/``kv_try_fetch_blob``/``kv_try_delete`` with its
+  key's **namespace shape** (literal fragments segmented on ``/``,
+  runtime values as holes: ``f"{uid}/arrive/{rank}"`` →
+  ``*/arrive/*``);
+- the *resource effects* — the debit/credit/acquire/release/probe
+  verb families on budget/gate/window/breaker receivers (the same
+  receiver taxonomy as the resource-pairing pass, imported from it so
+  the two can never skew);
+- a *may-block* bit with the direct reason (the async-blocking pass's
+  ``blocking_reason`` — again imported, not re-derived);
+- the *call records* — ``(shape, lineno, argroots)`` triples the
+  project resolves to in-package targets, shared by the call graph
+  and every check below.
+
+Local summaries are **cached** to ``tools/lint/.summary_cache.json``
+keyed by each file's content hash: parsing still happens every run
+(every lexical pass needs the AST anyway), but the summary-extraction
+walk — and nothing else — is skipped on a hit, which is what keeps
+thirteen passes inside the repo's 10-second wall-time budget.  The
+cache stores only what this module can re-derive; deleting it is
+always safe.
+
+On top of the locals, the **closure** is computed bottom-up over the
+project's SCCs (callees before callers; members of a cycle reach a
+fixpoint together and are marked recursive):
+
+- ``may_block_chain(fkey)`` — the call chain to the nearest blocking
+  operation, if any package-local chain reaches one;
+- ``has_collectives(fkey)`` — does any collective run under this
+  function, transitively;
+- ``collective_seq(fkey)`` — the flattened collective sequence with
+  ``alt``/``loop`` structure, callee sequences spliced in (the
+  protocol-lockstep comparison surface);
+- ``marker_exposure(fkey)`` — does a path reach a commit-marker write
+  with no synchronization point (collective or blocking KV get)
+  before it, and does the function establish sync on every path — the
+  compositional form of the manifest-last discipline;
+- ``res_closure(fkey)`` — the transitive (verb-family, kind) resource
+  effects, plus the per-root evidence the closure-domain sanction and
+  the effect-escape pass consume.
+
+Conservatism, stated once: an unresolved call contributes nothing —
+external and dynamic dispatch are out of scope by design, and each
+pass documents which direction that errs.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FileUnit, walk_skipping_nested_defs
+from .interproc import COLLECTIVE_NAMES, KV_OP_NAMES, FKey, Project
+
+CACHE_BASENAME = ".summary_cache.json"
+# bump whenever the serialized summary format changes (call-record
+# shapes, term grammar): a version mismatch is a whole-cache miss.
+# SEMANTIC rule changes (SPECS receivers, blocking table, KV verb
+# sets) need no bump: the cache key also folds in a fingerprint of
+# the rule-defining sources (_rules_fingerprint), so editing any of
+# them is a whole-cache miss automatically — without it, a dev whose
+# warm cache predates the rule edit would see green locally while a
+# cold CI run reports findings.
+CACHE_VERSION = 2
+
+_rules_fp_cache: List[str] = []
+
+
+def _rules_fingerprint() -> str:
+    if _rules_fp_cache:
+        return _rules_fp_cache[0]
+    h = hashlib.sha1()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for rel in (
+        "summaries.py",
+        "interproc.py",
+        "core.py",  # receiver_name/call_name/walk_skipping_nested_defs
+        os.path.join("passes", "resource_pairing.py"),
+        os.path.join("passes", "async_blocking.py"),
+        os.path.join("passes", "collective_safety.py"),
+    ):
+        try:
+            with open(os.path.join(here, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(rel.encode())  # missing file still perturbs
+    _rules_fp_cache.append(h.hexdigest())
+    return _rules_fp_cache[0]
+_MAX_CHAIN = 8  # reported blocking-chain hops before truncation
+
+# KV verb families (the kv-matching pairing axes)
+KV_PRODUCERS = frozenset({"kv_set", "kv_publish_blob"})
+KV_CONSUMERS = frozenset({"kv_get", "kv_try_get", "kv_try_fetch_blob"})
+KV_DELETERS = frozenset({"kv_try_delete"})
+
+# resource verb families, mapped from the resource-pairing SPECS at
+# extraction time (acquire side / release side)
+ACQUIRE = "acquire"
+RELEASE = "release"
+
+HOLE = None  # a runtime value inside a key shape
+
+# except* groups (3.11+) share Try's statement shape; None on 3.10
+_TRYSTAR = getattr(ast, "TryStar", None)
+
+# Files whose blocking operations are deliberate and amortized — a
+# chain ENDING here is not an event-loop hazard.  Substrate-level
+# knowledge (the nature of the blocking SOURCE), so chain selection
+# below can prefer a non-exempt chain when a function blocks through
+# BOTH an exempt and a real source; the effect-escape pass imports
+# this set for its final exemption decision.
+# - _csrc/__init__.py: the lazy native-library loader opens
+#   /proc/cpuinfo and may compile once per process, memoized; the
+#   production event loop never pays even the one-time cost — the
+#   scheduler's _LoopThread warms the loader before run_forever (the
+#   in-tree fix the effect-escape pass's first repo run produced).
+# - resilience/failpoints.py: the latency failpoint's time.sleep IS
+#   the injected fault — it fires only when a test arms it, and
+#   stalling the loop is exactly the scenario being rehearsed.
+BLOCKING_SOURCE_EXEMPT = frozenset(
+    {
+        "torchsnapshot_tpu/_csrc/__init__.py",
+        "torchsnapshot_tpu/resilience/failpoints.py",
+    }
+)
+
+
+# the checkout THIS module lives in — the only tree whose default
+# cache location is ever written to
+_THIS_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _default_cache_path(root: Optional[str]) -> Optional[str]:
+    """The on-disk cache location for ``root``, or None when caching
+    is off.  Only THIS checkout gets a default cache: linting a
+    foreign tree (a supported CLI positional) must not create a
+    ``tools/lint/`` directory inside it — a read-only scan mutating
+    the scanned project is exactly the kind of surprise a lint must
+    not spring.  Callers who want a cache for another tree pass
+    ``cache_path`` explicitly."""
+    if root is None:
+        return None
+    if os.path.realpath(root) != os.path.realpath(_THIS_REPO):
+        return None
+    return os.path.join(root, "tools", "lint", CACHE_BASENAME)
+
+
+# --------------------------------------------------------- key shapes
+
+
+def _key_chunks(key: ast.expr) -> List[Optional[str]]:
+    """Literal fragments and holes of a key expression, in order."""
+    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+        return [key.value]
+    if isinstance(key, ast.JoinedStr):
+        out: List[Optional[str]] = []
+        for v in key.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.append(v.value)
+            else:
+                out.append(HOLE)
+        return out
+    if isinstance(key, ast.BinOp) and isinstance(key.op, ast.Add):
+        return _key_chunks(key.left) + _key_chunks(key.right)
+    return [HOLE]
+
+
+def key_shape(key: ast.expr) -> List[List[Optional[str]]]:
+    """The namespace shape: segments split on ``/``, each a list of
+    literal chunks and holes (adjacent holes collapsed).  See
+    ``shapes_unify`` for the one-segment-per-hole matching rule."""
+    segs: List[List[Optional[str]]] = [[]]
+    for chunk in _key_chunks(key):
+        if chunk is HOLE:
+            if not segs[-1] or segs[-1][-1] is not HOLE:
+                segs[-1].append(HOLE)
+            continue
+        parts = chunk.split("/")
+        for i, part in enumerate(parts):
+            if i > 0:
+                segs.append([])
+            if part:
+                if segs[-1] and segs[-1][-1] is not HOLE and isinstance(
+                    segs[-1][-1], str
+                ):
+                    segs[-1][-1] += part
+                else:
+                    segs[-1].append(part)
+    return [s for s in segs if s]
+
+
+def render_shape(shape: Sequence[Sequence[Optional[str]]]) -> str:
+    return "/".join(
+        "".join("*" if c is HOLE else c for c in seg) for seg in shape
+    )
+
+
+def _segment_unifies(
+    a: Sequence[Optional[str]], b: Sequence[Optional[str]]
+) -> bool:
+    """Can one concrete segment satisfy both segment patterns?  Exact
+    when one side is a pure literal; when both carry holes, only the
+    anchored prefix/suffix literals can conflict (the middles always
+    overlap — conservative toward unifying, which errs toward silence
+    for the orphan checks)."""
+    a_lit = len(a) == 1 and a[0] is not HOLE
+    b_lit = len(b) == 1 and b[0] is not HOLE
+    if a_lit and b_lit:
+        return a[0] == b[0]
+    if a_lit or b_lit:
+        lit = a[0] if a_lit else b[0]
+        pat = b if a_lit else a
+        return _pattern_matches_literal(pat, str(lit))
+    pa = a[0] if a and a[0] is not HOLE else ""
+    pb = b[0] if b and b[0] is not HOLE else ""
+    sa = a[-1] if a and a[-1] is not HOLE else ""
+    sb = b[-1] if b and b[-1] is not HOLE else ""
+    pa, pb, sa, sb = str(pa), str(pb), str(sa), str(sb)
+    pre_ok = pa.startswith(pb) or pb.startswith(pa)
+    suf_ok = sa.endswith(sb) or sb.endswith(sa)
+    return pre_ok and suf_ok
+
+
+def _pattern_matches_literal(
+    pat: Sequence[Optional[str]], lit: str
+) -> bool:
+    """Greedy in-order chunk matching: every literal chunk of ``pat``
+    must appear in order in ``lit``, anchored at the ends when the
+    pattern starts/ends with a literal; holes match ≥1 character."""
+    pos = 0
+    n = len(pat)
+    for i, chunk in enumerate(pat):
+        if chunk is HOLE:
+            pos += 1  # hole consumes at least one character
+            continue
+        chunk = str(chunk)
+        if i == 0:
+            if not lit.startswith(chunk):
+                return False
+            pos = len(chunk)
+        elif i == n - 1:
+            return len(lit) >= pos + len(chunk) and lit.endswith(chunk)
+        else:
+            found = lit.find(chunk, pos)
+            if found < 0:
+                return False
+            pos = found + len(chunk)
+    return pos <= len(lit)
+
+
+def shapes_unify(
+    a: Sequence[Sequence[Optional[str]]],
+    b: Sequence[Sequence[Optional[str]]],
+) -> bool:
+    """Can one concrete key satisfy both shapes?  Segment-wise zip: a
+    hole stands for exactly ONE segment.  Letting holes span segments
+    sounds more faithful (a prefix variable can carry ``/``) but makes
+    nearly everything unify — ``*/arrive/*`` would absorb its way
+    into ``*/depart`` — and an orphan check that never fires is no
+    check.  The factoring assumption this buys is real but mild:
+    protocol keys are built uid-head-plus-literal-segments, and
+    composite prefixes come from helpers, which lexically produce a
+    bare ``*`` (universal, excluded from evidence) anyway."""
+    if len(a) != len(b):
+        return False
+    return all(
+        _segment_unifies(sa, sb) for sa, sb in zip(a, b)
+    )
+
+
+# ------------------------------------------------------ local summary
+
+
+class FnSummary:
+    """One function's local (cacheable) effects; see module docstring
+    for the term grammar."""
+
+    __slots__ = ("term", "kv", "res", "block", "calls")
+
+    def __init__(self, term, kv, res, block, calls) -> None:
+        self.term = term  # nested JSON-able list of steps
+        self.kv = kv  # [op, shape, lineno]
+        self.res = res  # [family, kind, verb, root, lineno]
+        self.block = block  # [label, lineno, reason] | None
+        self.calls = calls  # [shape, lineno, argroots]
+
+    def to_dict(self) -> Dict:
+        return {
+            "term": self.term,
+            "kv": self.kv,
+            "res": self.res,
+            "block": self.block,
+            "calls": self.calls,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "FnSummary":
+        return cls(
+            d.get("term", []),
+            d.get("kv", []),
+            d.get("res", []),
+            d.get("block"),
+            d.get("calls", []),
+        )
+
+
+def _res_spec_tables():
+    """(acquire-verb → kind-regex list, release-verb → ...) derived
+    from the resource-pairing SPECS — imported lazily so the pass
+    registry's import order cannot cycle."""
+    from .passes.resource_pairing import SPECS
+
+    return SPECS
+
+
+def _mentions_rank(test: ast.expr) -> bool:
+    from .passes.collective_safety import _mentions_rank as f
+
+    return f(test)
+
+
+def _blocking_reason(call: ast.Call, sleep_names: Set[str]):
+    from .passes.async_blocking import blocking_reason as f
+
+    return f(call, sleep_names)
+
+
+def _sleep_names(tree: ast.AST) -> Set[str]:
+    from .passes.async_blocking import _time_imported_names as f
+
+    return f(tree)
+
+
+def _is_marker_write(call: ast.Call) -> bool:
+    """``sync_write(WriteIO(path=SNAPSHOT_METADATA_FNAME, ...))`` —
+    the durable commit marker, recognized by the constant's name
+    anywhere in the call's arguments."""
+    from .core import call_name
+
+    if call_name(call) != "sync_write":
+        return False
+    for arg in [*call.args, *(kw.value for kw in call.keywords)]:
+        for node in ast.walk(arg):
+            if (
+                isinstance(node, ast.Name)
+                and node.id == "SNAPSHOT_METADATA_FNAME"
+            ) or (
+                isinstance(node, ast.Attribute)
+                and node.attr == "SNAPSHOT_METADATA_FNAME"
+            ):
+                return True
+    return False
+
+
+class _Extractor:
+    def __init__(self, unit: FileUnit) -> None:
+        self.unit = unit
+        self.sleep_names = _sleep_names(unit.tree)
+        self.specs = _res_spec_tables()
+
+    def extract(self, fn: ast.AST) -> FnSummary:
+        from .core import call_name, receiver_name
+
+        kv: List[List] = []
+        res: List[List] = []
+        block: Optional[List] = None
+        calls: List[List] = []
+
+        def steps_from_exprs(exprs: Iterable[ast.expr]) -> List:
+            nonlocal block
+            found: List[Tuple[int, int, ast.Call]] = []
+            for e in exprs:
+                if e is None:
+                    continue
+                if isinstance(e, ast.Call):
+                    found.append((e.lineno, e.col_offset, e))
+                for sub in walk_skipping_nested_defs(e):
+                    if isinstance(sub, ast.Call):
+                        found.append((sub.lineno, sub.col_offset, sub))
+            found.sort(key=lambda t: (t[0], t[1]))
+            steps: List = []
+            seen: Set[int] = set()
+            for lineno, _col, call in found:
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                name = call_name(call)
+                if name in COLLECTIVE_NAMES or name in KV_OP_NAMES:
+                    # these are protocol effects AND (for the
+                    # synchronous waits among them) blocking
+                    # operations: the may-block bit must still be set
+                    # or a sync kv_get/barrier helper moved one module
+                    # away silently loses effect-escape coverage
+                    reason = _blocking_reason(call, self.sleep_names)
+                    if reason is not None and block is None:
+                        block = [name or "<call>", lineno, reason]
+                if name in COLLECTIVE_NAMES:
+                    steps.append(["op", name, lineno])
+                    continue
+                if name in KV_OP_NAMES:
+                    if call.args:
+                        kv.append(
+                            [name, key_shape(call.args[0]), lineno]
+                        )
+                    if name == "kv_get":
+                        # blocking KV get: a full-world wait point in
+                        # the marker-ordering sense
+                        steps.append(["kvget", lineno])
+                    continue
+                if name in ("run_in_executor", "to_thread"):
+                    # KV ops dispatched BY REFERENCE (the fan-out
+                    # transport's `run_in_executor(None,
+                    # coord.kv_publish_blob, prefix, buf)`) still
+                    # produce/consume keys — the arg after the
+                    # reference is the key
+                    args = list(call.args)
+                    for i, a in enumerate(args[:-1]):
+                        ref = (
+                            a.attr if isinstance(a, ast.Attribute)
+                            else a.id if isinstance(a, ast.Name)
+                            else None
+                        )
+                        if ref in KV_OP_NAMES:
+                            kv.append(
+                                [ref, key_shape(args[i + 1]), lineno]
+                            )
+                if _is_marker_write(call):
+                    steps.append(["marker", lineno])
+                    continue
+                func = call.func
+                root = (
+                    receiver_name(func)
+                    if isinstance(func, ast.Attribute)
+                    else ""
+                )
+                matched_res = False
+                if isinstance(func, ast.Attribute) and (
+                    "lock" not in root.lower()
+                ):
+                    for spec in self.specs:
+                        if func.attr in spec.acquires and (
+                            spec.receiver_re.search(root)
+                        ):
+                            res.append(
+                                [ACQUIRE, spec.kind, func.attr, root,
+                                 lineno]
+                            )
+                            matched_res = True
+                        elif func.attr in spec.releases and (
+                            spec.receiver_re.search(root)
+                        ):
+                            res.append(
+                                [RELEASE, spec.kind, func.attr, root,
+                                 lineno]
+                            )
+                            matched_res = True
+                if matched_res:
+                    continue
+                reason = _blocking_reason(call, self.sleep_names)
+                if reason is not None:
+                    if block is None:
+                        block = [name or "<call>", lineno, reason]
+                    continue
+                shape = Project.call_shape(call)
+                if shape is None:
+                    continue
+                argroots = []
+                for a in [
+                    *call.args, *(kw.value for kw in call.keywords)
+                ]:
+                    if isinstance(a, ast.Name):
+                        argroots.append(a.id)
+                    elif isinstance(a, ast.Attribute):
+                        argroots.append(a.attr)
+                idx = len(calls)
+                calls.append([list(shape), lineno, argroots])
+                steps.append(["call", idx, lineno])
+            return steps
+
+        def build(stmts: Sequence[ast.stmt]) -> List:
+            term: List = []
+            for st in stmts:
+                if isinstance(
+                    st,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue  # separate scope, separate summary
+                if isinstance(st, ast.If):
+                    term.extend(steps_from_exprs([st.test]))
+                    tag = (
+                        "rankalt" if _mentions_rank(st.test) else "alt"
+                    )
+                    term.append(
+                        [tag, build(st.body), build(st.orelse),
+                         st.lineno]
+                    )
+                elif isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+                    header = (
+                        [st.test] if isinstance(st, ast.While)
+                        else [st.iter]
+                    )
+                    term.extend(steps_from_exprs(header))
+                    body = build(st.body) + build(st.orelse)
+                    if body:
+                        term.append(["loop", body, st.lineno])
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    term.extend(
+                        steps_from_exprs(
+                            [it.context_expr for it in st.items]
+                        )
+                    )
+                    term.extend(build(st.body))
+                elif isinstance(st, ast.Try) or (
+                    _TRYSTAR is not None and isinstance(st, _TRYSTAR)
+                ):
+                    term.extend(build(st.body))
+                    term.extend(build(st.orelse))
+                    for h in st.handlers:
+                        hb = build(h.body)
+                        if hb:
+                            term.append(["alt", hb, [], st.lineno])
+                    term.extend(build(st.finalbody))
+                elif isinstance(st, ast.Match):
+                    # each case arm is conditionally executed: model
+                    # as nested alt arms so collectives/markers/KV
+                    # effects inside cases stay visible
+                    term.extend(steps_from_exprs([st.subject]))
+                    for case in st.cases:
+                        cb = build(case.body)
+                        if cb:
+                            term.append(
+                                ["alt", cb, [], st.lineno]
+                            )
+                elif isinstance(st, (ast.Return, ast.Raise)):
+                    exprs = (
+                        [st.value] if isinstance(st, ast.Return)
+                        else [st.exc, st.cause]
+                    )
+                    term.extend(steps_from_exprs(exprs))
+                    term.append(["exit", st.lineno])
+                else:
+                    term.extend(
+                        steps_from_exprs(
+                            [
+                                c for c in ast.iter_child_nodes(st)
+                                if isinstance(c, ast.expr)
+                            ]
+                        )
+                    )
+            return term
+
+        term = build(getattr(fn, "body", []) or [])
+        return FnSummary(term, kv, res, block, calls)
+
+
+# ------------------------------------------------------ summary table
+
+
+class SummaryTable:
+    """Local summaries for every function in the project (cache-aware)
+    plus the bottom-up closures the interprocedural passes query."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.locals: Dict[FKey, FnSummary] = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self._targets: Dict[FKey, List[List[FKey]]] = {}
+        self._may_block: Dict[FKey, Optional[List[Tuple[str, str]]]] = {}
+        self._has_coll: Dict[FKey, bool] = {}
+        self._coll_seq: Dict[FKey, Tuple] = {}
+        self._marker: Dict[FKey, Tuple] = {}
+        self._res_closure: Dict[FKey, Set[Tuple[str, str]]] = {}
+        self._build()
+
+    # ------------------------------------------------ build + cache
+
+    def _build(self) -> None:
+        cache_path = self.project.cache_path or _default_cache_path(
+            self.project.root
+        )
+        rules = _rules_fingerprint()
+        cached: Dict[str, Dict] = {}
+        if cache_path and os.path.isfile(cache_path):
+            try:
+                with open(cache_path, encoding="utf-8") as f:
+                    data = json.load(f)
+                if (
+                    isinstance(data, dict)
+                    and data.get("version") == CACHE_VERSION
+                    and data.get("rules") == rules
+                ):
+                    cached = data.get("files", {})
+            except (OSError, ValueError):
+                cached = {}  # unreadable/corrupt cache == cold cache
+        fresh: Dict[str, Dict] = {}
+        dirty = False
+        for unit in self.project.units:
+            h = hashlib.sha1(unit.source.encode("utf-8")).hexdigest()
+            entry = cached.get(unit.relpath)
+            if entry is not None and entry.get("h") == h:
+                self.cache_hits += 1
+                fns = {
+                    qn: FnSummary.from_dict(d)
+                    for qn, d in entry.get("fns", {}).items()
+                }
+                fresh[unit.relpath] = entry
+            else:
+                self.cache_misses += 1
+                dirty = True
+                ex = _Extractor(unit)
+                fns = {
+                    qn: ex.extract(node)
+                    for qn, node in unit.functions()
+                }
+                fresh[unit.relpath] = {
+                    "h": h,
+                    "fns": {
+                        qn: s.to_dict() for qn, s in fns.items()
+                    },
+                }
+            for qn, s in fns.items():
+                self.locals[(unit.relpath, qn)] = s
+        if cache_path and (dirty or len(fresh) != len(cached)):
+            self._save_cache(cache_path, fresh, rules)
+        self._resolve_targets()
+        self._bottom_up()
+
+    @staticmethod
+    def _save_cache(
+        path: str, files: Dict[str, Dict], rules: str
+    ) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(
+                    {
+                        "version": CACHE_VERSION,
+                        "rules": rules,
+                        "files": files,
+                    },
+                    f,
+                )
+            os.replace(tmp, path)
+        except OSError:
+            # a read-only checkout just runs cold every time; the
+            # cache is an optimization, never a correctness input
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _resolve_targets(self) -> None:
+        for key, summ in self.locals.items():
+            unit = self.project.by_path[key[0]]
+            per_call: List[List[FKey]] = []
+            for shape, _lineno, _roots in summ.calls:
+                per_call.append(
+                    self.project.resolve_call(
+                        unit, key[1], tuple(shape)
+                    )
+                )
+            self._targets[key] = per_call
+
+    def targets(self, key: FKey, call_idx: int) -> List[FKey]:
+        lst = self._targets.get(key)
+        if lst is None or call_idx >= len(lst):
+            return []
+        return lst[call_idx]
+
+    def _is_async(self, key: FKey) -> bool:
+        node = self.project.function_node(key)
+        return isinstance(node, ast.AsyncFunctionDef)
+
+    # ------------------------------------------------ bottom-up pass
+
+    def _bottom_up(self) -> None:
+        sccs = self.project.sccs()
+        for comp in sccs:
+            # iterate each component to an actual fixpoint: facts only
+            # ever grow (None→chain, False→True, set growth), so this
+            # terminates, and a fixed round count would drop facts
+            # needing more propagation hops than rounds in larger
+            # cycles (a 4-node SCC needs 3)
+            changed = True
+            while changed:
+                changed = False
+                for key in comp:
+                    changed = self._compute_one(key) or changed
+
+    def _compute_one(self, key: FKey) -> bool:
+        """(Re)derive one function's closure facts; returns True when
+        any fact changed (the fixpoint loop's progress signal)."""
+        summ = self.locals.get(key)
+        if summ is None:
+            return False
+        # may-block: direct reason, else a sync callee chain.  Among
+        # candidate chains, one ending at a NON-exempt source wins: a
+        # helper that blocks through both a failpoint AND a real
+        # open() must not be laundered by whichever chain happened to
+        # be found first.
+        chain: Optional[List[Tuple[str, str]]] = None
+        fallback: Optional[List[Tuple[str, str]]] = None
+        if summ.block is not None:
+            label, lineno, reason = summ.block
+            chain = [(key[0], f"{label}() at line {lineno}: {reason}")]
+        else:
+            for idx, (shape, lineno, _roots) in enumerate(summ.calls):
+                for tgt in self.targets(key, idx):
+                    if self._is_async(tgt):
+                        continue  # awaited elsewhere; checked itself
+                    sub = self._may_block.get(tgt)
+                    if not sub:
+                        continue
+                    name = shape[-1]
+                    if len(sub) > _MAX_CHAIN - 1:
+                        # truncate the MIDDLE, never the terminal
+                        # element: chain[-1] is the blocking source,
+                        # and the exemption/attribution logic reads it
+                        sub = sub[: _MAX_CHAIN - 2] + [sub[-1]]
+                    cand = [
+                        (key[0], f"{name}() at line {lineno}")
+                    ] + sub
+                    if cand[-1][0] not in BLOCKING_SOURCE_EXEMPT:
+                        chain = cand
+                        break
+                    if fallback is None:
+                        fallback = cand
+                if chain:
+                    break
+            if chain is None:
+                chain = fallback
+        # collective presence
+        has = self._term_has_ops(summ.term) or any(
+            self._has_coll.get(t, False)
+            for idx in range(len(summ.calls))
+            for t in self.targets(key, idx)
+        )
+        # resource closure
+        acc: Set[Tuple[str, str]] = {
+            (family, kind) for family, kind, _v, _r, _l in summ.res
+        }
+        for idx in range(len(summ.calls)):
+            for t in self.targets(key, idx):
+                acc |= self._res_closure.get(t, set())
+        changed = (
+            chain != self._may_block.get(key)
+            or has != self._has_coll.get(key, False)
+            or acc != self._res_closure.get(key, set())
+        )
+        self._may_block[key] = chain
+        self._has_coll[key] = has
+        self._res_closure[key] = acc
+        # collective sequence + marker exposure are derived lazily
+        # (they need the whole component settled first)
+        self._coll_seq.pop(key, None)
+        self._marker.pop(key, None)
+        return changed
+
+    def _term_has_ops(self, term) -> bool:
+        for step in term:
+            tag = step[0]
+            if tag == "op":
+                return True
+            if tag in ("alt", "rankalt"):
+                if self._term_has_ops(step[1]) or self._term_has_ops(
+                    step[2]
+                ):
+                    return True
+            elif tag == "loop":
+                if self._term_has_ops(step[1]):
+                    return True
+        return False
+
+    # ------------------------------------------------ public queries
+
+    def may_block_chain(
+        self, key: FKey
+    ) -> Optional[List[Tuple[str, str]]]:
+        return self._may_block.get(key)
+
+    def has_collectives(self, key: FKey) -> bool:
+        return self._has_coll.get(key, False)
+
+    def res_closure(self, key: FKey) -> Set[Tuple[str, str]]:
+        return self._res_closure.get(key, set())
+
+    def collective_seq(
+        self,
+        key: FKey,
+        _stack: Optional[Set[FKey]] = None,
+        _cut: Optional[List[bool]] = None,
+    ) -> Tuple:
+        """The flattened collective sequence: op names in order, with
+        ``("alt", a, b)`` and ``("loop", s)`` structure; callee
+        sequences spliced in (recursion splices nothing).  Results are
+        memoized whenever the expansion completed without hitting a
+        recursion cut — a cut result depends on WHERE in the cycle the
+        walk entered and must not be cached (``_cut`` propagates that
+        fact to the caller)."""
+        got = self._coll_seq.get(key)
+        if got is not None:
+            return got
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            if _cut is not None:
+                _cut[0] = True
+            return ()
+        summ = self.locals.get(key)
+        if summ is None:
+            self._coll_seq[key] = ()
+            return ()
+        cut = [False]
+        seq = self._seq_of_term(key, summ, summ.term, stack | {key}, cut)
+        if not cut[0]:
+            self._coll_seq[key] = seq
+        elif _cut is not None:
+            _cut[0] = True
+        return seq
+
+    def _seq_of_term(
+        self,
+        key: FKey,
+        summ: FnSummary,
+        term,
+        stack: Set[FKey],
+        cut: Optional[List[bool]] = None,
+    ) -> Tuple:
+        out: List = []
+        for step in term:
+            tag = step[0]
+            if tag == "op":
+                out.append(step[1])
+            elif tag == "call":
+                for tgt in self.targets(key, step[1]):
+                    sub = self.collective_seq(tgt, stack, _cut=cut)
+                    if sub:
+                        out.extend(sub)
+                        break
+            elif tag in ("alt", "rankalt"):
+                a = self._seq_of_term(key, summ, step[1], stack, cut)
+                b = self._seq_of_term(key, summ, step[2], stack, cut)
+                if a or b:
+                    out.append(("alt", a, b))
+            elif tag == "loop":
+                s = self._seq_of_term(key, summ, step[1], stack, cut)
+                if s:
+                    out.append(("loop", s))
+        return tuple(out)
+
+    def local_collective_seq(self, summ: FnSummary, term) -> Tuple:
+        """Direct collective ops only (what the lexical pass already
+        sees) — the protocol-lockstep dedup baseline."""
+        out: List = []
+        for step in term:
+            tag = step[0]
+            if tag == "op":
+                out.append(step[1])
+            elif tag in ("alt", "rankalt"):
+                a = self.local_collective_seq(summ, step[1])
+                b = self.local_collective_seq(summ, step[2])
+                if a or b:
+                    out.append(("alt", a, b))
+            elif tag == "loop":
+                s = self.local_collective_seq(summ, step[1])
+                if s:
+                    out.append(("loop", s))
+        return tuple(out)
+
+    # ------------------------------------------- marker exposure
+
+    def marker_exposure(
+        self, key: FKey, _stack: Optional[Set[FKey]] = None
+    ) -> Tuple[Optional[Tuple[str, str, int]], str]:
+        """``(exposed, ensures)``: ``exposed`` is the first commit-
+        marker write reachable with NO preceding synchronization point
+        when the function is entered unsynchronized — as
+        ``(relpath, context, lineno)`` — else None.  ``ensures`` is
+        "always" when every path through the function establishes a
+        sync point, else "maybe"."""
+        got = self._marker.get(key)
+        if got is not None:
+            return got
+        stack = _stack or set()
+        if key in stack:
+            return (None, "maybe")
+        stack = stack | {key}
+        summ = self.locals.get(key)
+        if summ is None:
+            return (None, "maybe")
+        exposed, synced = self._walk_marker(
+            key, summ, summ.term, False, stack
+        )
+        result = (exposed, "always" if synced else "maybe")
+        if _stack is None:
+            self._marker[key] = result
+        return result
+
+    def _walk_marker(
+        self, key: FKey, summ: FnSummary, term, synced: bool,
+        stack: Set[FKey],
+    ):
+        exposed: Optional[Tuple[str, str, int]] = None
+        for step in term:
+            tag = step[0]
+            if tag in ("op", "kvget"):
+                synced = True
+            elif tag == "marker":
+                if not synced and exposed is None:
+                    exposed = (key[0], key[1], step[1])
+            elif tag == "call":
+                for tgt in self.targets(key, step[1]):
+                    sub_exposed, ensures = self.marker_exposure(
+                        tgt, stack
+                    )
+                    if (
+                        not synced
+                        and sub_exposed is not None
+                        and exposed is None
+                    ):
+                        exposed = sub_exposed
+                    if ensures == "always":
+                        synced = True
+                    break
+            elif tag in ("alt", "rankalt"):
+                e1, s1 = self._walk_marker(
+                    key, summ, step[1], synced, stack
+                )
+                e2, s2 = self._walk_marker(
+                    key, summ, step[2], synced, stack
+                )
+                if exposed is None:
+                    exposed = e1 or e2
+                synced = s1 and s2
+            elif tag == "loop":
+                e1, _s1 = self._walk_marker(
+                    key, summ, step[1], synced, stack
+                )
+                if exposed is None:
+                    exposed = e1
+                # the body may run zero times: state is unchanged
+        return exposed, synced
+
+    # ------------------------------------- closure-domain sanction
+
+    def closure_sanction(
+        self, unit: FileUnit, qualname: str, kind: str,
+        releases: Iterable[str], root: str,
+    ) -> Optional[str]:
+        """The executor-handoff proof the resource-pairing hook asks
+        for: ``qualname`` is a def nested in a FUNCTION (a pipeline
+        closure), and the enclosing function's closure domain — the
+        enclosing def, every def nested under it, and their resolved
+        in-module callees — contains a matching release-family verb of
+        the same ``kind`` on the same receiver ``root``.  Returns the
+        evidence string (where the release lives) or None.
+
+        This is balance-by-containment, not path-exactness: the debit
+        is owned by task machinery the enclosing executor drives, and
+        the per-path invariant is delegated to the runtime budget-
+        balance suites — but the *existence and location* of the other
+        side is now machine-checked, so a rename or refactor that
+        drops the credit fails the lint instead of leaking quietly.
+        """
+        if "." not in qualname:
+            return None
+        mi = self.project.mod_info(unit)
+        enclosing = qualname.rsplit(".", 1)[0]
+        if enclosing not in mi.fn_index:
+            return None  # enclosing scope is a class, not an executor
+        # the acquiring def ITSELF is excluded from the domain: its own
+        # releases were already weighed by the CFG check that is asking
+        # for this proof (and found reachable-around on some path) — a
+        # happy-path release inside the leaky closure is no evidence of
+        # a cross-task handoff, only a sibling's/enclosing's is
+        self_key = (unit.relpath, qualname)
+        domain: List[FKey] = [
+            (unit.relpath, qn)
+            for qn in mi.fn_index
+            if (qn == enclosing or qn.startswith(enclosing + "."))
+            and (unit.relpath, qn) != self_key
+            and not qn.startswith(qualname + ".")
+        ]
+        seen: Set[FKey] = set()
+        work = list(domain)
+        rel_set = set(releases)
+        while work:
+            k = work.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            if k == self_key or k[1].startswith(qualname + "."):
+                continue  # never re-enter the acquiring def via edges
+            summ = self.locals.get(k)
+            if summ is None:
+                continue
+            for _family, skind, verb, sroot, lineno in summ.res:
+                if (
+                    skind == kind
+                    and verb in rel_set
+                    and sroot == root
+                ):
+                    return (
+                        f"{verb}() on {sroot} in {k[1]} "
+                        f"({k[0]}:{lineno})"
+                    )
+            for idx in range(len(summ.calls)):
+                for t in self.targets(k, idx):
+                    if t[0] == unit.relpath and t not in seen:
+                        work.append(t)
+        return None

@@ -41,6 +41,7 @@ from typing import Any, Awaitable, Callable, List, Optional, Tuple
 
 import psutil
 
+from . import _csrc
 from . import codec as codec_mod
 from . import knobs
 from .cas import store as cas_store_mod
@@ -224,6 +225,24 @@ class _LoopThread:
 
     def _run(self) -> None:
         asyncio.set_event_loop(self.loop)
+        # Warm the lazy native-library loader BEFORE the loop runs:
+        # load() may open /proc/cpuinfo and even compile the .so on
+        # its first call in a process, and the first digest/codec user
+        # is otherwise an async pipeline task — a multi-second compile
+        # on the event loop stalls every in-flight pipeline at once
+        # (surfaced by snaplint effect-escape; load() is memoized, so
+        # this costs one no-op lock acquire ever after).  Best-effort:
+        # a loader failure here must not kill the thread before
+        # run_forever, or every submit() would hang on a dead loop —
+        # the first real native user re-hits load() and degrades to
+        # the pure-python path as before.
+        try:
+            _csrc.load()
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "native fastio warm-up failed; continuing without it",
+                exc_info=True,
+            )
         self.loop.run_forever()
 
     def submit(self, coro: Awaitable) -> concurrent.futures.Future:

@@ -16,9 +16,13 @@ from torchsnapshot_tpu.storage.fs import FSStoragePlugin
 
 
 def test_native_write_passes_fsync_mode(tmp_path, monkeypatch):
-    plugin = FSStoragePlugin(str(tmp_path))
+    # FASTIO=0 pins the pre-engine native leg (tsnp_write_file); the
+    # engine leg's fsync discipline is pinned separately below
+    with knobs.override_fastio(False):
+        plugin = FSStoragePlugin(str(tmp_path))
     if plugin._lib is None:
         pytest.skip("native ext unavailable")
+    assert plugin._fastio is None
     calls = []
     real = plugin._lib.tsnp_write_file
 
@@ -40,6 +44,31 @@ def test_native_write_passes_fsync_mode(tmp_path, monkeypatch):
     assert modes == {"data": 0, "meta": 1}
     # ... and the temp files were renamed onto the final names
     assert sorted(os.listdir(tmp_path)) == ["data", "meta"]
+
+
+def test_engine_write_passes_fsync_mode(tmp_path, monkeypatch):
+    # the fast-I/O engine leg: bulk writes stay page-cache, the durable
+    # write fdatasyncs its temp file before the rename — same contract
+    # as the pre-engine leg above
+    plugin = FSStoragePlugin(str(tmp_path))
+    if plugin._fastio is None:
+        pytest.skip("fast-I/O engine unavailable")
+    synced = []
+    real_fdatasync = os.fdatasync
+    monkeypatch.setattr(
+        os,
+        "fdatasync",
+        lambda fd: (synced.append("file"), real_fdatasync(fd))[1],
+    )
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(plugin.write(WriteIO(path="data", buf=b"d")))
+    assert synced == []  # bulk writes: no sync
+    loop.run_until_complete(
+        plugin.write(WriteIO(path="meta", buf=b"m", durable=True))
+    )
+    assert synced == ["file"]
+    assert sorted(os.listdir(tmp_path)) == ["data", "meta"]
+    assert (tmp_path / "meta").read_bytes() == b"m"
 
 
 def test_fallback_durable_write_fsyncs(tmp_path, monkeypatch):
@@ -68,7 +97,8 @@ def test_fallback_durable_write_fsyncs(tmp_path, monkeypatch):
 
 
 def test_fs_sync_data_knob_syncs_bulk_writes(tmp_path, monkeypatch):
-    plugin = FSStoragePlugin(str(tmp_path))
+    with knobs.override_fastio(False):
+        plugin = FSStoragePlugin(str(tmp_path))
     if plugin._lib is None:
         pytest.skip("native ext unavailable")
     calls = []
@@ -83,6 +113,23 @@ def test_fs_sync_data_knob_syncs_bulk_writes(tmp_path, monkeypatch):
     with knobs.override_fs_sync_data(True):
         loop.run_until_complete(plugin.write(WriteIO(path="data", buf=b"d")))
     assert calls == [1]
+
+
+def test_fs_sync_data_knob_syncs_bulk_writes_engine(tmp_path, monkeypatch):
+    plugin = FSStoragePlugin(str(tmp_path))
+    if plugin._fastio is None:
+        pytest.skip("fast-I/O engine unavailable")
+    synced = []
+    real_fdatasync = os.fdatasync
+    monkeypatch.setattr(
+        os,
+        "fdatasync",
+        lambda fd: (synced.append(fd), real_fdatasync(fd))[1],
+    )
+    loop = asyncio.new_event_loop()
+    with knobs.override_fs_sync_data(True):
+        loop.run_until_complete(plugin.write(WriteIO(path="data", buf=b"d")))
+    assert len(synced) == 1
 
 
 @pytest.mark.parametrize("native", [True, False])

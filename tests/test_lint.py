@@ -1231,6 +1231,34 @@ def test_instrumentation_covers_codec_entry_points():
     assert "encode_frame_async" in findings[0].message
 
 
+def test_instrumentation_covers_fastio_entry_points():
+    """The fast-I/O engine's byte-moving methods must carry spans —
+    once the engine is on, fs I/O time lives inside them, and an
+    unbracketed engine would make the fastest path the least
+    attributable one."""
+    from tools.lint.passes.instrumentation import TARGETS
+
+    cov = TARGETS["torchsnapshot_tpu/storage/fastio.py"]
+    assert "FastIOEngine" in cov
+    # the byte movers are ENFORCED, not allowlisted away
+    assert not {"write_file", "read_into", "pwrite_part"} & cov["FastIOEngine"]
+    findings = _run(
+        "instrumentation",
+        """
+        class FastIOEngine:
+            def write_file(self, path, buf, sync_file, want_digest):
+                return None
+
+            def read_into(self, path, offset, length, out):
+                with obs.span("fastio/read_into", path=path):
+                    return 0
+        """,
+        filename="torchsnapshot_tpu/storage/fastio.py",
+    )
+    assert len(findings) == 1
+    assert "write_file" in findings[0].message
+
+
 def test_instrumentation_covers_serving_read_entry_points():
     """Serving read path pins: the zero-copy mapping call (fs.mmap_read)
     and the shared-host cache's single-flight fill must stay

@@ -64,6 +64,16 @@ TARGETS: Dict[str, Dict[str, Set[str]]] = {
             "last_durable_step", "heartbeats", "summary",
         },
     },
+    "torchsnapshot_tpu/storage/fastio.py": {
+        # the fast-I/O engine's byte-moving entry points (write_file /
+        # read_into / pwrite_part) carry spans — they are where fs I/O
+        # time lives once the engine is on, and an unbracketed engine
+        # would make the FASTEST path the least attributable one.  The
+        # allowlisted names are probe-time plumbing and accessors:
+        # open_direct is one open(2) inside an already-bracketed stripe
+        # span, pool_free_count is a pure accessor for the chaos suite
+        "FastIOEngine": {"open_direct", "pool_free_count"},
+    },
     "torchsnapshot_tpu/continuous/store.py": {
         # read_state/read_chunks (the verified recovery fan-in — the
         # RTO's I/O half) carry spans and are enforced; the allowlisted

@@ -259,6 +259,37 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint32),
         ]
         try:
+            # newer symbols (the fast-I/O engine, storage/fastio.py):
+            # tolerate a cached .so from older source — the engine then
+            # reports itself unavailable and the fs plugin keeps the
+            # pre-engine native path
+            lib.tsnp_part_pwrite.restype = ctypes.c_int
+            lib.tsnp_part_pwrite.argtypes = [
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
+            lib.tsnp_part_pread.restype = ctypes.c_int64
+            lib.tsnp_part_pread.argtypes = [
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+            ]
+        except AttributeError:
+            logger.debug("loaded fastio lacks the part pwrite/pread symbols")
+        try:
             # newer symbols (the "huff" block codec): tolerate a cached
             # .so from older source — codec.py then reports huff
             # unavailable instead of crashing every native-ext consumer

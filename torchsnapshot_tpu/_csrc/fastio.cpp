@@ -14,6 +14,7 @@
 #include <fcntl.h>
 #include <sys/stat.h>
 #include <sys/types.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 // When the build links libz (-DTSNP_USE_ZLIB -lz), the fused digest
@@ -498,6 +499,240 @@ int tsnp_write_file_digest(const char *path, const void *buf, int64_t size,
   if (close(fd) != 0 && rc == 0)
     rc = -errno;
   return rc;
+}
+
+// ------------------------------------------------------- fast-I/O engine
+// Part-granular pwrite/pread entry points for storage/fastio.py: one
+// ctypes call per part, entirely outside the GIL, with the (crc32,
+// adler32) digest fused into the same pass that moves the bytes and
+// O_DIRECT alignment owned HERE (the Python layer never does sector
+// math).  See docs/fastio.md for the fallback ladder.
+
+static int pwrite_full(int fd, const void *p, int64_t n, int64_t off) {
+  const char *s = static_cast<const char *>(p);
+  while (n > 0) {
+    ssize_t w = pwrite(fd, s, static_cast<size_t>(n), static_cast<off_t>(off));
+    if (w < 0) {
+      if (errno == EINTR)
+        continue;
+      return -errno;
+    }
+    s += w;
+    off += w;
+    n -= w;
+  }
+  return 0;
+}
+
+static int64_t pread_full(int fd, void *p, int64_t n, int64_t off) {
+  char *d = static_cast<char *>(p);
+  int64_t got = 0;
+  while (got < n) {
+    ssize_t r = pread(fd, d + got, static_cast<size_t>(n - got),
+                      static_cast<off_t>(off + got));
+    if (r < 0) {
+      if (errno == EINTR)
+        continue;
+      return -static_cast<int64_t>(errno);
+    }
+    if (r == 0)
+      break;  // EOF: short read, caller surfaces it
+    got += r;
+  }
+  return got;
+}
+
+// Buffered digesting positional write: each 256KB block is digested
+// while cache-hot, but the write syscalls batch 64 blocks into ONE
+// pwritev (16MB per syscall) — the per-block write(2) chain of
+// tsnp_write_file_digest costs a syscall per 256KB, which at local-NVMe
+// rates is measurable pure overhead.
+static int pwrite_digest_stream(int fd, const uint8_t *p, int64_t n,
+                                int64_t off, int want, uint32_t *crc,
+                                uint32_t *adl) {
+  enum { BLK = 262144, NIOV = 64 };
+  struct iovec iov[NIOV];
+  while (n > 0) {
+    int cnt = 0;
+    int64_t batch = 0;
+    while (n > 0 && cnt < NIOV) {
+      int64_t blk = n > BLK ? BLK : n;
+      if (want) {
+        *crc = crc32z_update(*crc, p, blk);
+        *adl = adler32_update(*adl, p, blk);
+      }
+      iov[cnt].iov_base = const_cast<uint8_t *>(p);
+      iov[cnt].iov_len = static_cast<size_t>(blk);
+      cnt++;
+      batch += blk;
+      p += blk;
+      n -= blk;
+    }
+    int64_t done = 0;
+    int idx = 0;
+    while (done < batch) {
+      ssize_t w = pwritev(fd, iov + idx, cnt - idx,
+                          static_cast<off_t>(off + done));
+      if (w < 0) {
+        if (errno == EINTR)
+          continue;
+        return -errno;
+      }
+      done += w;
+      // advance the iovec cursor past the consumed bytes (a partial
+      // pwritev may stop mid-iovec)
+      while (idx < cnt && w >= static_cast<ssize_t>(iov[idx].iov_len)) {
+        w -= static_cast<ssize_t>(iov[idx].iov_len);
+        idx++;
+      }
+      if (idx < cnt && w > 0) {
+        iov[idx].iov_base = static_cast<char *>(iov[idx].iov_base) + w;
+        iov[idx].iov_len -= static_cast<size_t>(w);
+      }
+    }
+    off += batch;
+  }
+  return 0;
+}
+
+// Write src[0:size] at byte `offset` of an already-open file, fusing
+// the zlib (crc32, adler32) of src into the same pass when
+// want_digest (out[0]=crc32, out[1]=adler32).
+//
+// fd_direct >= 0 selects the O_DIRECT split: the sub-sector head
+// ([offset, align_up(offset))) and tail ([align_down(end), end)) go
+// buffered through fd, while the aligned body is copied through the
+// caller's `bounce` buffer (alignment-satisfying, bounce_cap an align
+// multiple) in one fused copy+digest pass and pwritten via fd_direct —
+// sector-aligned offset, length, and memory, as O_DIRECT requires.
+// The head/tail/body file ranges are disjoint, so mixing the two fds
+// on one file is coherent.  fd_direct < 0 writes everything buffered
+// via the pwritev-batched digesting stream.  Returns 0 or -errno.
+int tsnp_part_pwrite(int fd, int fd_direct, const void *src, int64_t size,
+                     int64_t offset, int64_t align, void *bounce,
+                     int64_t bounce_cap, int want_digest, uint32_t *out) {
+  const uint8_t *p = static_cast<const uint8_t *>(src);
+  uint32_t crc = 0, adl = 1;
+  int rc;
+  if (size > 0 && fd_direct >= 0 && align > 0 && bounce != nullptr &&
+      bounce_cap >= align) {
+    int64_t end = offset + size;
+    int64_t head_end = (offset + align - 1) / align * align;
+    if (head_end > end)
+      head_end = end;
+    int64_t body_end = end / align * align;
+    if (body_end < head_end)
+      body_end = head_end;  // span too small to hold an aligned body
+    int64_t head = head_end - offset;
+    if (head > 0) {
+      if (want_digest) {
+        crc = crc32z_update(crc, p, head);
+        adl = adler32_update(adl, p, head);
+      }
+      if ((rc = pwrite_full(fd, p, head, offset)) != 0)
+        return rc;
+    }
+    const uint8_t *q = p + head;
+    int64_t body = body_end - head_end;
+    int64_t cur = head_end;
+    while (body > 0) {
+      int64_t blk = body > bounce_cap ? bounce_cap : body;
+      // fused copy+digest into the aligned bounce, 256KB sub-blocks so
+      // the digest runs on cache-hot bytes (same structure as
+      // tsnp_copy_digest)
+      int64_t o = 0;
+      while (o < blk) {
+        int64_t sb = blk - o > 262144 ? 262144 : blk - o;
+        memcpy(static_cast<uint8_t *>(bounce) + o, q + o,
+               static_cast<size_t>(sb));
+        if (want_digest) {
+          crc = crc32z_update(crc, q + o, sb);
+          adl = adler32_update(adl, q + o, sb);
+        }
+        o += sb;
+      }
+      if ((rc = pwrite_full(fd_direct, bounce, blk, cur)) != 0)
+        return rc;
+      q += blk;
+      cur += blk;
+      body -= blk;
+    }
+    int64_t tail = end - body_end;
+    if (tail > 0) {
+      if (want_digest) {
+        crc = crc32z_update(crc, q, tail);
+        adl = adler32_update(adl, q, tail);
+      }
+      if ((rc = pwrite_full(fd, q, tail, body_end)) != 0)
+        return rc;
+    }
+  } else if (size > 0) {
+    if ((rc = pwrite_digest_stream(fd, p, size, offset, want_digest, &crc,
+                                   &adl)) != 0)
+      return rc;
+  }
+  if (want_digest) {
+    out[0] = crc;
+    out[1] = adl;
+  }
+  return 0;
+}
+
+// Read `size` bytes at `offset` into dst.  fd_direct >= 0 reads the
+// aligned body via O_DIRECT into the caller's bounce buffer (then one
+// memcpy to dst — the copy is the price of page-cache bypass; dst is
+// arbitrary caller memory) with the sub-sector head/tail read buffered
+// through fd; fd_direct < 0 reads everything buffered straight into
+// dst.  Returns bytes read (short only at EOF), or -errno.
+int64_t tsnp_part_pread(int fd, int fd_direct, void *dst, int64_t size,
+                        int64_t offset, int64_t align, void *bounce,
+                        int64_t bounce_cap) {
+  uint8_t *d = static_cast<uint8_t *>(dst);
+  if (size <= 0)
+    return 0;
+  if (fd_direct < 0 || align <= 0 || bounce == nullptr ||
+      bounce_cap < align)
+    return pread_full(fd, d, size, offset);
+  int64_t end = offset + size;
+  int64_t head_end = (offset + align - 1) / align * align;
+  if (head_end > end)
+    head_end = end;
+  int64_t body_end = end / align * align;
+  if (body_end < head_end)
+    body_end = head_end;
+  int64_t total = 0;
+  int64_t head = head_end - offset;
+  if (head > 0) {
+    int64_t n = pread_full(fd, d, head, offset);
+    if (n < 0)
+      return n;
+    total += n;
+    if (n < head)
+      return total;  // EOF inside the head
+  }
+  int64_t body = body_end - head_end;
+  int64_t cur = head_end;
+  while (body > 0) {
+    int64_t blk = body > bounce_cap ? bounce_cap : body;
+    int64_t n = pread_full(fd_direct, bounce, blk, cur);
+    if (n < 0)
+      return n;
+    if (n > 0)
+      memcpy(d + (cur - offset), bounce, static_cast<size_t>(n));
+    total += n;
+    if (n < blk)
+      return total;  // EOF inside the body
+    cur += blk;
+    body -= blk;
+  }
+  int64_t tail = end - body_end;
+  if (tail > 0) {
+    int64_t n = pread_full(fd, d + (body_end - offset), tail, body_end);
+    if (n < 0)
+      return n;
+    total += n;
+  }
+  return total;
 }
 
 // memcpy src -> dst while computing zlib crc32 AND adler32 of the bytes,

@@ -348,6 +348,14 @@ class StoragePlugin(abc.ABC):
     # ReadIO.byte_range, so striped restore works against any backend.
     supports_striped_write: bool = False
 
+    # True when this plugin's striped-write HANDLES honor write_part's
+    # ``want_digest`` (StripedWriteHandle.supports_fused_digest) — the
+    # scheduler then defers checksum work for stripe-eligible writes
+    # too: the folded per-part digests replace the separate staging-
+    # phase pass over the whole object.  Plugin-level so the defer
+    # decision can be made BEFORE a handle exists.
+    supports_fused_part_digest: bool = False
+
     # True when this plugin can honor ReadIO.want_mmap by serving raw
     # object bytes as a read-only mmap-backed buffer (fs, the shared-
     # host cache, tiered fast reads).

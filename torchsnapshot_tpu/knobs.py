@@ -70,6 +70,9 @@ _FANOUT_TIMEOUT_S = "FANOUT_TIMEOUT_S"
 _CONTINUOUS = "CONTINUOUS"
 _CONTINUOUS_PROMOTE_EVERY_N = "CONTINUOUS_PROMOTE_EVERY_N"
 _CONTINUOUS_GRACE_S = "CONTINUOUS_GRACE_S"
+_FASTIO = "FASTIO"
+_FASTIO_DIRECT = "FASTIO_DIRECT"
+_FASTIO_BUFFER_POOL_BYTES = "FASTIO_BUFFER_POOL_BYTES"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -352,6 +355,32 @@ _DEFAULTS = {
     # signal and exits.  Size it under your orchestrator's kill grace
     # (GCE spot gives 30s; leave headroom for the exit itself).
     _CONTINUOUS_GRACE_S: 10.0,
+    # Native fast-I/O engine (storage/fastio.py): the fs plugin's
+    # part readers/writers run as single GIL-free native calls —
+    # pwritev-batched syscalls with the (crc32, adler32) digest fused
+    # into the same pass that moves the bytes (part writes stop paying
+    # a separate digest read).  Requires the native ext; 0 keeps the
+    # pre-engine fs paths (still native when ENABLE_NATIVE_EXT is on).
+    # Probed ONCE at plugin init, never per-op.
+    _FASTIO: 1,
+    # O_DIRECT data path: takes write (and restores read) snapshot
+    # payload bytes around the page cache, so a take doesn't churn the
+    # cache and a serving cold start doesn't evict the model it is
+    # loading.  The engine owns all alignment (sub-sector heads/tails
+    # bounce through the aligned pool; the aligned body goes direct) —
+    # bytes and digests are bitwise-identical either way.  Where
+    # O_DIRECT is unsupported (e.g. tmpfs on older kernels) the engine
+    # degrades to buffered I/O plus best-effort
+    # posix_fadvise(DONTNEED).  Off by default: direct writes are
+    # synchronous to media, which trades take latency for cache
+    # hygiene — see docs/fastio.md for when that pays.
+    _FASTIO_DIRECT: 0,
+    # Total preallocated aligned bounce-buffer pool for the engine
+    # (split into fixed 4MB buffers, min one).  Direct-path parts each
+    # hold one buffer for the duration of their copy+write; an
+    # exhausted pool backpressures (the part waits for a buffer, and
+    # storage.fastio.pool_waits counts the waits).
+    _FASTIO_BUFFER_POOL_BYTES: 64 * 1024 * 1024,
 }
 
 _OVERRIDES: dict = {}
@@ -720,6 +749,24 @@ def get_continuous_grace_s() -> float:
     return max(0.0, float(_get_raw(_CONTINUOUS_GRACE_S)))
 
 
+def fastio_enabled() -> bool:
+    """Native fast-I/O engine master switch (see _FASTIO above); the
+    engine additionally requires the native ext to load with the part
+    pwrite/pread symbols — this knob can only turn it OFF."""
+    return bool(_get_int(_FASTIO))
+
+
+def fastio_direct_enabled() -> bool:
+    """O_DIRECT data-path request (see _FASTIO_DIRECT above); honored
+    only where the engine's one-time probe finds O_DIRECT support,
+    degrading to buffered + posix_fadvise(DONTNEED) otherwise."""
+    return bool(_get_int(_FASTIO_DIRECT))
+
+
+def get_fastio_buffer_pool_bytes() -> int:
+    return max(4 * 1024 * 1024, _get_int(_FASTIO_BUFFER_POOL_BYTES))
+
+
 def restore_donation() -> str:
     """One of "on" | "off" | "auto" (see _RESTORE_DONATE above).
 
@@ -953,6 +1000,18 @@ def override_continuous_promote_every_n(value: int):
 
 def override_continuous_grace_s(value: float):
     return _override(_CONTINUOUS_GRACE_S, value)
+
+
+def override_fastio(value: bool):
+    return _override(_FASTIO, int(value))
+
+
+def override_fastio_direct(value: bool):
+    return _override(_FASTIO_DIRECT, int(value))
+
+
+def override_fastio_buffer_pool_bytes(value: int):
+    return _override(_FASTIO_BUFFER_POOL_BYTES, value)
 
 
 def override_failpoint_seed(value: int):

@@ -79,6 +79,13 @@ from .metrics import (  # noqa: F401
     CONTINUOUS_STEPS,
     EVENT_HANDLER_ERRORS,
     EXCEPTIONS_SWALLOWED,
+    FASTIO_BUFFERED_PARTS,
+    FASTIO_BYTES_READ,
+    FASTIO_BYTES_WRITTEN,
+    FASTIO_DIRECT_PARTS,
+    FASTIO_DONTNEED_READS,
+    FASTIO_FUSED_DIGESTS,
+    FASTIO_POOL_WAITS,
     GC_BYTES_RECLAIMED,
     IO_QUEUE_DEPTH,
     LATENCY_BUCKETS_S,

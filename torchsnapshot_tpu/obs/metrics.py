@@ -105,6 +105,21 @@ CACHE_MISSES = "storage.cache.misses"
 CACHE_SINGLEFLIGHT_WAITS = "storage.cache.singleflight_waits"
 CACHE_BYTES_FILLED = "storage.cache.bytes_filled"
 CACHE_EVICTIONS = "storage.cache.evictions"
+# Native fast-I/O engine (storage/fastio.py): bytes moved through the
+# engine's GIL-free part readers/writers, parts that took the O_DIRECT
+# leg vs the buffered (pwritev-batched) leg, part digests fused into
+# the same native pass that moved the bytes (each one is a full read
+# pass the old path paid separately), waits for an exhausted aligned
+# bounce-buffer pool (backpressure — size FASTIO_BUFFER_POOL_BYTES up
+# if this grows), and reads that applied the posix_fadvise(DONTNEED)
+# fallback where O_DIRECT was unavailable.
+FASTIO_BYTES_WRITTEN = "storage.fastio.bytes_written"
+FASTIO_BYTES_READ = "storage.fastio.bytes_read"
+FASTIO_DIRECT_PARTS = "storage.fastio.direct_parts"
+FASTIO_BUFFERED_PARTS = "storage.fastio.buffered_parts"
+FASTIO_FUSED_DIGESTS = "storage.fastio.fused_digests"
+FASTIO_POOL_WAITS = "storage.fastio.pool_waits"
+FASTIO_DONTNEED_READS = "storage.fastio.dontneed_reads"
 # Zero-copy mmap reads (io_types.ReadIO.want_mmap): reads served as
 # read-only file-backed mappings instead of heap copies, and the bytes
 # mapped (pages fault in lazily — mapped ≠ resident).

@@ -534,12 +534,19 @@ async def _execute_write_pipelines(
         ):
             precomputed = getattr(wr.buffer_stager, "piece_digests", None)
             if (
-                getattr(storage, "supports_fused_digest", False)
+                (
+                    # stripe-eligible writes defer when the plugin's
+                    # part handles fuse digests (the folded per-part
+                    # digests replace this pass); whole-object writes
+                    # defer on the plugin-level fused write
+                    getattr(storage, "supports_fused_part_digest", False)
+                    if stripe.write_eligible(p.buf_size, storage)
+                    else getattr(storage, "supports_fused_digest", False)
+                )
                 and wr.dedup is None
                 and wr.cas is None
                 and not will_encode  # fused digest would hash STORED bytes
                 and precomputed is None
-                and not stripe.write_eligible(p.buf_size, storage)
                 and all(
                     rng is None or (rng[0] == 0 and rng[1] == p.buf_size)
                     for _, rng in (wr.checksum_sinks or ())
@@ -633,14 +640,32 @@ async def _execute_write_pipelines(
                     "dedup link for %r failed (%r); writing normally",
                     wr.path, e,
                 )
-        if not p.defer_digest and stripe.write_eligible(p.buf_size, storage):
+        if stripe.write_eligible(p.buf_size, storage):
             # whole-staged striped write: the buffer exists, so split it
             # into concurrent parts (true multipart on s3, compose parts
-            # on gcs, offset-parallel pwrite on fs).  Checksums were
-            # applied at staging — defer_digest is disabled for
-            # stripe-eligible writes (_stage_one_inner), since part
-            # writes can't fuse a whole-object digest.
-            await stripe.striped_write(storage, wr.path, p.buf)
+            # on gcs, engine/offset-parallel pwrite on fs).  When the
+            # digest was deferred (_stage_one_inner: the plugin's part
+            # handles fuse), each part's (crc32, adler32) rides its
+            # write and the folded result replaces the staging-phase
+            # pass; a declining handle degrades to that one extra pass.
+            d = await stripe.striped_write(
+                storage, wr.path, p.buf, want_digests=p.defer_digest
+            )
+            if p.defer_digest:
+                if d is None:
+                    await asyncio.get_running_loop().run_in_executor(
+                        executor,
+                        _apply_checksum_sinks,
+                        p.buf,
+                        wr.checksum_sinks,
+                        wr.digest_sink,
+                        None,
+                    )
+                else:
+                    for sink, _rng in wr.checksum_sinks or ():
+                        sink(d[0])
+                    if wr.digest_sink is not None:
+                        wr.digest_sink([d[0], d[1], d[2]])
             return p
         wio = WriteIO(path=wr.path, buf=p.buf, want_digest=p.defer_digest)
         await storage.write(wio)

@@ -7,8 +7,14 @@ Two backends, selected at construction:
 - **native** (default when the C++ ext builds): single-syscall-chain
   write/read in ``_csrc/fastio.cpp`` called via ctypes from executor
   threads with the GIL released — one C call per object instead of
-  aiofiles' per-chunk thread hops.
-- **aiofiles** fallback, behaviorally identical.
+  aiofiles' per-chunk thread hops.  With the fast-I/O engine
+  (``storage/fastio.py``, probed once here at init) the per-object and
+  per-part legs additionally fuse the (crc32, adler32) digest into the
+  write pass, batch syscalls via pwritev, and optionally take the
+  O_DIRECT page-cache-bypass path (``FASTIO_DIRECT``; see
+  docs/fastio.md for the fallback ladder).
+- **aiofiles** fallback, behaviorally identical (imported once at
+  init, never per op).
 
 Ranged reads seek + read only the requested bytes either way, so
 ``read_object`` under a memory budget touches O(range) data.
@@ -149,11 +155,26 @@ class FSStoragePlugin(StoragePlugin):
             from .. import _csrc
 
             self._lib = _csrc.load()
+        # fast-I/O engine (storage/fastio.py): probed ONCE here — knob,
+        # engine symbols, and the root's O_DIRECT support all resolve
+        # at plugin init, never per op
+        self._fastio = None
+        if self._lib is not None:
+            from . import fastio as _fastio_mod
+
+            self._fastio = _fastio_mod.create_engine(self._lib, root)
         # fused digest-while-writing is only real on the native path
         self.supports_fused_digest = bool(
-            self._lib is not None
-            and hasattr(self._lib, "tsnp_write_file_digest")
+            self._fastio is not None
+            or (
+                self._lib is not None
+                and hasattr(self._lib, "tsnp_write_file_digest")
+            )
         )
+        # part-level twin: the engine's pwrite_part fuses each striped
+        # part's digest into the write, so the scheduler may defer
+        # digest work for stripe-eligible writes too
+        self.supports_fused_part_digest = self._fastio is not None
         self._executor: Optional[ThreadPoolExecutor] = (
             ThreadPoolExecutor(
                 max_workers=knobs.get_max_per_rank_io_concurrency(),
@@ -162,6 +183,21 @@ class FSStoragePlugin(StoragePlugin):
             if self._lib is not None
             else None
         )
+        # aiofiles fallback: import ONCE at init (repeated per-op
+        # imports cost import-lock acquisitions on the hot path).  Only
+        # the pure-Python backend needs it; absence degrades those legs
+        # to synchronous work on the loop's default pool.
+        self._aiofiles = None
+        self._aiofiles_os = None
+        if self._lib is None:
+            try:
+                import aiofiles
+                import aiofiles.os
+
+                self._aiofiles = aiofiles
+                self._aiofiles_os = aiofiles.os
+            except ImportError as e:
+                obs.swallowed_exception("storage.fs.aiofiles_import", e)
 
     def _full(self, path: str) -> str:
         return os.path.join(self.root, path)
@@ -228,7 +264,31 @@ class FSStoragePlugin(StoragePlugin):
                 sync_attempt, f"write {write_io.path}", breaker=breaker
             )
             return
-        import aiofiles
+        if self._aiofiles is None:
+            # aiofiles missing from the environment: same temp+rename
+            # bytes via one synchronous write on the default pool
+            def plain_work():
+                failpoint("storage.fs.write", path=write_io.path)
+                tmp = _tmp_name(full)
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(write_io.buf)
+                    failpoint("storage.fs.write.sync", path=write_io.path)
+                    os.replace(tmp, full)
+                except BaseException:
+                    _unlink_quiet(tmp)
+                    raise
+
+            async def plain_attempt():
+                await asyncio.get_running_loop().run_in_executor(
+                    None, plain_work
+                )
+
+            await self._retry(
+                plain_attempt, f"write {write_io.path}", breaker=breaker
+            )
+            return
+        aiofiles = self._aiofiles
 
         async def aio_attempt():
             failpoint("storage.fs.write", path=write_io.path)
@@ -274,19 +334,27 @@ class FSStoragePlugin(StoragePlugin):
         digests = None
         tmp = _tmp_name(full)
         try:
-            if want_digest and hasattr(self._lib, "tsnp_write_file_digest"):
+            if self._fastio is not None:
+                # fast-I/O engine: pwritev-batched (optionally
+                # O_DIRECT) write with the digest fused into the same
+                # native pass; temp+rename commit stays here
+                digests = self._fastio.write_file(
+                    tmp, view, sync_file, want_digest
+                )
+            elif want_digest and hasattr(self._lib, "tsnp_write_file_digest"):
                 out = (ctypes.c_uint32 * 2)()
                 rc = self._lib.tsnp_write_file_digest(
                     tmp.encode(), addr, view.nbytes, 1 if sync_file else 0, out
                 )
-                if rc == 0:
-                    digests = (int(out[0]), int(out[1]))
+                if rc != 0:
+                    raise OSError(-rc, os.strerror(-rc), full)
+                digests = (int(out[0]), int(out[1]))
             else:
                 rc = self._lib.tsnp_write_file(
                     tmp.encode(), addr, view.nbytes, 1 if sync_file else 0
                 )
-            if rc != 0:
-                raise OSError(-rc, os.strerror(-rc), full)
+                if rc != 0:
+                    raise OSError(-rc, os.strerror(-rc), full)
             failpoint("storage.fs.write.sync", path=full)
             os.replace(tmp, full)
         except BaseException:
@@ -345,7 +413,36 @@ class FSStoragePlugin(StoragePlugin):
                 executor=self._executor,
             )
             return
-        import aiofiles
+        if self._aiofiles is None:
+            # aiofiles missing from the environment: one synchronous
+            # read on the default pool, same into-honor contract
+            def plain_read():
+                failpoint("storage.fs.read", path=read_io.path)
+                with open(full, "rb") as f:
+                    if read_io.byte_range is None:
+                        start, length = 0, os.fstat(f.fileno()).st_size
+                    else:
+                        start, end = read_io.byte_range
+                        length = end - start
+                        f.seek(start)
+                    dst = resolve_read_destination(read_io.into, length)
+                    got = f.readinto(memoryview(dst).cast("B"))
+                    if got != length:
+                        raise OSError(
+                            5, f"short read: {got} of {length} bytes", full
+                        )
+                    return read_io.into if dst is read_io.into else dst
+
+            async def plain_attempt():
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, plain_read
+                )
+
+            read_io.buf = await self._retry(
+                plain_attempt, f"read {read_io.path}"
+            )
+            return
+        aiofiles = self._aiofiles
 
         async def aio_attempt():
             failpoint("storage.fs.read", path=read_io.path)
@@ -410,14 +507,19 @@ class FSStoragePlugin(StoragePlugin):
                 pass  # non-contiguous/exotic hint: ignore, normal path
         out = dst if dst is not None else np.empty(length, dtype=np.uint8)
         if length:
-            n = self._lib.tsnp_read_file(
-                full.encode(),
-                _buffer_address(memoryview(out).cast("B")),
-                offset,
-                length,
-            )
-            if n < 0:
-                raise OSError(-n, os.strerror(-n), full)
+            if self._fastio is not None:
+                # fast-I/O engine: optionally O_DIRECT (page-cache-
+                # bypassing) read straight into the destination
+                n = self._fastio.read_into(full, offset, length, out)
+            else:
+                n = self._lib.tsnp_read_file(
+                    full.encode(),
+                    _buffer_address(memoryview(out).cast("B")),
+                    offset,
+                    length,
+                )
+                if n < 0:
+                    raise OSError(-n, os.strerror(-n), full)
             if n != length:
                 if dst is not None:
                     # short read can't satisfy the in-place contract;
@@ -439,18 +541,26 @@ class FSStoragePlugin(StoragePlugin):
         self._ensure_dir(full)
         tmp = _tmp_name(full)
 
-        def _open() -> int:
+        def _open():
             fd = os.open(tmp, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o644)
+            fd_direct = -1
             try:
                 os.ftruncate(fd, total_size)
+                if self._fastio is not None:
+                    # one O_DIRECT fd shared by every part's aligned
+                    # body (engine declines per part below the direct
+                    # size floor); -1 when the direct leg is off
+                    fd_direct = self._fastio.open_direct(tmp)
             except BaseException:
+                if fd_direct >= 0:
+                    os.close(fd_direct)
                 os.close(fd)
                 _unlink_quiet(tmp)
                 raise
-            return fd
+            return fd, fd_direct
 
-        fd = await self._off_loop(_open)
-        return _FSStripedWriteHandle(self, path, full, tmp, fd)
+        fd, fd_direct = await self._off_loop(_open)
+        return _FSStripedWriteHandle(self, path, full, tmp, fd, fd_direct)
 
     async def _off_loop(self, fn):
         """Run a sync syscall off the event loop (the plugin's executor
@@ -466,10 +576,12 @@ class FSStoragePlugin(StoragePlugin):
             await asyncio.get_running_loop().run_in_executor(
                 self._executor, os.remove, full
             )
+        elif self._aiofiles_os is not None:
+            await self._aiofiles_os.remove(full)
         else:
-            import aiofiles.os
-
-            await aiofiles.os.remove(full)
+            await asyncio.get_running_loop().run_in_executor(
+                None, os.remove, full
+            )
 
     async def link_from(self, base_url: str, path: str) -> None:
         """Hardlink the base snapshot's object (content-addressed dedup
@@ -505,10 +617,12 @@ class FSStoragePlugin(StoragePlugin):
             st = await asyncio.get_running_loop().run_in_executor(
                 self._executor, os.stat, full
             )
+        elif self._aiofiles_os is not None:
+            st = await self._aiofiles_os.stat(full)
         else:
-            import aiofiles.os
-
-            st = await aiofiles.os.stat(full)
+            st = await asyncio.get_running_loop().run_in_executor(
+                None, os.stat, full
+            )
         return st.st_size
 
     async def close(self) -> None:
@@ -517,24 +631,35 @@ class FSStoragePlugin(StoragePlugin):
 
 
 class _FSStripedWriteHandle(StripedWriteHandle):
-    """Offset-parallel ``pwrite`` into a preallocated sibling temp file.
+    """Offset-parallel part writes into a preallocated sibling temp file.
 
-    Keeps the plugin's temp+rename commit discipline: parts land in the
-    ``.tsnp-tmp-*`` file (preallocated with ftruncate so concurrent
-    pwrites never race an append), ``complete`` optionally fdatasyncs
-    and ``os.replace``s onto the final name — a mid-stripe failure or
-    abort leaves NO partial file where a reader (or a recovery sweep)
-    would trust it.  Each part retries independently under the shared
-    fs policy (EINTR/EAGAIN transient, ENOSPC/EIO fatal) and feeds the
-    fs breaker."""
+    With the fast-I/O engine each part is ONE GIL-free native call
+    (pwritev-batched, optionally O_DIRECT for the aligned body, the
+    part's (crc32, adler32) fused into the same pass — the handle then
+    honors ``want_digest`` and the stripe engine skips its separate
+    per-part digest read); without it, the pre-engine ``os.pwrite``
+    loop.  Either way the plugin's temp+rename commit discipline holds:
+    parts land in the ``.tsnp-tmp-*`` file (preallocated with ftruncate
+    so concurrent pwrites never race an append), ``complete``
+    optionally fdatasyncs and ``os.replace``s onto the final name — a
+    mid-stripe failure or abort leaves NO partial file where a reader
+    (or a recovery sweep) would trust it.  Each part retries
+    independently under the shared fs policy (EINTR/EAGAIN transient,
+    ENOSPC/EIO fatal) and feeds the fs breaker."""
 
-    def __init__(self, plugin: FSStoragePlugin, path, full, tmp, fd) -> None:
+    def __init__(
+        self, plugin: FSStoragePlugin, path, full, tmp, fd, fd_direct=-1
+    ) -> None:
         self._plugin = plugin
         self._path = path
         self._final = full
         self._tmp = tmp
         self._fd = fd
+        self._fd_direct = fd_direct
         self._closed = False
+        # the handle fuses part digests exactly when the engine writes
+        # the parts (io_types.StripedWriteHandle contract)
+        self.supports_fused_digest = plugin._fastio is not None
         # extent actually written: the preallocated size is an UPPER
         # bound when parts carry data-dependent sizes (codec frames) —
         # complete() truncates to this high-water mark, so raw-sized
@@ -543,27 +668,31 @@ class _FSStripedWriteHandle(StripedWriteHandle):
 
     async def write_part(
         self, index: int, offset: int, buf, want_digest: bool = False
-    ) -> None:
-        # no fused part digest: pwrite has no digesting variant in the
-        # native lib, so the engine computes part digests itself
+    ):
         view = memoryview(buf).cast("B")
         self._hwm = max(self._hwm, offset + view.nbytes)
+        engine = self._plugin._fastio
 
-        def attempt() -> None:
+        def attempt():
             failpoint(
                 "storage.fs.part.write", path=self._path, part=index
             )
+            if engine is not None:
+                return engine.pwrite_part(
+                    self._fd, self._fd_direct, offset, view, want_digest
+                )
             pos = 0
             while pos < view.nbytes:
                 pos += os.pwrite(self._fd, view[pos:], offset + pos)
+            return None
 
-        async def aio_attempt() -> None:
+        async def aio_attempt():
             # off-loop even on the aiofiles fallback (plugin executor
             # None -> the loop's default pool): a part-sized pwrite on
             # the loop thread would stall every concurrent pipeline
-            await self._plugin._off_loop(attempt)
+            return await self._plugin._off_loop(attempt)
 
-        await self._plugin._retry(
+        return await self._plugin._retry(
             aio_attempt,
             f"write {self._path} [part {index}]",
             breaker=get_breaker("fs"),
@@ -592,6 +721,8 @@ class _FSStripedWriteHandle(StripedWriteHandle):
     def _close_fd(self) -> None:
         if not self._closed:
             self._closed = True
+            if self._fd_direct >= 0:
+                os.close(self._fd_direct)
             os.close(self._fd)
 
     async def abort(self) -> None:

@@ -306,6 +306,12 @@ class HostCachedStoragePlugin(StoragePlugin):
         self.supports_striped_write = bool(
             getattr(inner, "supports_striped_write", False)
         )
+        # striped writes delegate to inner's handles verbatim, so the
+        # part-level fused-digest capability passes through too — the
+        # scheduler's defer decision must see the INNER plugin's truth
+        self.supports_fused_part_digest = bool(
+            getattr(inner, "supports_fused_part_digest", False)
+        )
         m = obs.REGISTRY
         self._m_hits = m.counter(obs.CACHE_HITS)
         self._m_misses = m.counter(obs.CACHE_MISSES)

@@ -43,6 +43,9 @@ class MemoryStoragePlugin(StoragePlugin):
         from .._csrc import load as _load_native
 
         self.supports_fused_digest = _load_native() is not None
+        # the striped handle fuses per-part digests under the same
+        # condition (see _MemoryStripedWriteHandle.write_part)
+        self.supports_fused_part_digest = self.supports_fused_digest
 
     async def write(self, write_io: WriteIO) -> None:
         # the failpoint rides the shared retry policy so chaos tests

@@ -1349,3 +1349,158 @@ def test_chaos_cas_gc_racing_take_never_deletes_referenced_chunk(tmp_path):
         store.sync_close()
         assert mgr.snapshot(2).verify(deep=True).ok
         _assert_roundtrip(mgr.path_for_step(2), seed=5)
+
+
+# ============================================ live publication scenarios
+
+
+def test_chaos_publisher_dies_before_announce_subscribers_converge(tmp_path):
+    """Rank 0 publishes step 1 cleanly, then dies between the durable
+    record commit and the KV announce of step 2 (failpoint at
+    publish.announce).  Rank 1 must converge to step 2 anyway via the
+    durable-poll fallback — bitwise-correct weights, no torn swap, the
+    fallback counter advanced — and the announce channel must end the
+    run clean: a recovering publisher's close() leaves no announce key
+    behind."""
+    body = r"""
+    import time
+    from torchsnapshot_tpu import knobs, obs
+    from torchsnapshot_tpu.publish import Publisher, Subscriber
+    from torchsnapshot_tpu.publish import announce as announce_mod
+
+    pub_root = os.path.join(snap_dir, "pub")
+    N = 4096
+    if rank == 0:
+        w = np.arange(N, dtype=np.float32)
+        pub = Publisher(pub_root, coordinator=coord, chunk_size_bytes=1024)
+        pub.publish_state({"app": StateDict(w=w.copy())}, 1)
+        coord.kv_set("chaos/pub/step1", "ok")
+        # wait until the subscriber HOLDS step 1 — the scenario needs a
+        # delta swap (held record -> step 2), not a cold catch-up
+        assert coord.kv_get("chaos/sub/step1", timeout_s=60) == "ok"
+        w[0] = -1.0
+        # the kill arms ONLY around step 2's publish: record lands
+        # durably, the announce never happens
+        try:
+            with knobs.override_failpoints("publish.announce=runtime:1:1"):
+                pub.publish_state({"app": StateDict(w=w.copy())}, 2)
+            raise SystemExit("failpoint publish.announce never fired")
+        except RuntimeError:
+            pass  # died between record and announce: no cleanup runs
+        coord.kv_set("chaos/pub/died", "1")
+        # the subscriber converges on the DURABLE record alone
+        assert coord.kv_get("chaos/sub/step2", timeout_s=60) == "ok"
+        # recovery: a restarted publisher adopts the root, publishes,
+        # and close() clears the announce key (publish-paired cleanup)
+        pub2 = Publisher(pub_root, coordinator=coord, chunk_size_bytes=1024)
+        w[1] = -2.0
+        pub2.publish_state({"app": StateDict(w=w.copy())}, 3)
+        pub2.close()
+        ns = announce_mod.ns_for_root(pub_root)
+        assert coord.kv_try_get(announce_mod.announce_key(ns)) is None, (
+            "announce key leaked past publisher close()"
+        )
+        coord.kv_set("chaos/pub/done", "1")
+        print("PUB-OK")
+    else:
+        state = {"app": StateDict(w=np.zeros(N, np.float32))}
+        sub = Subscriber(pub_root, state, coordinator=coord, poll_s=0.1)
+        coord.kv_get("chaos/pub/step1", timeout_s=60)
+        deadline = time.monotonic() + 60
+        while sub.step != 1 and time.monotonic() < deadline:
+            sub.poll_once(wait_s=0.05)
+        assert sub.step == 1
+        coord.kv_set("chaos/sub/step1", "ok")
+        coord.kv_get("chaos/pub/died", timeout_s=60)
+        fb0 = obs.counter(obs.PUBLISH_FALLBACK_POLLS).value
+        # ONE poll interval after the durable commit is visible, the
+        # subscriber must hold step 2 — announce or no announce
+        deadline = time.monotonic() + 60
+        while sub.step != 2 and time.monotonic() < deadline:
+            sub.poll_once(wait_s=0.1)
+        assert sub.step == 2, "durable-poll fallback never converged"
+        assert obs.counter(obs.PUBLISH_FALLBACK_POLLS).value > fb0, (
+            "step 2 had no announce: the fallback counter must advance"
+        )
+        w = np.arange(N, dtype=np.float32)
+        w[0] = -1.0
+        np.testing.assert_array_equal(state["app"]["w"], w)
+        coord.kv_set("chaos/sub/step2", "ok")
+        coord.kv_get("chaos/pub/done", timeout_s=60)
+        # follow the recovery publication too
+        deadline = time.monotonic() + 60
+        while sub.step != 3 and time.monotonic() < deadline:
+            sub.poll_once(wait_s=0.1)
+        assert sub.step == 3
+        sub.close()
+        print("SUB-OK")
+    """
+    results = _launch_chaos_workers(tmp_path, body, env_per_rank=[{}, {}])
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {rank} failed:\n{out}"
+    assert "PUB-OK" in results[0][1]
+    assert "SUB-OK" in results[1][1]
+
+
+def test_chaos_subscriber_dies_mid_apply_next_poll_reapplies(tmp_path):
+    """A subscriber process killed between staging and the swap
+    (failpoint at publish.subscriber.apply) leaves its live state at
+    the last complete generation; a FRESH subscriber process over the
+    same root re-applies cleanly from the durable record — the
+    publication root carries everything needed to recover, no
+    subscriber-side state survives the crash."""
+    body = r"""
+    import time
+    from torchsnapshot_tpu.publish import Publisher, Subscriber
+
+    pub_root = os.path.join(snap_dir, "pub")
+    N = 4096
+    if rank == 0:
+        w = np.arange(N, dtype=np.float32)
+        pub = Publisher(pub_root, coordinator=coord, chunk_size_bytes=1024)
+        pub.publish_state({"app": StateDict(w=w.copy())}, 1)
+        coord.kv_set("chaos/pub/step1", "ok")
+        assert coord.kv_get("chaos/sub/crashed", timeout_s=60) == "1"
+        pub.close()
+        print("PUB-OK")
+    else:
+        state = {"app": StateDict(w=np.zeros(N, np.float32))}
+        sub = Subscriber(pub_root, state, coordinator=coord, poll_s=0.1)
+        coord.kv_get("chaos/pub/step1", timeout_s=60)
+        # the armed failpoint kills this apply between stage and swap
+        try:
+            while sub.step != 1:
+                sub.poll_once(wait_s=0.05)
+            raise SystemExit("failpoint publish.subscriber.apply never fired")
+        except RuntimeError:
+            pass
+        # crash invariant: generation never advanced, weights untouched
+        assert sub.generation == 0 and sub.step is None
+        np.testing.assert_array_equal(state["app"]["w"], np.zeros(N, np.float32))
+        # "next poll" after the crash: a fresh subscriber (the restarted
+        # serving process) over the same root applies cleanly
+        sub2 = Subscriber(pub_root, state, coordinator=coord, poll_s=0.1)
+        deadline = time.monotonic() + 60
+        while sub2.step != 1 and time.monotonic() < deadline:
+            sub2.poll_once(wait_s=0.05)
+        assert sub2.step == 1 and sub2.generation == 1
+        np.testing.assert_array_equal(
+            state["app"]["w"], np.arange(N, dtype=np.float32)
+        )
+        sub.close()
+        sub2.close()
+        coord.kv_set("chaos/sub/crashed", "1")
+        print("SUB-OK")
+    """
+    results = _launch_chaos_workers(
+        tmp_path,
+        body,
+        env_per_rank=[
+            {},
+            {"TORCHSNAPSHOT_TPU_FAILPOINTS": "publish.subscriber.apply=runtime:1:1"},
+        ],
+    )
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {rank} failed:\n{out}"
+    assert "PUB-OK" in results[0][1]
+    assert "SUB-OK" in results[1][1]

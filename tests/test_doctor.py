@@ -194,3 +194,48 @@ def test_two_process_take_merged_record_and_straggler(tmp_path, capsys):
     assert main(["doctor", snap_dir]) == 0
     out = capsys.readouterr().out
     assert "straggler: rank 1 (write phase" in out
+
+
+# -------------------------------------------------- publication health
+
+
+def test_doctor_reports_publish_counters(tmp_path, capsys):
+    """Flight records window counters between the take's ``capture()``
+    and its commit, so publish.* rows appear when publication activity
+    happens INSIDE that window (e.g. a continuous loop publishing while
+    the take runs).  Build the record through the public aggregate API
+    with publication traffic inside the window and assert doctor
+    renders the publication health line (with --json parity)."""
+    from torchsnapshot_tpu.publish import Publisher, Subscriber
+
+    path = _take(tmp_path)
+    root = str(tmp_path / "pub")
+    w = np.arange(4096, dtype=np.float32)
+    before = aggregate.capture()
+    pub = Publisher(root, chunk_size_bytes=1024)
+    state = {"app": StateDict(w=np.zeros(4096, np.float32))}
+    sub = Subscriber(root, state)
+    try:
+        pub.publish_state({"app": StateDict(w=w.copy())}, 1)
+        sub.poll_once()
+        w[0] = -1.0
+        pub.publish_state({"app": StateDict(w=w.copy())}, 2)
+        sub.poll_once()
+    finally:
+        sub.close()
+        pub.close()
+    payload = aggregate.rank_payload(0, "take", before)
+    record = aggregate.merge_payloads([payload], "take", path, 1)
+    rec_path = os.path.join(path, aggregate.OBSRECORD_FNAME)
+    with open(rec_path, "wb") as f:
+        f.write(aggregate.encode_record(record))
+    assert main(["doctor", path]) == 0
+    out = capsys.readouterr().out
+    assert "publish:" in out
+    assert "records" in out and "subscriber swaps" in out
+    assert main(["doctor", path, "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    c = rec["merged"]["counters"]
+    assert c["publish.records"] >= 2
+    assert c["publish.subscriber_swaps"] >= 2
+    assert c["publish.subscriber_bytes_fetched"] >= 4096 * 4

@@ -2362,3 +2362,68 @@ def test_resource_pairing_return_of_derived_value_not_a_handoff():
         """,
     )
     assert len(findings) == 1  # the reservation still leaks
+
+
+# ----------------------------------------------- live publication lint
+
+
+def test_instrumentation_covers_publish_entry_points():
+    """The live-publication protocol's load-bearing transitions are
+    pinned into the instrumentation coverage map: a hot-swap incident
+    review reconstructs publish commits (publish/record span), the
+    subscriber's notice→plan→fetch→apply pass (publish/poll), and the
+    swap itself (publish/apply) — none of these may be allowlisted
+    away."""
+    from tools.lint.passes.instrumentation import TARGETS
+
+    pub_allow = TARGETS["torchsnapshot_tpu/publish/publisher.py"][
+        "Publisher"
+    ]
+    assert not {
+        "publish_record",
+        "publish_continuous",
+        "publish_snapshot",
+        "publish_state",
+    } & pub_allow
+    sub_allow = TARGETS["torchsnapshot_tpu/publish/subscriber.py"][
+        "Subscriber"
+    ]
+    assert "poll_once" not in sub_allow
+    lw_allow = TARGETS["torchsnapshot_tpu/publish/apply.py"][
+        "LiveWeights"
+    ]
+    assert "apply" not in lw_allow
+    assert {"write_record", "read_head"} & set(
+        TARGETS["torchsnapshot_tpu/publish/record.py"]["PublishStore"]
+    ) == {"write_record", "read_head"}
+
+
+def test_kv_hygiene_announce_without_delete_flagged():
+    """Publication announce keys (the /pub/ segment — the live-weight
+    publication convention) are publish-paired-with-delete: a stale
+    announce would point every new subscriber at a retired publisher's
+    head forever."""
+    findings = _run(
+        "kv-hygiene",
+        """
+        def announce(coord, ns, step, path):
+            coord.kv_set(f"{ns}/pub/head", f"{step}:{path}")
+        """,
+    )
+    assert len(findings) == 1
+    assert "announce" in findings[0].message
+    assert "kv_try_delete" in findings[0].message
+
+
+def test_kv_hygiene_announce_with_module_delete_clean():
+    findings = _run(
+        "kv-hygiene",
+        """
+        def announce(coord, ns, step, path):
+            coord.kv_set(f"{ns}/pub/head", f"{step}:{path}")
+
+        def clear(coord, ns):
+            coord.kv_try_delete(f"{ns}/pub/head")
+        """,
+    )
+    assert findings == []

@@ -395,3 +395,59 @@ def test_cli_stats_codec_rollup_raw_snapshot(tmp_path, capsys):
     rollup = stats["codec"]
     assert set(rollup["by_codec"]) == {"raw"}
     assert rollup["ratio"] == 1.0
+
+
+# ------------------------------------------------- publication rollups
+
+
+def _publish_stats_fixture(tmp_path):
+    from torchsnapshot_tpu.publish import Publisher, Subscriber
+
+    root = str(tmp_path / "pub")
+    w = np.arange(4096, dtype=np.float32)
+    pub = Publisher(root, chunk_size_bytes=1024)
+    state = {"app": StateDict(w=np.zeros(4096, np.float32))}
+    sub = Subscriber(root, state, sub_id="sub-cli")
+    try:
+        pub.publish_state({"app": StateDict(w=w.copy())}, 1)
+        sub.poll_once()
+        w[0] = -1.0
+        pub.publish_state({"app": StateDict(w=w.copy())}, 2)
+        sub.poll_once()
+    finally:
+        sub.close()
+        pub.close()
+    return root
+
+
+def test_cli_stats_publication_root_human(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    root = _publish_stats_fixture(tmp_path)
+    assert main(["stats", root]) == 0
+    out = capsys.readouterr().out
+    assert "[publication root]" in out
+    assert "published step 2" in out
+    assert "source: state" in out
+    # the delta rollup: one 1KB chunk of a 16KB leaf moved
+    assert "last update:" in out
+    assert "1/16 chunks" in out
+    # the fleet lag row from the subscriber's stamp
+    assert "sub-cli: step 2 (lag 0 steps" in out
+
+
+def test_cli_stats_publication_root_json_parity(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    root = _publish_stats_fixture(tmp_path)
+    assert main(["stats", root, "--json"]) == 0
+    roll = json.loads(capsys.readouterr().out)
+    assert roll["step"] == 2
+    assert roll["source"] == "state"
+    assert roll["stats"]["bytes_delta"] == 1024
+    assert roll["stats"]["bytes_total"] == 4096 * 4
+    (entry,) = roll["subscribers"]
+    assert entry["id"] == "sub-cli"
+    assert entry["lag_steps"] == 0
+    assert entry["generation"] == 2
+    assert entry["bytes_fetched"] >= 4096 * 4  # cold fetch + delta

@@ -1,0 +1,570 @@
+"""Live weight publication (publish/): delta planning over every record
+family, the generation/atomic-swap law, resharding subscribers, fleet
+stamps, retention, and a 2-process publisher→subscriber acceptance run
+(bitwise-correct swaps at a small fraction of full-restore bytes)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import StateDict, knobs
+from torchsnapshot_tpu.cas.store import chunk_key, chunk_location
+from torchsnapshot_tpu.publish import (
+    Publisher,
+    PublishStore,
+    Subscriber,
+    TemplateMismatchError,
+    build_record,
+    make_ref,
+    plan_delta,
+    root_rollup,
+)
+from torchsnapshot_tpu.utils.checksums import adler32_fast, crc32_fast
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHUNK = 1024
+N = 4096  # float32 -> 16 chunks per leaf at CHUNK
+
+
+def _keyed_ref(data):
+    key = chunk_key((crc32_fast(data), adler32_fast(data), len(data)))
+    return make_ref(key, 0, chunk_location(key))
+
+
+def _chunked_leaf(arr, chunk=CHUNK):
+    raw = arr.tobytes()
+    refs = [
+        _keyed_ref(raw[lo : lo + chunk])
+        for lo in range(0, len(raw), chunk)
+    ]
+    return {
+        "kind": "array",
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "size": len(raw),
+        "refs": refs,
+    }
+
+
+def _record(step, leaves, bases=("file:///base",)):
+    return build_record(step, "test", list(bases), leaves)
+
+
+# ---------------------------------------------------------------- planner
+
+
+def test_plan_cold_subscribe_fetches_everything():
+    arr = np.arange(N, dtype=np.float32)
+    rec = _record(1, {"w": _chunked_leaf(arr)})
+    plan = plan_delta(rec, None)
+    assert len(plan.fetches) == N * 4 // CHUNK
+    assert plan.full_leaves == ["w"]
+    assert plan.stats["bytes_fetch"] == plan.stats["bytes_total"] == N * 4
+    # cold fetches land at ascending leaf offsets, tiling the stream
+    offs = [f.leaf_off for f in plan.fetches]
+    assert offs == list(range(0, N * 4, CHUNK))
+
+
+def test_plan_chunked_delta_fetches_only_changed_chunks():
+    a = np.arange(N, dtype=np.float32)
+    b = a.copy()
+    b[0] = -1.0  # chunk 0
+    b[N - 1] = -2.0  # last chunk
+    held = _record(1, {"w": _chunked_leaf(a)})
+    new = _record(2, {"w": _chunked_leaf(b)})
+    plan = plan_delta(new, held)
+    assert len(plan.fetches) == 2
+    assert sorted(f.leaf_off for f in plan.fetches) == [0, N * 4 - CHUNK]
+    assert plan.stats["chunks_reused"] == N * 4 // CHUNK - 2
+    assert plan.stats["bytes_fetch"] == 2 * CHUNK
+    assert plan.full_leaves == []
+
+
+def test_plan_unkeyed_extent_refs_reuse_on_identity():
+    """Pre-CAS / striped records carry un-keyed extent refs: reuse
+    demands the identical immutable identity (base, path, extent)."""
+
+    def extent_leaf(lo, hi):
+        return {
+            "kind": "array",
+            "dtype": "float32",
+            "shape": [(hi - lo) // 4],
+            "size": hi - lo,
+            "refs": [
+                make_ref(
+                    None, 0, "objects/w",
+                    byte_range=[lo, hi], nbytes=hi - lo,
+                )
+            ],
+        }
+
+    held = _record(1, {"w": extent_leaf(0, 2048)}, bases=("file:///snapA",))
+    same = _record(2, {"w": extent_leaf(0, 2048)}, bases=("file:///snapA",))
+    assert plan_delta(same, held).fetches == []
+    # same path+extent in a DIFFERENT snapshot: the immutability
+    # argument is gone, must fetch
+    moved = _record(3, {"w": extent_leaf(0, 2048)}, bases=("file:///snapB",))
+    assert len(plan_delta(moved, held).fetches) == 1
+
+
+def test_plan_mixed_keyed_unkeyed_is_conservative():
+    arr = np.arange(256, dtype=np.float32)
+    raw = arr.tobytes()
+    keyed = {
+        "kind": "array",
+        "dtype": "float32",
+        "shape": [256],
+        "size": 1024,
+        "refs": [_keyed_ref(raw)],
+    }
+    unkeyed = {
+        "kind": "array",
+        "dtype": "float32",
+        "shape": [256],
+        "size": 1024,
+        "refs": [
+            make_ref(None, 0, "objects/w", byte_range=[0, 1024], nbytes=1024)
+        ],
+    }
+    held = _record(1, {"w": unkeyed})
+    new = _record(2, {"w": keyed})
+    assert len(plan_delta(new, held).fetches) == 1
+
+
+def test_plan_meta_change_forces_full_leaf():
+    a = np.arange(N, dtype=np.float32)
+    held = _record(1, {"w": _chunked_leaf(a)})
+    new = _record(2, {"w": _chunked_leaf(a.astype(np.float64))})
+    plan = plan_delta(new, held)
+    assert plan.full_leaves == ["w"]
+    assert plan.stats["bytes_fetch"] == plan.stats["bytes_total"]
+
+
+def test_plan_whole_object_single_keyed_ref_reuses():
+    arr = np.arange(64, dtype=np.float32)
+    leaf = {
+        "kind": "array",
+        "dtype": "float32",
+        "shape": [64],
+        "size": 256,
+        "refs": [_keyed_ref(arr.tobytes())],
+    }
+    held = _record(1, {"w": leaf})
+    new = _record(2, {"w": dict(leaf)})
+    assert plan_delta(new, held).fetches == []
+
+
+def test_plan_shard_spec_windows_to_dim0_slab():
+    arr = np.arange(N, dtype=np.float32).reshape(16, 256)  # 1KB rows
+    rec = _record(1, {"w": _chunked_leaf(arr)})
+    # subscriber holds rows 4..8 -> bytes [4096, 8192): chunks 4..7
+    spec = {"w": ((4, 0), (4, 256))}
+    plan = plan_delta(rec, None, shard_spec=spec)
+    assert plan.windows["w"] == (4096, 8192)
+    assert sorted(f.leaf_off for f in plan.fetches) == [
+        4096, 5120, 6144, 7168,
+    ]
+    assert plan.stats["bytes_total"] == 4096  # the window, not the leaf
+
+
+def test_plan_shard_spec_rejects_non_slab():
+    arr = np.zeros((16, 256), np.float32)
+    rec = _record(1, {"w": _chunked_leaf(arr)})
+    with pytest.raises(ValueError, match="dim-0 slab"):
+        plan_delta(rec, None, shard_spec={"w": ((0, 8), (16, 128))})
+
+
+# ------------------------------------------------------- record store
+
+
+def test_record_store_marker_last_and_crc(tmp_path):
+    root = str(tmp_path / "pub")
+    arr = np.arange(64, dtype=np.float32)
+    rec = _record(3, {"w": _chunked_leaf(arr)})
+    store = PublishStore(root)
+    try:
+        assert store.read_head() is None
+        path = store.write_record(rec)
+        head = store.read_head()
+        assert head is not None and head["step"] == 3
+        assert store.read_record(path)["step"] == 3
+    finally:
+        store.sync_close()
+    # flip a byte in the record body: the self-CRC fails the read
+    body = os.path.join(root, path)
+    blob = open(body, "rb").read()
+    flipped = blob[:40] + bytes([blob[40] ^ 0x01]) + blob[41:]
+    open(body, "wb").write(flipped)
+    store = PublishStore(root)
+    try:
+        with pytest.raises(RuntimeError, match="checksum|corrupt"):
+            store.read_record(path)
+    finally:
+        store.sync_close()
+
+
+def test_build_record_rejects_refs_not_tiling_leaf():
+    with pytest.raises(ValueError, match="tile"):
+        build_record(
+            1,
+            "test",
+            ["file:///b"],
+            {
+                "w": {
+                    "kind": "array",
+                    "dtype": "float32",
+                    "shape": [256],
+                    "size": 1024,
+                    "refs": [
+                        make_ref(
+                            None, 0, "p", byte_range=[0, 512], nbytes=512
+                        )
+                    ],
+                }
+            },
+        )
+
+
+# ----------------------------------------------------- swap atomicity
+
+
+def test_atomic_swap_no_torn_reads(tmp_path):
+    """A reader inside pinned() must observe every leaf from ONE
+    generation: while the subscriber flips between all-zeros and
+    all-ones published states, a pinned read never sees a mix."""
+    root = str(tmp_path / "pub")
+    state = {
+        "app": StateDict(
+            a=np.zeros(N, np.float32), b=np.zeros(N, np.float32)
+        )
+    }
+    pub = Publisher(root, chunk_size_bytes=CHUNK)
+    sub = Subscriber(root, state)
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            with sub.live.pinned():
+                a0 = float(state["app"]["a"][0])
+                b_last = float(state["app"]["b"][-1])
+                if a0 != b_last:
+                    torn.append((a0, b_last))
+            time.sleep(0.0002)  # let the applier take the barrier
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for step, fill in ((1, 1.0), (2, 0.0), (3, 1.0), (4, 0.0)):
+            pub.publish_state(
+                {
+                    "app": StateDict(
+                        a=np.full(N, fill, np.float32),
+                        b=np.full(N, fill, np.float32),
+                    )
+                },
+                step,
+            )
+            assert sub.poll_once() == step
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        sub.close()
+        pub.close()
+    assert torn == [], f"torn swap observed: {torn[:5]}"
+    assert sub.generation == 4 and sub.step == 4
+
+
+def test_apply_failure_preserves_generation(tmp_path):
+    """A failure mid-apply (between staging and swap) leaves the live
+    state bitwise at the last complete generation; the NEXT poll
+    re-applies cleanly."""
+    root = str(tmp_path / "pub")
+    w = np.arange(N, dtype=np.float32)
+    pub = Publisher(root, chunk_size_bytes=CHUNK)
+    state = {"app": StateDict(w=np.zeros(N, np.float32))}
+    sub = Subscriber(root, state)
+    try:
+        pub.publish_state({"app": StateDict(w=w.copy())}, 1)
+        assert sub.poll_once() == 1
+        held = state["app"]["w"].copy()
+        pub.publish_state({"app": StateDict(w=w + 7.0)}, 2)
+        with knobs.override_failpoints(
+            "publish.subscriber.apply=runtime:1:1"
+        ):
+            with pytest.raises(RuntimeError, match="injected"):
+                sub.poll_once()
+        assert sub.generation == 1 and sub.step == 1
+        assert np.array_equal(state["app"]["w"], held)
+        assert sub.poll_once() == 2
+        assert np.array_equal(state["app"]["w"], w + 7.0)
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_strict_template_mismatch_raises(tmp_path):
+    root = str(tmp_path / "pub")
+    pub = Publisher(root, chunk_size_bytes=CHUNK)
+    sub = Subscriber(
+        root, {"app": StateDict(other=np.zeros(8, np.float32))}
+    )
+    try:
+        pub.publish_state({"app": StateDict(w=np.ones(N, np.float32))}, 1)
+        with pytest.raises(TemplateMismatchError):
+            sub.poll_once()
+    finally:
+        sub.close()
+        pub.close()
+
+
+# ------------------------------------------------- resharding subscribe
+
+
+def test_resharded_subscriber_holds_dim0_slab(tmp_path):
+    """A subscriber from a DIFFERENT world size follows the published
+    global array through a dim-0 slab shard_spec: it fetches only its
+    window and applies into its local (smaller) leaf."""
+    root = str(tmp_path / "pub")
+    full = np.arange(N, dtype=np.float32).reshape(16, 256)
+    pub = Publisher(root, chunk_size_bytes=CHUNK)
+    # this "rank" holds rows 4..12 of the global [16, 256] array
+    state = {"app": StateDict(w=np.zeros((8, 256), np.float32))}
+    spec = {"app/w": ((4, 0), (8, 256))}
+    sub = Subscriber(root, state, shard_spec=spec)
+    try:
+        pub.publish_state({"app": StateDict(w=full.copy())}, 1)
+        assert sub.poll_once() == 1
+        assert np.array_equal(state["app"]["w"], full[4:12])
+        # sparse update: one row inside the window, one outside
+        full2 = full.copy()
+        full2[5] += 100.0  # inside
+        full2[0] -= 100.0  # outside — must NOT be fetched
+        pub.publish_state({"app": StateDict(w=full2)}, 2)
+        b0 = sub._bytes_fetched_total
+        assert sub.poll_once() == 2
+        assert np.array_equal(state["app"]["w"], full2[4:12])
+        assert sub._bytes_fetched_total - b0 == CHUNK  # one chunk only
+    finally:
+        sub.close()
+        pub.close()
+
+
+# ------------------------------------------------- publisher behaviors
+
+
+def test_publish_state_writes_only_new_chunks(tmp_path):
+    root = str(tmp_path / "pub")
+    w = np.arange(N, dtype=np.float32)
+    pub = Publisher(root, chunk_size_bytes=CHUNK)
+    try:
+        path1 = pub.publish_state({"app": StateDict(w=w.copy())}, 1)
+        assert path1.endswith(".json")
+        pool = os.path.join(root, "objects")
+        count1 = sum(len(fs) for _, _, fs in os.walk(pool))
+        assert count1 == N * 4 // CHUNK
+        w[0] = -1.0
+        pub.publish_state({"app": StateDict(w=w.copy())}, 2)
+        count2 = sum(len(fs) for _, _, fs in os.walk(pool))
+        # one changed chunk written, the superseded basis chunk pruned
+        assert count2 <= count1 + 1
+        store = PublishStore(root)
+        try:
+            assert store.read_head()["step"] == 2
+        finally:
+            store.sync_close()
+    finally:
+        pub.close()
+
+
+def test_publish_retention_prunes_records(tmp_path):
+    root = str(tmp_path / "pub")
+    w = np.zeros(N, np.float32)
+    pub = Publisher(root, retain=2, chunk_size_bytes=CHUNK)
+    try:
+        for step in range(1, 6):
+            w[0] = step
+            pub.publish_state({"app": StateDict(w=w.copy())}, step)
+        records = sorted(os.listdir(os.path.join(root, "records")))
+        assert len(records) == 2, records
+        roll = root_rollup(root)
+        assert roll is not None and roll["step"] == 5
+    finally:
+        pub.close()
+
+
+def test_root_rollup_subscriber_lag(tmp_path):
+    root = str(tmp_path / "pub")
+    w = np.zeros(N, np.float32)
+    pub = Publisher(root, chunk_size_bytes=CHUNK)
+    state = {"app": StateDict(w=np.zeros(N, np.float32))}
+    sub = Subscriber(root, state, sub_id="sub-lag")
+    try:
+        pub.publish_state({"app": StateDict(w=w)}, 1)
+        sub.poll_once()
+        w2 = w.copy()
+        w2[0] = 9.0
+        pub.publish_state({"app": StateDict(w=w2)}, 2)
+        roll = root_rollup(root)
+        assert roll["step"] == 2
+        (entry,) = [s for s in roll["subscribers"] if s["id"] == "sub-lag"]
+        assert entry["step"] == 1 and entry["lag_steps"] == 1
+        sub.poll_once()
+        roll = root_rollup(root)
+        (entry,) = [s for s in roll["subscribers"] if s["id"] == "sub-lag"]
+        assert entry["lag_steps"] == 0
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_publish_announce_disabled_still_converges(tmp_path):
+    root = str(tmp_path / "pub")
+    with knobs.override_publish_announce(False):
+        pub = Publisher(root, chunk_size_bytes=CHUNK)
+        state = {"app": StateDict(w=np.zeros(64, np.float32))}
+        sub = Subscriber(root, state, poll_s=0.05)
+        try:
+            pub.publish_state(
+                {"app": StateDict(w=np.ones(64, np.float32))}, 1
+            )
+            assert sub.poll_once(wait_s=0.05) == 1
+            assert float(state["app"]["w"][0]) == 1.0
+        finally:
+            sub.close()
+            pub.close()
+
+
+def test_follow_thread_survives_and_swaps(tmp_path):
+    root = str(tmp_path / "pub")
+    pub = Publisher(root, chunk_size_bytes=CHUNK)
+    state = {"app": StateDict(w=np.zeros(N, np.float32))}
+    sub = Subscriber(root, state, poll_s=0.02)
+    swaps = []
+    handle = sub.follow(on_swap=lambda step, gen: swaps.append((step, gen)))
+    try:
+        pub.publish_state({"app": StateDict(w=np.ones(N, np.float32))}, 1)
+        deadline = time.monotonic() + 20
+        while not swaps and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert swaps == [(1, 1)]
+        assert handle.alive  # degrade-never-wedge: still watching
+    finally:
+        handle.stop()
+        sub.close()
+        pub.close()
+    assert not handle.alive
+
+
+# ------------------------------------------------ 2-proc acceptance
+
+
+def _launch_publish_workers(tmp_path, body, world=2, timeout_s=120):
+    script = os.path.join(str(tmp_path), "publish_worker.py")
+    with open(script, "w") as f:
+        f.write(
+            textwrap.dedent(
+                f"""
+                import os, sys, time
+                sys.path.insert(0, {_REPO!r})
+                import numpy as np
+                from torchsnapshot_tpu import StateDict
+                from torchsnapshot_tpu.coordination import FileCoordinator
+                from torchsnapshot_tpu.publish import Publisher, Subscriber
+
+                rank = int(sys.argv[1])
+                world = int(sys.argv[2])
+                coord = FileCoordinator({os.path.join(str(tmp_path), "kv")!r}, rank, world)
+                pub_root = {os.path.join(str(tmp_path), "pub")!r}
+                """
+            )
+            + textwrap.dedent(body)
+        )
+    base_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, str(r), str(world)],
+            env=base_env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout_s)[0].decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise AssertionError("publish worker wedged past wall-clock bound")
+    return [(p.returncode, out) for p, out in zip(procs, outs)]
+
+
+def test_publish_two_process_acceptance(tmp_path):
+    """Rank 0 publishes 5 live-weight steps (seeded ~2% sparse
+    mutations); rank 1 follows and, after every swap, must hold BITWISE
+    the exact published weights — verified by digest exchange through
+    the KV — while total fetched bytes stay well under 5x full restore."""
+    body = r"""
+    import zlib
+    CHUNK = 1024
+    STEPS = 5
+
+    def mutate(w, step):
+        rng = np.random.default_rng(step)
+        rows = rng.choice(w.shape[0], max(1, w.shape[0] // 50), replace=False)
+        w[rows] += rng.standard_normal((len(rows), w.shape[1])).astype(w.dtype)
+        return w
+
+    if rank == 0:
+        w = np.arange(16384, dtype=np.float32).reshape(64, 256)
+        pub = Publisher(pub_root, coordinator=coord, chunk_size_bytes=CHUNK)
+        for step in range(1, STEPS + 1):
+            if step > 1:
+                mutate(w, step)
+            pub.publish_state({"app": StateDict(w=w.copy())}, step)
+            coord.kv_set(f"acc/pub/{step}/digest", str(zlib.crc32(w.tobytes())))
+            # wait for the subscriber's verdict before mutating further
+            got = coord.kv_get(f"acc/sub/{step}/digest", timeout_s=60)
+            assert got == str(zlib.crc32(w.tobytes())), (
+                f"step {step}: subscriber diverged"
+            )
+        fetched = int(coord.kv_get("acc/sub/bytes", timeout_s=60))
+        full = w.nbytes
+        assert fetched < 0.5 * STEPS * full, (
+            f"delta subscription moved {fetched} bytes; "
+            f"{STEPS} full restores would be {STEPS * full}"
+        )
+        print(f"PUBLISHER-OK fetched={fetched} full={full}")
+        pub.close()
+    else:
+        state = {"app": StateDict(w=np.zeros((64, 256), np.float32))}
+        sub = Subscriber(pub_root, state, coordinator=coord, poll_s=0.1)
+        for step in range(1, STEPS + 1):
+            expect = coord.kv_get(f"acc/pub/{step}/digest", timeout_s=60)
+            deadline = time.monotonic() + 60
+            while sub.step != step and time.monotonic() < deadline:
+                sub.poll_once(wait_s=0.05)
+            assert sub.step == step, f"never reached step {step}"
+            digest = str(zlib.crc32(state["app"]["w"].tobytes()))
+            assert digest == expect, f"step {step}: torn/wrong weights"
+            coord.kv_set(f"acc/sub/{step}/digest", digest)
+        coord.kv_set("acc/sub/bytes", str(sub._bytes_fetched_total))
+        print(f"SUBSCRIBER-OK bytes={sub._bytes_fetched_total}")
+        sub.close()
+    """
+    results = _launch_publish_workers(tmp_path, body)
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {rank} failed:\n{out}"
+    assert "PUBLISHER-OK" in results[0][1]
+    assert "SUBSCRIBER-OK" in results[1][1]

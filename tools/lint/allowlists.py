@@ -46,6 +46,20 @@ ALLOWLIST: Tuple[Allow, ...] = (
     ),
     Allow(
         pass_id="retry-discipline",
+        file="torchsnapshot_tpu/coordination.py",
+        context="kv_watch",
+        justification=(
+            "Same shape as FileCoordinator._kv_get_impl: kv_watch IS "
+            "the change-wait KV primitive (value absent or unchanged "
+            "is the wait's normal pending state, kv_try_get never "
+            "raises into the loop), not a backoff retry of a fallible "
+            "op — and its deadline is the caller's poll interval, so "
+            "the retry module's shared-progress window would cap the "
+            "WRONG budget."
+        ),
+    ),
+    Allow(
+        pass_id="retry-discipline",
         file="torchsnapshot_tpu/obs/aggregate.py",
         context="collect_and_merge",
         justification=(

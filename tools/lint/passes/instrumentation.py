@@ -33,8 +33,10 @@ TARGETS: Dict[str, Dict[str, Set[str]]] = {
         # methods that ARE bracketed.  verify delegates to
         # verify_snapshot, which brackets itself (verify.py) — the AST
         # check can't see through the delegation, and a second bracket
-        # here would double-fire the event
-        "Snapshot": {"metadata", "get_manifest", "verify"},
+        # here would double-fire the event.  publish_to delegates to
+        # Publisher.publish_snapshot whose publish/from_snapshot span
+        # is the bracket — same can't-see-through-delegation shape
+        "Snapshot": {"metadata", "get_manifest", "verify", "publish_to"},
     },
     "torchsnapshot_tpu/manager.py": {
         # path arithmetic and delegating one-liners (steps() — which
@@ -85,6 +87,43 @@ TARGETS: Dict[str, Dict[str, Set[str]]] = {
             "storage", "read_head", "read_step_manifest",
             "write_manifest", "write_head", "delete_quiet",
             "sync_close",
+        },
+    },
+    "torchsnapshot_tpu/publish/publisher.py": {
+        # every publication source (publish_record/_continuous/
+        # _snapshot/_state) and close carry spans — a publication that
+        # stalls a training step's promotion sweep must be attributable.
+        # namespace is a pure accessor over an already-derived string
+        "Publisher": {"namespace"},
+    },
+    "torchsnapshot_tpu/publish/subscriber.py": {
+        # poll_once carries the swap span (publish/poll) — the serving
+        # fleet's hot-swap latency lives there.  follow only spawns the
+        # watcher thread (all its work re-enters poll_once); close is
+        # plugin teardown whose storage latency instrument_storage
+        # already attributes; the rest are pure accessors
+        "Subscriber": {
+            "step", "generation", "poll_interval_s", "follow", "close",
+        },
+    },
+    "torchsnapshot_tpu/publish/apply.py": {
+        # apply (stage + atomic swap) carries the publish/apply span —
+        # swap stalls block request pinning and must be visible.
+        # pinned IS the request-side lock bracket (adding a span would
+        # record one event per served request — noise at serving QPS);
+        # the rest are accessors over already-held state
+        "LiveWeights": {
+            "pinned", "generation", "step", "current_leaves",
+        },
+    },
+    "torchsnapshot_tpu/publish/record.py": {
+        # same discipline as ContinuousStore: single-op delegations to
+        # sync storage calls whose latency is already attributed by
+        # obs.instrument_storage; the commit ordering they implement is
+        # bracketed one level up (Publisher.publish_record's span)
+        "PublishStore": {
+            "storage", "read_head", "read_record", "read_stamps",
+            "write_record", "write_stamp", "delete_quiet", "sync_close",
         },
     },
 }

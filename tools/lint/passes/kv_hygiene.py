@@ -24,7 +24,13 @@ guard it:
    behind by a finished job reads as a live-but-stalled rank forever,
    so a module that publishes heartbeats must also contain the
    ``kv_try_delete`` that clears them at clean shutdown
-   (continuous/heartbeat.py).
+   (continuous/heartbeat.py).  Publication ANNOUNCE keys (a ``/pub/``
+   segment — the live-weight publication convention, publish/
+   announce.py) follow the identical rule: a stale announce key makes
+   every future subscriber on that namespace wake, re-read the durable
+   HEAD, and re-sleep on every poll forever — the module that sets
+   one must contain the ``kv_try_delete`` that clears it at clean
+   shutdown.
 
 Scope: the ``torchsnapshot_tpu`` package.  ``coordination.py`` itself
 is the primitive layer — its keys are built from caller-supplied
@@ -90,6 +96,7 @@ class KvHygienePass(LintPass):
         out: List[Finding] = []
         publishes: List[ast.Call] = []
         heartbeats: List[ast.Call] = []
+        announces: List[ast.Call] = []
         has_delete = False
         for node in ast.walk(unit.tree):
             if not isinstance(node, ast.Call):
@@ -103,6 +110,8 @@ class KvHygienePass(LintPass):
                 publishes.append(node)
             elif "/hb/" in _key_literal_text(node.args[0]):
                 heartbeats.append(node)
+            elif "/pub/" in _key_literal_text(node.args[0]):
+                announces.append(node)
             head = _literal_head(node.args[0])
             if head is not None:
                 out.append(
@@ -149,6 +158,24 @@ class KvHygienePass(LintPass):
                         "live-but-stalled rank forever; clear it at "
                         "clean shutdown like continuous/heartbeat.py "
                         "does",
+                    )
+                )
+        if (
+            announces
+            and not has_delete
+            and unit.relpath != _PRIMITIVE_FILE
+        ):
+            for node in announces:
+                out.append(
+                    self.finding(
+                        unit,
+                        node,
+                        "kv_set() of a publication announce key "
+                        "(/pub/) without a reachable kv_try_delete in "
+                        "this module — a stale announce key wakes "
+                        "every future subscriber on the namespace on "
+                        "every poll forever; clear it at clean "
+                        "shutdown like publish/announce.py does",
                     )
                 )
         return out

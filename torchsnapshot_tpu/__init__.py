@@ -30,6 +30,11 @@ from .continuous import (  # noqa: F401
 from .event import Event  # noqa: F401
 from .event_handlers import register_event_handler, unregister_event_handler  # noqa: F401
 from .manager import SnapshotManager, delete_snapshot  # noqa: F401
+from .publish import (  # noqa: F401
+    LiveWeights,
+    Publisher,
+    Subscriber,
+)
 from .tier import (  # noqa: F401
     TierConfig,
     TieredStoragePlugin,
@@ -58,6 +63,9 @@ __all__ = [
     "drain_promotions",
     "ContinuousCheckpointer",
     "recover_state",
+    "Publisher",
+    "Subscriber",
+    "LiveWeights",
     "SnapshotAbortedError",
     "VerifyResult",
     "verify_snapshot",

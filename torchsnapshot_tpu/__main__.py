@@ -357,6 +357,50 @@ def _continuous_store_rollup(root: str) -> Optional[dict]:
         store.sync_close()
 
 
+def _publish_stats(path: str) -> Optional[dict]:
+    """Stats rollup for a live-weight publication root (publish/):
+    published HEAD, the last update's delta cost, and per-subscriber
+    lag from the fleet's stamp files.  None when ``path`` isn't a
+    publication root (the continuous/snapshot stats paths take over)."""
+    from .publish import root_rollup
+
+    return root_rollup(path)
+
+
+def _render_publish_stats(roll: dict) -> None:
+    print(f"{roll['root']}  [publication root]")
+    line = f"  published step {roll['step']}"
+    if roll.get("source"):
+        line += f" (source: {roll['source']}, {roll.get('leaves', 0)} leaves)"
+    print(line)
+    if roll.get("record_error"):
+        print(f"  WARNING: record unreadable: {roll['record_error']}")
+    stats = roll.get("stats") or {}
+    if stats.get("bytes_total"):
+        ratio = stats.get("bytes_delta", 0) / stats["bytes_total"]
+        print(
+            f"  last update: {_human(stats.get('bytes_delta', 0))} delta "
+            f"of {_human(stats['bytes_total'])} total "
+            f"({ratio:.1%}; {stats.get('chunks_delta', 0)}/"
+            f"{stats.get('chunks_total', 0)} chunks)"
+        )
+    subs = roll.get("subscribers") or []
+    if not subs:
+        print("  subscribers: (no stamps)")
+        return
+    print(f"  subscribers: {len(subs)}")
+    for s in subs:
+        if s.get("malformed"):
+            print(f"    {s['id']}: MALFORMED stamp")
+            continue
+        print(
+            f"    {s['id']}: step {s['step']} "
+            f"(lag {s['lag_steps']} steps, stamped {s['age_s']:.1f}s "
+            f"ago, gen {s['generation']}, "
+            f"{_human(s['bytes_fetched'])} fetched)"
+        )
+
+
 def _continuous_stats(path: str) -> Optional[dict]:
     """Stats rollup for a continuous root: either one store, or a host
     root holding per-rank ``r<k>`` stores.  None when ``path`` is
@@ -414,6 +458,13 @@ def _cmd_stats(args) -> int:
     from .manifest import is_container_entry
     from .snapshot import Snapshot
 
+    pubroll = _publish_stats(args.path)
+    if pubroll is not None:
+        if args.json:
+            print(json.dumps(pubroll, indent=2))
+        else:
+            _render_publish_stats(pubroll)
+        return 0
     cont = _continuous_stats(args.path)
     if cont is not None:
         if args.json:
@@ -589,6 +640,17 @@ def _doctor_counters(record) -> dict:
         ),
         "continuous_preemption_drains": c.get(
             "continuous.preemption_drains", 0
+        ),
+        "publish_records": c.get("publish.records", 0),
+        "publish_bytes_delta": c.get("publish.bytes_delta", 0),
+        "publish_sub_swaps": c.get("publish.subscriber_swaps", 0),
+        "publish_sub_bytes_fetched": c.get(
+            "publish.subscriber_bytes_fetched", 0
+        ),
+        "publish_fallback_polls": c.get("publish.fallback_polls", 0),
+        "publish_watch_errors": c.get("publish.watch_errors", 0),
+        "publish_announce_failures": c.get(
+            "publish.announce_failures", 0
         ),
         "exceptions_swallowed": c.get("exceptions.swallowed", 0),
     }
@@ -767,6 +829,25 @@ def _render_doctor(record) -> None:
         )
     if c["mmap_reads"]:
         print(f"  mmap: {c['mmap_reads']} zero-copy reads")
+    if c["publish_records"] or c["publish_sub_swaps"]:
+        line = (
+            f"  publish: {c['publish_records']} records "
+            f"({_human(c['publish_bytes_delta'])} delta), "
+            f"{c['publish_sub_swaps']} subscriber swaps "
+            f"({_human(c['publish_sub_bytes_fetched'])} fetched)"
+        )
+        trouble = []
+        if c["publish_fallback_polls"]:
+            trouble.append(f"{c['publish_fallback_polls']} fallback polls")
+        if c["publish_announce_failures"]:
+            trouble.append(
+                f"{c['publish_announce_failures']} announce failures"
+            )
+        if c["publish_watch_errors"]:
+            trouble.append(f"{c['publish_watch_errors']} watch errors")
+        if trouble:
+            line += " — " + ", ".join(trouble)
+        print(line)
     _render_topology_rollup(record.get("topology"), c)
     _render_continuous_rollup(record.get("continuous"), c)
     slow = record.get("slow_objects") or []

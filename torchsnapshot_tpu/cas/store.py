@@ -90,6 +90,36 @@ def validate_table(table: Any) -> bool:
     return True
 
 
+def diff_tables(
+    old: Optional[Dict[str, Any]], new: Dict[str, Any]
+) -> Tuple[List[int], List[int]]:
+    """Positional chunk delta between two chunk tables of the SAME
+    logical object: ``(changed, reused)`` index lists into
+    ``new["keys"]``.  A chunk is reused only when the old table holds
+    the SAME content key at the SAME byte offset — the conservative
+    direction: offset-shifted identical content re-fetches rather than
+    risking a mapping the applier can't place.  ``old=None`` (or a
+    table tiled at a different chunk size, where offsets can't line
+    up) marks every chunk changed.  This is the publication planner's
+    primitive (publish/delta.py): a subscriber's per-update wire cost
+    is exactly the ``changed`` side."""
+    keys = list(new["keys"])
+    if (
+        old is None
+        or int(old.get("chunk_size", -1)) != int(new["chunk_size"])
+    ):
+        return list(range(len(keys))), []
+    old_keys = list(old["keys"])
+    changed: List[int] = []
+    reused: List[int] = []
+    for i, key in enumerate(keys):
+        if i < len(old_keys) and old_keys[i] == key:
+            reused.append(i)
+        else:
+            changed.append(i)
+    return changed, reused
+
+
 def record_root(snapshot_path: str, cas_root: str) -> str:
     """How the CAS root is written into a snapshot's metadata: relative
     (``../cas``) when the root is a sibling of the snapshot directory —

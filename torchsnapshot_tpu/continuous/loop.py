@@ -138,6 +138,7 @@ class ContinuousCheckpointer:
         retain_steps: int = 2,
         topology: Any = None,
         preemption_hook: bool = True,
+        publisher: Any = None,
     ) -> None:
         self.local_root = local_root.rstrip("/")
         self.durable_root = (
@@ -193,6 +194,12 @@ class ContinuousCheckpointer:
         # OUTSIDE the lock
         self._promo_lock = threading.Lock()
         self._promotions: List[Tuple[PromotionGroup, Set[str], Set[str], int]] = []
+        # live-weight publication (publish/): every confirmed durable
+        # promotion is published so serving subscribers can delta-swap
+        # to it.  Best-effort by design — publication rides behind the
+        # durability contract, never gates it
+        self._publisher = publisher
+        self._published_step: Optional[int] = None
         self._preemption_handle: Optional[int] = None
         if preemption_hook:
             self._preemption_handle = preemption.on_preemption(
@@ -813,6 +820,35 @@ class ContinuousCheckpointer:
         # so a failed delete costs at most a leaked file)
         for root, path in deletions:
             self._store(root).delete_quiet(path)
+        self._publish_durable_head()
+
+    def _publish_durable_head(self) -> None:
+        """Publish the durable HEAD step if it advanced past the last
+        publication (publish/).  Runs outside ``_promo_lock`` (it does
+        storage I/O) and is best-effort: a failed publication leaves
+        subscribers one step behind until the next promotion — the
+        durable mirror itself is already committed either way."""
+        if self._publisher is None:
+            return
+        with self._promo_lock:
+            step = self._durable_head_step
+            if step is None or (
+                self._published_step is not None
+                and step <= self._published_step
+            ):
+                return
+            self._published_step = step
+        try:
+            self._publisher.publish_continuous(
+                self.durable_store_root, step
+            )
+        except Exception as e:  # noqa: BLE001 — publication is
+            # best-effort; retried implicitly at the next promotion
+            obs.swallowed_exception("continuous.publish", e)
+            logger.warning(
+                "publication of durable step %d failed; subscribers "
+                "stay at the previous published step", step,
+            )
 
     def promote(self) -> bool:
         """Force a durable promotion of the newest fully-replicated

@@ -507,6 +507,36 @@ class FileCoordinator(Coordinator):
             self.kv_get(f"{name}/depart", timeout_s)
 
 
+def kv_watch(
+    coordinator: Coordinator,
+    key: str,
+    last: "Optional[str]" = None,
+    timeout_s: float = 0.0,
+    poll_s: float = 0.025,
+) -> "Optional[str]":
+    """Watch helper for announce-style keys: poll ``kv_try_get(key)``
+    until its value exists AND differs from ``last``, or ``timeout_s``
+    elapses (returns None).  This is the publication subsystem's fast
+    path (publish/subscriber.py) — one non-blocking probe per tick, so
+    a host full of waiting subscribers never parks threads in a
+    blocking ``kv_get``, and a timeout is a NORMAL return (the caller
+    falls back to its durable poll, the fanout degrade-never-wedge
+    contract).  Any probe error also returns None: a broken announce
+    channel must degrade the watcher, not wedge it."""
+    deadline = time.monotonic() + max(0.0, timeout_s)
+    while True:
+        try:
+            value = coordinator.kv_try_get(key)
+        except Exception as e:  # noqa: BLE001 — degrade to durable poll
+            obs.swallowed_exception("coordination.kv_watch", e)
+            return None
+        if value is not None and value != last:
+            return value
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(min(poll_s, max(0.0, deadline - time.monotonic())))
+
+
 def get_default_coordinator() -> Coordinator:
     """JaxCoordinator when jax.distributed is initialized, else local."""
     try:

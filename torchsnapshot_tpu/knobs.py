@@ -73,6 +73,9 @@ _CONTINUOUS_GRACE_S = "CONTINUOUS_GRACE_S"
 _FASTIO = "FASTIO"
 _FASTIO_DIRECT = "FASTIO_DIRECT"
 _FASTIO_BUFFER_POOL_BYTES = "FASTIO_BUFFER_POOL_BYTES"
+_PUBLISH_POLL_S = "PUBLISH_POLL_S"
+_PUBLISH_ANNOUNCE = "PUBLISH_ANNOUNCE"
+_PUBLISH_RETAIN = "PUBLISH_RETAIN"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -381,6 +384,25 @@ _DEFAULTS = {
     # exhausted pool backpressures (the part waits for a buffer, and
     # storage.fastio.pool_waits counts the waits).
     _FASTIO_BUFFER_POOL_BYTES: 64 * 1024 * 1024,
+    # Live weight publication (publish/): how often a Subscriber's
+    # watcher re-reads the durable publication HEAD when no KV announce
+    # arrives (the degraded-mode cadence — the KV announce is the fast
+    # path, this poll is the floor that keeps a fleet converging when
+    # the announce channel is down or the publisher died between record
+    # and announce).
+    _PUBLISH_POLL_S: 2.0,
+    # Whether publishers announce new publication records over the
+    # coordination KV (the low-latency wake-up for subscribers).  0
+    # degrades every subscriber to pure durable polling — the escape
+    # hatch when the coordination service itself is suspect.  The
+    # durable record/marker is written either way; announce is never
+    # load-bearing for correctness.
+    _PUBLISH_ANNOUNCE: 1,
+    # Publication records each publisher retains (older records and any
+    # pool chunks only they referenced are pruned after a successful
+    # publish).  A subscriber holding an older step than the retention
+    # window simply takes a fuller delta against the newest record.
+    _PUBLISH_RETAIN: 4,
 }
 
 _OVERRIDES: dict = {}
@@ -749,6 +771,25 @@ def get_continuous_grace_s() -> float:
     return max(0.0, float(_get_raw(_CONTINUOUS_GRACE_S)))
 
 
+def get_publish_poll_s() -> float:
+    """Subscriber durable-poll cadence in seconds (see _PUBLISH_POLL_S
+    above); also the announce-watch timeout, so one interval bounds how
+    stale a subscriber can run behind a dead announce channel."""
+    return max(0.01, float(_get_raw(_PUBLISH_POLL_S)))
+
+
+def publish_announce_enabled() -> bool:
+    """Whether publishers announce records over the coordination KV
+    (see _PUBLISH_ANNOUNCE above)."""
+    return bool(_get_int(_PUBLISH_ANNOUNCE))
+
+
+def get_publish_retain() -> int:
+    """Publication records a publisher keeps (min 1 — the HEAD record
+    always survives)."""
+    return max(1, _get_int(_PUBLISH_RETAIN))
+
+
 def fastio_enabled() -> bool:
     """Native fast-I/O engine master switch (see _FASTIO above); the
     engine additionally requires the native ext to load with the part
@@ -1000,6 +1041,18 @@ def override_continuous_promote_every_n(value: int):
 
 def override_continuous_grace_s(value: float):
     return _override(_CONTINUOUS_GRACE_S, value)
+
+
+def override_publish_poll_s(value: float):
+    return _override(_PUBLISH_POLL_S, value)
+
+
+def override_publish_announce(value: bool):
+    return _override(_PUBLISH_ANNOUNCE, value)
+
+
+def override_publish_retain(value: int):
+    return _override(_PUBLISH_RETAIN, value)
 
 
 def override_fastio(value: bool):

@@ -218,6 +218,7 @@ class SnapshotManager:
         coordinator: Optional[Coordinator] = None,
         tier: Optional[Union[TierConfig, Dict[str, Any]]] = None,
         cas: Optional[Union[bool, str, Dict[str, Any]]] = None,
+        publisher: Any = None,
     ) -> None:
         if keep_last_n is not None and keep_last_n < 1:
             raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
@@ -270,6 +271,11 @@ class SnapshotManager:
         # tiered: the crash-recovery re-promotion sweep runs once, at
         # the first post-commit hook (see repromote)
         self._repromoted = False
+        # live-weight publication (publish/): rank 0 publishes every
+        # committed save so serving subscribers can delta-swap to it.
+        # Best-effort — publication rides behind the commit, never
+        # gates or fails it
+        self._publisher = publisher
 
     # ------------------------------------------------------------ paths
 
@@ -541,6 +547,7 @@ class SnapshotManager:
             coordinator=self._coordinator, base=base, **take_kwargs,
         )
         self._after_commit(step)
+        self._publish(step, snap)
         return snap
 
     def restore_latest(
@@ -678,6 +685,25 @@ class SnapshotManager:
         # listing; only definitively-absent metadata un-indexes a step
         self._write_index(sorted(set(committed) | self._last_unverifiable))
         self._apply_retention(committed)
+
+    def _publish(self, step: int, snap: Optional[Snapshot]) -> None:
+        """Publish a freshly committed step to the live-weight
+        publication root (rank 0, best-effort — see __init__)."""
+        if self._publisher is None or self._coord.rank != 0:
+            return
+        try:
+            self._publisher.publish_snapshot(
+                self.path_for_step(step),
+                step,
+                metadata=None if snap is None else snap.metadata,
+            )
+        except Exception as e:  # noqa: BLE001 — publication never
+            # fails a committed save; subscribers catch up next step
+            obs.swallowed_exception("manager.publish", e)
+            logger.warning(
+                "publication of committed step %d failed; serving "
+                "subscribers stay at the previous published step", step,
+            )
 
     def gc(self) -> None:
         """Apply retention: delete all but the newest ``keep_last_n``
@@ -876,6 +902,7 @@ class _ManagedPendingSnapshot:
     def wait(self) -> Snapshot:
         snap = self._pending.wait()
         self._manager._after_commit(self._step)
+        self._manager._publish(self._step, snap)
         return snap
 
     def done(self) -> bool:

@@ -121,6 +121,20 @@ from .metrics import (  # noqa: F401
     FANOUT_BYTES_REDISTRIBUTED,
     FANOUT_PUBLISHES,
     FANOUT_FALLBACKS,
+    PUBLISH_RECORDS,
+    PUBLISH_BYTES_DELTA,
+    PUBLISH_CHUNKS_DELTA,
+    PUBLISH_ANNOUNCE_FAILURES,
+    PUBLISH_SUB_SWAPS,
+    PUBLISH_SUB_BYTES_FETCHED,
+    PUBLISH_SUB_CHUNKS_FETCHED,
+    PUBLISH_SUB_CHUNKS_REUSED,
+    PUBLISH_SUB_LAG_S,
+    PUBLISH_SUB_APPLY_S,
+    PUBLISH_FALLBACK_POLLS,
+    PUBLISH_WATCH_ERRORS,
+    PUBLISH_LEAVES_SKIPPED,
+    PUBLISH_GENERATION,
     MetricsRegistry,
     counter,
     gauge,

@@ -211,6 +211,38 @@ CONTINUOUS_RESTORES_FROM_PEER = "continuous.restores_from_peer"
 CONTINUOUS_RESTORES_FROM_DURABLE = "continuous.restores_from_durable"
 CONTINUOUS_RESTORE_S = "continuous.restore_s"
 CONTINUOUS_PREEMPTION_DRAINS = "continuous.preemption_drains"
+# Live weight publication (publish/): the training→serving hot-swap
+# channel.  Publisher side: records counts publication records
+# committed (marker-last), bytes/chunks_delta the NEW bytes/chunks
+# this record introduced vs the previous one (the wire cost of one
+# update), announce_failures the best-effort KV announces that failed
+# (subscribers degrade to durable polling — this counter is the only
+# trace).  Subscriber side: swaps counts completed generation bumps,
+# bytes/chunks_fetched the actual delta traffic, chunks_reused the
+# chunks the held generation already had (the savings), lag_s is
+# record-publish-time → swap-complete (the propagation lag a serving
+# fleet cares about), apply_s the staged-apply + swap wall time,
+# fallback_polls counts durable-poll wake-ups that found a new record
+# the announce channel never delivered, watch_errors counts watcher
+# iterations that failed and were retried (degrade-never-wedge),
+# leaves_skipped counts record leaves a subscriber could not apply
+# (template mismatch in non-strict mode) or a publisher could not
+# reference (codec'd/sharded sources); generation gauges the
+# subscriber's current swap generation.
+PUBLISH_RECORDS = "publish.records"
+PUBLISH_BYTES_DELTA = "publish.bytes_delta"
+PUBLISH_CHUNKS_DELTA = "publish.chunks_delta"
+PUBLISH_ANNOUNCE_FAILURES = "publish.announce_failures"
+PUBLISH_SUB_SWAPS = "publish.subscriber_swaps"
+PUBLISH_SUB_BYTES_FETCHED = "publish.subscriber_bytes_fetched"
+PUBLISH_SUB_CHUNKS_FETCHED = "publish.subscriber_chunks_fetched"
+PUBLISH_SUB_CHUNKS_REUSED = "publish.subscriber_chunks_reused"
+PUBLISH_SUB_LAG_S = "publish.subscriber_lag_s"
+PUBLISH_SUB_APPLY_S = "publish.subscriber_apply_s"
+PUBLISH_FALLBACK_POLLS = "publish.fallback_polls"
+PUBLISH_WATCH_ERRORS = "publish.watch_errors"
+PUBLISH_LEAVES_SKIPPED = "publish.leaves_skipped"
+PUBLISH_GENERATION = "publish.generation"
 # Resilience (resilience/): transient-error retries (total, plus
 # per-backend twins named resilience.<backend>.retries), cross-rank
 # aborts initiated via the poison protocol, deterministic failpoint

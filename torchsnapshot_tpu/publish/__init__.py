@@ -1,0 +1,38 @@
+"""Live weight publication: delta-restore subscribers that hot-swap
+serving fleets without cold restarts.
+
+Training side, a ``Publisher`` turns each durable commit — a continuous
+loop promotion, a finished snapshot, or the live state itself — into a
+small self-verifying publication record (content-keyed chunk refs, no
+bulk copy for content-addressed sources) committed marker-last and
+announced over the coordination KV.  Serving side, a ``Subscriber``
+watches the announce key with a durable-poll fallback, plans the chunk
+delta against the step it holds, fetches only changed chunks through
+the host cache, and applies them with a generation counter behind an
+atomic swap barrier — a request pinned with ``LiveWeights.pinned()``
+never observes a torn mix of steps.  See docs/publication.md.
+"""
+
+from .announce import ns_for_root
+from .apply import LiveWeights, TemplateMismatchError
+from .delta import DeltaPlan, FetchItem, leaf_window, plan_delta
+from .publisher import Publisher
+from .record import PublishStore, build_record, make_ref, root_rollup
+from .subscriber import FollowHandle, Subscriber
+
+__all__ = [
+    "DeltaPlan",
+    "FetchItem",
+    "FollowHandle",
+    "LiveWeights",
+    "PublishStore",
+    "Publisher",
+    "Subscriber",
+    "TemplateMismatchError",
+    "build_record",
+    "leaf_window",
+    "make_ref",
+    "ns_for_root",
+    "plan_delta",
+    "root_rollup",
+]

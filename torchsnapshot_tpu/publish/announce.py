@@ -1,0 +1,94 @@
+"""Publication announce channel: the low-latency wake-up beside the
+durable marker.
+
+The durable record/HEAD pair is the source of truth; the KV announce
+only exists so subscribers learn about a new record in milliseconds
+instead of a poll interval.  One key per publisher namespace
+(``{ns}/pub/head`` → ``"<step>:<record path>"``), republished on every
+publication — subscribers watch it with ``coordination.kv_watch`` and
+fall back to durable polling on timeout, so a lost announce (publisher
+killed between marker and announce, coordination service down, knob
+off) degrades latency, never correctness.
+
+KV hygiene (tools/lint kv-hygiene pass): ``ns`` is a per-publisher uid
+so concurrent jobs never collide, and ``clear`` deletes the key at
+clean shutdown — the announce-namespace (``/pub/``) twin of the
+heartbeat discipline in continuous/heartbeat.py.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Tuple
+
+from .. import obs
+
+logger = logging.getLogger(__name__)
+
+
+def announce(
+    coordinator: Any, ns: str, step: int, record_path: str
+) -> bool:
+    """Best-effort announce of a freshly committed record; returns
+    whether the KV write landed.  Never raises — the durable marker is
+    already down, so a failed announce costs subscribers one poll
+    interval, not the publication."""
+    try:
+        coordinator.kv_set(
+            f"{ns}/pub/head", f"{int(step)}:{record_path}"
+        )
+        return True
+    except Exception as e:  # noqa: BLE001 — announce is best-effort
+        obs.counter(obs.PUBLISH_ANNOUNCE_FAILURES).inc()
+        obs.swallowed_exception("publish.announce", e)
+        return False
+
+
+def announce_key(ns: str) -> str:
+    return f"{ns}/pub/head"
+
+
+def current(coordinator: Any, ns: str) -> Optional[Tuple[int, str]]:
+    """The currently-announced ``(step, record path)``, or None when
+    nothing is announced / the probe failed / the value is malformed.
+    The subscriber's non-blocking precheck: a changed announce skips
+    the blocking watch entirely."""
+    try:
+        raw = coordinator.kv_try_get(f"{ns}/pub/head")
+    except Exception as e:  # noqa: BLE001 — a KV outage degrades to
+        # the durable poll, exactly like a lost announce
+        obs.swallowed_exception("publish.announce.current", e)
+        return None
+    return parse_announcement(raw)
+
+
+def ns_for_root(root: str) -> str:
+    """The announce namespace for a publication root.  Derived from the
+    root URL (not a program-order uid) because publisher and subscriber
+    are UNRELATED processes — the root is the only name they share.
+    Distinct roots never collide; two publishers on one root already
+    race at the durable layer, so sharing the announce key adds no new
+    hazard."""
+    import zlib
+
+    root = root.rstrip("/")
+    return f"tsnp-pub-{zlib.crc32(root.encode('utf-8')) & 0xFFFFFFFF:08x}"
+
+
+def parse_announcement(raw: Optional[str]) -> Optional[Tuple[int, str]]:
+    """``(step, record path)`` from an announce value, or None for
+    absent/malformed values (a malformed announce degrades to the
+    durable poll like any other announce failure)."""
+    if raw is None:
+        return None
+    step_s, sep, path = str(raw).partition(":")
+    if not sep or not step_s.isdigit() or not path:
+        logger.warning("malformed publication announce: %r", raw)
+        return None
+    return int(step_s), path
+
+
+def clear(coordinator: Any, ns: str) -> None:
+    """Announce-paired cleanup: drop the publisher's announce key at
+    clean shutdown (kv_try_delete is best-effort by contract)."""
+    coordinator.kv_try_delete(f"{ns}/pub/head")

@@ -1,0 +1,229 @@
+"""Delta planner: which bytes a subscriber must move to reach a newly
+published step from the one it holds.
+
+The plan is computed purely from two publication records (no storage
+I/O): for every leaf in the new record, refs are compared POSITIONALLY
+against the held record's refs at the same leaf byte offset.  A ref is
+reused — zero wire cost — when both sides carry the same content key
+at the same offset; keyed refs with different keys fetch; un-keyed
+refs (pre-CAS sources) reuse only on an identical ``(base-url, path,
+extent)`` identity, which is safe because snapshot objects are
+immutable once committed — and conservative everywhere else.  A leaf
+whose dtype/shape/kind changed (or that the held record lacks) fetches
+in full.
+
+Resharding subscribers: a subscriber whose local leaf is a dim-0 slab
+of the published (global) array passes a ``shard_spec`` — per logical
+path, ``(offsets, local_shape)`` in the global coordinate system (the
+``preparers/overlap.py`` box algebra).  The planner then keeps only
+fetch items overlapping the subscriber's byte window, and the applier
+places each fetched chunk at its window-relative offset.  Chunks are
+always fetched WHOLE even at window edges — the content key covers the
+whole chunk, and a trimmed fetch could not be verified; the applier
+slices.  Non-slab shardings are rejected loudly (fetch layouts that
+can't be expressed as one contiguous byte window per leaf need the
+full resharding restore path, not a hot-swap).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..preparers.overlap import is_dim0_slab, make_box
+from .record import ref_nbytes
+
+
+@dataclass
+class FetchItem:
+    """One ref a subscriber must fetch: where the bytes live and where
+    they land in the leaf's byte stream."""
+
+    leaf: str
+    base: str  # resolved base URL
+    path: str
+    byte_range: Optional[Tuple[int, int]]
+    key: Optional[str]
+    leaf_off: int  # offset of this ref in the leaf's byte stream
+    nbytes: int
+
+
+@dataclass
+class DeltaPlan:
+    fetches: List[FetchItem] = field(default_factory=list)
+    # leaf → (window_lo, window_hi) byte extent the subscriber applies
+    # (the full leaf unless a shard_spec narrowed it)
+    windows: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    # leaves rebuilt from scratch (held no basis: new/changed meta)
+    full_leaves: List[str] = field(default_factory=list)
+    stats: Dict[str, int] = field(default_factory=dict)
+
+
+def _leaf_meta(leaf: Dict[str, Any]) -> Tuple:
+    return (
+        leaf.get("kind"),
+        leaf.get("dtype"),
+        tuple(leaf.get("shape") or ()),
+        leaf.get("tag"),
+        int(leaf["size"]),
+        # inlined primitives carry their value IN the meta: a changed
+        # value must re-apply even though there are no refs to diff
+        leaf.get("ptype"),
+        leaf.get("v"),
+    )
+
+
+def _ref_offsets(refs: List[Dict[str, Any]]) -> List[int]:
+    offs, pos = [], 0
+    for ref in refs:
+        offs.append(pos)
+        pos += ref_nbytes(ref)
+    return offs
+
+
+def leaf_window(
+    leaf: Dict[str, Any], spec: Optional[Tuple]
+) -> Tuple[int, int]:
+    """The byte extent of ``leaf`` a subscriber holds: the whole stream,
+    or — for a sharded subscriber — the dim-0 slab its local box maps
+    to.  Raises ValueError for non-slab boxes or non-array leaves."""
+    size = int(leaf["size"])
+    if spec is None:
+        return (0, size)
+    if leaf.get("kind") != "array":
+        raise ValueError(
+            "shard_spec names a non-array leaf — only array leaves "
+            "reshard"
+        )
+    offsets, local_shape = spec
+    global_shape = [int(d) for d in leaf["shape"]]
+    inner = make_box(list(offsets), list(local_shape))
+    outer = make_box([0] * len(global_shape), global_shape)
+    if not is_dim0_slab(inner, outer):
+        raise ValueError(
+            f"subscriber box {inner} is not a dim-0 slab of the "
+            f"published shape {global_shape}; hot-swap resharding "
+            f"requires one contiguous byte window per leaf"
+        )
+    if not global_shape or int(np.prod(global_shape)) == 0:
+        return (0, 0)
+    row_bytes = size // int(global_shape[0]) if global_shape[0] else 0
+    lo = int(offsets[0]) * row_bytes
+    hi = (int(offsets[0]) + int(local_shape[0])) * row_bytes
+    return (lo, hi)
+
+
+def _spans_overlap(a_lo: int, a_hi: int, b_lo: int, b_hi: int) -> bool:
+    return a_lo < b_hi and b_lo < a_hi
+
+
+def plan_delta(
+    new_record: Dict[str, Any],
+    held_record: Optional[Dict[str, Any]],
+    shard_spec: Optional[Dict[str, Tuple]] = None,
+) -> DeltaPlan:
+    """The fetch plan to move from ``held_record`` (None = cold
+    subscribe: everything fetches) to ``new_record``.  ``shard_spec``
+    maps logical leaf path → ``(offsets, local_shape)`` for resharding
+    subscribers (see module docstring).  Stats count bytes/chunks over
+    the subscriber's windows, so ``bytes_total`` is exactly what a full
+    restore of the same subscriber would move."""
+    plan = DeltaPlan()
+    new_bases = [str(b).rstrip("/") for b in new_record["bases"]]
+    held_leaves: Dict[str, Any] = (
+        dict(held_record["leaves"]) if held_record else {}
+    )
+    held_bases = (
+        [str(b).rstrip("/") for b in held_record["bases"]]
+        if held_record
+        else []
+    )
+    bytes_fetch = bytes_total = 0
+    chunks_fetch = chunks_total = chunks_reused = 0
+    leaves_changed = 0
+    for path, leaf in new_record["leaves"].items():
+        spec = (shard_spec or {}).get(path)
+        win_lo, win_hi = leaf_window(leaf, spec)
+        plan.windows[path] = (win_lo, win_hi)
+        refs = leaf["refs"]
+        offs = _ref_offsets(refs)
+        held = held_leaves.get(path)
+        same_meta = held is not None and _leaf_meta(held) == _leaf_meta(
+            leaf
+        )
+        held_at: Dict[int, Dict[str, Any]] = {}
+        if same_meta:
+            held_at = dict(zip(_ref_offsets(held["refs"]), held["refs"]))
+        if not same_meta:
+            plan.full_leaves.append(path)
+        leaf_fetched = False
+        for ref, off in zip(refs, offs):
+            n = ref_nbytes(ref)
+            if not _spans_overlap(off, off + n, win_lo, win_hi):
+                continue
+            chunks_total += 1
+            bytes_total += n
+            prev = held_at.get(off)
+            if prev is not None and _same_content(
+                ref, prev, new_bases, held_bases
+            ):
+                chunks_reused += 1
+                continue
+            chunks_fetch += 1
+            bytes_fetch += n
+            leaf_fetched = True
+            br = ref.get("o")
+            plan.fetches.append(
+                FetchItem(
+                    leaf=path,
+                    base=new_bases[int(ref["b"])],
+                    path=str(ref["p"]),
+                    byte_range=tuple(br) if br is not None else None,
+                    key=ref.get("k"),
+                    leaf_off=off,
+                    nbytes=n,
+                )
+            )
+        if leaf_fetched:
+            leaves_changed += 1
+    plan.stats = {
+        "bytes_fetch": bytes_fetch,
+        "bytes_total": bytes_total,
+        "chunks_fetch": chunks_fetch,
+        "chunks_total": chunks_total,
+        "chunks_reused": chunks_reused,
+        "leaves_changed": leaves_changed,
+        "leaves_total": len(new_record["leaves"]),
+    }
+    return plan
+
+
+def _same_content(
+    ref: Dict[str, Any],
+    prev: Dict[str, Any],
+    new_bases: List[str],
+    held_bases: List[str],
+) -> bool:
+    """Whether two positionally-aligned refs are byte-identical.  Keyed
+    vs keyed: key equality (the content-addressed fast path).  Un-keyed
+    vs un-keyed: identical immutable identity ``(base-url, path,
+    extent, nbytes)``.  Mixed: conservative fetch."""
+    k, pk = ref.get("k"), prev.get("k")
+    if k is not None and pk is not None:
+        return k == pk
+    if k is None and pk is None:
+        try:
+            same_base = (
+                new_bases[int(ref["b"])] == held_bases[int(prev["b"])]
+            )
+        except (IndexError, ValueError):
+            return False
+        return (
+            same_base
+            and ref["p"] == prev["p"]
+            and ref.get("o") == prev.get("o")
+            and ref_nbytes(ref) == ref_nbytes(prev)
+        )
+    return False

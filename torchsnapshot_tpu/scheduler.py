@@ -1090,6 +1090,118 @@ def sync_execute_buffer_writes(
             lt.shutdown()
 
 
+async def _execute_chunk_reads(
+    items: List[Tuple[str, Optional[Tuple[int, int]], Optional[str], int]],
+    storage: StoragePlugin,
+    budget: _Budget,
+    io_concurrency: int,
+    span_label: str,
+) -> List[bytes]:
+    """Read ``(path, byte_range, content_key, nbytes)`` items under the
+    host-memory budget, verifying keyed payloads against their embedded
+    (crc32, adler32, size) digest — a torn or stale copy fails closed.
+    Results come back in submission order."""
+    from .utils.checksums import adler32_fast, crc32_fast
+
+    sem = asyncio.Semaphore(io_concurrency)
+    cond = asyncio.Condition()
+    in_use = 0
+    out: List[Optional[bytes]] = [None] * len(items)
+
+    async def one(i: int) -> None:
+        nonlocal in_use
+        path, byte_range, key, nbytes = items[i]
+        async with cond:
+            await cond.wait_for(
+                lambda: in_use == 0 or in_use + nbytes <= budget.total
+            )
+            in_use += nbytes
+        try:
+            async with sem:
+                with obs_tracer.span(
+                    span_label, path=path, bytes=nbytes
+                ):
+                    io = ReadIO(path=path, byte_range=byte_range)
+                    await storage.read(io)
+            view = memoryview(io.buf).cast("B")
+            if key is not None and (
+                view.nbytes != cas_store_mod.key_size(key)
+                or cas_store_mod.chunk_key(
+                    (crc32_fast(view), adler32_fast(view), view.nbytes)
+                )
+                != key
+            ):
+                raise IOError(
+                    f"chunk {key} at {path!r} failed its content "
+                    f"check ({view.nbytes} bytes)"
+                )
+            if view.nbytes != nbytes:
+                raise IOError(
+                    f"ranged read of {path!r} returned {view.nbytes} "
+                    f"bytes, expected {nbytes}"
+                )
+            out[i] = bytes(view)
+        finally:
+            async with cond:
+                in_use -= nbytes
+                cond.notify_all()
+
+    results = await asyncio.gather(
+        *(one(i) for i in range(len(items))), return_exceptions=True
+    )
+    errs = [r for r in results if isinstance(r, BaseException)]
+    if errs:
+        raise errs[0]
+    # every slot filled: a None would have surfaced as an error above
+    return [b for b in out if b is not None]
+
+
+def sync_execute_chunk_reads(
+    items: List[Tuple[str, Optional[Tuple[int, int]], Optional[str], int]],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    priorities: Optional[List[int]] = None,
+    span_label: str = "scheduler/chunk_read",
+    loop_thread: Optional[_LoopThread] = None,
+) -> List[bytes]:
+    """Verified ranged/content-addressed reads for delta subscribers
+    (publish/subscriber.py): fetch ``(path, byte_range, content_key,
+    nbytes)`` items concurrently under the staging memory budget and
+    return payloads in the caller's order.  ``priorities`` reuses the
+    restore priority classes (ReadReq.priority discipline from
+    sync_execute_read_reqs): a stable sort dispatches lower classes
+    first, so a serving fleet can front-load the leaves its next
+    request needs while bulk deltas trail — within a class, submission
+    order is preserved.  ``loop_thread`` lets a long-lived watcher
+    reuse one event-loop thread across polls instead of paying
+    thread+loop churn per update."""
+    if not items:
+        return []
+    order = list(range(len(items)))
+    if priorities is not None and any(priorities):
+        order.sort(key=lambda i: priorities[i])
+    budget = _Budget(memory_budget_bytes)
+    own_loop = loop_thread is None
+    lt = loop_thread or _LoopThread(name="tsnp-publish-loop")
+    try:
+        fetched = lt.submit(
+            _execute_chunk_reads(
+                [items[i] for i in order],
+                storage,
+                budget,
+                knobs.get_max_per_rank_io_concurrency(),
+                span_label,
+            )
+        ).result()
+    finally:
+        if own_loop:
+            lt.shutdown()
+    out: List[bytes] = [b""] * len(items)
+    for pos, i in enumerate(order):
+        out[i] = fetched[pos]
+    return out
+
+
 def sync_execute_copy_reqs(
     paths: List[str],
     src_storage: StoragePlugin,

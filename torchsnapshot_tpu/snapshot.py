@@ -1331,6 +1331,18 @@ class Snapshot:
     def get_manifest(self) -> Dict[str, Entry]:
         return dict(self.metadata.manifest)
 
+    def publish_to(self, publisher: Any, step: int) -> str:
+        """Publish this committed snapshot to a live-weight publication
+        root (publish/Publisher) so serving subscribers can delta-swap
+        to it; returns the publication record path.  ``step`` orders
+        the publication (snapshots don't carry one themselves — the
+        manager's publish hook passes its index step).  Unlike the
+        manager/continuous hooks this is the EXPLICIT path and raises
+        on failure."""
+        return publisher.publish_snapshot(
+            self.path, step, metadata=self.metadata
+        )
+
     def _prime_tier_digests(self, storage: Any) -> None:
         """Tiered storage: install the committed metadata's whole-object
         digest table on the plugin so fast/peer-tier reads verify before

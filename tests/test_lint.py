@@ -1,5 +1,5 @@
 """Tier-1 wiring for the snaplint suite (tools/lint): the repo must be
-clean under all thirteen passes (modulo the reviewed allowlist and the
+clean under all sixteen passes (modulo the reviewed allowlist and the
 baseline ratchet), each pass must actually detect its bug class (a
 checker that can't fail is no check), and the allowlist/baseline
 machinery must enforce its contracts (written justifications; finding
@@ -48,7 +48,7 @@ def _run(pass_id, src, filename="torchsnapshot_tpu/example.py"):
 
 def test_repo_is_clean():
     """THE gate: zero unbaselined findings repo-wide under ALL
-    thirteen passes — flow-sensitive and interprocedural ones
+    sixteen passes — flow-sensitive, interprocedural and concurrency ones
     included.  New findings must be fixed or allowlisted with a
     written justification — see docs/static_analysis.md.  Also the
     wall-time budget: the full-repo run (CFG construction, call
@@ -85,8 +85,11 @@ def test_flow_sensitive_and_interproc_passes_registered():
         "protocol-lockstep",
         "kv-matching",
         "effect-escape",
+        "lockset-race",
+        "lock-order",
+        "domain-crossing",
     } <= ids
-    assert len(ALL_PASSES) == 13
+    assert len(ALL_PASSES) == 16
     # and the bench.py "lint" rollup (repo_summary) reports the roster
     s = repo_summary(_REPO_ROOT)
     assert set(s["passes"]) == ids
@@ -94,7 +97,7 @@ def test_flow_sensitive_and_interproc_passes_registered():
 
 def test_repo_summary_timings_and_cache_stats():
     """The BENCH "lint" block's cost attribution: per-pass wall time
-    for all thirteen passes and the summary-cache hit/miss split, with
+    for all sixteen passes and the summary-cache hit/miss split, with
     hits+misses covering every scanned file (so a cache regression is
     visible as a miss-count spike, not just a slower wall time)."""
     s = repo_summary(_REPO_ROOT)

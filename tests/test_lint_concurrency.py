@@ -1,0 +1,679 @@
+"""The concurrency snaplint layer (tools/lint/domains.py,
+tools/lint/shared_state.py) and the three passes built on it
+(lockset-race, lock-order, domain-crossing): domain inference must
+seed from the structural spawn sites and propagate callers-first,
+per-access locksets must join lexical frames with interprocedural
+must-entry locks, and each pass must both catch its bug class and
+accept the sanctioned shape right next to it — every fixture here is
+a violating + clean pair for exactly that reason."""
+
+import textwrap
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.lint.core import FileUnit, run_project_sources  # noqa: E402
+from tools.lint.domains import (  # noqa: E402
+    CALLER,
+    EVENT_LOOP,
+    EXECUTOR,
+    get_domain_map,
+)
+from tools.lint.interproc import Project  # noqa: E402
+from tools.lint.passes import ALL_PASSES  # noqa: E402
+from tools.lint.shared_state import get_model  # noqa: E402
+
+_BY_ID = {p.pass_id: p for p in ALL_PASSES}
+
+
+def _project(sources):
+    units = [
+        FileUnit(path, textwrap.dedent(src))
+        for path, src in sources.items()
+    ]
+    return Project(units)
+
+
+def _run(pass_id, sources):
+    return run_project_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()},
+        [_BY_ID[pass_id]],
+    )
+
+
+# ------------------------------------------------- domain inference
+
+
+def test_async_def_seeds_event_loop_domain():
+    p = _project(
+        {
+            "pkg/a.py": """
+            async def handler():
+                pass
+            """
+        }
+    )
+    dm = get_domain_map(p)
+    assert dm.domains_of(("pkg/a.py", "handler")) == {EVENT_LOOP}
+
+
+def test_thread_spawn_seeds_named_thread_domain():
+    p = _project(
+        {
+            "pkg/a.py": """
+            import threading
+
+            def _run():
+                pass
+
+            def start():
+                t = threading.Thread(target=_run, name="tsnp-worker")
+                t.start()
+            """
+        }
+    )
+    dm = get_domain_map(p)
+    assert dm.domains_of(("pkg/a.py", "_run")) == {"thread:tsnp-worker"}
+    # the public spawner itself is caller-domain
+    assert CALLER in dm.domains_of(("pkg/a.py", "start"))
+
+
+def test_timer_spawn_seeds_thread_domain():
+    """threading.Timer(interval, fn) fires fn on its own thread; an
+    unnamed spawn falls back to the target's qualname."""
+    p = _project(
+        {
+            "pkg/a.py": """
+            import threading
+
+            def _expire():
+                pass
+
+            def arm():
+                threading.Timer(5.0, _expire).start()
+            """
+        }
+    )
+    dm = get_domain_map(p)
+    assert dm.domains_of(("pkg/a.py", "_expire")) == {"thread:_expire"}
+
+
+def test_executor_submit_seeds_executor_domain():
+    p = _project(
+        {
+            "pkg/a.py": """
+            def _work():
+                pass
+
+            def kick(pool):
+                pool.submit(_work)
+            """
+        }
+    )
+    dm = get_domain_map(p)
+    assert dm.domains_of(("pkg/a.py", "_work")) == {EXECUTOR}
+
+
+def test_domains_propagate_callers_first_through_private_callees():
+    """A private helper reached from both a thread root and the public
+    sync API carries BOTH domains — that union is what makes its
+    field accesses multi-domain."""
+    p = _project(
+        {
+            "pkg/a.py": """
+            import threading
+
+            def _shared_helper():
+                pass
+
+            def _run():
+                _shared_helper()
+
+            def api():
+                threading.Thread(target=_run, name="bg").start()
+                _shared_helper()
+            """
+        }
+    )
+    dm = get_domain_map(p)
+    assert dm.domains_of(("pkg/a.py", "_shared_helper")) == {
+        "thread:bg",
+        CALLER,
+    }
+
+
+def test_call_soon_threadsafe_callback_is_event_loop_domain():
+    p = _project(
+        {
+            "pkg/a.py": """
+            def _on_item(x):
+                pass
+
+            def feed(loop):
+                loop.call_soon_threadsafe(_on_item, 1)
+            """
+        }
+    )
+    dm = get_domain_map(p)
+    assert dm.domains_of(("pkg/a.py", "_on_item")) == {EVENT_LOOP}
+
+
+# ---------------------------------------------- entry locksets
+
+
+def test_must_entry_lockset_from_single_guarded_callsite():
+    p = _project(
+        {
+            "pkg/a.py": """
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def _flush():
+                pass
+
+            def api():
+                with _LOCK:
+                    _flush()
+            """
+        }
+    )
+    model = get_model(p)
+    assert model.must_entry[("pkg/a.py", "_flush")] == {"pkg/a.py:_LOCK"}
+
+
+def test_must_entry_joins_to_empty_on_unguarded_callsite():
+    p = _project(
+        {
+            "pkg/a.py": """
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def _flush():
+                pass
+
+            def api():
+                with _LOCK:
+                    _flush()
+
+            def other_api():
+                _flush()
+            """
+        }
+    )
+    model = get_model(p)
+    assert model.must_entry[("pkg/a.py", "_flush")] == frozenset()
+    # ... but the may-entry set remembers the guarded path (lock-order)
+    assert "pkg/a.py:_LOCK" in model.may_entry[("pkg/a.py", "_flush")]
+
+
+# ---------------------------------------------------- lockset-race
+
+
+_RACY_COUNTER = {
+    "pkg/a.py": """
+    import threading
+
+    def _compute():
+        return 1
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.total = 0
+            threading.Thread(target=self._run, name="adder").start()
+
+        def _run(self):
+            self.total = self.total + _compute()
+
+        def snapshot(self):
+            return self.total
+    """
+}
+
+
+def test_unlocked_multi_domain_counter_flagged():
+    findings = _run("lockset-race", _RACY_COUNTER)
+    assert len(findings) == 1
+    f = findings[0]
+    assert "Worker.total" in f.message
+    assert "EMPTY lockset intersection" in f.message
+    assert "thread:adder" in f.message
+
+
+def test_consistently_locked_counter_clean():
+    findings = _run(
+        "lockset-race",
+        {
+            "pkg/a.py": """
+            import threading
+
+            def _compute():
+                return 1
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0
+                    threading.Thread(
+                        target=self._run, name="adder"
+                    ).start()
+
+                def _run(self):
+                    with self._lock:
+                        self.total = self.total + _compute()
+
+                def snapshot(self):
+                    with self._lock:
+                        return self.total
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_check_then_act_under_two_different_locks_flagged():
+    """The bug no single-access check can see: the load side and the
+    store side each hold a lock — just not the same one."""
+    findings = _run(
+        "lockset-race",
+        {
+            "pkg/a.py": """
+            import threading
+
+            def _make():
+                return object()
+
+            class Cache:
+                def __init__(self):
+                    self._read_lock = threading.Lock()
+                    self._write_lock = threading.Lock()
+                    self.value = None
+                    threading.Thread(
+                        target=self._refresh, name="refresher"
+                    ).start()
+
+                def _refresh(self):
+                    with self._write_lock:
+                        self.value = _make()
+
+                def ensure(self):
+                    with self._read_lock:
+                        missing = self.value is None
+                    if missing:
+                        with self._write_lock:
+                            self.value = _make()
+            """
+        },
+    )
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "check-then-act" in msg
+    assert "two locks serialize nothing" in msg
+
+
+def test_must_entry_lockset_counts_as_held():
+    """An access in a private helper whose EVERY callsite holds the
+    lock is effectively locked — no finding, no lexical with needed."""
+    findings = _run(
+        "lockset-race",
+        {
+            "pkg/a.py": """
+            import threading
+
+            def _compute():
+                return 1
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0
+                    threading.Thread(
+                        target=self._run, name="adder"
+                    ).start()
+
+                def _bump(self):
+                    self.total = self.total + _compute()
+
+                def _run(self):
+                    with self._lock:
+                        self._bump()
+
+                def snapshot(self):
+                    with self._lock:
+                        return self.total
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_domain_private_with_justification_suppresses():
+    src = dict(_RACY_COUNTER)
+    src["pkg/a.py"] = textwrap.dedent(src["pkg/a.py"]).replace(
+        "class Worker:",
+        '@domain_private(\n'
+        '    "each Worker is owned by its one spawning test; the '
+        'thread joins before snapshot is ever called"\n'
+        ')\n'
+        'class Worker:',
+    )
+    findings = _run("lockset-race", src)
+    assert findings == []
+
+
+def test_domain_private_token_justification_flagged():
+    src = dict(_RACY_COUNTER)
+    src["pkg/a.py"] = textwrap.dedent(src["pkg/a.py"]).replace(
+        "class Worker:",
+        '@domain_private("fine")\nclass Worker:',
+    )
+    findings = _run("lockset-race", src)
+    msgs = [f.message for f in findings]
+    # the token excuse is itself a finding AND does not suppress
+    assert any("written" in m and "justification" in m for m in msgs)
+    assert any("EMPTY lockset intersection" in m for m in msgs)
+
+
+def test_load_only_and_init_stores_stay_quiet():
+    findings = _run(
+        "lockset-race",
+        {
+            "pkg/a.py": """
+            import threading
+
+            class Reporter:
+                def __init__(self, path):
+                    self.path = path
+                    threading.Thread(
+                        target=self._run, name="bg"
+                    ).start()
+
+                def _run(self):
+                    print(self.path)
+
+                def where(self):
+                    return self.path
+            """
+        },
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------- lock-order
+
+
+def test_lock_order_cycle_through_callee_flagged():
+    """f takes A then calls g which takes B (an A→B edge no single
+    function shows lexically); h nests B→A — a cycle."""
+    findings = _run(
+        "lock-order",
+        {
+            "pkg/m.py": """
+            import threading
+
+            LOCK_A = threading.Lock()
+            LOCK_B = threading.Lock()
+
+            def outer():
+                with LOCK_A:
+                    _inner()
+
+            def _inner():
+                with LOCK_B:
+                    pass
+
+            def other():
+                with LOCK_B:
+                    with LOCK_A:
+                        pass
+            """
+        },
+    )
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "lock-order cycle" in msg
+    assert "pkg/m.py:LOCK_A" in msg and "pkg/m.py:LOCK_B" in msg
+
+
+def test_consistent_lock_order_clean():
+    findings = _run(
+        "lock-order",
+        {
+            "pkg/m.py": """
+            import threading
+
+            LOCK_A = threading.Lock()
+            LOCK_B = threading.Lock()
+
+            def outer():
+                with LOCK_A:
+                    _inner()
+
+            def _inner():
+                with LOCK_B:
+                    pass
+
+            def other():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_rlock_self_reacquisition_not_a_cycle():
+    findings = _run(
+        "lock-order",
+        {
+            "pkg/m.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def get(self):
+                    with self._lock:
+                        return self._peek()
+
+                def _peek(self):
+                    with self._lock:
+                        return 1
+            """
+        },
+    )
+    assert findings == []
+
+
+# --------------------------------------------------- domain-crossing
+
+
+_LOOP_VS_THREAD = {
+    "pkg/b.py": """
+    import threading
+
+    class Bridge:
+        def __init__(self):
+            self.pending = []
+            threading.Thread(target=self._feed, name="feeder").start()
+
+        def _feed(self):
+            self.pending.append(1)
+
+        async def drain(self):
+            items = self.pending
+            self.pending = []
+            return items
+    """
+}
+
+
+def test_event_loop_vs_thread_state_without_lock_flagged():
+    findings = _run("domain-crossing", _LOOP_VS_THREAD)
+    assert len(findings) == 1
+    f = findings[0]
+    assert "Bridge.pending" in f.message
+    assert "event-loop" in f.message
+    assert "thread:feeder" in f.message
+    # one finding per field: lockset-race must NOT double-report it
+    assert _run("lockset-race", _LOOP_VS_THREAD) == []
+
+
+def test_shared_lock_on_both_sides_clean():
+    findings = _run(
+        "domain-crossing",
+        {
+            "pkg/b.py": """
+            import threading
+
+            class Bridge:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.pending = []
+                    threading.Thread(
+                        target=self._feed, name="feeder"
+                    ).start()
+
+                def _feed(self):
+                    with self._lock:
+                        self.pending.append(1)
+
+                async def drain(self):
+                    with self._lock:
+                        items = self.pending
+                        self.pending = []
+                    return items
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_call_soon_threadsafe_handoff_sanctioned():
+    """The blessed pattern the pass message recommends: the thread
+    never touches loop-side state — it hands the item across with
+    call_soon_threadsafe and the callback (event-loop domain) owns
+    the list exclusively."""
+    findings = _run(
+        "domain-crossing",
+        {
+            "pkg/b.py": """
+            import threading
+
+            class Bridge:
+                def __init__(self, loop):
+                    self._loop = loop
+                    self.items = []
+                    threading.Thread(
+                        target=self._feed, name="feeder"
+                    ).start()
+
+                def _feed(self):
+                    self._loop.call_soon_threadsafe(self._on_item, 1)
+
+                def _on_item(self, x):
+                    self.items.append(x)
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_queue_handoff_sanctioned():
+    findings = _run(
+        "domain-crossing",
+        {
+            "pkg/b.py": """
+            import queue
+            import threading
+
+            class Bridge:
+                def __init__(self):
+                    self.q = queue.Queue()
+                    threading.Thread(
+                        target=self._feed, name="feeder"
+                    ).start()
+
+                def _feed(self):
+                    self.q.put(1)
+
+                async def drain(self):
+                    return self.q.get_nowait()
+            """
+        },
+    )
+    assert findings == []
+
+
+# ------------------------------------------- summary-cache schema
+
+
+def test_cache_entry_missing_schema_version_is_per_file_miss(tmp_path):
+    """Satellite: per-entry schema keying.  A cache file whose header
+    passes but whose ENTRY predates the per-entry "v" key (or carries
+    a stale one) must be a per-file miss, not a silent reuse — a
+    pass-logic bump that only changed CACHE_VERSION invalidates every
+    spliced-in old entry even if the content hash still matches."""
+    import json
+
+    from tools.lint.summaries import CACHE_VERSION
+
+    cache = tmp_path / "cache.json"
+    src = "def f():\n    pass\n"
+
+    def build():
+        unit = FileUnit("pkg/a.py", src)
+        p = Project([unit], cache_path=str(cache))
+        return p.summaries
+
+    t1 = build()
+    assert (t1.cache_hits, t1.cache_misses) == (0, 1)
+    data = json.loads(cache.read_text())
+    entry = data["files"]["pkg/a.py"]
+    assert entry["v"] == CACHE_VERSION
+    # splice in a stale per-entry version with the SAME content hash
+    entry["v"] = CACHE_VERSION - 1
+    cache.write_text(json.dumps(data))
+    t2 = build()
+    assert (t2.cache_hits, t2.cache_misses) == (0, 1)
+    # dropping the key entirely (a pre-schema entry) also misses
+    data = json.loads(cache.read_text())
+    del data["files"]["pkg/a.py"]["v"]
+    cache.write_text(json.dumps(data))
+    t3 = build()
+    assert (t3.cache_hits, t3.cache_misses) == (0, 1)
+    # and the rewritten entry hits again
+    t4 = build()
+    assert (t4.cache_hits, t4.cache_misses) == (1, 0)
+
+
+def test_conc_summaries_survive_cache_round_trip(tmp_path):
+    """Domain seeds and locksets must come out of a warm cache exactly
+    as they went in — a lossy conc round-trip would make the three
+    concurrency passes flap between cold and warm runs."""
+    cache = tmp_path / "cache.json"
+    sources = {
+        path: textwrap.dedent(src)
+        for path, src in _RACY_COUNTER.items()
+    }
+
+    def findings():
+        units = [FileUnit(p, s) for p, s in sources.items()]
+        project = Project(units, cache_path=str(cache))
+        return [
+            f.fingerprint
+            for f in _BY_ID["lockset-race"].run_project(project)
+        ]
+
+    cold = findings()
+    warm = findings()
+    assert cold == warm
+    assert len(cold) == 1

@@ -1,9 +1,11 @@
 """snaplint — pass-based AST static analysis for this repo.
 
-``python -m tools.lint`` runs thirteen passes repo-wide — six lexical
-walks, four on the flow-sensitive CFG substrate, and three
+``python -m tools.lint`` runs sixteen passes repo-wide — six lexical
+walks, four on the flow-sensitive CFG substrate, three
 interprocedural passes over the package-wide call graph and effect
-summaries (protocol-lockstep, kv-matching, effect-escape) — with a
+summaries (protocol-lockstep, kv-matching, effect-escape), and three
+concurrency passes over execution-domain inference and per-access
+locksets (lockset-race, lock-order, domain-crossing) — with a
 per-pass allowlist requiring written justifications and a
 ``baseline.json`` ratchet (legacy finding counts may only decrease).
 ``--changed [REF]`` is the pre-commit mode.  See

@@ -1,0 +1,269 @@
+"""Execution-domain inference over the package call graph.
+
+A *domain* is "which flow of control runs this function": the public
+caller's thread, a named background thread, the asyncio event loop, an
+executor pool worker, or a signal handler.  The concurrency passes
+(lockset-race, domain-crossing) only care about state reachable from
+two or more domains — everything touched by exactly one flow of
+control is race-free by construction, which is what keeps those passes
+quiet on the ~90% of the package that is single-threaded.
+
+Domains are SEEDED structurally at spawn/registration sites (recorded
+per-function in the summary cache by shared_state.extract_conc) and
+then PROPAGATED callers-first through the SCC condensation of
+interproc.Project's call graph:
+
+- ``async def`` body            → ``event-loop``
+- ``Thread(target=f, name="n")``/``threading.Timer(s, f)``
+                                → ``thread:n`` (falls back to the
+                                  resolved target's qualname when the
+                                  name isn't a literal; an f-string
+                                  name keeps its literal prefix + "*")
+- ``run_in_executor(ex, f)`` / ``executor.submit(f)`` /
+  ``asyncio.to_thread(f)`` / ``fut.add_done_callback(f)``
+                                → ``executor`` (pool workers are
+                                  interchangeable: one merged domain)
+- ``signal.signal(sig, h)``     → ``signal``
+- ``loop.call_soon_threadsafe(f)`` / ``call_soon`` / ``call_later`` /
+  ``call_at``                   → ``event-loop`` (the seeding doubles
+                                  as the sanctioned handoff primitive
+                                  the domain-crossing pass accepts)
+- public sync function (no ``_``-prefixed component in its qualname)
+                                → ``caller``
+
+Propagation is the obvious union along call edges, with one refinement:
+calling an ``async def`` from sync code constructs a coroutine, it does
+not execute the body there — so caller domains never propagate INTO
+async functions (they are already seeded ``event-loop``).
+
+The result is intentionally a MAY analysis: a function reachable from
+two domains may never actually run concurrently with itself (e.g. the
+spawner joins before touching shared state).  Findings on such
+join-ordered handoffs are what ``@domain_private`` / the allowlist's
+written-justification machinery are for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from .interproc import FKey, Project
+
+EVENT_LOOP = "event-loop"
+EXECUTOR = "executor"
+SIGNAL = "signal"
+CALLER = "caller"
+
+# call_soon_threadsafe is BOTH a seed (the callback runs on the loop)
+# and the sanctioned cross-domain handoff primitive; the other three
+# only matter when sync setup code schedules loop work.
+_LOOP_SCHEDULERS = frozenset(
+    {"call_soon_threadsafe", "call_soon", "call_later", "call_at"}
+)
+# the callback argument's positional index per scheduler/spawner verb
+_EXECUTOR_VERBS = {
+    "run_in_executor": 1,  # loop.run_in_executor(pool, f, ...)
+    "submit": 0,  # executor.submit(f, ...)
+    "to_thread": 0,  # asyncio.to_thread(f, ...)
+    "add_done_callback": 0,  # runs on whichever thread completes
+}
+
+
+def _ref_shape(expr: ast.expr) -> Optional[List]:
+    """Serialize a function REFERENCE (not a call) into the same
+    ``("name", f)`` / ``("attr", recv, m)`` shape resolve_call takes.
+    Lambdas and partials are opaque — their bodies run inline at the
+    spawn site's domain anyway only if resolvable, so we skip them."""
+    if isinstance(expr, ast.Name):
+        return ["name", expr.id]
+    if isinstance(expr, ast.Attribute):
+        parts: List[str] = []
+        cur = expr.value
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            recv = ".".join(reversed(parts))
+        else:
+            recv = ""
+        return ["attr", recv, expr.attr]
+    return None
+
+
+def _thread_name(call: ast.Call) -> Optional[str]:
+    """The Thread's ``name=`` kwarg as a domain-stable string: literal
+    → itself, f-string → leading literal chunks + "*", else None."""
+    for kw in call.keywords:
+        if kw.arg != "name":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return v.value
+        if isinstance(v, ast.JoinedStr):
+            prefix = []
+            for part in v.values:
+                if isinstance(part, ast.Constant):
+                    prefix.append(str(part.value))
+                else:
+                    break
+            return ("".join(prefix) + "*") if prefix else None
+    return None
+
+
+def _kwarg_or_pos(call: ast.Call, kwarg: str, pos: int) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == kwarg:
+            return kw.value
+    if pos < len(call.args):
+        return call.args[pos]
+    return None
+
+
+def spawn_records(call: ast.Call) -> List[List]:
+    """Domain-seeding records for one call node:
+    ``[kind, name|None, target_shape, lineno]`` with kind in
+    {"thread", "executor", "signal", "event-loop"}.  Empty for calls
+    that spawn nothing (the overwhelmingly common case)."""
+    from .core import call_name
+
+    name = call_name(call)
+    out: List[List] = []
+    if name == "Thread":
+        tgt = _kwarg_or_pos(call, "target", 1)
+        shape = _ref_shape(tgt) if tgt is not None else None
+        if shape is not None:
+            out.append(["thread", _thread_name(call), shape, call.lineno])
+    elif name == "Timer":
+        # threading.Timer(interval, fn): fires on its own thread
+        tgt = _kwarg_or_pos(call, "function", 1)
+        shape = _ref_shape(tgt) if tgt is not None else None
+        if shape is not None:
+            out.append(["thread", _thread_name(call), shape, call.lineno])
+    elif name in _EXECUTOR_VERBS:
+        tgt = _kwarg_or_pos(call, "", _EXECUTOR_VERBS[name])
+        shape = _ref_shape(tgt) if tgt is not None else None
+        if shape is not None:
+            out.append(["executor", None, shape, call.lineno])
+    elif name == "signal" and len(call.args) >= 2:
+        shape = _ref_shape(call.args[1])
+        if shape is not None:
+            out.append(["signal", None, shape, call.lineno])
+    elif name in _LOOP_SCHEDULERS:
+        idx = 0 if name in ("call_soon_threadsafe", "call_soon") else 1
+        tgt = _kwarg_or_pos(call, "callback", idx)
+        shape = _ref_shape(tgt) if tgt is not None else None
+        if shape is not None:
+            out.append(["event-loop", None, shape, call.lineno])
+    return out
+
+
+def _is_public(qualname: str) -> bool:
+    """Public sync API: no ``_``-prefixed component.  ``__init__`` and
+    other dunders on a public class count as public (a constructor IS
+    caller-domain code), but init-time stores are already exempt at
+    the access level so this rarely matters."""
+    for part in qualname.split("."):
+        if part.startswith("_") and not (
+            part.startswith("__") and part.endswith("__")
+        ):
+            return False
+    return True
+
+
+class DomainMap:
+    """Per-function domain sets for one project, computed once and
+    memoized on the Project instance (see get_domain_map)."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self._domains: Dict[FKey, FrozenSet[str]] = {}
+        # spawn edges actually resolved, for the passes' messages:
+        # target fkey -> [(domain, spawning fkey, lineno)]
+        self.spawn_sites: Dict[FKey, List[Tuple[str, FKey, int]]] = {}
+        # functions with ANY structural seed: the entry-lockset roots
+        # (an external flow of control enters holding nothing)
+        self.seeded: FrozenSet[FKey] = frozenset()
+        self._compute()
+
+    def domains_of(self, key: FKey) -> FrozenSet[str]:
+        return self._domains.get(key, frozenset())
+
+    # ------------------------------------------------------- build
+
+    def _seed(self) -> Dict[FKey, set]:
+        project = self.project
+        table = project.summaries
+        seeds: Dict[FKey, set] = {}
+        for key, summ in table.locals.items():
+            unit = project.by_path.get(key[0])
+            if unit is None:
+                continue
+            for kind, name, shape, lineno in summ.conc.get("spawns", ()):
+                for tgt in project.resolve_call(
+                    unit, key[1], tuple(shape)
+                ):
+                    if kind == "thread":
+                        dom = f"thread:{name}" if name else f"thread:{tgt[1]}"
+                    else:
+                        dom = kind  # executor/signal/event-loop merge
+                    seeds.setdefault(tgt, set()).add(dom)
+                    self.spawn_sites.setdefault(tgt, []).append(
+                        (dom, key, lineno)
+                    )
+        for key in table.locals:
+            node = project.function_node(key)
+            if isinstance(node, ast.AsyncFunctionDef):
+                seeds.setdefault(key, set()).add(EVENT_LOOP)
+            elif _is_public(key[1]):
+                seeds.setdefault(key, set()).add(CALLER)
+        return seeds
+
+    def _compute(self) -> None:
+        project = self.project
+        seeds = self._seed()
+        self.seeded = frozenset(seeds)
+        rgraph = project.rgraph
+        doms: Dict[FKey, set] = {
+            k: set(seeds.get(k, ())) for k in project.graph
+        }
+        # seeds may name functions outside the graph keyset (shouldn't
+        # happen, but a half-resolved target must not KeyError)
+        for k, s in seeds.items():
+            doms.setdefault(k, set(s))
+        async_keys = {
+            k
+            for k in doms
+            if isinstance(
+                project.function_node(k), ast.AsyncFunctionDef
+            )
+        }
+        # callers-first: reversed reverse-topological SCC order, with
+        # a fixpoint inside each component for intra-SCC cycles
+        order = list(reversed(project.sccs()))
+        for comp in order:
+            changed = True
+            while changed:
+                changed = False
+                for k in comp:
+                    if k in async_keys:
+                        continue  # seeded event-loop; sync callers
+                        # merely construct the coroutine
+                    cur = doms.setdefault(k, set())
+                    for caller in rgraph.get(k, ()):
+                        add = doms.get(caller)
+                        if add and not add <= cur:
+                            cur |= add
+                            changed = True
+        self._domains = {
+            k: frozenset(v) for k, v in doms.items() if v
+        }
+
+
+def get_domain_map(project: Project) -> DomainMap:
+    dm = getattr(project, "_domain_map", None)
+    if dm is None:
+        dm = DomainMap(project)
+        project._domain_map = dm
+    return dm

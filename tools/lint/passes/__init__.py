@@ -6,12 +6,18 @@ the flow-sensitive substrate — resource-pairing the per-function CFGs
 (``FileUnit.cfg`` + ``cfg.reach``), async-blocking the intra-module
 call graph (``FileUnit.local_defs``/``callers``); kv-hygiene and
 metric-registry are module-level hygiene sweeps that shipped with it.
-The last three are **interprocedural** (``ProjectPass``): they run
+The last six are **interprocedural** (``ProjectPass``): they run
 once per project over the package-wide call graph and the summary
 table (tools/lint/interproc.py, tools/lint/summaries.py) instead of
 once per file — protocol-lockstep for cross-call SPMD collective
 discipline, kv-matching for producer/consumer key-shape pairing,
-effect-escape for resource handoffs and cross-module blocking chains.
+effect-escape for resource handoffs and cross-module blocking chains,
+and the concurrency trio riding execution-domain inference
+(tools/lint/domains.py) and the shared-state/lockset model
+(tools/lint/shared_state.py): lockset-race for Eraser-style
+inconsistent locking of multi-domain fields, lock-order for cycles in
+the package lock acquisition graph, domain-crossing for unsanctioned
+event-loop/thread state crossings.
 """
 
 from __future__ import annotations
@@ -21,6 +27,7 @@ from typing import Tuple
 from ..core import LintPass
 from .async_blocking import AsyncBlockingPass
 from .collective_safety import CollectiveSafetyPass
+from .domain_crossing import DomainCrossingPass
 from .effect_escape import EffectEscapePass
 from .exception_hygiene import ExceptionHygienePass
 from .instrumentation import InstrumentationPass
@@ -28,6 +35,8 @@ from .knob_registry import KnobRegistryPass
 from .kv_hygiene import KvHygienePass
 from .kv_matching import KvMatchingPass
 from .lock_discipline import LockDisciplinePass
+from .lock_order import LockOrderPass
+from .lockset_race import LocksetRacePass
 from .metric_registry import MetricRegistryPass
 from .protocol_lockstep import ProtocolLockstepPass
 from .resource_pairing import ResourcePairingPass
@@ -47,4 +56,7 @@ ALL_PASSES: Tuple[LintPass, ...] = (
     ProtocolLockstepPass(),
     KvMatchingPass(),
     EffectEscapePass(),
+    LocksetRacePass(),
+    LockOrderPass(),
+    DomainCrossingPass(),
 )

@@ -1,0 +1,79 @@
+"""domain-crossing: event-loop state and thread state may only meet
+through a lock or a sanctioned handoff primitive.
+
+The event loop is single-threaded BY CONTRACT — loop-domain code
+normally needs no locks, which is exactly why a background thread
+reaching into loop-owned state (or an ``async def`` mutating state a
+thread sweeps) is so easy to write and so hard to see in review: each
+side looks locally correct.  This pass takes the shared-field map
+(shared_state.ConcurrencyModel) and flags every field whose domain set
+contains ``event-loop`` PLUS any other domain, where the accesses
+neither share a lock (non-empty lockset intersection, same bar as
+lockset-race) nor go through a blessed handoff:
+
+- ``loop.call_soon_threadsafe(cb)`` — the asyncio-sanctioned entry
+  into the loop (and a domain SEED: the callback itself becomes
+  loop-domain code, so its own accesses are judged consistently);
+- queue/Event traffic (``put``/``get``/``set``/``wait``/…) — receiver
+  methods that serialize internally;
+- the ``_ByteGate``/budget/breaker verbs resource-pairing models
+  (``reserve``/``release``/``debit``/``credit``/…) — those objects
+  exist to be the cross-domain rendezvous.
+
+Same exemptions as lockset-race (init stores, load-only fields,
+constant latches, ``@domain_private``); the two passes partition the
+shared-field universe on ``event-loop ∈ domains`` so one racy field
+yields exactly one finding.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..core import Finding, ProjectPass
+from ..domains import EVENT_LOOP
+from ..shared_state import get_model
+
+
+class DomainCrossingPass(ProjectPass):
+    pass_id = "domain-crossing"
+    description = (
+        "event-loop vs thread state crossings need a lock or a "
+        "sanctioned handoff (call_soon_threadsafe, queues, gate/budget)"
+    )
+
+    def run_project(self, project) -> Iterable[Finding]:
+        model = get_model(project)
+        out: List[Finding] = []
+        for fkey, accesses, doms in model.shared_fields():
+            if EVENT_LOOP not in doms:
+                continue  # lockset-race pass territory
+            if (fkey[0], fkey[1]) in model.domain_private:
+                continue
+            verdict = model.field_verdict(accesses)
+            if verdict is None:
+                continue
+            stores = verdict["stores"]
+            anchor = min(stores, key=lambda a: (a.fn[0], a.lineno))
+            field_name = (
+                f"{fkey[1]}.{fkey[2]}"
+                if fkey[1] != "<module>"
+                else fkey[2]
+            )
+            others = sorted(doms - {EVENT_LOOP})
+            out.append(
+                self.finding_at(
+                    anchor.fn[0],
+                    anchor.lineno,
+                    anchor.fn[1],
+                    f"'{field_name}' crosses the event-loop/"
+                    f"{', '.join(others)} domain boundary with no "
+                    f"shared lock and no sanctioned handoff — hand it "
+                    f"across with loop.call_soon_threadsafe, a queue, "
+                    f"or a gate/budget object, or guard both sides "
+                    f"with one lock (the loop side then pays that "
+                    f"lock on every touch: prefer the handoff)",
+                )
+            )
+        out.sort(key=lambda f: (f.file, f.line))
+        return out

@@ -1,0 +1,80 @@
+"""lock-order: the package-wide lock acquisition graph must be acyclic.
+
+Deadlock needs four ingredients; the only one a linter can remove is
+circular wait.  shared_state.extract_conc records every acquisition
+site together with the locks already held there (lexical nesting), and
+the ConcurrencyModel extends "already held" through the call graph via
+may-entry locksets — so ``f`` taking lock A and calling ``g`` which
+takes lock B contributes the edge A→B even though no single function
+nests them.  Any cycle in the resulting graph is a potential deadlock:
+two flows of control entering the cycle from different points block
+each other forever, and unlike a race it strikes with both sides
+written "correctly".
+
+One finding per cycle (per lock-graph SCC), naming the full order and
+one concrete acquisition site per edge — the reviewer's job is to pick
+a canonical order, not to chase sites.  Self-edges (re-acquiring the
+lock you hold) are skipped: every in-tree re-acquisition is an RLock
+by construction and the acquire-pairing rule in lock-discipline
+already polices raw acquire/release.
+
+Lock identity is shared_state._ConcExtractor._lock_id's: ``Class.attr``
+for instance locks, ``module:NAME`` for module locks, ``factory()``
+for keyed-guard factories (``index_lock(root)``) — deliberately
+collapsing per-instance locks of one class into one node, because a
+cycle among them (two instances locked in both orders) is still a
+real deadlock (the classic transfer(a, b) / transfer(b, a)).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..core import Finding, ProjectPass
+from ..shared_state import get_model
+
+
+class LockOrderPass(ProjectPass):
+    pass_id = "lock-order"
+    description = (
+        "no cycles in the package lock-order graph (nested + "
+        "call-graph acquisitions)"
+    )
+
+    def run_project(self, project) -> Iterable[Finding]:
+        model = get_model(project)
+        out: List[Finding] = []
+        for cycle in model.lock_cycles():
+            # cycle is [L1, L2, ..., L1]
+            edges = list(zip(cycle, cycle[1:]))
+            parts: List[str] = []
+            anchor = None
+            for a, b in edges:
+                site = model.edge_site(a, b)
+                if site is None:
+                    parts.append(f"{a} -> {b} (site unresolved)")
+                    continue
+                relpath, lineno, qualname = site
+                parts.append(
+                    f"{a} -> {b} at {relpath}:{lineno} ({qualname})"
+                )
+                if anchor is None:
+                    anchor = site
+            if anchor is None:
+                continue
+            order = " -> ".join(cycle)
+            out.append(
+                self.finding_at(
+                    anchor[0],
+                    anchor[1],
+                    anchor[2],
+                    f"lock-order cycle {order}: two flows of control "
+                    f"entering this cycle at different points "
+                    f"deadlock each other; acquisition sites: "
+                    f"{'; '.join(parts)} — pick ONE canonical order "
+                    f"and restructure the later acquisitions to "
+                    f"honor it",
+                )
+            )
+        out.sort(key=lambda f: (f.file, f.line))
+        return out

@@ -1,0 +1,133 @@
+"""lockset-race: a field reachable from ≥2 execution domains must have
+a non-empty lockset intersection across its unsanctioned accesses.
+
+This is the Eraser lockset algorithm (Savage et al. 1997) lifted
+through the package call graph: shared_state.ConcurrencyModel supplies
+every ``self.<attr>``/module-global access with its EFFECTIVE lockset
+(lexical ``with``/acquire frames ∪ the function's must-entry lockset —
+locks provably held at every call site), and domains.DomainMap supplies
+which flows of control reach each accessor.  A field touched from two
+domains whose access locksets share no lock has, by construction, an
+interleaving where both domains are inside their "critical sections"
+at once.
+
+Refinements that keep the pass quiet on correct code:
+
+- ``__init__``/``__post_init__`` stores are pre-publication;
+- load-only fields cannot race with themselves;
+- accesses that only feed a thread-safe receiver (``q.put``,
+  ``evt.set``, ``call_soon_threadsafe``, resource-pairing verbs) are
+  sanctioned handoffs;
+- latch fields whose every post-init store is a bare True/False/None
+  constant are GIL-atomic flag flips — exempt from the torn-state
+  check, though a guard-then-mutate on one still surfaces through the
+  fields it guards;
+- when the load side and the store side DO hold locks but different
+  ones (check-then-act under two locks, the bug pattern no
+  single-access check can see), the finding says so explicitly.
+
+Fields involving the event-loop domain are the domain-crossing pass's
+jurisdiction (one finding per field, not two).  Suppression: fix it,
+or ``@domain_private("<why this class is single-domain, ≥20 chars>")``
+on the owning class, or an allowlists.py entry — all three leave a
+written trail.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..core import Finding, ProjectPass
+from ..domains import EVENT_LOOP
+from ..shared_state import get_model
+
+
+def _fmt_domains(doms) -> str:
+    return ", ".join(sorted(doms))
+
+
+def _fmt_locks(locks) -> str:
+    return "{" + ", ".join(sorted(locks)) + "}" if locks else "no lock"
+
+
+class LocksetRacePass(ProjectPass):
+    pass_id = "lockset-race"
+    description = (
+        "multi-domain fields need a consistent lock (Eraser locksets "
+        "over the package call graph)"
+    )
+
+    def run_project(self, project) -> Iterable[Finding]:
+        model = get_model(project)
+        out: List[Finding] = []
+        for relpath, lineno, cls in model.bad_domain_private:
+            out.append(
+                self.finding_at(
+                    relpath, lineno, cls,
+                    f"@domain_private on '{cls}' needs a written "
+                    f"justification of at least 20 characters saying "
+                    f"WHY this class's fields are single-domain — an "
+                    f"empty or token excuse is not a reviewed decision",
+                )
+            )
+        for fkey, accesses, doms in model.shared_fields():
+            if EVENT_LOOP in doms:
+                continue  # domain-crossing pass territory
+            if (fkey[0], fkey[1]) in model.domain_private:
+                continue
+            verdict = model.field_verdict(accesses)
+            if verdict is None:
+                continue
+            stores = verdict["stores"]
+            anchor = min(stores, key=lambda a: (a.fn[0], a.lineno))
+            owner = (
+                fkey[1] if fkey[1] != "<module>" else "module global"
+            )
+            field_name = (
+                f"{fkey[1]}.{fkey[2]}"
+                if fkey[1] != "<module>"
+                else fkey[2]
+            )
+            why = []
+            if "lms" in verdict:
+                a = verdict["lms"]
+                why.append(
+                    f"load-modify-store at {a.fn[0]}:{a.lineno} loses "
+                    f"updates across domains"
+                )
+            if "cta" in verdict:
+                ld, st = verdict["cta"]
+                both = ld.locks and st.locks
+                why.append(
+                    f"check-then-act in {ld.fn[1]} (load line "
+                    f"{ld.lineno}, store line {st.lineno}"
+                    + (
+                        f"; loaded under {_fmt_locks(ld.locks)} but "
+                        f"stored under {_fmt_locks(st.locks)} — two "
+                        f"locks serialize nothing against each other"
+                        if both
+                        else ""
+                    )
+                    + ")"
+                )
+            if "inconsistent" in verdict:
+                why.append(
+                    f"locking is inconsistent: some accesses hold "
+                    f"{{{', '.join(verdict['inconsistent'])}}}, "
+                    f"others hold nothing"
+                )
+            out.append(
+                self.finding_at(
+                    anchor.fn[0],
+                    anchor.lineno,
+                    anchor.fn[1],
+                    f"'{field_name}' ({owner}) is reached from "
+                    f"domains [{_fmt_domains(doms)}] with EMPTY "
+                    f"lockset intersection — {'; '.join(why)}; guard "
+                    f"every access with one shared lock, or mark the "
+                    f"class @domain_private with a written "
+                    f"justification",
+                )
+            )
+        out.sort(key=lambda f: (f.file, f.line))
+        return out

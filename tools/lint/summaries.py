@@ -26,7 +26,7 @@ Local summaries are **cached** to ``tools/lint/.summary_cache.json``
 keyed by each file's content hash: parsing still happens every run
 (every lexical pass needs the AST anyway), but the summary-extraction
 walk — and nothing else — is skipped on a hit, which is what keeps
-thirteen passes inside the repo's 10-second wall-time budget.  The
+sixteen passes inside the repo's 10-second wall-time budget.  The
 cache stores only what this module can re-derive; deleting it is
 always safe.
 
@@ -67,14 +67,20 @@ from .interproc import COLLECTIVE_NAMES, KV_OP_NAMES, FKey, Project
 
 CACHE_BASENAME = ".summary_cache.json"
 # bump whenever the serialized summary format changes (call-record
-# shapes, term grammar): a version mismatch is a whole-cache miss.
+# shapes, term grammar, the conc block): a version mismatch is a
+# whole-cache miss, and — since the concurrency PR — every per-file
+# entry ALSO carries the schema version it was extracted under, so a
+# single stale entry spliced into a newer cache (partial write, tool
+# downgrade/upgrade race) is a per-file miss rather than silently
+# reused.
 # SEMANTIC rule changes (SPECS receivers, blocking table, KV verb
-# sets) need no bump: the cache key also folds in a fingerprint of
-# the rule-defining sources (_rules_fingerprint), so editing any of
-# them is a whole-cache miss automatically — without it, a dev whose
-# warm cache predates the rule edit would see green locally while a
-# cold CI run reports findings.
-CACHE_VERSION = 2
+# sets, domain-seed and lockset rules) need no bump: the cache key
+# also folds in a fingerprint of the rule-defining sources
+# (_rules_fingerprint), so editing any of them is a whole-cache miss
+# automatically — without it, a dev whose warm cache predates the
+# rule edit would see green locally while a cold CI run reports
+# findings.
+CACHE_VERSION = 3
 
 _rules_fp_cache: List[str] = []
 
@@ -88,9 +94,14 @@ def _rules_fingerprint() -> str:
         "summaries.py",
         "interproc.py",
         "core.py",  # receiver_name/call_name/walk_skipping_nested_defs
+        "domains.py",  # spawn-site recognition feeds conc extraction
+        "shared_state.py",  # access/lockset extraction rules
         os.path.join("passes", "resource_pairing.py"),
         os.path.join("passes", "async_blocking.py"),
         os.path.join("passes", "collective_safety.py"),
+        os.path.join("passes", "lockset_race.py"),
+        os.path.join("passes", "lock_order.py"),
+        os.path.join("passes", "domain_crossing.py"),
     ):
         try:
             with open(os.path.join(here, rel), "rb") as f:
@@ -290,14 +301,20 @@ class FnSummary:
     """One function's local (cacheable) effects; see module docstring
     for the term grammar."""
 
-    __slots__ = ("term", "kv", "res", "block", "calls")
+    __slots__ = ("term", "kv", "res", "block", "calls", "conc")
 
-    def __init__(self, term, kv, res, block, calls) -> None:
+    def __init__(self, term, kv, res, block, calls, conc=None) -> None:
         self.term = term  # nested JSON-able list of steps
         self.kv = kv  # [op, shape, lineno]
         self.res = res  # [family, kind, verb, root, lineno]
         self.block = block  # [label, lineno, reason] | None
         self.calls = calls  # [shape, lineno, argroots]
+        # concurrency facts (tools/lint/shared_state.py grammar):
+        #   spawns: [kind, name|None, shape, lineno]
+        #   acc:    [owner, field, rw, locks, lineno, sanction|None]
+        #   lockacq:[lock_id, held_before, lineno]
+        #   heldcalls: [shape, held, lineno]  (held non-empty only)
+        self.conc = conc or {}
 
     def to_dict(self) -> Dict:
         return {
@@ -306,6 +323,7 @@ class FnSummary:
             "res": self.res,
             "block": self.block,
             "calls": self.calls,
+            "conc": self.conc,
         }
 
     @classmethod
@@ -316,6 +334,7 @@ class FnSummary:
             d.get("res", []),
             d.get("block"),
             d.get("calls", []),
+            d.get("conc") or {},
         )
 
 
@@ -610,10 +629,19 @@ class SummaryTable:
                 cached = {}  # unreadable/corrupt cache == cold cache
         fresh: Dict[str, Dict] = {}
         dirty = False
+        from .shared_state import extract_conc
+
         for unit in self.project.units:
             h = hashlib.sha1(unit.source.encode("utf-8")).hexdigest()
             entry = cached.get(unit.relpath)
-            if entry is not None and entry.get("h") == h:
+            # an entry is reusable only if BOTH the content hash and
+            # the per-entry schema version match — a stale entry
+            # spliced into a newer cache file must be a per-file miss
+            if (
+                entry is not None
+                and entry.get("h") == h
+                and entry.get("v") == CACHE_VERSION
+            ):
                 self.cache_hits += 1
                 fns = {
                     qn: FnSummary.from_dict(d)
@@ -624,12 +652,14 @@ class SummaryTable:
                 self.cache_misses += 1
                 dirty = True
                 ex = _Extractor(unit)
-                fns = {
-                    qn: ex.extract(node)
-                    for qn, node in unit.functions()
-                }
+                fns = {}
+                for qn, node in unit.functions():
+                    s = ex.extract(node)
+                    s.conc = extract_conc(unit, qn, node)
+                    fns[qn] = s
                 fresh[unit.relpath] = {
                     "h": h,
+                    "v": CACHE_VERSION,
                     "fns": {
                         qn: s.to_dict() for qn, s in fns.items()
                     },

@@ -29,6 +29,7 @@ import numpy as np
 
 from . import knobs, obs
 from .io_types import BufferConsumer, BufferStager, ReadReq, WriteReq
+from .utils import domain_private
 from .manifest import (
     ArrayEntry,
     ChunkedArrayEntry,
@@ -40,6 +41,11 @@ from .manifest import (
 logger = logging.getLogger(__name__)
 
 
+@domain_private(
+    "a batch is built by the planner, staged exactly once by one "
+    "pipeline task, and its stagers list is cleared by that same "
+    "task — instances are never shared between concurrent stage calls"
+)
 class BatchedBufferStager(BufferStager):
     """Stage sub-buffers into one slab (reference BatchedBufferStager,
     batcher.py:51-103).

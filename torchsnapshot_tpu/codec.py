@@ -51,6 +51,7 @@ import asyncio
 import logging
 import os
 import struct
+import threading
 import time
 import weakref
 import zlib
@@ -232,6 +233,7 @@ def available_codecs() -> List[str]:
 
 
 _warned_unavailable: set = set()
+_warned_lock = threading.Lock()  # resolve runs from loop + executors
 
 
 def resolve_codec(name: Optional[str] = None) -> str:
@@ -245,8 +247,10 @@ def resolve_codec(name: Optional[str] = None) -> str:
         return "raw"
     codec = _REGISTRY.get(name)
     if codec is None or not codec.available():
-        if name not in _warned_unavailable:
+        with _warned_lock:
+            first = name not in _warned_unavailable
             _warned_unavailable.add(name)
+        if first:
             why = "unknown codec" if codec is None else "not installed"
             logger.warning(
                 "TORCHSNAPSHOT_TPU_CODEC=%r %s (available: %s); writing "

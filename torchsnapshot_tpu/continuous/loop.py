@@ -194,6 +194,11 @@ class ContinuousCheckpointer:
         # OUTSIDE the lock
         self._promo_lock = threading.Lock()
         self._promotions: List[Tuple[PromotionGroup, Set[str], Set[str], int]] = []
+        # guards the lazy singletons (_ns, _targets, _target_pool,
+        # _io_loop): created on first use from the step or worker
+        # thread, torn down by close() — the expensive/collective
+        # resolution work itself runs OUTSIDE the lock
+        self._init_lock = threading.Lock()
         # live-weight publication (publish/): every confirmed durable
         # promotion is published so serving subscribers can delta-swap
         # to it.  Best-effort by design — publication rides behind the
@@ -252,22 +257,24 @@ class ContinuousCheckpointer:
         return self._executor
 
     def _ensure_target_pool(self) -> ThreadPoolExecutor:
-        if self._target_pool is None:
-            self._target_pool = ThreadPoolExecutor(
-                max_workers=4,
-                thread_name_prefix="tsnp-continuous-target",
-            )
-        return self._target_pool
+        with self._init_lock:
+            if self._target_pool is None:
+                self._target_pool = ThreadPoolExecutor(
+                    max_workers=4,
+                    thread_name_prefix="tsnp-continuous-target",
+                )
+            return self._target_pool
 
     def _ensure_io_loop(self) -> Any:
         """One long-lived event-loop thread for ALL per-step chunk
         writes (every target, every step): per-call thread+loop churn
         would sit on the once-per-training-step hot path."""
-        if self._io_loop is None:
-            from ..scheduler import _LoopThread
+        with self._init_lock:
+            if self._io_loop is None:
+                from ..scheduler import _LoopThread
 
-            self._io_loop = _LoopThread(name="tsnp-continuous-io")
-        return self._io_loop
+                self._io_loop = _LoopThread(name="tsnp-continuous-io")
+            return self._io_loop
 
     def _ensure_worker(self) -> None:
         if self._worker is None or not self._worker.is_alive():
@@ -293,9 +300,10 @@ class ContinuousCheckpointer:
         matches across ranks as long as every rank constructs/uses its
         checkpointer in the same program order — the same contract as
         every other foreground coordination op."""
-        if self._ns is None:
-            self._ns = self._coord._next_uid("cc")
-        return self._ns
+        with self._init_lock:
+            if self._ns is None:
+                self._ns = self._coord._next_uid("cc")
+            return self._ns
 
     def _exchange_peer_roots(self) -> Optional[List[str]]:
         """All ranks' host roots indexed by rank — exchanged over the
@@ -317,8 +325,9 @@ class ContinuousCheckpointer:
         from the exchanged per-rank roots by topology preference
         (different-slice first).  Symmetric — every rank reaches this
         from its own first step()."""
-        if self._targets is not None:
-            return self._targets
+        with self._init_lock:
+            if self._targets is not None:
+                return self._targets
         coord = self._coord
         self._ensure_ns()
         if self._replica_roots is not None:
@@ -347,12 +356,14 @@ class ContinuousCheckpointer:
         # the local store is always the first target — it is both the
         # promotion source and the fastest recovery path after a plain
         # process crash (host survived)
-        self._targets = [self.local_store_root] + [
+        targets = [self.local_store_root] + [
             self._rank_store_root(h) for h in hosts
         ]
-        for root in self._targets:
+        with self._init_lock:
+            self._targets = targets
+        for root in targets:
             self._seed_holds(root)
-        return self._targets
+        return targets
 
     def _detect_topology(self) -> Any:
         try:
@@ -369,7 +380,6 @@ class ContinuousCheckpointer:
         """Best-effort warm start against a surviving store: trust the
         chunks its committed HEAD step references, so a restart doesn't
         re-replicate unchanged content."""
-        holds = self._holds.setdefault(root, set())
         try:
             store = self._store(root)
             head = store.read_head()
@@ -381,9 +391,10 @@ class ContinuousCheckpointer:
                 for rec in manifest["leaves"].values()
                 for k in rec["keys"]
             }
-            holds.update(keys)
-            self._target_heads[root] = int(head["step"])
-            self._recent.append((int(head["step"]), keys))
+            with self._promo_lock:
+                self._holds.setdefault(root, set()).update(keys)
+                self._target_heads[root] = int(head["step"])
+                self._recent.append((int(head["step"]), keys))
         except Exception as e:  # noqa: BLE001 — cold start is correct
             obs.swallowed_exception("continuous.seed_holds", e)
 
@@ -399,8 +410,9 @@ class ContinuousCheckpointer:
                 for rec in manifest["leaves"].values()
                 for k in rec["keys"]
             }
-            self._durable_confirmed |= keys
-            self._durable_head_step = int(head["step"])
+            with self._promo_lock:
+                self._durable_confirmed |= keys
+                self._durable_head_step = int(head["step"])
         except Exception as e:  # noqa: BLE001 — full promotion instead
             obs.swallowed_exception("continuous.seed_durable", e)
 
@@ -595,10 +607,11 @@ class ContinuousCheckpointer:
                     job.step, root, e,
                 )
                 return False
-            # per-root state only (distinct dict keys): thread-safe
-            # under concurrent target replication
-            self._holds.setdefault(root, set()).update(job.all_keys)
-            self._target_heads[root] = job.step
+            # distinct dict keys per target, but sweeps on the
+            # accessor threads iterate the whole map concurrently
+            with self._promo_lock:
+                self._holds.setdefault(root, set()).update(job.all_keys)
+                self._target_heads[root] = job.step
             return True
 
         with obs.span(
@@ -629,7 +642,7 @@ class ContinuousCheckpointer:
         # next one is enqueued): peer-only/manual-promote runs would
         # otherwise report a stale durable step forever and keep the
         # finished group's keys pinned against pruning
-        if self._promotions:
+        if self._pending_promotions():
             self._sweep_promotions()
         if (
             job.promote
@@ -637,13 +650,16 @@ class ContinuousCheckpointer:
         ):
             self._enqueue_promotion(job)
         coord = self._coordinator
-        if coord is not None and self._ns is not None:
+        with self._init_lock:
+            ns = self._ns
+            targets = self._targets
+        if coord is not None and ns is not None:
             # publish what peers ACTUALLY hold: the loss floor.  -1 =
             # peers exist but none holds a complete step yet; with no
             # peer targets the local head is this rank's only truth
             lp = self.last_peer_step()
             if lp is None:
-                has_peers = len(self._targets or ()) > 1
+                has_peers = len(targets or ()) > 1
                 lp = (
                     -1
                     if has_peers
@@ -651,7 +667,7 @@ class ContinuousCheckpointer:
                         self.local_store_root, -1
                     )
                 )
-            heartbeat.publish(coord, self._ns, coord.rank, lp)
+            heartbeat.publish(coord, ns, coord.rank, lp)
 
     def _record_recent(self, job: _StepJob) -> None:
         """Retention: keep the last ``retain_steps`` steps' manifests
@@ -661,14 +677,15 @@ class ContinuousCheckpointer:
         it would destroy the one replica it holds, so it keeps
         everything until it catches up.  Chunks a pending promotion
         still needs to read from the local store are protected too."""
-        self._recent.append((job.step, set(job.all_keys)))
-        while len(self._recent) > self.retain_steps:
-            old_step, _old_keys = self._recent.pop(0)
-            keep: Set[str] = set()
-            for _s, ks in self._recent:
-                keep |= ks
-            protect = set(keep)
-            with self._promo_lock:
+        deletions: List[Tuple[str, str]] = []  # (store root, path)
+        with self._promo_lock:
+            self._recent.append((job.step, set(job.all_keys)))
+            while len(self._recent) > self.retain_steps:
+                old_step, _old_keys = self._recent.pop(0)
+                keep: Set[str] = set()
+                for _s, ks in self._recent:
+                    keep |= ks
+                protect = set(keep)
                 pending_steps: Set[int] = set()
                 for _g, new_keys, step_keys, s in self._promotions:
                     protect |= new_keys | step_keys
@@ -678,20 +695,29 @@ class ContinuousCheckpointer:
                     # manifest from the local store — defer its GC to
                     # the sweep that reconciles the group
                     self._manifest_gc_pending.add(old_step)
-            for root in list(self._holds):
-                if root == self.durable_store_root:
-                    continue
-                if self._target_heads.get(root) != job.step:
-                    continue  # lagging target: its HEAD still needs these
-                store = self._store(root)
-                holds = self._holds[root]
-                for key in sorted(holds - protect):
-                    store.delete_quiet(chunk_location(key))
-                    holds.discard(key)
-                if old_step not in pending_steps:
-                    store.delete_quiet(step_manifest_path(old_step))
+                for root in list(self._holds):
+                    if root == self.durable_store_root:
+                        continue
+                    if self._target_heads.get(root) != job.step:
+                        continue  # lagging target: its HEAD still
+                        # needs these
+                    holds = self._holds[root]
+                    for key in sorted(holds - protect):
+                        deletions.append((root, chunk_location(key)))
+                        holds.discard(key)
+                    if old_step not in pending_steps:
+                        deletions.append(
+                            (root, step_manifest_path(old_step))
+                        )
+        # physical deletes strictly outside the lock (lock-discipline)
+        for root, path in deletions:
+            self._store(root).delete_quiet(path)
 
     # -------------------------------------------------------- promotion
+
+    def _pending_promotions(self) -> int:
+        with self._promo_lock:
+            return len(self._promotions)
 
     def _enqueue_promotion(self, job: _StepJob) -> None:
         """Hand this step to the write-back promoter: data job copies
@@ -733,10 +759,9 @@ class ContinuousCheckpointer:
         CONFIRMED residency only).  Also drains the deferred manifest
         GC for steps whose promotion settled after retention evicted
         them.  Called from the worker thread (per replication job) and
-        from main-thread accessors after the loop went quiet
-        (last_durable_step/summary post drain) — the bookkeeping is
-        only racy while a job is in flight, when the accessors are
-        advisory anyway."""
+        from main-thread accessors (last_durable_step/summary) —
+        every bookkeeping touch happens under ``_promo_lock``; only
+        the physical deletes run outside it."""
         deletions: List[Tuple[str, str]] = []  # (store root, path)
         with self._promo_lock:
             still: List[Tuple[PromotionGroup, Set[str], Set[str], int]] = []
@@ -863,7 +888,9 @@ class ContinuousCheckpointer:
             if head is None:
                 return False
             manifest_keys: Set[str] = set()
-            for s, ks in self._recent:
+            with self._promo_lock:
+                recent = list(self._recent)
+            for s, ks in recent:
                 if s == head:
                     manifest_keys = ks
                     break
@@ -948,17 +975,20 @@ class ContinuousCheckpointer:
                 preemption.remove_handler(self._preemption_handle)
                 self._preemption_handle = None
             coord = self._coordinator
-            if coord is not None and self._ns is not None:
-                heartbeat.clear(coord, self._ns, coord.rank)
+            with self._init_lock:
+                ns = self._ns
+            if coord is not None and ns is not None:
+                heartbeat.clear(coord, ns, coord.rank)
             if self._executor is not None:
                 self._executor.shutdown(wait=False)
                 self._executor = None
-            if self._target_pool is not None:
-                self._target_pool.shutdown(wait=False)
-                self._target_pool = None
-            if self._io_loop is not None:
-                self._io_loop.shutdown()
-                self._io_loop = None
+            with self._init_lock:
+                pool, self._target_pool = self._target_pool, None
+                io_loop, self._io_loop = self._io_loop, None
+            if pool is not None:
+                pool.shutdown(wait=False)
+            if io_loop is not None:
+                io_loop.shutdown()
             for store in self._stores.values():
                 store.sync_close()
             self._stores.clear()
@@ -1018,10 +1048,10 @@ class ContinuousCheckpointer:
         """The newest step EVERY peer target holds completely (the loss
         floor: a host killed now restores at least this step from a
         peer); None before the first replication or without peers."""
+        with self._init_lock:
+            all_targets = self._targets or ()
         targets = [
-            t
-            for t in (self._targets or ())
-            if t != self.local_store_root
+            t for t in all_targets if t != self.local_store_root
         ]
         if not targets:
             return None
@@ -1034,38 +1064,47 @@ class ContinuousCheckpointer:
         # reconcile any promotion that settled since the last
         # replication job (the final promote()+drain()+close flow ends
         # with no further job to sweep for it)
-        if self._promotions:
+        if self._pending_promotions():
             self._sweep_promotions()
-        return self._durable_head_step
+        with self._promo_lock:
+            return self._durable_head_step
 
     def heartbeats(self) -> Optional[Dict[int, Optional[int]]]:
         """Every rank's last published heartbeat step (None when the
         loop has not exchanged its namespace yet)."""
         coord = self._coordinator
-        if coord is None or self._ns is None:
+        with self._init_lock:
+            ns = self._ns
+        if coord is None or ns is None:
             return None
-        return heartbeat.read_all(coord, self._ns, coord.world_size)
+        return heartbeat.read_all(coord, ns, coord.world_size)
 
     def summary(self) -> Dict[str, Any]:
         """JSON-safe state for flight records / doctor / stats."""
-        if self._promotions:
+        if self._pending_promotions():
             self._sweep_promotions()
         local_head = self._target_heads.get(self.local_store_root)
         peer_step = self.last_peer_step()
+        with self._init_lock:
+            targets = self._targets
+        with self._promo_lock:
+            durable_head = self._durable_head_step
+            pending = len(self._promotions)
+            target_heads = dict(self._target_heads)
         return {
             "last_step": self._last_step,
             "local_head_step": local_head,
             "last_peer_step": peer_step,
-            "last_durable_step": self._durable_head_step,
+            "last_durable_step": durable_head,
             "replication_lag_steps": (
                 max(0, self._last_step - peer_step)
                 if self._last_step is not None and peer_step is not None
                 else None
             ),
-            "peer_targets": max(0, len(self._targets or ()) - 1),
+            "peer_targets": max(0, len(targets or ()) - 1),
             "target_heads": {
                 root: head
-                for root, head in sorted(self._target_heads.items())
+                for root, head in sorted(target_heads.items())
             },
-            "promotions_pending": len(self._promotions),
+            "promotions_pending": pending,
         }

@@ -39,6 +39,7 @@ def _pack(arrays: List[Any]):
 
 
 _pack_jit = None
+_PACK_JIT_LOCK = threading.Lock()
 
 # benchmark/diagnostic counters: how often the compiled device-side
 # pack/unpack COMPLETED (evidence that the one-DMA path engaged on
@@ -62,9 +63,14 @@ def pack_arrays_to_host(arrays: List[Any]) -> np.ndarray:
     global _pack_jit
     import jax
 
-    if _pack_jit is None:
-        _pack_jit = jax.jit(_pack)
-    packed = _pack_jit(arrays)
+    # executor threads pack concurrently; the jit wrapper itself is
+    # cheap to build, so every touch stays under the lock (the traced
+    # COMPILE below happens outside it, per arg signature, inside jax)
+    with _PACK_JIT_LOCK:
+        if _pack_jit is None:
+            _pack_jit = jax.jit(_pack)
+        pack_fn = _pack_jit
+    packed = pack_fn(arrays)
     try:
         packed.copy_to_host_async()
     except Exception as e:

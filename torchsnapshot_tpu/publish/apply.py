@@ -56,11 +56,13 @@ class LiveWeights:
 
     @property
     def generation(self) -> int:
-        return self._generation
+        with self._lock:
+            return self._generation
 
     @property
     def step(self) -> Optional[int]:
-        return self._step
+        with self._lock:
+            return self._step
 
     @contextlib.contextmanager
     def pinned(self) -> Iterator[Tuple[Optional[int], int]]:
@@ -74,10 +76,11 @@ class LiveWeights:
     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """``(manifest, flattened)`` of the live state — the apply
         basis and the subscriber's template view."""
-        state_tree = {
-            k: (v.state_dict() if hasattr(v, "state_dict") else v)
-            for k, v in self._app_state.items()
-        }
+        with self._lock:
+            state_tree = {
+                k: (v.state_dict() if hasattr(v, "state_dict") else v)
+                for k, v in self._app_state.items()
+            }
         return flatten(state_tree)
 
     def apply(

@@ -94,7 +94,8 @@ class Publisher:
     def namespace(self) -> Optional[str]:
         """The announce namespace (per-publisher uid); None until the
         first publication (or when announce is off / no coordinator)."""
-        return self._ns
+        with self._lock:
+            return self._ns
 
     def _announce_ns(self) -> Optional[str]:
         if not knobs.publish_announce_enabled():
@@ -340,7 +341,8 @@ class Publisher:
         from .delta import plan_delta
 
         probe = {"bases": bases, "leaves": leaves, "step": -1}
-        prev = self._last_record
+        with self._lock:
+            prev = self._last_record
         prev_probe = None
         if prev is not None:
             prev_probe = {

@@ -91,6 +91,10 @@ class Subscriber:
         self._strict = strict
         self._store = PublishStore(self.root)
         self._ns = announce_mod.ns_for_root(self.root)
+        # serializes the poll engine: a caller-thread poll_once racing
+        # the follow thread would double-fetch and double-apply the
+        # same step; the blocking waits (sleep/kv_watch) stay OUTSIDE
+        self._poll_lock = threading.Lock()
         self._held_record: Optional[Dict[str, Any]] = None
         self._last_announce: Optional[str] = None
         # per-base fetch plugins, cached across polls (host cache ON:
@@ -130,27 +134,30 @@ class Subscriber:
         head = self._store.read_head()
         if head is None:
             return None
-        held = self._held_record
-        if held is not None and int(head["step"]) == int(held["step"]):
-            return None
-        with obs.span(
-            "publish/poll",
-            root=self.root,
-            step=head["step"],
-            held=None if held is None else held["step"],
-        ):
-            record = self._store.read_record(str(head["record"]))
-            plan = plan_delta(record, held, self._shard_spec)
-            fetched = self._fetch(record, plan)
-            t0 = time.monotonic()
-            gen = self.live.apply(
-                record, plan, fetched, strict=self._strict
-            )
-            apply_s = time.monotonic() - t0
-            self._held_record = record
-            self._account(record, plan, apply_s)
-            self._stamp(record, gen)
-            return gen
+        with self._poll_lock:
+            held = self._held_record
+            if held is not None and int(head["step"]) == int(
+                held["step"]
+            ):
+                return None
+            with obs.span(
+                "publish/poll",
+                root=self.root,
+                step=head["step"],
+                held=None if held is None else held["step"],
+            ):
+                record = self._store.read_record(str(head["record"]))
+                plan = plan_delta(record, held, self._shard_spec)
+                fetched = self._fetch(record, plan)
+                t0 = time.monotonic()
+                gen = self.live.apply(
+                    record, plan, fetched, strict=self._strict
+                )
+                apply_s = time.monotonic() - t0
+                self._held_record = record
+                self._account(record, plan, apply_s)
+                self._stamp(record, gen)
+                return gen
 
     def follow(
         self,
@@ -187,12 +194,14 @@ class Subscriber:
         if self._closed:
             return
         self._closed = True
-        for storage in self._fetch_storage.values():
+        with self._poll_lock:
+            storages = list(self._fetch_storage.values())
+            self._fetch_storage.clear()
+        for storage in storages:
             try:
                 storage.sync_close()
             except Exception as e:  # noqa: BLE001 — teardown
                 obs.swallowed_exception("publish.subscriber.close", e)
-        self._fetch_storage.clear()
         self._store.sync_close()
 
     # ------------------------------------------------------- internals
@@ -211,22 +220,28 @@ class Subscriber:
             # no fast path: the durable poll IS the cadence
             time.sleep(wait_s)
             return
+        # snapshot the poll state under the lock; the blocking watch
+        # itself must NOT hold it (a swap in flight would stall it)
+        with self._poll_lock:
+            held = self._held_record
+            held_step = None if held is None else int(held["step"])
+            last = self._last_announce
         cur = announce_mod.current(self._coordinator, self._ns)
         if cur is not None and (
-            self._held_record is None
-            or cur[0] != int(self._held_record["step"])
+            held_step is None or cur[0] != held_step
         ):
             # already-pending announce: skip the blocking watch
             return
         raw = kv_watch(
             self._coordinator,
             announce_mod.announce_key(self._ns),
-            last=self._last_announce,
+            last=last,
             timeout_s=wait_s,
         )
         if raw is None:
             return
-        self._last_announce = raw
+        with self._poll_lock:
+            self._last_announce = raw
         if announce_mod.parse_announcement(raw) is None:
             # malformed: treat as a plain wake-up; HEAD decides
             return

@@ -147,11 +147,12 @@ class CircuitBreaker:
         with self._lock:
             self._probe_in_flight = False
             self._consecutive_failures += 1
+            failures = self._consecutive_failures
             tripped = (
                 self._state == HALF_OPEN
                 or (
                     self._state == CLOSED
-                    and self._consecutive_failures >= self.threshold
+                    and failures >= self.threshold
                 )
             )
             if tripped:
@@ -163,7 +164,7 @@ class CircuitBreaker:
             logger.warning(
                 "circuit breaker %r tripped open after %d consecutive "
                 "failure(s); failing fast for %.1fs",
-                self.name, self._consecutive_failures, self.cooldown_s,
+                self.name, failures, self.cooldown_s,
             )
 
     def reset(self) -> None:

@@ -45,6 +45,7 @@ import errno as _errno
 import fnmatch
 import logging
 import random
+import re as _re
 import threading
 import zlib
 from typing import List, Optional
@@ -92,17 +93,14 @@ _ERROR_KINDS = {
     "runtime": lambda s: RuntimeError(f"injected failure at {s}"),
 }
 
-# delay<ms>: sleep instead of raise (injected slowness, not failure)
-_DELAY_RE = None  # compiled lazily below
+# delay<ms>: sleep instead of raise (injected slowness, not failure).
+# Compiled eagerly: failpoint() fires from every execution domain, and
+# a lazy compile-on-first-use is a check-then-act on a module global.
+_DELAY_RE = _re.compile(r"delay(\d+)$")
 
 
 def _delay_ms(kind: str):
     """Milliseconds for a ``delay<ms>`` kind, or None for raising kinds."""
-    global _DELAY_RE
-    if _DELAY_RE is None:
-        import re
-
-        _DELAY_RE = re.compile(r"delay(\d+)$")
     m = _DELAY_RE.fullmatch(kind)
     return int(m.group(1)) if m else None
 

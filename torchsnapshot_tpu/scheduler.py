@@ -326,15 +326,20 @@ class PendingIOWork:
         self._executor = executor
         self._stats = stats
         self._completed = False
+        # the caller's sync_complete and the commit thread can both
+        # reach ensure_started: without the lock a deferred pipeline
+        # could be spun up TWICE (two budget admissions, double writes)
+        self._start_lock = threading.Lock()
 
     def ensure_started(self) -> concurrent.futures.Future:
         """Kick off the pipeline if construction deferred it (the
         async_take path defers so the commit thread — not the caller's
         blocked window — pays for pipeline spin-up and the GIL contention
         of the first staging memcpys)."""
-        if self._fut is None:
-            self._fut = self._starter()
-        return self._fut
+        with self._start_lock:
+            if self._fut is None:
+                self._fut = self._starter()
+            return self._fut
 
     def sync_complete(self) -> None:
         if self._completed:

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import os
+import threading
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
@@ -149,6 +150,10 @@ def _fsync_dir_chain(leaf_dir: str, stop_below: str) -> None:
 class FSStoragePlugin(StoragePlugin):
     def __init__(self, root: str) -> None:
         self.root = root
+        # mkdir dedup across the loop's writes and executor legs; the
+        # makedirs itself runs OUTSIDE the lock (exist_ok makes a
+        # concurrent double-create benign, a held lock would not)
+        self._dirs_lock = threading.Lock()
         self._dirs_created: set = set()
         self._lib = None
         if knobs.is_native_ext_enabled():
@@ -204,8 +209,11 @@ class FSStoragePlugin(StoragePlugin):
 
     def _ensure_dir(self, full: str) -> None:
         d = os.path.dirname(full)
-        if d not in self._dirs_created:
-            os.makedirs(d, exist_ok=True)
+        with self._dirs_lock:
+            if d in self._dirs_created:
+                return
+        os.makedirs(d, exist_ok=True)
+        with self._dirs_lock:
             self._dirs_created.add(d)
 
     async def _retry(self, fn, op_name: str, executor=None, breaker=None):

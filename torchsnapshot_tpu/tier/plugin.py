@@ -37,6 +37,7 @@ Construction normally goes through ``url_to_storage_plugin(url,
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Any, Dict, List, Optional
 
 from .. import knobs, obs
@@ -100,7 +101,11 @@ class TieredStoragePlugin(StoragePlugin):
                 f"got {self.policy!r}"
             )
         self.replica_count = int(replica_count)
-        # all ranks' fast roots, indexed by rank (may include our own)
+        # all ranks' fast roots, indexed by rank (may include our own).
+        # Exchanged lazily at finalize_take on the commit thread while
+        # loop-side reads consult it for peer-repair candidates — every
+        # touch goes through _peer_lock
+        self._peer_lock = threading.Lock()
         self._peer_urls = (
             [u.rstrip("/") for u in peer_fast_urls]
             if peer_fast_urls
@@ -397,16 +402,18 @@ class TieredStoragePlugin(StoragePlugin):
         (_pick_replica_targets) may have put the replica on a
         different-slice rank instead of a successor — pruning could
         miss a replica that mere ordering cannot."""
-        peers = [u for u in (self._peer_urls or ()) if u != self.fast_url]
+        with self._peer_lock:
+            peer_urls = self._peer_urls or ()
+        peers = [u for u in peer_urls if u != self.fast_url]
         if len(peers) < 2:
             return peers
         rank_str, _, _rest = path.partition("/")
-        if not rank_str.isdigit() or not self._peer_urls:
+        if not rank_str.isdigit() or not peer_urls:
             return peers
-        n = len(self._peer_urls)
+        n = len(peer_urls)
         writer = int(rank_str) % n
         likely = [
-            self._peer_urls[(writer + d) % n]
+            peer_urls[(writer + d) % n]
             for d in range(0, max(1, self.replica_count) + 1)
         ]
         ordered = [u for u in likely if u in peers]
@@ -487,16 +494,19 @@ class TieredStoragePlugin(StoragePlugin):
            done-handshake needs.
 
         KV-only (explicit keys) — safe from the async commit thread."""
-        peers = self._peer_urls
+        with self._peer_lock:
+            peers = self._peer_urls
         if self.replica_count > 0:
             if peers is None and coordinator.world_size > 1:
+                # the exchange is a collective — strictly outside the lock
                 peers = [
                     u.rstrip("/")
                     for u in coordinator.kv_exchange(
                         f"{uid}/tierfast", self.fast_url
                     )
                 ]
-                self._peer_urls = peers
+                with self._peer_lock:
+                    self._peer_urls = peers
             if peers and len(peers) > 1:
                 rank = (
                     peers.index(self.fast_url)

@@ -39,6 +39,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import threading
 import time
 from typing import Any, Dict, Iterable, Optional, Set
 
@@ -217,7 +218,9 @@ class FanoutReadPlugin(StoragePlugin):
         )
         # (prefix, nparts) of this rank's successful publications, so
         # cleanup_published can reclaim the transient KV blobs after
-        # every slice member is past its reads
+        # every slice member is past its reads.  Reads append on the
+        # loop; cleanup runs on the restore caller — locked handoff
+        self._pub_lock = threading.Lock()
         self._published: list = []
         # the shared locations THIS rank is the designated reader for:
         # the scheduler front-loads these so siblings wait the minimum
@@ -247,7 +250,8 @@ class FanoutReadPlugin(StoragePlugin):
                 self.coordinator, prefix, read_io.buf, path
             )
             if nparts:
-                self._published.append((prefix, nparts))
+                with self._pub_lock:
+                    self._published.append((prefix, nparts))
             return
         data = await fetch_published(
             self.coordinator, prefix, path, knobs.get_fanout_timeout_s()
@@ -275,14 +279,15 @@ class FanoutReadPlugin(StoragePlugin):
         is past its reads by then, so nothing can still be consuming a
         blob.  Best-effort: a failed delete leaks one restore's blobs
         until job teardown, never fails the restore."""
-        for prefix, nparts in self._published:
+        with self._pub_lock:
+            published, self._published = self._published, []
+        for prefix, nparts in published:
             try:
                 self.coordinator.kv_try_delete(f"{prefix}/meta")
                 for i in range(nparts):
                     self.coordinator.kv_try_delete(f"{prefix}/p{i}")
             except Exception as e:  # noqa: BLE001 — best-effort cleanup
                 obs.swallowed_exception("topology.fanout.cleanup", e)
-        self._published = []
 
     # ------------------------------------------------- pass-throughs
 

@@ -1,0 +1,43 @@
+"""Small shared utilities.
+
+``domain_private`` is the concurrency lint's reviewed escape hatch
+(tools/lint: lockset-race / domain-crossing): a class whose instances
+are confined to one execution domain at a time — built, handed through
+a pipeline stage, released, never shared between concurrent flows —
+may keep its fields unlocked, and the decorator records WHY in the
+code next to the class it exempts.  The justification must be a real
+sentence (>= 20 characters); the linter rejects token excuses, and the
+runtime check below keeps the written contract from silently rotting
+into ``@domain_private("")``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["domain_private"]
+
+_MIN_JUSTIFICATION_CHARS = 20  # mirrored in tools/lint/core.py
+
+
+def domain_private(justification: str):
+    """Class decorator: exempt the class's fields from the multi-domain
+    lockset checks, with a written justification.
+
+    Runtime no-op by design — the contract is documentation plus static
+    checking, not enforcement.  The justification lands on the class as
+    ``__domain_private__`` so it is introspectable in a debugger.
+    """
+    if (
+        not isinstance(justification, str)
+        or len(justification.strip()) < _MIN_JUSTIFICATION_CHARS
+    ):
+        raise ValueError(
+            "domain_private needs a written justification of at least "
+            f"{_MIN_JUSTIFICATION_CHARS} characters saying why the "
+            "class is single-domain"
+        )
+
+    def _apply(cls):
+        cls.__domain_private__ = justification
+        return cls
+
+    return _apply

@@ -10,7 +10,14 @@ from __future__ import annotations
 import io
 from typing import Optional
 
+from . import domain_private
 
+
+@domain_private(
+    "a stream instance is owned by exactly one upload call at a time: "
+    "the SDK that reads it never shares a cursor across threads, so "
+    "_pos needs no lock"
+)
 class MemoryviewStream(io.RawIOBase):
     def __init__(self, view) -> None:
         self._view = memoryview(view).cast("B")

@@ -1504,3 +1504,277 @@ def test_chaos_subscriber_dies_mid_apply_next_poll_reapplies(tmp_path):
         assert rc == 0, f"rank {rank} failed:\n{out}"
     assert "PUB-OK" in results[0][1]
     assert "SUB-OK" in results[1][1]
+
+
+# ============================================ rank-death scenarios
+#
+# The fleet-survival contract (resilience/liveness.py, snapshot.py
+# takeover): a rank that DIES (SIGKILL / OOM, never reaching its
+# poison call) is detected by frozen heartbeat stamps within
+# LIVENESS_TIMEOUT_S; the survivors take over its replicated writes,
+# commit the snapshot with its exclusively-held paths declared in the
+# metadata's ``degraded`` section, and the result is restorable on
+# every surviving view, repairable from continuous peer mirrors, and
+# never torn or wedged.
+
+_LIVENESS_ENV = {
+    "TORCHSNAPSHOT_TPU_LIVENESS_TIMEOUT_S": "2",
+    "TORCHSNAPSHOT_TPU_LIVENESS_INTERVAL_S": "0.2",
+}
+
+
+def _mirror_leaf(root, lpath, arr):
+    """A continuous peer-RAM mirror holding one leaf for a dead rank —
+    the healing source SnapshotManager.repair() reads."""
+    from torchsnapshot_tpu.cas.store import chunk_key, chunk_location
+    from torchsnapshot_tpu.continuous.store import (
+        ContinuousStore,
+        encode_head,
+        encode_leaf,
+        encode_step_manifest,
+    )
+    from torchsnapshot_tpu.utils.checksums import adler32_fast, crc32_fast
+
+    store = ContinuousStore(root)
+    try:
+        rec, view = encode_leaf(arr)
+        key = chunk_key((crc32_fast(view), adler32_fast(view), view.nbytes))
+        store.storage.sync_write(
+            WriteIO(path=chunk_location(key), buf=bytes(view))
+        )
+        rec["keys"] = [key]
+        store.write_manifest(1, encode_step_manifest(1, 1 << 20, {lpath: rec}))
+        store.write_head(encode_head(1))
+    finally:
+        store.sync_close()
+
+
+def test_chaos_rank_death_mid_take_survivor_commits_then_repairs(tmp_path):
+    """THE takeover acceptance: rank 1 is killed at the very start of
+    the commit phase (os._exit — no poison, no cleanup).  Rank 0 must
+    detect the death via liveness, take over the dead rank's replicated
+    writes, and commit with only the dead rank's PRIVATE state declared
+    degraded — within the liveness window plus takeover grace, with
+    metadata that parses cleanly, restores of intact paths working, and
+    no wedge.  Afterwards the degraded path heals from a continuous
+    peer mirror (the self-heal half of the contract)."""
+    body = r"""
+    import time
+    if rank == 1:
+        # SIGKILL stand-in: die at the start of the commit phase,
+        # before contributing CRCs — peers only see frozen stamps
+        import torchsnapshot_tpu.snapshot as snap_mod
+
+        def bomb(*a, **k):
+            os._exit(9)
+
+        snap_mod._crc_payload = bomb
+    state = {"app": StateDict(
+        w=np.arange(64, dtype=np.float32) + rank,   # per-rank private
+        shared=np.full(32, 7.0),                    # replicated
+        big=np.arange(128, dtype=np.float64),       # replicated
+    )}
+    t0 = time.monotonic()
+    snap = Snapshot.take(
+        snap_dir, state, replicated=["app/shared", "app/big"],
+        coordinator=coord,
+    )
+    wall = time.monotonic() - t0
+    # liveness detection + takeover + degraded commit — never the
+    # 600s barrier deadline
+    assert wall < 60.0, f"degraded commit took {wall:.1f}s"
+    md = snap.metadata
+    # ONLY the dead rank's private state is lost; replicated objects
+    # were re-written by the survivor
+    assert sorted(md.degraded) == ["app/w"], md.degraded
+    assert md.degraded["app/w"]["origin_rank"] == 1
+    from torchsnapshot_tpu import obs
+    assert obs.counter(obs.TAKEOVER_DEGRADED_COMMITS).value >= 1
+    # not torn: a fresh open parses the committed marker
+    md2 = Snapshot(snap_dir).metadata
+    assert sorted(md2.degraded) == ["app/w"]
+    # restores of intact paths proceed on the survivor
+    from torchsnapshot_tpu.coordination import LocalCoordinator
+    s2 = {"app": StateDict(w=np.zeros(64, np.float32),
+                           shared=np.zeros(32), big=np.zeros(128))}
+    Snapshot(snap_dir, coordinator=LocalCoordinator()).restore(s2)
+    assert (s2["app"]["shared"] == 7.0).all(), "takeover bytes wrong"
+    assert (s2["app"]["big"] == np.arange(128)).all(), "takeover bytes wrong"
+    assert (s2["app"]["w"] == np.arange(64, dtype=np.float32)).all()
+    # the dead rank's view reports the loss; the survivor's is clean
+    from torchsnapshot_tpu.verify import verify_snapshot
+    res1 = verify_snapshot(Snapshot(snap_dir), deep=True, rank=1)
+    assert res1.ok and not res1.complete, str(res1)
+    assert res1.degraded == ["app/w"], res1.degraded
+    res0 = verify_snapshot(Snapshot(snap_dir), deep=True, rank=0)
+    assert res0.ok and res0.degraded == [], str(res0)
+    print(f"rank {rank} DEATH-CHAOS-OK")
+    """
+    t0 = time.monotonic()
+    results = _launch_chaos_workers(
+        tmp_path, body, env_per_rank=[_LIVENESS_ENV, _LIVENESS_ENV]
+    )
+    assert time.monotonic() - t0 < 90
+    rc0, out0 = results[0]
+    assert rc0 == 0, f"survivor failed:\n{out0}"
+    assert "rank 0 DEATH-CHAOS-OK" in out0
+    assert results[1][0] == 9, "rank 1 must have died at the bomb"
+
+    # --- self-heal: repair the degraded path from a peer mirror -------
+    from torchsnapshot_tpu.verify import verify_snapshot
+
+    snap_dir = os.path.join(str(tmp_path), "snap")
+    host_root = os.path.join(str(tmp_path), "cont")
+    _mirror_leaf(
+        os.path.join(host_root, "r1"),
+        "app/w",
+        np.arange(64, dtype=np.float32) + 1,
+    )
+    assert Snapshot(snap_dir).repair_degraded([host_root]) == ["app/w"]
+    healed = Snapshot(snap_dir)
+    assert not healed.metadata.degraded
+    res1 = verify_snapshot(healed, deep=True, rank=1)
+    assert res1.ok and res1.complete, str(res1)
+
+
+def test_chaos_tier_promotion_dead_peer_in_done_handshake_marker_lands(
+    tmp_path,
+):
+    """A peer killed between its data-promotion copy and its done-key:
+    the commit job must not wedge on the handshake — it skips the dead
+    peer via liveness, re-proves every manifest location is durable-
+    resident (the copies DID land), and the durable marker still lands."""
+    body = r"""
+    import time
+    from torchsnapshot_tpu import obs
+    from torchsnapshot_tpu.tier.promoter import (
+        drain_promotions, get_promoter,
+    )
+
+    fast = os.path.join(snap_dir, "fast")
+    durable = os.path.join(snap_dir, "durable")
+    opts = {"tier": {"fast_url": fast, "policy": "write_back"}}
+    state = {"app": StateDict(w=np.arange(256, dtype=np.float32) + rank)}
+    promoter = get_promoter()
+    promoter.pause()  # hold the jobs until the kill is armed
+    Snapshot.take(durable, state, coordinator=coord, storage_options=opts)
+    if rank == 1:
+        # die between the data copy and the done-key: the durable
+        # payload landed, the handshake never hears about it
+        real_kv_set = coord.kv_set
+
+        def dying_kv_set(key, value, *a, **kw):
+            if "/tierdone/" in key:
+                os._exit(9)
+            return real_kv_set(key, value, *a, **kw)
+
+        coord.kv_set = dying_kv_set
+    promoter.resume()
+    t0 = time.monotonic()
+    drain_promotions()
+    wall = time.monotonic() - t0
+    assert rank == 0, "rank 1 must have died inside the done-handshake"
+    assert wall < 60.0, f"done-handshake wedged for {wall:.1f}s"
+    assert obs.counter(obs.TAKEOVER_PROMOTER_DEAD_PEERS).value >= 1
+    # the marker still landed ...
+    assert os.path.exists(os.path.join(durable, ".snapshot_metadata"))
+    # ... and rightly so: EVERY rank's payload is durable-resident
+    from torchsnapshot_tpu.verify import verify_snapshot
+    for r in range(world):
+        res = verify_snapshot(Snapshot(durable), deep=True, rank=r)
+        assert res.ok and res.complete, f"rank {r} view: {res}"
+    print(f"rank {rank} TIER-DEATH-OK")
+    """
+    t0 = time.monotonic()
+    results = _launch_chaos_workers(
+        tmp_path, body, env_per_rank=[_LIVENESS_ENV, _LIVENESS_ENV]
+    )
+    assert time.monotonic() - t0 < 90
+    rc0, out0 = results[0]
+    assert rc0 == 0, f"rank 0 failed:\n{out0}"
+    assert "rank 0 TIER-DEATH-OK" in out0
+    assert results[1][0] == 9, "rank 1 must have died at the done-key"
+
+
+def test_chaos_fanout_dead_reader_alternate_takes_over_publishing(tmp_path):
+    """THE re-election acceptance: the designated reader dies before
+    reading; the NEXT candidate in the stable failover order re-reads
+    and RE-PUBLISHES, so the remaining sibling is served from the
+    takeover publication instead of stampeding the durable tier — one
+    per-object fallback fleet-wide, one extra durable GET."""
+    store_root = os.path.join(str(tmp_path), "objs")
+    os.makedirs(store_root, exist_ok=True)
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+
+    seed_plugin = FSStoragePlugin(root=store_root)
+    seed_plugin.sync_write(
+        WriteIO(
+            path="replicated/l0",
+            buf=np.arange(1024, dtype=np.float32).tobytes(),
+        )
+    )
+    seed_plugin.sync_close()
+
+    body = r"""
+    import json
+    import numpy as _np
+    from torchsnapshot_tpu import obs
+    from torchsnapshot_tpu.io_types import ReadIO
+    from torchsnapshot_tpu.storage.fs import FSStoragePlugin
+    from torchsnapshot_tpu.topology import FanoutReadPlugin, Topology
+
+    topo = Topology.from_spec("0,0,0", rank=rank, world_size=world)
+    cands = topo.reader_candidates("replicated/l0")
+    if rank == cands[0]:
+        os._exit(17)  # the designated reader died before reading
+    plugin = FanoutReadPlugin(
+        FSStoragePlugin(root=""" + repr(store_root) + r"""),
+        coord, topo, "fantakeover", ["replicated/l0"],
+    )
+    io = ReadIO(path="replicated/l0")
+    plugin.sync_read(io)
+    got = _np.frombuffer(bytes(memoryview(io.buf).cast("B")), _np.float32)
+    assert _np.array_equal(got, _np.arange(1024, dtype=_np.float32))
+    c = obs.metrics_snapshot()["counters"]
+    print("FANOUT " + json.dumps({
+        "rank": rank,
+        "fallbacks": c.get("topology.fanout_fallbacks", 0),
+        "durable": c.get("topology.fanout_durable_reads", 0),
+    }))
+    print(f"rank {rank} CHAOS-OK")
+    """
+    env = {"TORCHSNAPSHOT_TPU_FANOUT_TIMEOUT_S": "1"}
+    t0 = time.monotonic()
+    results = _launch_chaos_workers(
+        tmp_path, body, [env, env, env], world=3
+    )
+    assert time.monotonic() - t0 < 90
+    import json as _json
+
+    from torchsnapshot_tpu.topology import Topology
+
+    cands = Topology.from_spec(
+        "0,0,0", rank=0, world_size=3
+    ).reader_candidates("replicated/l0")
+    stats = {}
+    for r, (rc, out) in enumerate(results):
+        if r == cands[0]:
+            assert rc == 17, f"dead designated reader exited rc={rc}"
+            continue
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} CHAOS-OK" in out
+        stats[r] = next(
+            _json.loads(line[len("FANOUT "):])
+            for line in out.splitlines()
+            if line.startswith("FANOUT ")
+        )
+    alternate, third = cands[1], cands[2]
+    # the alternate counted exactly ONE fallback for the object (per-
+    # object counting, not per-wave) and issued the one takeover read
+    assert stats[alternate] == {
+        "rank": alternate, "fallbacks": 1, "durable": 1,
+    }
+    # the remaining sibling was served from the takeover publication:
+    # zero direct reads, zero fallbacks — no stampede
+    assert stats[third]["durable"] == 0
+    assert stats[third]["fallbacks"] == 0

@@ -239,3 +239,93 @@ def test_doctor_reports_publish_counters(tmp_path, capsys):
     assert c["publish.records"] >= 2
     assert c["publish.subscriber_swaps"] >= 2
     assert c["publish.subscriber_bytes_fetched"] >= 4096 * 4
+
+
+# ---------------------------------------------- liveness / takeover
+
+
+def test_doctor_renders_liveness_takeover_rows(tmp_path, capsys):
+    """Rank death leaves its trace in the flight record: doctor leads
+    with the liveness/takeover rows (with --json parity) so an incident
+    review sees "who died, what was taken over, what was lost" without
+    re-running anything."""
+    path = _take(tmp_path)
+    before = aggregate.capture()
+    obs.counter(obs.LIVENESS_HEARTBEATS).inc(12)
+    obs.counter(obs.LIVENESS_DEAD_RANKS).inc()
+    obs.counter(obs.TAKEOVER_OBJECTS).inc(2)
+    obs.counter(obs.TAKEOVER_BYTES).inc(4096)
+    obs.counter(obs.TAKEOVER_DEGRADED_COMMITS).inc()
+    obs.counter(obs.TAKEOVER_PROMOTER_DEAD_PEERS).inc()
+    obs.counter(obs.TAKEOVER_PATHS_REPAIRED).inc(3)
+    payload = aggregate.rank_payload(0, "take", before)
+    record = aggregate.merge_payloads([payload], "take", path, 1)
+    rec_path = os.path.join(path, aggregate.OBSRECORD_FNAME)
+    with open(rec_path, "wb") as f:
+        f.write(aggregate.encode_record(record))
+    assert main(["doctor", path]) == 0
+    out = capsys.readouterr().out
+    assert "liveness: 1 rank death(s) observed (12 heartbeats)" in out
+    assert "takeover:" in out
+    assert "2 objects re-written by survivors" in out
+    assert "1 degraded commit(s)" in out
+    assert "1 dead peer(s) skipped during tier promotion" in out
+    assert "3 path(s) repaired" in out
+    assert main(["doctor", path, "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    c = rec["merged"]["counters"]
+    assert c["liveness.dead_ranks"] == 1
+    assert c["takeover.objects"] == 2
+    assert c["takeover.bytes"] == 4096
+    assert c["takeover.degraded_commits"] == 1
+    assert c["takeover.promoter_dead_peers"] == 1
+    assert c["takeover.paths_repaired"] == 3
+
+
+def test_doctor_without_deaths_renders_no_liveness_rows(tmp_path, capsys):
+    path = _take(tmp_path)
+    assert main(["doctor", path]) == 0
+    out = capsys.readouterr().out
+    assert "liveness:" not in out
+    assert "takeover:" not in out
+
+
+def test_stats_renders_degraded_rows_with_json_parity(tmp_path, capsys):
+    """A degraded snapshot's stats lead with the loss: which logical
+    paths are gone and which dead rank held them (--json parity for
+    dashboards)."""
+    from torchsnapshot_tpu.io_types import WriteIO
+    from torchsnapshot_tpu.storage import url_to_storage_plugin
+
+    path = _take(tmp_path)
+    snap = Snapshot(path)
+    md = snap.metadata
+    md.degraded["m/x"] = {"origin_rank": 1}
+    storage = url_to_storage_plugin(path)
+    try:
+        storage.sync_write(
+            WriteIO(
+                path=".snapshot_metadata",
+                buf=md.to_yaml().encode(),
+                durable=True,
+            )
+        )
+    finally:
+        storage.sync_close()
+    assert main(["stats", path]) == 0
+    out = capsys.readouterr().out
+    assert "DEGRADED: 1 path(s) lost to rank death" in out
+    assert "m/x  (origin rank 1)" in out
+    assert main(["stats", path, "--json"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["degraded"] == {"m/x": 1}
+
+
+def test_stats_intact_snapshot_has_empty_degraded(tmp_path, capsys):
+    path = _take(tmp_path)
+    assert main(["stats", path, "--json"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["degraded"] == {}
+    capsys.readouterr()
+    assert main(["stats", path]) == 0
+    assert "DEGRADED" not in capsys.readouterr().out

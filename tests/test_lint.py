@@ -1970,6 +1970,41 @@ def test_kv_hygiene_plain_uid_kv_set_needs_no_delete():
     assert findings == []
 
 
+def test_kv_hygiene_liveness_session_shape_clean():
+    """The liveness publisher's exact shape (resilience/liveness.py): a
+    self-attribute-namespaced heartbeat stamp paired with the session's
+    own ``stop()`` delete in the same module is sanctioned — the stamp
+    key never outlives a clean exit."""
+    findings = _run(
+        "kv-hygiene",
+        """
+        class Session:
+            def _publish_loop(self, coord, seq):
+                coord.kv_set(f"{self._ns}/hb/{coord.rank}", str(seq))
+
+            def stop(self, coord):
+                coord.kv_try_delete(f"{self._ns}/hb/{coord.rank}")
+        """,
+    )
+    assert findings == []
+
+
+def test_kv_hygiene_takeover_recovery_keys_exempt():
+    """The commit-recovery protocol's control keys (takeover plans,
+    CRC re-exchange, commit acks) are uid-namespaced one-shot keys
+    consumed by waiters — no delete pairing required."""
+    findings = _run(
+        "kv-hygiene",
+        """
+        def recover(coord, uid, rank, plan, crcs):
+            coord.kv_set(f"{uid}/takeover/plan/{rank}", plan)
+            coord.kv_set(f"{uid}/takeover/crcs/{rank}", crcs)
+            coord.kv_set(f"{uid}/takeover/commit/{rank}", "ok")
+        """,
+    )
+    assert findings == []
+
+
 def test_kv_hygiene_scoped_to_package():
     findings = _run(
         "kv-hygiene",

@@ -853,6 +853,40 @@ def test_lockstep_marker_before_sync_flagged_and_after_sync_clean():
     assert _run("protocol-lockstep", clean) == []
 
 
+def test_lockstep_takeover_recovery_explicit_keys_clean():
+    """The commit-recovery protocol (snapshot.py write takeover) is
+    deliberately ASYMMETRIC: an elected leader writes explicit plan and
+    commit keys, survivors read them, and elected writers re-write the
+    dead rank's objects under rank-conditional branches.  Explicit-key
+    kv_set/kv_get are not collectives, so lockstep must stay silent —
+    this is the sanctioned shape for protocols that cannot be SPMD
+    because some ranks are dead."""
+    findings = _run(
+        "protocol-lockstep",
+        {
+            "torchsnapshot_tpu/recover.py": """
+            def recover(coord, uid, dead, plan):
+                live = [
+                    r for r in range(coord.world_size) if r not in dead
+                ]
+                leader = live[0]
+                if coord.rank == leader:
+                    coord.kv_set(f"{uid}/takeover/plan/{leader}", plan)
+                else:
+                    plan = coord.kv_get(f"{uid}/takeover/plan/{leader}")
+                for path, writer in sorted(plan.items()):
+                    if writer == coord.rank:
+                        coord.kv_set(f"{uid}/takeover/done/{path}", "ok")
+                if coord.rank == leader:
+                    coord.kv_set(f"{uid}/takeover/commit/{leader}", "ok")
+                else:
+                    coord.kv_get(f"{uid}/takeover/commit/{leader}")
+            """,
+        },
+    )
+    assert findings == []
+
+
 def test_lockstep_marker_synced_in_caller_clean():
     # the sync point and the marker live in DIFFERENT functions: the
     # entry-point projection must see the barrier before the call

@@ -1,0 +1,362 @@
+"""Rank liveness, write takeover election, and degraded-commit units.
+
+The chaos suite (test_chaos.py) proves the end-to-end contract — a
+SIGKILLed writer mid-take still yields a committed (possibly degraded)
+snapshot.  This file pins the building blocks in isolation: heartbeat
+stamp lifecycle, the frozen-stamp and opt-in absence death rules,
+death-aware KV waits and barriers, the ``hang`` failpoint kind, the
+deterministic takeover election, and the degraded manifest section's
+restore/verify/repair semantics.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+from torchsnapshot_tpu.coordination import FileCoordinator
+from torchsnapshot_tpu.io_types import WriteIO
+from torchsnapshot_tpu.resilience.liveness import (
+    DegradedSnapshotError,
+    LivenessMonitor,
+    LivenessSession,
+    RankDeadError,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fast_liveness():
+    """Sub-second liveness windows so death verdicts land in test time."""
+    with knobs.override_liveness_timeout_s(0.5):
+        with knobs.override_liveness_interval_s(0.05):
+            yield
+
+
+def _coord(tmp_path, rank=0, world=2):
+    return FileCoordinator(str(tmp_path / "kv"), rank, world)
+
+
+def _wait_for(predicate, timeout_s=10.0, tick_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(tick_s)
+    return predicate()
+
+
+# ------------------------------------------------- heartbeat sessions
+
+
+def test_session_stamps_advancing_seq_and_deletes_on_stop(tmp_path):
+    coord = _coord(tmp_path)
+    session = LivenessSession(coord, "op0").start()
+    try:
+        assert _wait_for(lambda: coord.kv_try_get("op0/hb/0") is not None)
+        first = int(coord.kv_try_get("op0/hb/0"))
+        # a live publisher keeps ADVANCING the sequence, not re-stamping
+        assert _wait_for(
+            lambda: int(coord.kv_try_get("op0/hb/0") or first) > first
+        )
+    finally:
+        session.stop()
+    # clean exit leaves no stamp: absence stays ambiguous, never a
+    # frozen-stamp death signature
+    assert coord.kv_try_get("op0/hb/0") is None
+
+
+def test_session_is_noop_in_single_rank_world(tmp_path):
+    coord = _coord(tmp_path, world=1)
+    session = LivenessSession(coord, "solo").start()
+    session.stop()
+    assert coord.kv_try_get("solo/hb/0") is None
+
+
+# ----------------------------------------------------- death verdicts
+
+
+def test_monitor_declares_frozen_stamp_dead_once(tmp_path):
+    coord = _coord(tmp_path)
+    coord.kv_set("op1/hb/1", "42")  # present but never advancing
+    monitor = LivenessMonitor(coord, "op1")
+    deaths0 = obs.counter(obs.LIVENESS_DEAD_RANKS).value
+    assert _wait_for(lambda: monitor.dead_ranks() == [1])
+    # repeated polls re-report the same verdict but count it once
+    assert monitor.dead_ranks() == [1]
+    assert obs.counter(obs.LIVENESS_DEAD_RANKS).value == deaths0 + 1
+    with pytest.raises(RankDeadError) as ei:
+        monitor.check()
+    assert ei.value.rank == 1
+    assert ei.value.dead_ranks == [1]
+    assert ei.value.ns == "op1"
+
+
+def test_monitor_advancing_stamp_is_never_dead(tmp_path):
+    """A SLOW peer that keeps stamping is never declared dead — the
+    rule is frozen progress, not elapsed wall clock."""
+    observer = _coord(tmp_path, rank=0)
+    peer = _coord(tmp_path, rank=1)
+    session = LivenessSession(peer, "op2").start()
+    try:
+        monitor = LivenessMonitor(observer, "op2")
+        deadline = time.monotonic() + 3 * knobs.get_liveness_timeout_s()
+        while time.monotonic() < deadline:
+            assert monitor.dead_ranks() == []
+            time.sleep(0.05)
+    finally:
+        session.stop()
+
+
+def test_monitor_absence_rule_is_opt_in(tmp_path):
+    coord = _coord(tmp_path)  # rank 1 never stamps under this ns
+    ambiguous = LivenessMonitor(coord, "op3")
+    strict = LivenessMonitor(coord, "op3", absent_after_s=0.3)
+    assert _wait_for(lambda: strict.dead_ranks() == [1])
+    # the default monitor treats absence as ambiguous forever (the peer
+    # may simply have finished and deleted its stamp)
+    assert ambiguous.dead_ranks() == []
+
+
+# ------------------------------------------------- death-aware waits
+
+
+def test_kv_get_raises_rank_dead_inside_liveness_scope(tmp_path):
+    coord = _coord(tmp_path)
+    coord.kv_set("op4/hb/1", "7")  # frozen: rank 1 is dead
+    monitor = LivenessMonitor(coord, "op4")
+    assert coord.dead_ranks() == []  # no scope, no death evidence
+    t0 = time.monotonic()
+    with coord.liveness_scope(monitor):
+        with pytest.raises(RankDeadError):
+            coord.kv_get("op4/never-set", timeout_s=60.0)
+        assert coord.dead_ranks() == [1]
+    # the death verdict cut the wait short — nowhere near the deadline
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_barrier_raises_rank_dead_inside_liveness_scope(tmp_path):
+    coord = _coord(tmp_path)
+    coord.kv_set("op5/hb/1", "7")
+    monitor = LivenessMonitor(coord, "op5")
+    t0 = time.monotonic()
+    with coord.liveness_scope(monitor):
+        with pytest.raises(RankDeadError):
+            coord.barrier("op5-bar", timeout_s=60.0)
+    assert time.monotonic() - t0 < 30.0
+
+
+# ------------------------------------------------- hang failpoint kind
+
+
+def test_failpoint_hang_parks_until_release():
+    from torchsnapshot_tpu.resilience.failpoints import (
+        failpoint,
+        release_hangs,
+    )
+
+    done = threading.Event()
+
+    def target():
+        failpoint("coord.kv_get", key="hung-key")
+        done.set()
+
+    with knobs.override_failpoints("coord.kv_get=hang"):
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        assert not done.wait(0.3), "hang failpoint did not park the thread"
+        release_hangs()
+        assert done.wait(10.0), "release_hangs() did not free the thread"
+        t.join(timeout=5.0)
+
+
+# ------------------------------------------------- takeover election
+
+
+def test_elect_takeover_writers_deterministic_and_least_loaded():
+    from torchsnapshot_tpu.partitioner import elect_takeover_writers
+
+    orphans = [("a", 100), ("b", 300), ("c", 50)]
+    w1 = elect_takeover_writers(orphans, [1], world_size=4)
+    w2 = elect_takeover_writers(list(reversed(orphans)), [1], world_size=4)
+    assert w1 == w2, "election must not depend on input order"
+    assert set(w1) == {"a", "b", "c"}
+    assert 1 not in w1.values(), "a dead rank can never be elected"
+    # greedy largest-first over loads spreads the orphans
+    assert len(set(w1.values())) == 3
+    with pytest.raises(ValueError):
+        elect_takeover_writers(orphans, [0, 1], world_size=2)
+
+
+def test_elect_takeover_writers_prefers_dead_writers_slice():
+    from torchsnapshot_tpu.partitioner import elect_takeover_writers
+    from torchsnapshot_tpu.topology import Topology
+
+    topo = Topology.from_spec("0,0,1,1", rank=0, world_size=4)
+    writers = elect_takeover_writers(
+        [("a", 100)],
+        [3],
+        world_size=4,
+        topology=topo,
+        origin_of={"a": 3},
+    )
+    # rank 2 shares the dead writer's slice: the re-write egresses over
+    # the uplink the original partition budgeted for
+    assert writers == {"a": 2}
+
+
+# ---------------------------------------- degraded commits: semantics
+
+
+def _forge_degraded(tmp_path, origin_rank=0, drop_payload=False):
+    """A committed single-rank snapshot whose ``app/w`` is marked lost
+    to ``origin_rank`` — the on-disk shape a degraded commit leaves."""
+    path = str(tmp_path / "snap")
+    state = {
+        "app": StateDict(
+            w=np.arange(8, dtype=np.float32),
+            b=np.ones(4, dtype=np.float32),
+        )
+    }
+    with knobs.override_disable_batching(True):
+        snap = Snapshot.take(path, state)
+    md = snap.metadata
+    md.degraded["app/w"] = {"origin_rank": origin_rank}
+    if drop_payload:
+        import os
+
+        loc = md.manifest["0/app/w"].location
+        os.remove(os.path.join(path, loc))
+    from torchsnapshot_tpu.storage import url_to_storage_plugin
+
+    storage = url_to_storage_plugin(path)
+    try:
+        storage.sync_write(
+            WriteIO(
+                path=".snapshot_metadata",
+                buf=md.to_yaml().encode(),
+                durable=True,
+            )
+        )
+    finally:
+        storage.sync_close()
+    return path
+
+
+def test_degraded_restore_blocks_origin_rank_and_raises_typed(tmp_path):
+    path = _forge_degraded(tmp_path, origin_rank=0)
+    dest = {
+        "app": StateDict(
+            w=np.zeros(8, np.float32), b=np.zeros(4, np.float32)
+        )
+    }
+    with pytest.raises(DegradedSnapshotError) as ei:
+        Snapshot(path).restore(dest)
+    assert ei.value.degraded_paths == ["app/w"]
+    assert "restore(paths=" in str(ei.value)
+    # intact paths restore fine on the same degraded snapshot
+    dest = {"app": StateDict(b=np.zeros(4, np.float32))}
+    Snapshot(path).restore(dest, paths=["app/b"])
+    np.testing.assert_array_equal(dest["app"]["b"], np.ones(4, np.float32))
+
+
+def test_degraded_other_ranks_private_loss_does_not_block(tmp_path):
+    """A degraded path that was another rank's PRIVATE state blocks
+    only that rank's view — this rank restores everything it owns."""
+    path = _forge_degraded(tmp_path, origin_rank=1)
+    dest = {
+        "app": StateDict(
+            w=np.zeros(8, np.float32), b=np.zeros(4, np.float32)
+        )
+    }
+    Snapshot(path).restore(dest)
+    np.testing.assert_array_equal(
+        dest["app"]["w"], np.arange(8, dtype=np.float32)
+    )
+
+
+def test_verify_reports_degraded_separately_from_missing(tmp_path):
+    from torchsnapshot_tpu.verify import verify_snapshot
+
+    path = _forge_degraded(tmp_path, origin_rank=0, drop_payload=True)
+    res = verify_snapshot(Snapshot(path), deep=True, rank=0)
+    # the lost payload is DECLARED, so the audit still passes — but the
+    # result distinguishes ok (no corruption) from complete (no loss)
+    assert res.ok, str(res)
+    assert not res.complete
+    assert res.degraded == ["app/w"]
+    assert res.missing == []
+    assert "degraded" in str(res)
+
+
+# ---------------------------------------- degraded commits: repair
+
+
+def _mirror_leaf(root, lpath, arr):
+    """A continuous peer-RAM mirror holding one leaf — what survivors'
+    continuous stores hold for a dead rank."""
+    from torchsnapshot_tpu.cas.store import chunk_key, chunk_location
+    from torchsnapshot_tpu.continuous.store import (
+        ContinuousStore,
+        encode_head,
+        encode_leaf,
+        encode_step_manifest,
+    )
+    from torchsnapshot_tpu.utils.checksums import adler32_fast, crc32_fast
+
+    store = ContinuousStore(root)
+    try:
+        rec, view = encode_leaf(arr)
+        key = chunk_key(
+            (crc32_fast(view), adler32_fast(view), view.nbytes)
+        )
+        store.storage.sync_write(
+            WriteIO(path=chunk_location(key), buf=bytes(view))
+        )
+        rec["keys"] = [key]
+        store.write_manifest(
+            1, encode_step_manifest(1, 1 << 20, {lpath: rec})
+        )
+        store.write_head(encode_head(1))
+    finally:
+        store.sync_close()
+
+
+def test_repair_degraded_heals_from_continuous_mirror(tmp_path):
+    from torchsnapshot_tpu.verify import verify_snapshot
+
+    path = _forge_degraded(tmp_path, origin_rank=0, drop_payload=True)
+    host_root = str(tmp_path / "cont")
+    _mirror_leaf(
+        host_root + "/r0", "app/w", np.arange(8, dtype=np.float32)
+    )
+    repaired0 = obs.counter(obs.TAKEOVER_PATHS_REPAIRED).value
+    snap = Snapshot(path)
+    assert snap.repair_degraded([host_root]) == ["app/w"]
+    assert (
+        obs.counter(obs.TAKEOVER_PATHS_REPAIRED).value == repaired0 + 1
+    )
+    # a FRESH open sees a complete snapshot: the marker rewrite was the
+    # last step, so the heal is atomic at the metadata level
+    healed = Snapshot(path)
+    assert not healed.metadata.degraded
+    res = verify_snapshot(healed, deep=True, rank=0)
+    assert res.ok and res.complete, str(res)
+    dest = {
+        "app": StateDict(
+            w=np.zeros(8, np.float32), b=np.zeros(4, np.float32)
+        )
+    }
+    healed.restore(dest)
+    np.testing.assert_array_equal(
+        dest["app"]["w"], np.arange(8, dtype=np.float32)
+    )
+
+
+def test_repair_degraded_without_usable_source_is_a_noop(tmp_path):
+    path = _forge_degraded(tmp_path, origin_rank=0, drop_payload=True)
+    snap = Snapshot(path)
+    assert snap.repair_degraded([str(tmp_path / "no-such-mirror")]) == []
+    # still degraded: a failed repair never clears the declaration
+    assert sorted(Snapshot(path).metadata.degraded) == ["app/w"]

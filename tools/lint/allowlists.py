@@ -60,6 +60,37 @@ ALLOWLIST: Tuple[Allow, ...] = (
     ),
     Allow(
         pass_id="retry-discipline",
+        file="torchsnapshot_tpu/snapshot.py",
+        context="_recovery_kv_get",
+        justification=(
+            "The takeover recovery protocol's KV wait: a fixed-interval "
+            "existence poll (kv_try_get never raises into the loop; an "
+            "absent key is the wait's normal pending state), same "
+            "primitive shape as FileCoordinator._kv_get_impl.  It "
+            "cannot route through the scoped Coordinator.kv_get because "
+            "that wait re-raises RankDeadError on the ALREADY-dead set "
+            "the recovery is recovering FROM — this loop's whole job is "
+            "to keep waiting through known deaths and raise only on NEW "
+            "ones, which it checks each tick via the monitor."
+        ),
+    ),
+    Allow(
+        pass_id="retry-discipline",
+        file="torchsnapshot_tpu/tier/promoter.py",
+        context="Promoter._await_done_keys",
+        justification=(
+            "The tier done-handshake wait: a fixed-interval existence "
+            "poll of each rank's done-key (kv_try_get never raises into "
+            "the loop; absence is the normal pending state while the "
+            "peer's copy job runs).  resilience.retry wraps ops that "
+            "FAIL transiently and would cap the wrong budget here; this "
+            "loop's exits are its own protocol facts — key landed, "
+            "poison observed, peer declared dead by the liveness "
+            "monitor, or the handshake deadline."
+        ),
+    ),
+    Allow(
+        pass_id="retry-discipline",
         file="torchsnapshot_tpu/obs/aggregate.py",
         context="collect_and_merge",
         justification=(
@@ -194,6 +225,25 @@ ALLOWLIST: Tuple[Allow, ...] = (
             "a miss that takes the locked slow path and re-checks.  "
             "Guarding the read would put a lock acquisition on every "
             "chunk of every snapshot for zero safety gain."
+        ),
+    ),
+    Allow(
+        pass_id="protocol-lockstep",
+        file="torchsnapshot_tpu/snapshot.py",
+        context="Snapshot._repair_degraded_impl",
+        justification=(
+            "Degraded-snapshot repair is a deliberately SINGLE-PROCESS "
+            "ops tool (SnapshotManager.repair gates it to rank 0; the "
+            "dead rank it heals is by definition not running): it "
+            "re-writes lost payloads from continuous-store mirrors and "
+            "then rewrites the already-committed marker strictly last, "
+            "with no fleet to synchronize with.  The pass's "
+            "sync-point-before-marker rule guards COLLECTIVE commits; "
+            "requiring one here would force a barrier into a recovery "
+            "path that must work precisely when peers are gone.  "
+            "Crash-safety holds without it: the marker write is atomic "
+            "and a crash mid-repair leaves the previous still-committed "
+            "(still-degraded) marker in place."
         ),
     ),
     Allow(

@@ -511,6 +511,12 @@ def _cmd_stats(args) -> int:
         "cas": _cas_stats_rollup(snap),
         "cache": _cache_stats_rollup(),
         "topology": _topology_stats_rollup(args.path),
+        "degraded": {
+            p: d.get("origin_rank")
+            for p, d in sorted(
+                (getattr(metadata, "degraded", None) or {}).items()
+            )
+        },
     }
     if args.json:
         print(json.dumps(stats, indent=2))
@@ -550,6 +556,13 @@ def _cmd_stats(args) -> int:
     _render_cas_stats(stats["cas"])
     _render_cache_stats(stats["cache"])
     _render_topology_rollup(stats["topology"])
+    if stats["degraded"]:
+        print(
+            f"  DEGRADED: {len(stats['degraded'])} path(s) lost to rank "
+            "death (re-take or `SnapshotManager.repair()` to heal):"
+        )
+        for p, origin in stats["degraded"].items():
+            print(f"    {p}  (origin rank {origin})")
     print(f"  largest {len(largest)}:")
     width = max((len(p) for p, _ in largest), default=10)
     for p, st in largest:
@@ -653,6 +666,13 @@ def _doctor_counters(record) -> dict:
             "publish.announce_failures", 0
         ),
         "exceptions_swallowed": c.get("exceptions.swallowed", 0),
+        "liveness_heartbeats": c.get("liveness.heartbeats", 0),
+        "dead_ranks_observed": c.get("liveness.dead_ranks", 0),
+        "takeover_objects": c.get("takeover.objects", 0),
+        "takeover_bytes": c.get("takeover.bytes", 0),
+        "degraded_commits": c.get("takeover.degraded_commits", 0),
+        "takeover_paths_repaired": c.get("takeover.paths_repaired", 0),
+        "promoter_dead_peers": c.get("takeover.promoter_dead_peers", 0),
     }
 
 
@@ -829,6 +849,34 @@ def _render_doctor(record) -> None:
         )
     if c["mmap_reads"]:
         print(f"  mmap: {c['mmap_reads']} zero-copy reads")
+    if (
+        c["dead_ranks_observed"]
+        or c["takeover_objects"]
+        or c["degraded_commits"]
+        or c["promoter_dead_peers"]
+        or c["takeover_paths_repaired"]
+    ):
+        print(
+            f"  liveness: {c['dead_ranks_observed']} rank death(s) "
+            f"observed ({c['liveness_heartbeats']} heartbeats)"
+        )
+        parts = []
+        if c["takeover_objects"]:
+            parts.append(
+                f"{c['takeover_objects']} objects re-written by "
+                f"survivors ({_human(c['takeover_bytes'])})"
+            )
+        if c["degraded_commits"]:
+            parts.append(f"{c['degraded_commits']} degraded commit(s)")
+        if c["promoter_dead_peers"]:
+            parts.append(
+                f"{c['promoter_dead_peers']} dead peer(s) skipped "
+                "during tier promotion"
+            )
+        if c["takeover_paths_repaired"]:
+            parts.append(f"{c['takeover_paths_repaired']} path(s) repaired")
+        if parts:
+            print("  takeover: " + ", ".join(parts))
     if c["publish_records"] or c["publish_sub_swaps"]:
         line = (
             f"  publish: {c['publish_records']} records "

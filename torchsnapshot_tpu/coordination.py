@@ -95,12 +95,15 @@ class Coordinator(abc.ABC):
 
     def kv_get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> str:
         """Blocking get: waits until the key exists.  Abort-aware inside
-        an ``abort_scope``."""
+        an ``abort_scope``; death-aware inside a ``liveness_scope``
+        (raises ``RankDeadError`` when a peer's heartbeat goes stale
+        instead of waiting out the full deadline)."""
         failpoint("coord.kv_get", key=key)
         scope = self._current_abort_scope()
-        if scope is None:
+        monitor = self._current_liveness()
+        if scope is None and monitor is None:
             return self._kv_get_impl(key, timeout_s)
-        return self._abortable_kv_get(key, timeout_s, scope)
+        return self._polling_kv_get(key, timeout_s, scope, monitor)
 
     def barrier(
         self, name: Optional[str] = None, timeout_s: float = _DEFAULT_TIMEOUT_S
@@ -127,10 +130,14 @@ class Coordinator(abc.ABC):
 
     def _barrier_inner(self, name: str, timeout_s: float) -> None:
         scope = self._current_abort_scope()
-        if scope is None:
+        monitor = self._current_liveness()
+        if scope is None and monitor is None:
             self._barrier_impl(name, timeout_s)
             return
-        self.raise_if_poisoned(scope)
+        if scope is not None:
+            self.raise_if_poisoned(scope)
+        if monitor is not None:
+            monitor.check()
         if self.world_size == 1:
             return
         # one deadline for the WHOLE barrier (matching the native
@@ -204,9 +211,21 @@ class Coordinator(abc.ABC):
     def _abortable_kv_get(
         self, key: str, timeout_s: float, scope: str
     ) -> str:
+        return self._polling_kv_get(key, timeout_s, scope, None)
+
+    def _polling_kv_get(
+        self, key: str, timeout_s: float, scope: Optional[str], monitor: Any
+    ) -> str:
+        """The shared short-poll wait: between probes it checks the
+        abort scope's poison key and/or the liveness monitor, so a
+        peer's failure (poison) or death (stale heartbeat) surfaces as
+        a typed error within one poll interval."""
         deadline = time.monotonic() + timeout_s
         while True:
-            self.raise_if_poisoned(scope)
+            if scope is not None:
+                self.raise_if_poisoned(scope)
+            if monitor is not None:
+                monitor.check()
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(
@@ -220,6 +239,34 @@ class Coordinator(abc.ABC):
             except Exception as e:  # noqa: BLE001 — timeouts poll on
                 if not _is_timeoutish(e):
                     raise
+
+    # ---- rank liveness (resilience/liveness.py) ------------------------
+
+    def _current_liveness(self) -> Any:
+        tls = self.__dict__.get("_liveness_tls")
+        return getattr(tls, "monitor", None) if tls is not None else None
+
+    @contextlib.contextmanager
+    def liveness_scope(self, monitor: Any) -> Iterator[None]:
+        """While active, this THREAD's kv_get/barrier waits check
+        ``monitor`` (a ``resilience.liveness.LivenessMonitor``) each
+        poll tick and raise ``RankDeadError`` when a peer's heartbeat
+        stamp goes stale — per-thread for the same reason as
+        ``abort_scope``."""
+        tls = self.__dict__.setdefault("_liveness_tls", threading.local())
+        prev = getattr(tls, "monitor", None)
+        tls.monitor = monitor
+        try:
+            yield
+        finally:
+            tls.monitor = prev
+
+    def dead_ranks(self) -> list:
+        """Peers the current thread's liveness monitor considers dead
+        (empty outside a ``liveness_scope`` — without heartbeats there
+        is no death evidence)."""
+        monitor = self._current_liveness()
+        return monitor.dead_ranks() if monitor is not None else []
 
     # ---- derived object-level ops --------------------------------------
 

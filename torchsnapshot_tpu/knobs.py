@@ -76,6 +76,9 @@ _FASTIO_BUFFER_POOL_BYTES = "FASTIO_BUFFER_POOL_BYTES"
 _PUBLISH_POLL_S = "PUBLISH_POLL_S"
 _PUBLISH_ANNOUNCE = "PUBLISH_ANNOUNCE"
 _PUBLISH_RETAIN = "PUBLISH_RETAIN"
+_LIVENESS_TIMEOUT_S = "LIVENESS_TIMEOUT_S"
+_LIVENESS_INTERVAL_S = "LIVENESS_INTERVAL_S"
+_TAKEOVER = "TAKEOVER"
 
 _DEFAULTS = {
     # Arrays larger than this are chunked along dim 0 for pipelined I/O
@@ -403,6 +406,24 @@ _DEFAULTS = {
     # publish).  A subscriber holding an older step than the retention
     # window simply takes a fuller delta against the newest record.
     _PUBLISH_RETAIN: 4,
+    # Rank liveness (resilience/liveness.py): a peer whose op-scoped
+    # heartbeat stamp stops advancing for longer than this is declared
+    # dead — death-aware waits raise RankDeadError(rank) instead of
+    # sitting out the full coordination deadline, and the take path
+    # starts write takeover / degraded commit.  Must be comfortably
+    # larger than LIVENESS_INTERVAL_S plus worst-case KV latency and GC
+    # pauses; too small fabricates deaths, too large just delays
+    # recovery (never corrupts — a falsely-declared rank that comes
+    # back finds the scope poisoned and aborts cleanly).
+    _LIVENESS_TIMEOUT_S: 30.0,
+    # Heartbeat publication cadence (and the monitor's sampling floor).
+    _LIVENESS_INTERVAL_S: 1.0,
+    # Write takeover: 1 (default) = when a writer rank dies mid-take,
+    # survivors re-write its replicated partition from their own copies
+    # and commit (complete, or typed-degraded for sharded-only loss).
+    # 0 = classic abort-the-world on rank death (RankDeadError
+    # propagates and the take fails).
+    _TAKEOVER: 1,
 }
 
 _OVERRIDES: dict = {}
@@ -790,6 +811,23 @@ def get_publish_retain() -> int:
     return max(1, _get_int(_PUBLISH_RETAIN))
 
 
+def get_liveness_timeout_s() -> float:
+    """Seconds of frozen heartbeat stamp before a peer rank is declared
+    dead (see _LIVENESS_TIMEOUT_S above)."""
+    return max(0.1, float(_get_raw(_LIVENESS_TIMEOUT_S)))
+
+
+def get_liveness_interval_s() -> float:
+    """Heartbeat publication / monitor sampling cadence in seconds."""
+    return max(0.01, float(_get_raw(_LIVENESS_INTERVAL_S)))
+
+
+def takeover_enabled() -> bool:
+    """Whether survivors take over a dead writer's partition and commit
+    instead of aborting the take (see _TAKEOVER above)."""
+    return bool(_get_int(_TAKEOVER))
+
+
 def fastio_enabled() -> bool:
     """Native fast-I/O engine master switch (see _FASTIO above); the
     engine additionally requires the native ext to load with the part
@@ -1053,6 +1091,18 @@ def override_publish_announce(value: bool):
 
 def override_publish_retain(value: int):
     return _override(_PUBLISH_RETAIN, value)
+
+
+def override_liveness_timeout_s(value: float):
+    return _override(_LIVENESS_TIMEOUT_S, value)
+
+
+def override_liveness_interval_s(value: float):
+    return _override(_LIVENESS_INTERVAL_S, value)
+
+
+def override_takeover(value: bool):
+    return _override(_TAKEOVER, int(value))
 
 
 def override_fastio(value: bool):

@@ -758,6 +758,46 @@ class SnapshotManager:
                 [self.path_for_step(s) for s in steps],
             )
 
+    def repair(
+        self,
+        sources: Sequence[str],
+        step: Optional[int] = None,
+    ) -> Dict[int, List[str]]:
+        """Heal degraded committed snapshots from continuous peer
+        stores (``Snapshot.repair_degraded`` — a take that survived a
+        rank death may have committed with a ``degraded`` manifest
+        section for state only the dead rank held).  ``sources``:
+        continuous host roots holding per-rank ``r<d>`` mirrors.
+        ``step`` limits the sweep to one step; default = every
+        committed step still carrying a degraded section.  Rank-0
+        discipline like gc.  Returns ``{step: repaired paths}``.
+
+        Note the other healing path needs no call at all: the NEXT
+        committed save is complete by construction, so under retention
+        a degraded step simply ages out."""
+        with log_event(Event("manager_repair", {"root": self.root})):
+            if self._coord.rank != 0:
+                return {}
+            committed = self._committed()
+            targets = (
+                [step]
+                if step is not None
+                else sorted(committed)
+            )
+            out: Dict[int, List[str]] = {}
+            for s in targets:
+                snap = committed.get(s) or self.snapshot(s)
+                try:
+                    degraded = getattr(snap.metadata, "degraded", None)
+                except Exception:  # noqa: BLE001 — unreadable: skip
+                    continue
+                if not degraded:
+                    continue
+                repaired = snap.repair_degraded(sources)
+                if repaired:
+                    out[s] = repaired
+            return out
+
     def _apply_retention(self, committed: Dict[int, Snapshot]) -> None:
         if self.keep_last_n is None:
             return

@@ -430,6 +430,17 @@ class SnapshotMetadata:
     # and deep-verify stay bitwise-identical.  ABSENT key ⇒ pre-CAS
     # snapshot: every read goes through the unchanged per-step path.
     cas: Dict[str, Any] = field(default_factory=dict)
+    # Degraded-commit record (resilience/liveness.py + the take path's
+    # write takeover): logical path → {"origin_rank": <dead rank>,
+    # "kind": <entry type>} for state only a rank that DIED mid-take
+    # held (per-rank/sharded payloads that no survivor could re-write).
+    # The snapshot is committed and restorable for every other path;
+    # restores touching a listed path raise a typed
+    # DegradedSnapshotError, verify/doctor/stats surface the set, and
+    # repair (Snapshot.repair_degraded / SnapshotManager.repair) or the
+    # next take removes entries as they heal.  ABSENT key ⇒ a complete
+    # snapshot — the invariant every pre-liveness snapshot satisfies.
+    degraded: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
     def to_json(self) -> str:
         d = {
@@ -443,6 +454,8 @@ class SnapshotMetadata:
             d["codecs"] = self.codecs
         if self.cas:
             d["cas"] = self.cas
+        if self.degraded:
+            d["degraded"] = self.degraded
         return json.dumps(d, sort_keys=True)
 
     # JSON is a YAML subset; emit JSON for speed, accept YAML on read
@@ -492,6 +505,11 @@ class SnapshotMetadata:
             cas=(
                 dict(d["cas"]) if isinstance(d.get("cas"), dict) else {}
             ),
+            degraded={
+                k: dict(v)
+                for k, v in (d.get("degraded") or {}).items()
+                if isinstance(v, dict)
+            },
         )
 
     from_json = from_yaml

@@ -89,6 +89,8 @@ from .metrics import (  # noqa: F401
     GC_BYTES_RECLAIMED,
     IO_QUEUE_DEPTH,
     LATENCY_BUCKETS_S,
+    LIVENESS_DEAD_RANKS,
+    LIVENESS_HEARTBEATS,
     PROMOTION_LAG_S,
     REGISTRY,
     RESILIENCE_ABORTS,
@@ -113,6 +115,11 @@ from .metrics import (  # noqa: F401
     TIER_FAST_MISSES,
     TIER_FAST_REPAIRS,
     TIER_PEER_HITS,
+    TAKEOVER_OBJECTS,
+    TAKEOVER_BYTES,
+    TAKEOVER_DEGRADED_COMMITS,
+    TAKEOVER_PATHS_REPAIRED,
+    TAKEOVER_PROMOTER_DEAD_PEERS,
     TOPOLOGY_SLICES,
     TOPOLOGY_REPLICATED_OBJECTS_WRITTEN,
     TOPOLOGY_REPLICATED_BYTES_WRITTEN,

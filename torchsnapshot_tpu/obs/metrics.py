@@ -254,6 +254,22 @@ RESILIENCE_ABORTS = "resilience.aborts"
 RESILIENCE_FAILPOINTS_FIRED = "resilience.failpoints_fired"
 RESILIENCE_BREAKER_TRIPS = "resilience.breaker_trips"
 RESILIENCE_BACKOFF_DELAY_S = "resilience.backoff_delay_s"
+# Rank liveness + write takeover (resilience/liveness.py,
+# snapshot take recovery): heartbeat stamps published, peer ranks
+# declared dead (stamp frozen past LIVENESS_TIMEOUT_S — each rank
+# counts its own observations), replicated objects/bytes a survivor
+# re-wrote on behalf of a dead writer, commits that landed with a
+# `degraded` manifest section (sharded-only loss), degraded paths
+# healed back to complete (next take / SnapshotManager.repair), and
+# dead peers the tier promoter's done-handshake skipped instead of
+# wedging on.
+LIVENESS_HEARTBEATS = "liveness.heartbeats"
+LIVENESS_DEAD_RANKS = "liveness.dead_ranks"
+TAKEOVER_OBJECTS = "takeover.objects"
+TAKEOVER_BYTES = "takeover.bytes"
+TAKEOVER_DEGRADED_COMMITS = "takeover.degraded_commits"
+TAKEOVER_PATHS_REPAIRED = "takeover.paths_repaired"
+TAKEOVER_PROMOTER_DEAD_PEERS = "takeover.promoter_dead_peers"
 # Exception hygiene (tools/lint exception-hygiene pass): every
 # deliberate broad-except swallow on a fallback path increments this
 # via obs.swallowed_exception, so "how often are we falling back" is a

@@ -96,3 +96,59 @@ def partition_replicated_writes(
         assignment[path] = writer
         charge(writer, nbytes)
     return assignment
+
+
+def elect_takeover_writers(
+    orphans: Sequence[Tuple[str, int]],
+    dead_ranks: Sequence[int],
+    world_size: int,
+    preloads: Sequence[int] = (),
+    topology: Optional[object] = None,
+    origin_of: Optional[Dict[str, int]] = None,
+) -> Dict[str, int]:
+    """Re-assign a dead writer's replicated objects to live ranks.
+
+    Pure and deterministic, like ``partition_replicated_writes`` — every
+    survivor computes the identical election locally from the shared
+    dead set, so takeover needs no extra collectives (the recovery
+    protocol only agrees on WHO is dead, not on who writes what).
+
+    ``orphans``: (logical_path, nbytes) whose elected writer died.
+    ``origin_of``: optional path → dead writer rank — with a topology,
+    a live rank in the dead writer's SLICE is preferred (the re-write
+    egresses over the uplink the original partition budgeted for,
+    instead of adding load to an unrelated slice's DCN), then the usual
+    slice → host → rank load order among the rest.  Greedy largest-first
+    over post-partition loads; ties by rank.
+    """
+    dead = set(dead_ranks)
+    live = [r for r in range(world_size) if r not in dead]
+    if not live:
+        raise ValueError("takeover election with zero live ranks")
+    loads: List[int] = list(preloads) if preloads else [0] * world_size
+    if len(loads) != world_size:
+        raise ValueError(f"preloads len {len(loads)} != world_size {world_size}")
+    explicit = topology is not None and getattr(topology, "explicit", False)
+    if explicit:
+        base_key, charge = _topology_chooser(topology, loads)
+    else:
+        def base_key(r: int):
+            return (loads[r], r)
+
+        def charge(r: int, nbytes: int) -> None:
+            loads[r] += nbytes
+
+    assignment: Dict[str, int] = {}
+    for path, nbytes in sorted(orphans, key=lambda kv: (-kv[1], kv[0])):
+        origin = (origin_of or {}).get(path)
+        if explicit and origin is not None:
+            dead_slice = topology.slice_of[origin]
+
+            def key(r: int):
+                return (topology.slice_of[r] != dead_slice,) + base_key(r)
+        else:
+            key = base_key
+        writer = min(live, key=key)
+        assignment[path] = writer
+        charge(writer, nbytes)
+    return assignment

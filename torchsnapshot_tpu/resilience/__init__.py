@@ -23,6 +23,11 @@ Four cooperating pieces (docs/resilience.md):
   drains (the continuous checkpoint loop's in-flight replication)
   finish inside a bounded grace window before the signal is
   re-delivered and the process exits as before.
+- **liveness** — op-scoped rank heartbeats and dead-rank detection:
+  a SIGKILLed/hung peer (which can never reach its ``poison`` call)
+  surfaces as a typed ``RankDeadError`` within ``LIVENESS_TIMEOUT_S``
+  via death-aware coordinator waits, enabling the take path's write
+  takeover and degraded commits instead of abort-the-world.
 
 Everything emits obs metrics (``resilience.retries``,
 ``resilience.aborts``, ``resilience.failpoints_fired``,
@@ -50,6 +55,13 @@ from .failpoints import (  # noqa: F401
     failpoint,
     parse_failpoints,
     refresh_from_knobs as refresh_failpoints,
+    release_hangs,
+)
+from .liveness import (  # noqa: F401
+    DegradedSnapshotError,
+    LivenessMonitor,
+    LivenessSession,
+    RankDeadError,
 )
 from .preemption import (  # noqa: F401
     notify_preemption,
@@ -88,6 +100,11 @@ __all__ = [
     "failpoint",
     "parse_failpoints",
     "refresh_failpoints",
+    "release_hangs",
+    "RankDeadError",
+    "DegradedSnapshotError",
+    "LivenessMonitor",
+    "LivenessSession",
     "SharedProgress",
     "retry_call",
     "classify_fs",

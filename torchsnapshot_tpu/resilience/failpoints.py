@@ -36,6 +36,12 @@ Beyond the raising kinds, ``delay<ms>`` (e.g. ``delay250``) SLEEPS at
 the site instead of raising — deterministic injected slowness for
 straggler-attribution tests, where the flight record must name the
 delayed rank and phase without any failure in the run.
+
+``hang`` blocks FOREVER at the site (until ``release_hangs()``) — the
+in-process stand-in for a SIGKILLed/wedged rank: the hung thread never
+raises, never poisons, and its peers only escape via the liveness
+layer's stale-heartbeat detection (resilience/liveness.py).  Dead-rank
+scenarios become injectable without real process kills.
 """
 
 from __future__ import annotations
@@ -105,6 +111,28 @@ def _delay_ms(kind: str):
     return int(m.group(1)) if m else None
 
 
+# hang: the thread parks on this event at the site — a simulated dead
+# rank.  release_hangs() frees every parked thread (test teardown).
+_HANG_RELEASE = threading.Event()
+
+
+def release_hangs() -> None:
+    """Release every thread currently parked at a ``hang`` failpoint
+    (and any that reach one before the armed set is next refreshed) —
+    call from test/bench teardown so simulated-dead threads can be
+    joined instead of leaking."""
+    global _HANG_RELEASE
+    # re-arm FIRST so a thread racing into failpoint() parks on the new
+    # event only if it reads it after this swap; then free the parked
+    # set.  The swap shares _LOCK with the parking read, so a parker
+    # observes either the old event (whose set() below frees it) or the
+    # re-armed one — never a torn intermediate.
+    with _LOCK:
+        old = _HANG_RELEASE
+        _HANG_RELEASE = threading.Event()
+    old.set()
+
+
 @dataclasses.dataclass
 class _Armed:
     pattern: str
@@ -134,10 +162,14 @@ def parse_failpoints(spec: str, seed: int = 0) -> List[_Armed]:
         site, _, rhs = raw.partition("=")
         parts = rhs.split(":")
         kind = parts[0].strip().lower()
-        if kind not in _ERROR_KINDS and _delay_ms(kind) is None:
+        if (
+            kind not in _ERROR_KINDS
+            and kind != "hang"
+            and _delay_ms(kind) is None
+        ):
             raise ValueError(
                 f"failpoint spec {raw!r}: unknown error kind {kind!r} "
-                f"(known: {sorted(_ERROR_KINDS)} or delay<ms>)"
+                f"(known: {sorted(_ERROR_KINDS)}, hang, or delay<ms>)"
             )
         probability = 1.0
         if len(parts) > 1 and parts[1].strip():
@@ -216,6 +248,19 @@ def failpoint(site: str, **attrs) -> None:
             if fp.remaining is not None:
                 fp.remaining -= 1
         obs.counter(obs.RESILIENCE_FAILPOINTS_FIRED).inc()
+        if fp.kind == "hang":
+            # simulated dead rank: park until release_hangs().  Snapshot
+            # the event BEFORE logging (under the lock release_hangs
+            # swaps it beneath) so a concurrent swap can't strand us on
+            # the re-armed event forever.
+            with _LOCK:
+                ev = _HANG_RELEASE
+            logger.info(
+                "failpoint %s hanging at %s (%s) until release_hangs()",
+                fp.pattern, site, attrs,
+            )
+            ev.wait()
+            continue
         ms = _delay_ms(fp.kind)
         if ms is not None:
             # injected slowness: sleep and keep evaluating the remaining

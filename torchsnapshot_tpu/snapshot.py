@@ -23,8 +23,12 @@ snapshot.py:112-1072).  The orchestration mirrors the reference call stacks
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
+import json
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import knobs, obs
@@ -46,7 +50,7 @@ from .manifest import (
     is_container_entry,
 )
 from .manifest_ops import consolidate_manifests, get_manifest_for_rank
-from .partitioner import partition_replicated_writes
+from .partitioner import elect_takeover_writers, partition_replicated_writes
 from .preparers import (
     estimate_write_bytes,
     path_is_replicated,
@@ -55,6 +59,11 @@ from .preparers import (
 )
 from .preparers.sharded import is_multi_device_jax_array
 from .resilience import SnapshotAbortedError
+from .resilience.liveness import (
+    DegradedSnapshotError,
+    LivenessSession,
+    RankDeadError,
+)
 from .serialization import serialize_object
 from .scheduler import (
     PendingIOWork,
@@ -476,6 +485,362 @@ def _cas_commit_refs(
             store.sync_close()
 
 
+# ------------------------------------------------------------- takeover
+# Surviving rank death mid-commit (docs/resilience.md, "surviving rank
+# death").  The liveness layer (resilience/liveness.py) turns a
+# SIGKILLed/hung peer into a typed RankDeadError at the commit path's
+# death-aware waits; the machinery below then finishes the commit
+# WITHOUT the dead rank: survivors re-write its replicated objects from
+# their own copies (every rank planned write reqs for every replicated
+# object and normally discards the non-elected ones), and sharded state
+# only the dead rank held is recorded in the metadata's ``degraded``
+# section instead of failing the take.
+
+_RECOVERY_POLL_S = 0.1
+# recovery's own wait bound — generous, because survivors may be
+# re-staging and re-writing the dead rank's replicated objects while
+# their peers wait on the takeover keys
+_RECOVERY_TIMEOUT_S = 600.0
+
+
+@dataclasses.dataclass
+class _TakeoverContext:
+    """Planning-time facts the commit path keeps so survivors can finish
+    a take after a peer dies mid-commit.  Every field is either
+    rank-agreed (topo/preloads/assignment/repl_items/gathered_manifests
+    — pure functions of gathered inputs) or rank-local write material
+    (repl_reqs/repl_chunk_reqs: the un-elected write reqs this rank
+    planned and would normally discard; exactly what a takeover writer
+    replays).  ``repl_entries`` are the UNBATCHED entry objects captured
+    before non-writers drop theirs and before batching re-points the
+    writer's at rank-local slabs — their ``replicated/`` locations are
+    rank-independent, so a survivor's re-write lands where the manifest
+    fix-up says it does."""
+
+    topo: Any
+    preloads: List[int]
+    assignment: Dict[str, int]
+    repl_reqs: Dict[str, List[WriteReq]]
+    repl_chunk_reqs: Dict[str, WriteReq]
+    chunk_parent: Dict[str, str]
+    repl_items: List[Tuple[str, int]]
+    repl_entries: Dict[str, Entry]
+    gathered_manifests: List[Dict[str, Any]]
+
+
+def _recovery_kv_get(
+    coordinator: Coordinator,
+    monitor: Any,
+    key: str,
+    expected_dead: set,
+    timeout_s: float = _RECOVERY_TIMEOUT_S,
+) -> str:
+    """A KV wait for the recovery protocol itself: the ranks in
+    ``expected_dead`` STAY dead (the liveness monitor keeps reporting
+    them), so only NEW deaths raise — a scoped ``kv_get`` would re-raise
+    on the known-dead set forever."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        value = coordinator.kv_try_get(key)
+        if value is not None:
+            return value
+        newly = [r for r in monitor.dead_ranks() if r not in expected_dead]
+        if newly:
+            raise RankDeadError(
+                newly[0],
+                set(newly) | set(expected_dead),
+                ns=getattr(monitor, "ns", ""),
+            )
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"takeover recovery timed out after {timeout_s}s "
+                f"waiting for {key!r}"
+            )
+        time.sleep(_RECOVERY_POLL_S)
+
+
+def _recover_commit_after_death(
+    *,
+    coordinator: Coordinator,
+    commit_uid: str,
+    path: str,
+    metadata: SnapshotMetadata,
+    storage: Any,
+    local_entries: Dict[str, Entry],
+    object_crcs: Dict[str, Any],
+    object_codecs: Dict[str, Any],
+    object_cas: Dict[str, Any],
+    cas_store: Any,
+    ctx: _TakeoverContext,
+    monitor: Any,
+    dead_err: RankDeadError,
+    already_committed: bool = False,
+) -> SnapshotMetadata:
+    """Finish a take's commit after ``dead_err`` declared peer rank(s)
+    dead.  Runs OUTSIDE the abort/liveness scopes (they would re-raise
+    on the known-dead set); all cross-rank traffic is explicit-key KV —
+    no collectives, no uid minting — so survivors' op counters stay
+    aligned for whatever runs next.
+
+    Protocol: (1) agree on the dead set via a leader-published plan,
+    (2) deterministically re-elect writers for the dead ranks' orphaned
+    replicated objects (``elect_takeover_writers`` — pure, so no extra
+    agreement round), (3) takeover writers replay their kept
+    un-elected write reqs, (4) every survivor applies the same manifest
+    fix-up and computes the same degraded set, (5) checksums re-exchange
+    under takeover keys, (6) the leader writes the metadata marker
+    (with a ``degraded`` section when sharded state died with its only
+    holder) and signals commit.
+
+    ``already_committed``: rank 0 had already written the marker when
+    death surfaced (a peer died between the two commit barriers) — the
+    snapshot is complete; the leader skips the rewrite and just drives
+    the protocol so survivors converge.
+    """
+    rank, world = coordinator.rank, coordinator.world_size
+    my_dead = set(dead_err.dead_ranks or [dead_err.rank])
+    logger.warning(
+        "rank %d: peer rank(s) %s declared dead during commit %s; "
+        "entering write takeover", rank, sorted(my_dead), commit_uid,
+    )
+
+    # --- agree on the dead set -----------------------------------------
+    # Survivors can observe death at different times (or observe
+    # different sets).  The lowest live rank in MY view is my leader
+    # candidate; it publishes an authoritative plan under a
+    # LEADER-SUFFIXED key.  If the candidate itself turns out dead while
+    # we wait, fold the new deaths in and re-elect — the dead set
+    # strictly grows, so at most ``world`` rounds.
+    plan_dead: Optional[List[int]] = None
+    for _ in range(world):
+        live = [r for r in range(world) if r not in my_dead]
+        if not live:
+            raise RuntimeError(
+                f"takeover for {commit_uid}: every rank is in the dead "
+                f"set {sorted(my_dead)}"
+            )
+        candidate = live[0]
+        plan_key = f"{commit_uid}/takeover/plan/{candidate}"
+        if candidate == rank:
+            coordinator.kv_set(plan_key, json.dumps(sorted(my_dead)))
+            plan_dead = sorted(my_dead)
+            break
+        try:
+            plan_dead = json.loads(
+                _recovery_kv_get(coordinator, monitor, plan_key, my_dead)
+            )
+            break
+        except RankDeadError as e:
+            my_dead |= set(e.dead_ranks or [e.rank])
+    if plan_dead is None:
+        raise RuntimeError(
+            f"takeover for {commit_uid}: no live leader converged"
+        )
+    dead = set(plan_dead)
+    if rank in dead:
+        # the fleet declared US dead (our heartbeats stalled past the
+        # timeout) and has moved on; our writes may have been taken
+        # over — refuse to race the survivors
+        raise RuntimeError(
+            f"rank {rank} was declared dead by the takeover plan for "
+            f"{commit_uid}; aborting locally"
+        )
+    live = [r for r in range(world) if r not in dead]
+    leader = live[0]
+
+    # --- re-elect writers for the orphaned replicated objects ----------
+    # Pure + deterministic (same dead set in → same election out), so
+    # every survivor computes who writes what with zero extra traffic.
+    orphans: List[Tuple[str, int]] = []
+    origin_of: Dict[str, int] = {}
+    for k, nbytes in ctx.repl_items:
+        w = ctx.assignment.get(k)
+        if w in dead:
+            orphans.append((k, nbytes))
+            origin_of[k] = w
+    takeover: Dict[str, int] = {}
+    if orphans:
+        takeover = elect_takeover_writers(
+            orphans, sorted(dead), world,
+            preloads=ctx.preloads, topology=ctx.topo, origin_of=origin_of,
+        )
+
+    # --- replay my taken-over write reqs -------------------------------
+    mine = sorted(k for k, w in takeover.items() if w == rank)
+    taken_paths: set = set()
+    for k in mine:
+        taken_paths.add(ctx.chunk_parent.get(k, k))
+    if mine and not already_committed:
+        reqs: List[WriteReq] = []
+        for k in mine:
+            if k in ctx.repl_reqs:
+                reqs.extend(ctx.repl_reqs[k])
+            else:
+                reqs.append(ctx.repl_chunk_reqs[k])
+        cost_of = dict(ctx.repl_items)
+        my_bytes = sum(cost_of.get(k, 0) for k in mine)
+        # digest/codec sinks were only attached to the originally-elected
+        # writer's reqs; the replayed ones need their own so the objects
+        # table and codec frame tables cover the re-written copies.
+        # (No ``wr.cas``: taken-over payloads are written plain at their
+        # locations even under a cas take — a location absent from the
+        # chunk tables reads through the plain path.)
+        cksum = knobs.write_checksums_enabled()
+        for wr in reqs:
+            def _codec_sink(table: dict, wr=wr) -> None:
+                object_codecs[wr.path] = table
+
+            wr.codec_sink = _codec_sink
+            if cksum:
+                def _object_sink(digest: List[int], wr=wr) -> None:
+                    wr.object_digest = tuple(digest)
+                    object_crcs[wr.path] = list(digest)
+
+                wr.digest_sink = _object_sink
+        logger.warning(
+            "rank %d: taking over %d replicated write unit(s) "
+            "(%d bytes) from dead rank(s) %s",
+            rank, len(mine), my_bytes, sorted(dead),
+        )
+        sync_execute_write_reqs(
+            reqs, storage, get_process_memory_budget_bytes(), rank,
+        ).sync_complete()
+        obs.counter(obs.TAKEOVER_OBJECTS).inc(len(mine))
+        obs.counter(obs.TAKEOVER_BYTES).inc(my_bytes)
+
+    # --- manifest fix-up + degraded set (identical on every survivor) --
+    degraded: Dict[str, Dict[str, Any]] = {}
+    if not already_committed:
+        for k in sorted(takeover):
+            w = takeover[k]
+            lp = ctx.chunk_parent.get(k, k)
+            # consolidation kept each replicated entry under ONE rank; if
+            # that carrier died, re-home the UNBATCHED entry under the new
+            # writer (the dead carrier's copy may point at a slab it never
+            # finished).  A live carrier (e.g. a surviving chunk-writer of
+            # a split entry) keeps carrying it — only dead keys move.
+            removed = False
+            for d in sorted(dead):
+                if metadata.manifest.pop(f"{d}/{lp}", None) is not None:
+                    removed = True
+            carried = any(f"{r}/{lp}" in metadata.manifest for r in live)
+            if removed or not carried:
+                entry = ctx.repl_entries.get(lp)
+                if entry is not None:
+                    metadata.manifest.setdefault(f"{w}/{lp}", entry)
+        # state only the dead rank held: everything in its gathered
+        # manifest except containers, in-manifest primitives and the
+        # replicated paths just taken over.  Conservative — payloads the
+        # dead rank DID land before dying are still marked (we cannot
+        # know), and verify/repair heal the marker afterwards.  The dead
+        # rank's manifest keys stay: repair and partial restores need
+        # the shapes and locations.
+        taken_over_lps = {ctx.chunk_parent.get(k, k) for k in takeover}
+        for d in sorted(dead):
+            per_rank = (
+                ctx.gathered_manifests[d]
+                if d < len(ctx.gathered_manifests)
+                else {}
+            )
+            for lp, ed in per_rank.items():
+                if lp in taken_over_lps:
+                    continue
+                try:
+                    entry = entry_from_dict(ed)
+                except Exception:  # noqa: BLE001
+                    continue
+                if is_container_entry(entry) or isinstance(
+                    entry, PrimitiveEntry
+                ):
+                    continue
+                degraded.setdefault(
+                    lp,
+                    {
+                        "origin_rank": d,
+                        "kind": getattr(entry, "type", "?"),
+                    },
+                )
+
+    # --- checksum re-exchange among survivors --------------------------
+    # The normal all_gather would block on the dead rank; explicit
+    # takeover keys carry the same _crc_payload JSON instead.  Taken-over
+    # entries ride each writer's payload (their staging sinks fired on
+    # the captured unbatched entry objects during the replay above).
+    aug_entries = dict(local_entries)
+    for lp in taken_paths:
+        e = ctx.repl_entries.get(lp)
+        if e is not None:
+            aug_entries[lp] = e
+    payload = _crc_payload(
+        aug_entries, object_crcs, object_codecs, object_cas
+    )
+    coordinator.kv_set(
+        f"{commit_uid}/takeover/crcs/{rank}", json.dumps(payload)
+    )
+    payloads: List[Dict[str, Any]] = []
+    for r in live:
+        if r == rank:
+            payloads.append(payload)
+            continue
+        # fast path first: a peer that published before us costs one
+        # try-get instead of entering the death-aware poll loop
+        raw = coordinator.kv_try_get(f"{commit_uid}/takeover/crcs/{r}")
+        if raw is None:
+            raw = _recovery_kv_get(
+                coordinator, monitor,
+                f"{commit_uid}/takeover/crcs/{r}", dead,
+            )
+        payloads.append(json.loads(raw))
+    if not already_committed:
+        _merge_crc_payloads(metadata, payloads)
+        if degraded:
+            metadata.degraded = dict(degraded)
+
+    # --- leader commits and signals ------------------------------------
+    commit_key = f"{commit_uid}/takeover/commit/{leader}"
+    if rank == leader:
+        try:
+            if not already_committed:
+                # same invariants as the clean path: never commit a
+                # poisoned take, chunk refs strictly before the marker
+                coordinator.raise_if_poisoned(commit_uid)
+                _cas_commit_refs(metadata, path, cas_store)
+                if degraded:
+                    obs.counter(obs.TAKEOVER_DEGRADED_COMMITS).inc()
+                storage.sync_write(
+                    WriteIO(
+                        path=SNAPSHOT_METADATA_FNAME,
+                        buf=metadata.to_yaml().encode(),
+                        durable=True,
+                    )
+                )
+            coordinator.kv_set(commit_key, "ok")
+        except BaseException as e:
+            try:
+                coordinator.kv_set(commit_key, f"failed: {e!r}")
+            except Exception as signal_exc:  # noqa: BLE001
+                # best-effort failure signal: survivors time out on the
+                # commit key instead if the KV store is down too
+                obs.swallowed_exception(
+                    "takeover.commit_failure_signal", signal_exc
+                )
+            raise
+    else:
+        status = _recovery_kv_get(coordinator, monitor, commit_key, dead)
+        if status != "ok":
+            raise RuntimeError(
+                f"takeover leader rank {leader} failed to commit "
+                f"{path!r}: {status}"
+            )
+    logger.warning(
+        "rank %d: takeover commit for %r done — %s (%d write unit(s) "
+        "re-written fleet-wide, %d degraded path(s))",
+        rank, path, "DEGRADED" if degraded else "complete",
+        len(takeover), len(degraded),
+    )
+    return metadata
+
+
 def _validate_app_state(app_state: Dict[str, Any]) -> None:
     # reference snapshot.py:672-690
     for key, value in app_state.items():
@@ -556,15 +921,30 @@ class Snapshot:
             # the persisted record describes exactly this take
             obs_before = obs.aggregate.capture()
             gp_begin = obs.goodput.take_begin(path)
-            (
-                metadata, pending_io, storage, commit_uid,
-                local_entries, object_crcs, object_codecs,
-                object_cas, cas_store,
-            ) = cls._take_impl(
-                path, app_state, replicated, coordinator,
-                is_async=False, base=base, leaf_transform=leaf_transform,
-                storage_options=storage_options, cas=cas,
-            )
+            # Death-aware take (resilience/liveness.py): the heartbeat
+            # PUBLISHER starts before planning — so a rank legitimately
+            # slow in staging keeps stamping and is never falsely
+            # declared dead — while the MONITOR is only consulted by
+            # the commit-phase waits below (liveness_scope).  The uid
+            # is minted here so the session can stamp under it.
+            commit_uid = coordinator._next_uid("commit")
+            session = LivenessSession(coordinator, commit_uid)
+            session.start()
+            try:
+                (
+                    metadata, pending_io, storage, commit_uid,
+                    local_entries, object_crcs, object_codecs,
+                    object_cas, cas_store, takeover_ctx,
+                ) = cls._take_impl(
+                    path, app_state, replicated, coordinator,
+                    is_async=False, base=base,
+                    leaf_transform=leaf_transform,
+                    storage_options=storage_options, cas=cas,
+                    commit_uid=commit_uid,
+                )
+            except BaseException:
+                session.stop()
+                raise
             # Abort-aware commit (resilience/abort.py): a rank hitting
             # an unrecoverable error here poisons the commit scope and
             # re-raises its ORIGINAL error; peers blocked in the gathers
@@ -573,8 +953,21 @@ class Snapshot:
             # to the barrier timeout.  Rank 0 re-checks the poison key
             # immediately before the metadata write, so a poisoned take
             # can never produce a committed snapshot.
+            #
+            # Death-aware commit: the liveness scope makes every
+            # barrier/kv wait below raise a typed RankDeadError when a
+            # peer's stamp goes stale — a SIGKILLed rank can never
+            # reach its poison call — and the handler finishes the
+            # commit via write takeover instead of aborting.
+            #
+            # ``committed`` is mutable so the RankDeadError handler can
+            # see whether rank 0 already wrote the marker (a peer dying
+            # between the two commit barriers must not degrade a
+            # complete snapshot).
+            committed = {"done": False}
             try:
-                with coordinator.abort_scope(commit_uid):
+                with coordinator.abort_scope(commit_uid), \
+                        coordinator.liveness_scope(session.monitor):
                     pending_io.sync_complete()
                     # tiered storage: replicate fast-tier payloads to
                     # peers and enqueue write-back promotion, strictly
@@ -649,9 +1042,48 @@ class Snapshot:
                                 durable=True,
                             )
                         )
+                        committed["done"] = True
                     coordinator.barrier()
             except SnapshotAbortedError:
                 raise
+            except RankDeadError as dead_err:
+                # a peer died mid-commit.  Recovery runs OUTSIDE the
+                # abort/liveness scopes (a scoped wait would re-raise
+                # on the known-dead set forever) and finishes the
+                # commit without the dead rank — complete when its
+                # replicated objects could be re-written by survivors,
+                # typed-degraded otherwise.
+                if not knobs.takeover_enabled() or coordinator.world_size <= 1:
+                    coordinator.poison(
+                        commit_uid,
+                        cause=repr(dead_err),
+                        site=f"take/rank{coordinator.rank}",
+                    )
+                    raise
+                try:
+                    metadata = _recover_commit_after_death(
+                        coordinator=coordinator,
+                        commit_uid=commit_uid,
+                        path=path,
+                        metadata=metadata,
+                        storage=storage,
+                        local_entries=local_entries,
+                        object_crcs=object_crcs,
+                        object_codecs=object_codecs,
+                        object_cas=object_cas,
+                        cas_store=cas_store,
+                        ctx=takeover_ctx,
+                        monitor=session.monitor,
+                        dead_err=dead_err,
+                        already_committed=committed["done"],
+                    )
+                except BaseException as e:
+                    coordinator.poison(
+                        commit_uid,
+                        cause=repr(e),
+                        site=f"takeover/rank{coordinator.rank}",
+                    )
+                    raise
             except BaseException as e:
                 coordinator.poison(
                     commit_uid,
@@ -660,6 +1092,7 @@ class Snapshot:
                 )
                 raise
             finally:
+                session.stop()
                 stamp_stripe(take_event)
                 storage.sync_close()
                 if cas_store is not None:
@@ -702,15 +1135,27 @@ class Snapshot:
         ):
             obs_before = obs.aggregate.capture()
             gp_begin = obs.goodput.take_begin(path)
-            (
-                metadata, pending_io, storage, commit_uid,
-                local_entries, object_crcs, object_codecs,
-                object_cas, cas_store,
-            ) = cls._take_impl(
-                path, app_state, replicated, coordinator,
-                is_async=True, base=base, leaf_transform=leaf_transform,
-                storage_options=storage_options, cas=cas,
-            )
+            # liveness publisher from the very start (see take()); the
+            # session hands off to the PendingSnapshot commit thread,
+            # which stops it when the background commit resolves
+            commit_uid = coordinator._next_uid("commit")
+            session = LivenessSession(coordinator, commit_uid)
+            session.start()
+            try:
+                (
+                    metadata, pending_io, storage, commit_uid,
+                    local_entries, object_crcs, object_codecs,
+                    object_cas, cas_store, takeover_ctx,
+                ) = cls._take_impl(
+                    path, app_state, replicated, coordinator,
+                    is_async=True, base=base,
+                    leaf_transform=leaf_transform,
+                    storage_options=storage_options, cas=cas,
+                    commit_uid=commit_uid,
+                )
+            except BaseException:
+                session.stop()
+                raise
         pending = PendingSnapshot(
             path=path,
             metadata=metadata,
@@ -725,6 +1170,8 @@ class Snapshot:
             obs_before=obs_before,
             object_cas=object_cas,
             cas_store=cas_store,
+            takeover_ctx=takeover_ctx,
+            liveness_session=session,
         )
         # goodput: the unblock point IS this return — training state is
         # independent of the snapshot from here; staging/IO/commit (and
@@ -744,10 +1191,11 @@ class Snapshot:
         leaf_transform: Optional[Callable[[str, Any], Any]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
         cas: Optional[Any] = None,
+        commit_uid: Optional[str] = None,
     ) -> Tuple[
         SnapshotMetadata, PendingIOWork, Any, str,
         Dict[str, Entry], Dict[str, int], Dict[str, Any],
-        Dict[str, Any], Any,
+        Dict[str, Any], Any, "_TakeoverContext",
     ]:
         # reference _take_impl, snapshot.py:517-635
         rank, world = coordinator.rank, coordinator.world_size
@@ -773,7 +1221,10 @@ class Snapshot:
         # so even a rank dying in the planning gathers — storage
         # construction, glob/key/manifest exchanges — poisons a scope
         # its peers are already watching instead of wedging them.
-        commit_uid = coordinator._next_uid("commit")
+        # Callers that run a liveness session mint it even earlier and
+        # pass it in, so heartbeats cover planning and staging too.
+        if commit_uid is None:
+            commit_uid = coordinator._next_uid("commit")
         try:
             with coordinator.abort_scope(commit_uid):
                 return cls._take_impl_inner(
@@ -814,7 +1265,7 @@ class Snapshot:
     ) -> Tuple[
         SnapshotMetadata, PendingIOWork, Any, str,
         Dict[str, Entry], Dict[str, int], Dict[str, Any],
-        Dict[str, Any], Any,
+        Dict[str, Any], Any, "_TakeoverContext",
     ]:
 
         # path + replicated coalescing across ranks
@@ -1020,11 +1471,26 @@ class Snapshot:
                     write_reqs.extend(reqs)
                     local_bytes += cost
 
+        # takeover (resilience): capture the UNBATCHED replicated entry
+        # objects on every rank — before non-writers drop theirs below
+        # and before batching re-points the writer's at rank-local
+        # slabs.  Their ``replicated/`` locations are rank-independent,
+        # so if this rank is later elected to re-write a dead peer's
+        # object, the re-homed manifest entry describes exactly what it
+        # wrote.  Object references (not dicts): the replay's staging
+        # sinks stamp crc32 onto these same objects.
+        repl_entry_objs: Dict[str, Entry] = {
+            lp: entries[lp]
+            for lp in set(repl_reqs) | set(chunk_parent.values())
+        }
+
         # balance replicated host-state writes across ranks
         # (reference partition_write_reqs, partitioner.py:216-310)
         split_repl_paths: set = set()
+        preloads: List[int] = [0] * world
+        assignment: Dict[str, int] = {}
         if repl_items:
-            preloads = (
+            preloads = list(
                 coordinator.all_gather_object(local_bytes)
                 if world > 1
                 else [local_bytes]
@@ -1288,10 +1754,21 @@ class Snapshot:
             write_reqs, storage, budget, rank,
             wait_for_staging=not unblock_early,
         )
+        takeover_ctx = _TakeoverContext(
+            topo=topo,
+            preloads=preloads,
+            assignment=assignment,
+            repl_reqs=repl_reqs,
+            repl_chunk_reqs=repl_chunk_reqs,
+            chunk_parent=chunk_parent,
+            repl_items=repl_items,
+            repl_entries=repl_entry_objs,
+            gathered_manifests=gathered_manifests,
+        )
         return (
             metadata, pending_io, storage, commit_uid,
             local_entry_objs, object_crcs, object_codecs, object_cas,
-            cas_store,
+            cas_store, takeover_ctx,
         )
 
     # --------------------------------------------------------------- restore
@@ -1458,8 +1935,17 @@ class Snapshot:
             abort_uid = coordinator._next_uid("restore")
             storage = None
             cas_reads = None
+            # death-aware restore (resilience/liveness.py): a peer that
+            # dies mid-restore surfaces as a typed RankDeadError at the
+            # barriers/kv waits within LIVENESS_TIMEOUT_S instead of a
+            # full wait-timeout wedge.  No takeover on the read path —
+            # restore holds no state its peers need re-created; failing
+            # fast with the dead rank named is the whole contract.
+            session = LivenessSession(coordinator, abort_uid)
             try:
-                with coordinator.abort_scope(abort_uid):
+                session.start()
+                with coordinator.abort_scope(abort_uid), \
+                        coordinator.liveness_scope(session.monitor):
                     metadata = self.metadata
                     manifest_for_rank = get_manifest_for_rank(metadata, rank)
                     storage = _storage_for(self.path, self._storage_options)
@@ -1541,6 +2027,7 @@ class Snapshot:
                 )
                 raise
             finally:
+                session.stop()
                 stamp_stripe(restore_event)
                 if storage is not None:
                     storage.sync_close()
@@ -1595,6 +2082,33 @@ class Snapshot:
             for p, e in key_manifest.items()
         ):
             return  # nothing under this key matches the filter
+        # degraded snapshot (takeover, docs/resilience.md): logical
+        # paths only a dead rank held are typed-missing, not silently
+        # zero.  A marker blocks THIS restore only when this rank's view
+        # would actually source the dead rank's bytes: its own rank IS
+        # the origin (per-rank private state), the entry is sharded (the
+        # merged view includes the dead rank's lost boxes), or it is
+        # replicated and was not taken over (every view overlays the
+        # dead writer's copy).  A peer's intact private copy of the same
+        # logical path restores normally.  Steer around the gap with
+        # restore(paths=...), or heal it first (SnapshotManager.repair()
+        # / the next take).
+        degraded = getattr(self.metadata, "degraded", None) or {}
+        if degraded:
+            hits = sorted(
+                p
+                for p, e in key_manifest.items()
+                if p in degraded
+                and not is_container_entry(e)
+                and (paths is None or path_is_replicated(p, paths))
+                and (
+                    rank == degraded[p].get("origin_rank")
+                    or isinstance(e, ShardedArrayEntry)
+                    or bool(getattr(e, "replicated", False))
+                )
+            )
+            if hits:
+                raise DegradedSnapshotError(self.path, hits)
         # current state provides in-place/sharding templates
         # (reference snapshot.py:754-762)
         _, targets = flatten(stateful.state_dict(), prefix=key)
@@ -1782,6 +2296,190 @@ class Snapshot:
         # would double-count the operation for every handler
         return verify_snapshot(self, deep=deep)
 
+    def repair_degraded(
+        self,
+        sources: Sequence[str],
+        paths: Optional[Sequence[str]] = None,
+    ) -> List[str]:
+        """Heal a degraded snapshot IN PLACE from continuous peer
+        stores (docs/resilience.md).
+
+        A snapshot committed degraded lost state only the dead rank
+        held.  The continuous checkpoint loop keeps a per-rank RAM/disk
+        mirror under ``<host-root>/r<rank>`` on every peer the dead
+        rank replicated to — this re-reads the lost leaves from those
+        mirrors (content-verified), re-writes them at their manifest
+        locations, drops them from the ``degraded`` section and
+        rewrites the commit marker.  Single-process ops tool: no
+        coordination — only the dead rank's entries and the marker are
+        touched, marker rewritten strictly last.
+
+        ``sources``: continuous host roots (the per-rank ``r<d>``
+        subdir is probed) and/or direct per-rank store roots ending in
+        ``/r<d>``.  ``paths``: restrict to these logical paths.
+
+        Returns the logical paths repaired.  Sharded device state
+        cannot be rebuilt from a host mirror (the mesh is gone) — such
+        paths are skipped with a warning; only a fresh complete take
+        heals them."""
+        with log_event(
+            Event("repair_degraded", {"path": self.path})
+        ), obs.span("snapshot/repair_degraded", path=self.path):
+            return self._repair_degraded_impl(sources, paths)
+
+    def _read_peer_leaves(
+        self, sources: Sequence[str], origin: int, lpaths: Sequence[str]
+    ) -> Dict[str, Any]:
+        """Materialize the wanted logical paths from the first usable
+        continuous mirror of rank ``origin``.  Only roots NAMESPACED to
+        that rank are probed — a same-shaped leaf from some other
+        rank's mirror would be the wrong rank's data."""
+        from .continuous.store import ContinuousStore, decode_leaf
+
+        wanted = set(lpaths)
+        for src in sources:
+            src = str(src).rstrip("/")
+            root = src if src.endswith(f"/r{origin}") else f"{src}/r{origin}"
+            store = ContinuousStore(root)
+            try:
+                head = store.read_head()
+                if head is None:
+                    continue
+                manifest = store.read_step_manifest(str(head["manifest"]))
+                recs = {
+                    lp: rec
+                    for lp, rec in manifest["leaves"].items()
+                    if lp in wanted
+                }
+                if not recs:
+                    continue
+                chunks = store.read_chunks(
+                    [k for rec in recs.values() for k in rec["keys"]]
+                )
+                out: Dict[str, Any] = {}
+                for lp, rec in recs.items():
+                    data = b"".join(chunks[k] for k in rec["keys"])
+                    if len(data) != int(rec["size"]):
+                        raise IOError(
+                            f"leaf {lp!r}: assembled {len(data)} bytes, "
+                            f"manifest says {rec['size']}"
+                        )
+                    out[lp] = decode_leaf(rec, data)
+                logger.info(
+                    "repair: recovered %d/%d leaves of dead rank %d from "
+                    "%r (step %d)",
+                    len(out), len(wanted), origin, root, int(head["step"]),
+                )
+                return out
+            except Exception as e:  # noqa: BLE001 — ladder to next source
+                logger.warning(
+                    "repair source %r unusable for rank %d (%r); trying "
+                    "the next one", root, origin, e,
+                )
+            finally:
+                store.sync_close()
+        return {}
+
+    def _repair_degraded_impl(
+        self, sources: Sequence[str], paths: Optional[Sequence[str]]
+    ) -> List[str]:
+        metadata = self.metadata
+        degraded = dict(getattr(metadata, "degraded", None) or {})
+        if not degraded:
+            return []
+        if isinstance(sources, str):
+            sources = [sources]
+        wanted = {
+            p: info
+            for p, info in degraded.items()
+            if paths is None or p in set(paths)
+        }
+        by_origin: Dict[int, List[str]] = {}
+        for p, info in wanted.items():
+            by_origin.setdefault(int(info.get("origin_rank", -1)), []).append(p)
+        cksum = knobs.write_checksums_enabled()
+        storage = _storage_for(self.path, self._storage_options)
+        repaired: List[str] = []
+        try:
+            for d, lpaths in sorted(by_origin.items()):
+                leaves = self._read_peer_leaves(sources, d, lpaths)
+                reqs: List[WriteReq] = []
+                staged: List[Tuple[str, Entry]] = []
+                for lp in sorted(set(lpaths) & set(leaves)):
+                    old = metadata.manifest.get(f"{d}/{lp}")
+                    if isinstance(old, ShardedArrayEntry):
+                        logger.warning(
+                            "repair: %r is sharded device state — a host "
+                            "mirror cannot rebuild the mesh layout; only "
+                            "a fresh take heals it", lp,
+                        )
+                        continue
+                    entry, ereqs = prepare_write(
+                        obj=leaves[lp], logical_path=lp, rank=d,
+                    )
+                    for wr in ereqs:
+                        # plain writes (no codec_sink): a repaired object
+                        # must read through the raw path, so stale codec
+                        # tables for its locations are dropped below
+                        if cksum:
+                            def _sink(digest: List[int], wr=wr) -> None:
+                                wr.object_digest = tuple(digest)
+                                metadata.objects[wr.path] = list(digest)
+
+                            wr.digest_sink = _sink
+                    reqs.extend(ereqs)
+                    staged.append((lp, entry))
+                if not staged:
+                    continue
+                sync_execute_write_reqs(
+                    reqs, storage, get_process_memory_budget_bytes(),
+                    self._coordinator.rank,
+                ).sync_complete()
+                for lp, entry in staged:
+                    old = metadata.manifest.get(f"{d}/{lp}")
+                    if old is not None:
+                        # the dead rank's never-landed locations leave
+                        # the objects/codecs tables with the entry
+                        old_locs = [
+                            loc
+                            for loc in [getattr(old, "location", None)]
+                            if isinstance(loc, str)
+                        ] + [
+                            s.location
+                            for attr in ("shards", "chunks")
+                            for s in getattr(old, attr, None) or ()
+                        ]
+                        for loc in old_locs:
+                            metadata.codecs.pop(loc, None)
+                            if cksum:
+                                # keep only digests the repair re-stamped
+                                new_locs = {r.path for r in reqs}
+                                if loc not in new_locs:
+                                    metadata.objects.pop(loc, None)
+                    metadata.manifest[f"{d}/{lp}"] = entry
+                    metadata.degraded.pop(lp, None)
+                    repaired.append(lp)
+            if repaired:
+                # marker strictly last: a crash mid-repair leaves a
+                # still-committed (still-degraded) snapshot, never a
+                # marker pointing at unwritten repairs
+                storage.sync_write(
+                    WriteIO(
+                        path=SNAPSHOT_METADATA_FNAME,
+                        buf=metadata.to_yaml().encode(),
+                        durable=True,
+                    )
+                )
+                obs.counter(obs.TAKEOVER_PATHS_REPAIRED).inc(len(repaired))
+                logger.warning(
+                    "repair: healed %d degraded path(s) of %r; %d still "
+                    "degraded", len(repaired), self.path,
+                    len(metadata.degraded),
+                )
+        finally:
+            storage.sync_close()
+        return sorted(repaired)
+
     def materialize(
         self, rank: Optional[int] = None,
         priority: Optional[Sequence[str]] = None,
@@ -1917,6 +2615,8 @@ class PendingSnapshot:
         obs_before: Optional[Dict[str, Any]] = None,
         object_cas: Optional[Dict[str, Any]] = None,
         cas_store: Optional[Any] = None,
+        takeover_ctx: Optional[_TakeoverContext] = None,
+        liveness_session: Optional[LivenessSession] = None,
     ) -> None:
         self.path = path
         self._storage_options = storage_options
@@ -1943,6 +2643,17 @@ class PendingSnapshot:
         # every sink has fired; the store handle closes with the commit
         self._object_cas = object_cas if object_cas is not None else {}
         self._cas_store = cas_store
+        # write takeover (resilience): planning-time context + the
+        # liveness session (handed off by async_take, already stamping
+        # since before planning), so a peer rank dying during the
+        # background commit is survived the same way as in the sync
+        # path.  Assigned HERE (before the thread starts) so there is
+        # no attribute race with the commit thread.
+        self._takeover_ctx = takeover_ctx
+        self._liveness_session = liveness_session or LivenessSession(
+            coordinator, commit_uid
+        )
+        self._committed = False
         self._exc: Optional[BaseException] = None
         self._snapshot: Optional[Snapshot] = None
         self._thread = threading.Thread(
@@ -1972,8 +2683,20 @@ class PendingSnapshot:
             # learn of this failure in one poll interval even before the
             # arrive/depart protocol rounds complete
             coord.poison(uid, cause=repr(e), site=f"async_commit/rank{rank}")
-        with coord.abort_scope(uid):
-            self._complete_snapshot_protocol(coord, uid, rank, world, status)
+        # death-aware background commit: heartbeat under the commit uid
+        # and run the protocol's kv waits with the liveness monitor, so
+        # a SIGKILLed peer surfaces as RankDeadError (handled inside the
+        # protocol via write takeover) instead of a full wait timeout
+        try:
+            self._liveness_session.start()
+            with coord.abort_scope(uid), coord.liveness_scope(
+                self._liveness_session.monitor
+            ):
+                self._complete_snapshot_protocol(
+                    coord, uid, rank, world, status
+                )
+        finally:
+            self._liveness_session.stop()
 
     def _complete_snapshot_protocol(
         self, coord: Coordinator, uid: str, rank: int, world: int, status: str
@@ -2092,6 +2815,7 @@ class PendingSnapshot:
                                 durable=True,
                             )
                         )
+                        self._committed = True
                         depart = "ok"
                     else:
                         depart = f"peers failed: {failed}"
@@ -2112,6 +2836,23 @@ class PendingSnapshot:
                 # tiers report from the promoter's metadata copy
                 # instead)
                 obs.goodput.durable_commit(self.path)
+        except RankDeadError as dead_err:
+            # a peer died during the background commit.  Recovery uses
+            # only kv_set/kv_try_get (no scoped waits), so running it
+            # here — scopes still active — is safe; tolerance for the
+            # known-dead set lives in _recovery_kv_get.
+            try:
+                if status != "ok":
+                    # this rank already failed and poisoned; a dead peer
+                    # on top of that doesn't change the local outcome
+                    raise dead_err
+                self._recover_after_death(coord, uid, rank, world, dead_err)
+            except BaseException as e:  # noqa: BLE001
+                coord.poison(
+                    uid, cause=repr(e), site=f"takeover/rank{rank}"
+                )
+                if self._exc is None:
+                    self._exc = e
         except BaseException as e:  # noqa: BLE001
             if self._exc is None:
                 self._exc = e
@@ -2140,6 +2881,46 @@ class PendingSnapshot:
                     "storage close after async commit failed",
                     exc_info=True,
                 )
+
+    def _recover_after_death(
+        self,
+        coord: Coordinator,
+        uid: str,
+        rank: int,
+        world: int,
+        dead_err: RankDeadError,
+    ) -> None:
+        """Finish the background commit without the dead peer(s) — same
+        machinery as the sync path.  Async caveat (documented in
+        docs/resilience.md): a takeover writer re-stages the orphaned
+        replicated objects from the live application state, which may
+        have advanced since async_take returned; the re-written copies
+        are self-consistent but can be newer than the dead rank's."""
+        if (
+            self._takeover_ctx is None
+            or not knobs.takeover_enabled()
+            or world <= 1
+        ):
+            raise dead_err
+        _recover_commit_after_death(
+            coordinator=coord,
+            commit_uid=uid,
+            path=self.path,
+            metadata=self._metadata,
+            storage=self._storage,
+            local_entries=self._local_entries,
+            object_crcs=self._object_crcs,
+            object_codecs=self._object_codecs,
+            object_cas=self._object_cas,
+            cas_store=self._cas_store,
+            ctx=self._takeover_ctx,
+            monitor=self._liveness_session.monitor,
+            dead_err=dead_err,
+            already_committed=self._committed,
+        )
+        self._committed = True
+        if getattr(self._storage, "policy", None) != "write_back":
+            obs.goodput.durable_commit(self.path)
 
     def wait(self) -> Snapshot:
         """Block until the background commit finishes; re-raise any error

@@ -18,6 +18,18 @@ The KV handshake uses only explicit keys (``{uid}/tierdone/{rank}``) —
 no collectives, no uid counters — so it is legal from this background
 thread under the same rules as the async-commit thread.
 
+Dead peers (resilience/liveness.py): each rank's data job heartbeats
+under ``{uid}/tier`` while it copies; the commit job's done-key wait
+consults a ``LivenessMonitor`` (with the absence rule on — every live
+peer starts stamping promptly here) so a SIGKILLed peer cannot wedge
+the handshake for the full timeout.  A dead peer is SKIPPED, counted
+(``takeover.promoter_dead_peers``), and the durable marker still lands
+— but only after re-proving completeness directly: every location in
+the marker's own manifest must be durable-resident (the dead peer may
+have died after its copies landed but before its done-key).  A dead
+peer whose objects never landed withholds the marker exactly like a
+failed job.
+
 ``pause()``/``resume()`` exist for tests (deterministic "interrupted
 promotion" scenarios); ``drain()`` blocks until the queue is empty and
 surfaces any job errors.
@@ -186,21 +198,38 @@ class Promoter:
                     # this host's fast root holds only its own share of
                     # the global manifest — copy what exists locally
                     paths = [p for p in paths if _stat_ok(src, p)]
-                with obs.span(
-                    "tier/promote_data", durable=group.durable_url,
-                    objects=len(paths),
-                ):
-                    sync_execute_copy_reqs(
-                        paths,
-                        src,
-                        dst,
-                        get_process_memory_budget_bytes(),
-                    )
                 coord = group.coordinator
+                hb = None
                 if coord is not None and group.uid is not None:
-                    coord.kv_set(
-                        f"{group.uid}/tierdone/{coord.rank}", "ok"
-                    )
+                    # heartbeat for the commit job's done-key wait: a
+                    # SLOW copy keeps stamping (never declared dead); a
+                    # killed process leaves a frozen/absent stamp and is
+                    # skipped instead of wedging the handshake
+                    from ..resilience.liveness import LivenessSession
+
+                    hb = LivenessSession(
+                        coord, f"{group.uid}/tier"
+                    ).start()
+                try:
+                    with obs.span(
+                        "tier/promote_data", durable=group.durable_url,
+                        objects=len(paths),
+                    ):
+                        sync_execute_copy_reqs(
+                            paths,
+                            src,
+                            dst,
+                            get_process_memory_budget_bytes(),
+                        )
+                    if coord is not None and group.uid is not None:
+                        coord.kv_set(
+                            f"{group.uid}/tierdone/{coord.rank}", "ok"
+                        )
+                finally:
+                    # strictly after the done-key: the stamp must stay
+                    # live until peers can observe completion
+                    if hb is not None:
+                        hb.stop()
                 return
             # commit: all ranks durable → metadata last
             with obs.span(
@@ -213,16 +242,16 @@ class Promoter:
                         f"withheld: this rank's data promotion failed"
                     )
                 coord = group.coordinator
+                dead_skipped: List[int] = []
                 if coord is not None and group.uid is not None:
-                    # abort-aware done-key wait: a peer whose data
-                    # promotion failed poisons {uid}/tier, and this wait
-                    # raises SnapshotAbortedError promptly — the durable
-                    # commit marker is withheld either way
-                    with coord.abort_scope(f"{group.uid}/tier"):
-                        for r in range(coord.world_size):
-                            coord.kv_get(
-                                f"{group.uid}/tierdone/{r}", _DONE_TIMEOUT_S
-                            )
+                    # abort-aware, death-aware done-key wait: a peer
+                    # whose data promotion FAILED poisons {uid}/tier and
+                    # this raises SnapshotAbortedError promptly; a peer
+                    # that DIED (frozen/never-appearing heartbeat) is
+                    # skipped so the handshake can't wedge — the
+                    # residency re-proof below decides whether the
+                    # marker may still land
+                    dead_skipped = self._await_done_keys(coord, group)
                 if group.recovery:
                     # no cross-rank handshake in recovery mode: gate the
                     # commit marker on every manifest location actually
@@ -269,6 +298,19 @@ class Promoter:
                     read_io = ReadIO(path=_METADATA_FNAME)
                     src.sync_read(read_io)
                     marker = bytes(memoryview(read_io.buf).cast("B"))
+                if dead_skipped:
+                    if group.marker_payload is not None:
+                        # pinned-marker groups carry no parseable
+                        # manifest to re-prove completeness against
+                        raise RuntimeError(
+                            f"durable commit for {group.durable_url!r} "
+                            f"withheld: dead peer(s) {dead_skipped} and "
+                            f"a pinned marker — completeness cannot be "
+                            f"re-proven"
+                        )
+                    self._require_durable_complete(
+                        dst, marker, dead_skipped, group
+                    )
                 dst.sync_write(
                     WriteIO(
                         path=_METADATA_FNAME, buf=marker, durable=True
@@ -287,6 +329,96 @@ class Promoter:
         finally:
             src.sync_close()
             dst.sync_close()
+
+
+    def _await_done_keys(
+        self, coord, group: PromotionGroup
+    ) -> List[int]:
+        """Wait for every rank's ``{uid}/tierdone/{r}`` key.  Returns
+        the ranks SKIPPED because the liveness monitor declared them
+        dead (frozen or never-appearing ``{uid}/tier`` heartbeat) with
+        their done-key still absent.  A dead rank whose done-key DID
+        land is just a finished rank — death only matters while its
+        key is missing."""
+        from .. import knobs
+        from ..resilience.liveness import LivenessMonitor
+
+        # absence rule ON: every live peer's data job starts stamping
+        # as soon as it dequeues, so prolonged absence here means the
+        # process never got that far (or is gone)
+        monitor = LivenessMonitor(
+            coord,
+            f"{group.uid}/tier",
+            absent_after_s=knobs.get_liveness_timeout_s(),
+        )
+        deadline = time.monotonic() + _DONE_TIMEOUT_S
+        skipped: List[int] = []
+        for r in range(coord.world_size):
+            while True:
+                if coord.kv_try_get(f"{group.uid}/tierdone/{r}") is not None:
+                    break
+                coord.raise_if_poisoned(f"{group.uid}/tier")
+                if r != coord.rank and r in monitor.dead_ranks():
+                    skipped.append(r)
+                    obs.counter(
+                        obs.TAKEOVER_PROMOTER_DEAD_PEERS
+                    ).inc()
+                    logger.warning(
+                        "tier promotion %r: rank %d declared dead "
+                        "before publishing its done-key; skipping it "
+                        "in the handshake", group.durable_url, r,
+                    )
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"tier promotion for {group.durable_url!r}: "
+                        f"done-key for live rank {r} never appeared "
+                        f"within {_DONE_TIMEOUT_S:g}s"
+                    )
+                time.sleep(0.1)
+        return skipped
+
+    def _require_durable_complete(
+        self,
+        dst,
+        marker: bytes,
+        dead_skipped: List[int],
+        group: PromotionGroup,
+    ) -> None:
+        """Dead peers were skipped in the handshake — the marker may
+        only land if the durable tier is provably complete anyway (the
+        peer died AFTER its copies landed but before its done-key).
+        The marker bytes carry the global manifest, so completeness is
+        re-proven directly against the durable tier; anything missing
+        withholds the marker exactly like a failed job."""
+        from ..manifest import SnapshotMetadata
+
+        md = SnapshotMetadata.from_yaml(marker.decode())
+        chunked = set((md.cas or {}).get("chunks") or {})
+        locs: Set[str] = set()
+        for entry in md.manifest.values():
+            loc = getattr(entry, "location", None)
+            if isinstance(loc, str):
+                locs.add(loc)
+            for attr in ("shards", "chunks"):
+                for shard in getattr(entry, attr, None) or ():
+                    locs.add(shard.location)
+        missing = sorted(
+            p for p in locs - chunked if not _stat_ok(dst, p)
+        )
+        if missing:
+            raise RuntimeError(
+                f"durable commit for {group.durable_url!r} withheld: "
+                f"dead peer(s) {dead_skipped} skipped in the "
+                f"done-handshake and {len(missing)} manifest "
+                f"object(s) are not durable-resident — e.g. "
+                f"{missing[:3]}"
+            )
+        logger.warning(
+            "tier promotion %r: committing despite dead peer(s) %s — "
+            "all %d manifest locations are durable-resident",
+            group.durable_url, dead_skipped, len(locs - chunked),
+        )
 
 
 def _stat_ok(storage, path: str) -> bool:

@@ -14,12 +14,25 @@ digest-verified, meta-key-last so presence implies completeness);
 sibling ranks poll for the publication and consume it instead of
 issuing their own GET.
 
-Failure semantics — a dead reader degrades, never wedges: a sibling
-that sees no publication within ``FANOUT_TIMEOUT_S`` (or a digest
-mismatch, or any delivery error) falls back to a DIRECT durable read
-and counts a ``topology.fanout_fallbacks``.  Publication itself is
-best-effort: a publish failure costs peers their savings, not the
-restore.
+Failure semantics — a dead reader degrades, never wedges, and never
+stampedes: a sibling that sees no publication within
+``FANOUT_TIMEOUT_S`` does NOT immediately issue its own durable GET
+(at slice scale that synchronized burst is the very DDoS fan-out
+exists to prevent).  Instead the slice re-elects: the next rank in the
+stable ``Topology.reader_candidates`` rotation — agreed on every
+process with zero communication — takes over the durable read AND the
+publication, while the remaining siblings wait one more bounded window
+for the takeover publication.  Only if that second window also passes
+(both readers dead / publication broken) do siblings read direct, and
+then in host-staggered waves: co-hosted processes collapse through the
+shared-host cache's single-flight, and each host's wave starts
+``_FALLBACK_STAGGER_S`` after the previous one, so the durable tier
+sees a ramp instead of a thundering herd.
+``topology.fanout_fallbacks`` counts affected OBJECTS (once per object
+per rank), not raw read attempts; a digest mismatch or delivery error
+still falls back directly (the bytes can't be trusted — correctness
+over smoothness).  Publication itself is best-effort: a publish
+failure costs peers their savings, not the restore.
 
 Composition: the wrapper goes OUTSIDE the shared-host cache, so the
 designated reader's one GET is itself host-deduped — per slice the
@@ -60,6 +73,12 @@ _SHARED_PREFIX = "replicated/"
 # how often a sibling re-probes the KV for its designated reader's
 # publication (one kv_try_get per tick)
 _FETCH_POLL_S = 0.025
+# per-HOST wave spacing for the last-resort direct fallback (both
+# elected readers silent): host k's processes start their direct read
+# k * this many seconds after the first wave — long enough to spread
+# the burst, short enough to be noise next to the two timeout windows
+# already spent
+_FALLBACK_STAGGER_S = 0.05
 
 
 def fanout_enabled(topology: Topology) -> bool:
@@ -234,6 +253,29 @@ class FanoutReadPlugin(StoragePlugin):
         self._m_durable = m.counter(obs.FANOUT_DURABLE_READS)
         self._m_saved = m.counter(obs.FANOUT_DURABLE_GETS_SAVED)
         self._m_fallbacks = m.counter(obs.FANOUT_FALLBACKS)
+        # per-OBJECT fallback accounting: striped/codec restores issue
+        # several ranged reads per object, and counting each would make
+        # one broken object look like a fleet incident
+        self._fallback_paths: Set[str] = set()
+
+    def _count_fallback(self, path: str) -> None:
+        with self._pub_lock:
+            if path in self._fallback_paths:
+                return
+            self._fallback_paths.add(path)
+        self._m_fallbacks.inc()
+
+    async def _read_and_publish(self, read_io: ReadIO, prefix: str) -> None:
+        """The designated-reader duty: one durable GET, then publish
+        the bytes for the slice's siblings."""
+        await self.inner.read(read_io)
+        self._m_durable.inc()
+        nparts = await publish_object(
+            self.coordinator, prefix, read_io.buf, read_io.path
+        )
+        if nparts:
+            with self._pub_lock:
+                self._published.append((prefix, nparts))
 
     async def read(self, read_io: ReadIO) -> None:
         path = read_io.path
@@ -244,30 +286,69 @@ class FanoutReadPlugin(StoragePlugin):
             self.uid, self.topology.slice_id, path, read_io.byte_range
         )
         if path in self.local_publish_paths:
-            await self.inner.read(read_io)
-            self._m_durable.inc()
-            nparts = await publish_object(
-                self.coordinator, prefix, read_io.buf, path
-            )
-            if nparts:
-                with self._pub_lock:
-                    self._published.append((prefix, nparts))
+            await self._read_and_publish(read_io, prefix)
             return
+        timeout_s = knobs.get_fanout_timeout_s()
         data = await fetch_published(
-            self.coordinator, prefix, path, knobs.get_fanout_timeout_s()
+            self.coordinator, prefix, path, timeout_s
         )
-        if data is not None:
-            try:
-                out = resolve_read_destination(read_io.into, len(data))
-                memoryview(out).cast("B")[:] = data
-                read_io.buf = out
-                self._m_saved.inc()
+        if data is None:
+            # designated reader silent past the deadline (dead, hung,
+            # or its publish failed): re-elect.  The candidates
+            # rotation is identical on every process, so the slice
+            # agrees with zero communication that the NEXT candidate
+            # takes over the read+publish while everyone else waits
+            # one more bounded window for the takeover publication.
+            cands = self.topology.reader_candidates(path)
+            alternate = cands[1] if len(cands) > 1 else cands[0]
+            if self.coordinator.rank == alternate:
+                logger.warning(
+                    "fan-out: designated reader rank %d published "
+                    "nothing for %r within %gs; rank %d taking over "
+                    "the slice read", cands[0], path, timeout_s,
+                    alternate,
+                )
+                self._count_fallback(path)
+                await self._read_and_publish(read_io, prefix)
                 return
-            except Exception as e:  # noqa: BLE001 — delivery mismatch:
-                # e.g. an ``into`` destination sized for a different
-                # extent; the direct read below is always correct
-                obs.swallowed_exception("topology.fanout.deliver", e)
-        self._m_fallbacks.inc()
+            data = await fetch_published(
+                self.coordinator, prefix, path, timeout_s
+            )
+            if data is None:
+                # both elected readers silent: every sibling reads
+                # direct — in host-staggered waves (co-hosted
+                # processes collapse via the shared-host cache's
+                # single-flight; each host's wave starts one stagger
+                # after the previous), so the durable tier sees a
+                # ramp, never a synchronized burst
+                self._count_fallback(path)
+                hosts_in_order: list = []
+                for r in cands:
+                    h = self.topology.host_of[r]
+                    if h not in hosts_in_order:
+                        hosts_in_order.append(h)
+                my_host = self.topology.host_of[self.coordinator.rank]
+                pos = (
+                    hosts_in_order.index(my_host)
+                    if my_host in hosts_in_order
+                    else len(hosts_in_order)
+                )
+                if pos:
+                    await asyncio.sleep(_FALLBACK_STAGGER_S * pos)
+                self._m_durable.inc()
+                await self.inner.read(read_io)
+                return
+        try:
+            out = resolve_read_destination(read_io.into, len(data))
+            memoryview(out).cast("B")[:] = data
+            read_io.buf = out
+            self._m_saved.inc()
+            return
+        except Exception as e:  # noqa: BLE001 — delivery mismatch:
+            # e.g. an ``into`` destination sized for a different
+            # extent; the direct read below is always correct
+            obs.swallowed_exception("topology.fanout.deliver", e)
+        self._count_fallback(path)
         self._m_durable.inc()
         await self.inner.read(read_io)
 

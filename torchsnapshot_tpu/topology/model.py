@@ -179,11 +179,23 @@ class Topology:
         Deterministic on every process; consecutive keys spread across
         the slice's members (hosts first, then ranks within a host) so
         per-host durable ingress stays balanced."""
+        return self.reader_candidates(key, slice_id)[0]
+
+    def reader_candidates(
+        self, key: str, slice_id: Optional[int] = None
+    ) -> Tuple[int, ...]:
+        """The slice's FAILOVER ORDER for reading ``key``: every member
+        rank, rotated in the stable (host, rank) order so the designated
+        reader comes first.  Identical on every process, so when the
+        designated reader dies mid-restore the siblings agree — with no
+        extra communication — that ``candidates[1]`` takes over the
+        durable read and the publication (fanout.py re-election)."""
         members = self.ranks_in_slice(
             self.slice_id if slice_id is None else slice_id
         )
         ordered = sorted(members, key=lambda r: (self.host_of[r], r))
-        return ordered[zlib.crc32(key.encode()) % len(ordered)]
+        idx = zlib.crc32(key.encode()) % len(ordered)
+        return tuple(ordered[idx:] + ordered[:idx])
 
     def replica_preference(self, rank: Optional[int] = None) -> Tuple[int, ...]:
         """Every OTHER rank, ordered best-replica-target-first for

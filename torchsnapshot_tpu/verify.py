@@ -24,7 +24,12 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import codec
-from .manifest import Entry, PrimitiveEntry, is_container_entry
+from .manifest import (
+    Entry,
+    PrimitiveEntry,
+    ShardedArrayEntry,
+    is_container_entry,
+)
 from .manifest_ops import get_manifest_for_rank
 from .preparers import prepare_read
 from .scheduler import (
@@ -38,7 +43,16 @@ logger = logging.getLogger(__name__)
 
 @dataclass
 class VerifyResult:
-    """Audit outcome.  ``ok`` iff every check passed."""
+    """Audit outcome.  ``ok`` iff every check passed.
+
+    A snapshot committed degraded (a writer died mid-take and its
+    sharded/unreplicated state could not be taken over —
+    docs/resilience.md) lists the lost logical paths in ``degraded``:
+    those entries are *known-absent by contract*, so they are excluded
+    from the missing/truncated audit instead of drowning it in
+    expected failures.  ``ok`` therefore means "everything the
+    snapshot claims to hold is intact"; ``complete`` additionally
+    requires that nothing was lost at commit time."""
 
     objects_checked: int = 0
     entries_checked: int = 0
@@ -52,6 +66,9 @@ class VerifyResult:
     corrupt: List[Tuple[str, int, int]] = field(
         default_factory=list
     )  # (location, recorded_crc32, actual_crc32) — deep mode only
+    degraded: List[str] = field(
+        default_factory=list
+    )  # logical paths the commit recorded as lost to rank death
 
     @property
     def ok(self) -> bool:
@@ -59,15 +76,25 @@ class VerifyResult:
             self.missing or self.truncated or self.unreadable or self.corrupt
         )
 
+    @property
+    def complete(self) -> bool:
+        """``ok`` and the commit lost nothing to rank death."""
+        return self.ok and not self.degraded
+
     def raise_if_failed(self) -> None:
         if not self.ok:
             raise RuntimeError(f"snapshot verification failed: {self}")
 
     def __str__(self) -> str:
+        deg = (
+            f", {len(self.degraded)} degraded path(s)"
+            if self.degraded
+            else ""
+        )
         if self.ok:
             return (
                 f"OK ({self.objects_checked} objects, "
-                f"{self.entries_checked} entries)"
+                f"{self.entries_checked} entries{deg})"
             )
         parts = []
         if self.missing:
@@ -78,7 +105,7 @@ class VerifyResult:
             parts.append(f"unreadable={self.unreadable[:5]}")
         if self.corrupt:
             parts.append(f"corrupt={self.corrupt[:5]}")
-        return "FAILED " + ", ".join(parts)
+        return "FAILED " + ", ".join(parts) + deg
 
 
 def _expected_extents(manifest: Dict[str, Entry]) -> Dict[str, int]:
@@ -365,6 +392,26 @@ def _verify_impl(snapshot: Any, deep: bool, rank: int) -> VerifyResult:
 
     result = VerifyResult()
     manifest = dict(get_manifest_for_rank(snapshot.metadata, rank))
+    # degraded paths (lost to rank death at commit — manifest.degraded)
+    # are known-absent by contract: report them as degraded and drop
+    # them from the audit manifest so their never-written payloads don't
+    # flood ``missing``.  Same view rule as restore: this rank's audit
+    # is affected iff its view would source the dead rank's bytes.
+    degraded_meta = getattr(snapshot.metadata, "degraded", None) or {}
+    if degraded_meta:
+        result.degraded = sorted(
+            p
+            for p, e in manifest.items()
+            if p in degraded_meta
+            and not is_container_entry(e)
+            and (
+                rank == degraded_meta[p].get("origin_rank")
+                or isinstance(e, ShardedArrayEntry)
+                or bool(getattr(e, "replicated", False))
+            )
+        )
+        for p in result.degraded:
+            del manifest[p]
     storage = _storage_for(
         snapshot.path, getattr(snapshot, "_storage_options", None)
     )

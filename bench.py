@@ -354,6 +354,31 @@ def _resilience_rollup() -> dict:
     return out
 
 
+def _transport_rollup() -> dict:
+    """Payload-transport engine counters for the BENCH record
+    (transport/): which engine the round's redistribution bytes rode,
+    how many ops degraded mid-flight, and the per-engine byte totals —
+    the fan-out probe's per-leg numbers are relative deltas, this is
+    the round's absolute footprint.  Reads the live metrics registry;
+    no I/O."""
+    from torchsnapshot_tpu import obs
+    from torchsnapshot_tpu.transport import current_engine
+
+    counters = obs.metrics_snapshot().get("counters", {})
+    return {
+        "engine": current_engine() or "unresolved",
+        "collective_ops": counters.get(obs.TRANSPORT_COLLECTIVE_OPS, 0),
+        "collective_bytes": counters.get(
+            obs.TRANSPORT_COLLECTIVE_BYTES, 0
+        ),
+        "kv_ops": counters.get(obs.TRANSPORT_KV_OPS, 0),
+        "kv_bytes": counters.get(obs.TRANSPORT_KV_BYTES, 0),
+        "fallbacks": counters.get(obs.TRANSPORT_FALLBACKS, 0),
+        "device_moves": counters.get(obs.TRANSPORT_DEVICE_MOVES, 0),
+        "swept_parts": counters.get(obs.TRANSPORT_SWEPT_PARTS, 0),
+    }
+
+
 def _tier_probe(payload_mb: int = 32) -> dict:
     """Small write-back tiered roundtrip on local dirs (host arrays
     only — never touches the device mid-bench): records fast-tier
@@ -1142,7 +1167,10 @@ def _fanout_probe(
     }
 
     def leg(topology_spec, kv_sub) -> dict:
+        import zlib
+
         errors: list = []
+        digests: dict = {}
 
         def worker(r):
             try:
@@ -1158,6 +1186,15 @@ def _fanout_probe(
                     os.path.join(root, kv_sub), r, world
                 )
                 Snapshot(snap, coordinator=coord).restore(dest)
+                # bitwise identity across ranks AND engines: the
+                # payload-transport engine may change where bytes
+                # travel, never what arrives
+                digests[r] = zlib.crc32(
+                    b"".join(
+                        dest["m"][f"l{i}"].tobytes()
+                        for i in range(objects)
+                    )
+                )
             except Exception as e:  # noqa: BLE001 — surfaced below
                 errors.append(e)
 
@@ -1180,11 +1217,19 @@ def _fanout_probe(
         elapsed = time.perf_counter() - t0
         if errors:
             raise errors[0]
+        if len(set(digests.values())) > 1:
+            raise AssertionError(
+                f"restored payloads diverged across ranks: {digests}"
+            )
         c1 = obs.metrics_snapshot()["counters"]
 
         def d(name):
             return c1.get(name, 0) - c0.get(name, 0)
 
+        moved = {
+            "collective": d("transport.collective_bytes"),
+            "kv": d("transport.kv_bytes"),
+        }
         return {
             "elapsed_s": round(elapsed, 3),
             "durable_gets": d("topology.fanout_durable_reads"),
@@ -1193,12 +1238,44 @@ def _fanout_probe(
                 "topology.fanout_bytes_redistributed"
             ),
             "fallbacks": d("topology.fanout_fallbacks"),
+            "payload_digest": next(iter(digests.values()), None),
+            "transport": {
+                "collective_ops": d("transport.collective_ops"),
+                "kv_ops": d("transport.kv_ops"),
+                "transport_fallbacks": d("transport.fallbacks"),
+                **{
+                    f"{eng}_bytes_per_s": round(
+                        moved[eng] / max(elapsed, 1e-9)
+                    )
+                    for eng in ("collective", "kv")
+                },
+                **{f"{eng}_bytes": moved[eng] for eng in moved},
+            },
         }
 
     try:
         with knobs.override_disable_batching(True):
             Snapshot.take(snap, state, replicated=["**"])
-            out["fanout"] = leg(spec, "kv_fan")
+            # same restore, both payload-transport engines: the KV
+            # blob path vs the collective engine's device fabric
+            # (in-process registry mode under the thread-simulated
+            # world).  The digest cross-check asserts the engines are
+            # bitwise interchangeable; the per-engine bytes/s pair is
+            # the when-do-collectives-pay datum.
+            with knobs.override_transport("kv"):
+                out["fanout"] = leg(spec, "kv_fan")
+            with knobs.override_transport("collective"):
+                out["fanout_collective"] = leg(spec, "kv_fanc")
+            if (
+                out["fanout_collective"]["payload_digest"]
+                != out["fanout"]["payload_digest"]
+            ):
+                raise AssertionError(
+                    "engines disagree bitwise: "
+                    f"kv={out['fanout']['payload_digest']} collective="
+                    f"{out['fanout_collective']['payload_digest']}"
+                )
+            out["engines_bitwise_identical"] = True
             out["flat"] = leg(None, "kv_flat")
         # the acceptance inequality: O(objects) per slice, not
         # O(objects × ranks) — flat-leg GETs are implicit (every rank
@@ -2153,6 +2230,13 @@ def run_child() -> None:
             result["takeover"] = _takeover_probe()
         except Exception as e:
             result["takeover"] = {"error": f"{e!r}"[:200]}
+        # payload-transport footprint: the engine the round resolved
+        # and the absolute per-engine op/byte/fallback totals (the
+        # fan-out probe's per-leg deltas ride inside result["fanout"])
+        try:
+            result["transport"] = _transport_rollup()
+        except Exception as e:
+            result["transport"] = {"error": f"{e!r}"[:200]}
         print(json.dumps(result), flush=True)
         # spot-check one leaf round-tripped
         import ml_dtypes

@@ -731,6 +731,156 @@ def test_chaos_fanout_dead_reader_process_siblings_recover(tmp_path):
     assert survivor_fallbacks >= 1
 
 
+# ============================================== transport scenarios
+#
+# The payload-transport chaos contract (transport/): a collective
+# engine that raises mid-broadcast or cannot probe a device runtime
+# degrades the payload (or the whole resolve) to the KV blob path with
+# ``transport.fallbacks`` advancing — restores stay bitwise-correct,
+# the fan-out contract itself is untouched, nothing wedges, and no
+# fan-out KV blob keys or device-registry entries leak.
+
+
+def test_chaos_transport_publish_failure_degrades_payload_to_kv(tmp_path):
+    """Forced-collective fan-out where every collective publish raises
+    mid-broadcast (failpoint at transport.collective.publish): the
+    designated readers degrade their publications to the KV blob path,
+    siblings consume them inside the fan-out window (zero torn
+    restores, zero fan-out fallbacks), transport.fallbacks advances,
+    and neither KV blob keys nor device-registry entries are left
+    behind."""
+    import threading
+
+    from torchsnapshot_tpu.coordination import FileCoordinator
+    from torchsnapshot_tpu.transport import collective as collective_mod
+
+    snap_dir = _fanout_chaos_snapshot(tmp_path)
+    K, N = 3, 2048
+    kv_dir = os.path.join(str(tmp_path), "kv")
+    errors: list = []
+
+    def worker(r):
+        try:
+            dest = {
+                "m": StateDict(
+                    **{f"l{i}": np.zeros(N, np.float32) for i in range(K)}
+                )
+            }
+            coord = FileCoordinator(kv_dir, r, 2)
+            Snapshot(snap_dir, coordinator=coord).restore(dest)
+            for i in range(K):
+                np.testing.assert_array_equal(
+                    dest["m"][f"l{i}"],
+                    np.arange(N, dtype=np.float32) + 10 * i,
+                )
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    c0 = obs.metrics_snapshot()["counters"]
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    t0 = time.monotonic()
+    with knobs.override_topology("0,0"), knobs.override_disable_batching(
+        True
+    ), knobs.override_transport("collective"), knobs.override_failpoints(
+        "transport.collective.publish=runtime"
+    ):
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert time.monotonic() - t0 < 90, "degrade must be bounded, not a wedge"
+    assert errors == [], errors
+    c1 = obs.metrics_snapshot()["counters"]
+
+    def d(name):
+        return c1.get(name, 0) - c0.get(name, 0)
+
+    # the degrade is observable, and it cost nothing downstream: the
+    # sibling reads were served from the KV publications, not fallback
+    # direct reads
+    assert d("transport.fallbacks") >= 1
+    assert d("topology.fanout_fallbacks") == 0
+    assert d("topology.durable_gets_saved") == K
+    # no torn/leaked redistribution state after the restore
+    assert collective_mod._REGISTRY == {}
+    leftover = [nm for nm in os.listdir(kv_dir) if "%2Ffan%2F" in nm]
+    assert leftover == [], leftover
+
+
+def test_chaos_transport_no_device_mesh_resolves_kv_cleanly(tmp_path):
+    """TRANSPORT=collective on a fleet whose jax device probe fails
+    entirely (no mesh): every rank resolves to the KV engine with one
+    counted fallback, the fan-out restore runs its normal KV
+    publication path (designated readers only touch durable storage,
+    siblings are served publications), bytes are correct, and the KV
+    holds no fan keys after the fleet exits."""
+    _fanout_chaos_snapshot(tmp_path)
+    body = r"""
+    import json
+    from torchsnapshot_tpu import obs
+    from torchsnapshot_tpu.transport import collective as collective_mod
+
+    def _nodev():
+        raise RuntimeError("no device mesh in this fixture")
+
+    collective_mod._devices = _nodev
+
+    K, N = 3, 2048
+    dest = {"m": StateDict(**{
+        f"l{i}": np.zeros(N, np.float32) for i in range(K)
+    })}
+    Snapshot(snap_dir, coordinator=coord).restore(dest)
+    for i in range(K):
+        np.testing.assert_array_equal(
+            dest["m"][f"l{i}"], np.arange(N, dtype=np.float32) + 10 * i
+        )
+    from torchsnapshot_tpu.transport import current_engine
+    c = obs.metrics_snapshot()["counters"]
+    print("XPORT " + json.dumps({
+        "rank": rank,
+        "engine": current_engine(),
+        "fallbacks": c.get("transport.fallbacks", 0),
+        "fanout_fallbacks": c.get("topology.fanout_fallbacks", 0),
+        "durable": c.get("topology.fanout_durable_reads", 0),
+        "saved": c.get("topology.durable_gets_saved", 0),
+    }))
+    print(f"rank {rank} CHAOS-OK")
+    """
+    env = {
+        "TORCHSNAPSHOT_TPU_TOPOLOGY": "0,0",
+        "TORCHSNAPSHOT_TPU_DISABLE_BATCHING": "1",
+        "TORCHSNAPSHOT_TPU_TRANSPORT": "collective",
+    }
+    t0 = time.monotonic()
+    results = _launch_chaos_workers(tmp_path, body, [env, env], world=2)
+    assert time.monotonic() - t0 < 90
+    import json as _json
+
+    durable = saved = 0
+    for r, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} CHAOS-OK" in out
+        stats = next(
+            _json.loads(line[len("XPORT "):])
+            for line in out.splitlines()
+            if line.startswith("XPORT ")
+        )
+        # an explicit collective request the runtime cannot honor is a
+        # COUNTED degrade to KV on every rank
+        assert stats["engine"] == "kv", out
+        assert stats["fallbacks"] >= 1, out
+        assert stats["fanout_fallbacks"] == 0, out
+        durable += stats["durable"]
+        saved += stats["saved"]
+    # the transport degrade never degrades the fan-out contract:
+    # K durable GETs for the slice, every sibling read peer-served
+    assert durable == 3
+    assert saved == 3
+    kv_dir = os.path.join(str(tmp_path), "kv")
+    leftover = [nm for nm in os.listdir(kv_dir) if "%2Ffan%2F" in nm]
+    assert leftover == [], leftover
+
+
 # ================================================== codec scenarios
 #
 # The codec layer's chaos contract: a transient fault inside the encode

@@ -1425,6 +1425,26 @@ def test_instrumentation_covers_topology_entry_points():
     ]
 
 
+def test_instrumentation_covers_transport_entry_points():
+    """The payload-transport subsystem (transport/) is pinned into the
+    instrumentation coverage map: engine selection decides where every
+    redistribution byte travels, and the byte movers of BOTH engines
+    (plus the session consume wait) must stay span-covered — the
+    fastest path must never become the least attributable one."""
+    from tools.lint.passes.instrumentation import MODULE_FUNCTIONS, TARGETS
+
+    assert {"resolve_transport"} <= MODULE_FUNCTIONS[
+        "torchsnapshot_tpu/transport/__init__.py"
+    ]
+    kv_allow = TARGETS["torchsnapshot_tpu/transport/kv.py"]["KVTransport"]
+    assert not {"publish", "try_fetch"} & kv_allow
+    coll = TARGETS["torchsnapshot_tpu/transport/collective.py"]
+    assert not {"publish", "try_fetch", "device_move"} & coll[
+        "CollectiveTransport"
+    ]
+    assert "consume" not in coll["CollectiveFanoutSession"]
+
+
 def test_instrumentation_covers_continuous_entry_points():
     """The continuous checkpoint loop's transitions (step / drain /
     close / promote / restore_latest via the class check), the recovery
@@ -1471,6 +1491,51 @@ def test_collective_safety_designated_reader_kv_pattern_clean():
         """,
     )
     assert findings == []
+
+
+def test_collective_safety_transport_gate_protocol_clean():
+    """The collective transport's two-gate session protocol: the
+    source rank kv_sets go/go2 gates while consumers kv_get and ack —
+    explicit-key KV control traffic under rank conditionals (the
+    sanctioned asymmetric pattern) — and the broadcast itself sits in
+    the symmetric epilogue every process reaches.  The pass must
+    accept exactly that shape: payload collectives lockstep, control
+    plane asymmetric."""
+    findings = _run(
+        "collective-safety",
+        """
+        def session_transfer(coord, source_rank, parts):
+            if coord.rank == source_rank:
+                coord.kv_set("uid/x/0/go", "ok:1:1:128:0:1")
+                coord.kv_get("uid/x/0/ack/1")
+                coord.kv_set("uid/x/0/go2", "go")
+            else:
+                coord.kv_get("uid/x/0/go")
+                coord.kv_set("uid/x/0/ack/1", "1")
+                coord.kv_get("uid/x/0/go2")
+            for part in parts:  # every process enters every broadcast
+                coord.broadcast_object(part)
+        """,
+    )
+    assert findings == []
+
+
+def test_collective_safety_flags_source_only_broadcast():
+    """...but a broadcast entered only under the source branch is the
+    SPMD wedge the session protocol exists to prevent — consumers
+    never arrive and the source blocks forever."""
+    findings = _run(
+        "collective-safety",
+        """
+        def session_transfer(coord, source_rank, part):
+            if coord.rank == source_rank:
+                coord.broadcast_object(part)
+            else:
+                coord.kv_get("uid/x/0/go")
+        """,
+    )
+    assert len(findings) == 1
+    assert "broadcast_object" in findings[0].message
 
 
 def test_collective_safety_flags_collective_in_designated_branch():

@@ -825,6 +825,74 @@ def test_lockstep_call_without_collectives_after_rank_exit_clean():
     assert findings == []
 
 
+_SESSION_HELPERS = """
+def source_leg(coord, part):
+    coord.kv_set("uid/x/0/go", "ok")
+    coord.broadcast_object(part)
+
+def consumer_leg(coord, part):
+    coord.kv_get("uid/x/0/go")
+    coord.broadcast_object(part)
+
+def source_leg_degraded(coord, part):
+    coord.kv_set("uid/x/0/go", "skip")
+    coord.kv_publish_blob("uid/fan/p", part)
+"""
+
+
+def test_lockstep_transport_session_legs_clean():
+    """The collective transport session's shape, one hop removed: the
+    source and consumer arms run DIFFERENT helpers (gate write vs gate
+    read — asymmetric KV control traffic) but both project exactly one
+    broadcast, so every process enters the collective in the same
+    order.  Lockstep must hold through the helper calls."""
+    findings = _run(
+        "protocol-lockstep",
+        {
+            "torchsnapshot_tpu/helpers.py": _SESSION_HELPERS,
+            "torchsnapshot_tpu/entry.py": """
+            from torchsnapshot_tpu.helpers import source_leg, consumer_leg
+
+            def run_transfer(coord, source_rank, part):
+                if coord.rank == source_rank:
+                    source_leg(coord, part)
+                else:
+                    consumer_leg(coord, part)
+            """,
+        },
+    )
+    assert findings == []
+
+
+def test_lockstep_transport_source_degrading_alone_flagged():
+    """...but a source that degrades to the KV blob path WITHOUT
+    telling consumers to skip the broadcast strands every consumer in
+    a collective the source never enters — the exact wedge the
+    session's skip/cancel gates exist to prevent, and it must be
+    caught through the helper indirection."""
+    findings = _run(
+        "protocol-lockstep",
+        {
+            "torchsnapshot_tpu/helpers.py": _SESSION_HELPERS,
+            "torchsnapshot_tpu/entry.py": """
+            from torchsnapshot_tpu.helpers import (
+                consumer_leg,
+                source_leg_degraded,
+            )
+
+            def run_transfer(coord, source_rank, part):
+                if coord.rank == source_rank:
+                    source_leg_degraded(coord, part)
+                else:
+                    consumer_leg(coord, part)
+            """,
+        },
+    )
+    assert len(findings) == 1
+    assert "divergent collective sequences" in findings[0].message
+    assert findings[0].context == "run_transfer"
+
+
 def test_lockstep_marker_before_sync_flagged_and_after_sync_clean():
     violating = {
         "torchsnapshot_tpu/commit.py": """

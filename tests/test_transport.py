@@ -1,0 +1,497 @@
+"""Payload transport (transport/): engine selection matrix, the
+uint32-lane pack/chunk codec, KV-vs-collective bitwise equivalence,
+the kv_publish_blob orphan-sweep regression, the continuous
+replication device-move leg, publish/ subscriber chunk fan-in, and a
+4-process jax.distributed acceptance run (fan-out restore bytes moving
+over real collectives with the KV demoted to control plane)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import zlib
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+from torchsnapshot_tpu import transport as transport_mod
+from torchsnapshot_tpu.coordination import LocalCoordinator
+from torchsnapshot_tpu.scheduler import sync_execute_buffer_writes
+from torchsnapshot_tpu.storage.memory import (
+    _NAMESPACES,
+    MemoryStoragePlugin,
+    reset_namespace,
+)
+from torchsnapshot_tpu.transport import (
+    TransportUnavailable,
+    current_engine,
+    resolve_transport,
+)
+from torchsnapshot_tpu.transport import collective as collective_mod
+from torchsnapshot_tpu.transport.collective import (
+    _LANE,
+    _pack_parts,
+    _plan_parts,
+    _unpack_parts,
+)
+from torchsnapshot_tpu.transport.kv import KVTransport
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counters():
+    return obs.metrics_snapshot()["counters"]
+
+
+def _counter(name):
+    return _counters().get(name, 0)
+
+
+# ======================================================== codec helpers
+
+
+@pytest.mark.parametrize(
+    "nbytes", [0, 1, 3, 127, 128, 129, 4096, 8191, 100_001]
+)
+@pytest.mark.parametrize("part_bytes", [200, 4096, 8 << 20])
+def test_pack_unpack_bitwise_roundtrip(nbytes, part_bytes):
+    """The uint32-lane codec is bitwise lossless for every payload
+    size × chunking combination, including empty and odd tails."""
+    rng = np.random.default_rng(nbytes * 7919 + part_bytes)
+    data = rng.integers(0, 256, size=nbytes, dtype=np.uint8).tobytes()
+    nparts, ppad = _plan_parts(nbytes, part_bytes)
+    assert nparts >= 1 and ppad % _LANE == 0 and ppad >= _LANE
+    assert nparts * ppad >= nbytes
+    parts = _pack_parts(memoryview(data), nparts, ppad)
+    assert len(parts) == nparts
+    # every part is lane-identical: same uint32 word count everywhere,
+    # the broadcast shape contract
+    assert all(p.dtype == np.uint32 and p.shape == (ppad // 4,) for p in parts)
+    assert _unpack_parts(parts, nbytes) == data
+
+
+def test_plan_parts_chunks_large_payloads():
+    nparts, ppad = _plan_parts(10 << 20, 1 << 20)
+    assert nparts == 10 and nparts * ppad >= 10 << 20
+    # floor: part size never goes below one lane
+    nparts_tiny, ppad_tiny = _plan_parts(1024, 1)
+    assert ppad_tiny >= _LANE and nparts_tiny * ppad_tiny >= 1024
+
+
+# ===================================================== engine selection
+
+
+def test_engine_selection_kv_knob_short_circuits():
+    with knobs.override_transport("kv"):
+        t = resolve_transport(LocalCoordinator())
+    assert t.engine == "kv" and current_engine() == "kv"
+    t.close()
+
+
+def test_engine_selection_auto_single_process_is_quiet_kv():
+    """auto + no multi-process jax session → KV, and the miss is NOT a
+    degrade: transport.fallbacks must not advance for a world that
+    never could have used collectives."""
+    before = _counter("transport.fallbacks")
+    with knobs.override_transport("auto"):
+        t = resolve_transport(LocalCoordinator())
+    assert t.engine == "kv"
+    assert _counter("transport.fallbacks") == before
+    t.close()
+
+
+def test_engine_selection_forced_collective_local_mode():
+    with knobs.override_transport("collective"):
+        t = resolve_transport(LocalCoordinator())
+    try:
+        assert t.engine == "collective" and t.mode == "local"
+        assert current_engine() == "collective"
+    finally:
+        t.close()
+
+
+def test_forced_collective_broken_runtime_degrades_counted(monkeypatch):
+    """An explicit TRANSPORT=collective the runtime cannot honor lands
+    on KV with transport.fallbacks advancing — observable, never
+    wedged."""
+
+    def boom():
+        raise RuntimeError("no devices in this fixture")
+
+    monkeypatch.setattr(collective_mod, "_devices", boom)
+    before = _counter("transport.fallbacks")
+    with knobs.override_transport("collective"):
+        t = resolve_transport(LocalCoordinator())
+    assert t.engine == "kv"
+    assert _counter("transport.fallbacks") == before + 1
+    t.close()
+
+
+# ==================================== publish/fetch engine equivalence
+
+
+def _payloads():
+    rng = np.random.default_rng(42)
+    return {
+        "a": rng.integers(0, 256, size=70_001, dtype=np.uint8).tobytes(),
+        "b": b"x" * _LANE,
+        "c": rng.integers(0, 256, size=13, dtype=np.uint8).tobytes(),
+    }
+
+
+def test_collective_local_publish_fetch_bitwise_and_cleanup():
+    coord = LocalCoordinator()
+    with knobs.override_transport("collective"):
+        t = resolve_transport(coord)
+    assert t.engine == "collective"
+    ops0 = _counter("transport.collective_ops")
+    try:
+        with knobs.override_transport_part_bytes(16384):
+            for name, data in _payloads().items():
+                nparts = t.publish(f"x/{name}", data)
+                assert nparts >= 1
+                assert t.try_fetch(f"x/{name}") == data
+        assert _counter("transport.collective_ops") > ops0
+        for name, data in _payloads().items():
+            t.cleanup(f"x/{name}", 8)
+            # announce gone → a fresh probe sees nothing (not an error)
+            assert t.try_fetch(f"x/{name}") is None
+        assert collective_mod._REGISTRY == {}
+    finally:
+        t.close()
+
+
+def test_kv_transport_publish_fetch_bitwise_and_metered():
+    coord = LocalCoordinator()
+    t = KVTransport(coord)
+    ops0, bytes0 = _counter("transport.kv_ops"), _counter("transport.kv_bytes")
+    for name, data in _payloads().items():
+        t.publish(f"x/{name}", data)
+        assert t.try_fetch(f"x/{name}") == data
+    assert _counter("transport.kv_ops") >= ops0 + 3
+    assert _counter("transport.kv_bytes") >= bytes0 + sum(
+        len(d) for d in _payloads().values()
+    )
+    t.cleanup("x/a", 64)
+    assert t.try_fetch("x/a") is None
+    t.close()
+
+
+def test_collective_registry_miss_is_unavailable_not_error():
+    """Announce present but payload published by ANOTHER process (no
+    registry entry here) → TransportUnavailable, so the caller's KV
+    ladder takes over; never a silent None, never a crash."""
+    coord = LocalCoordinator()
+    with knobs.override_transport("collective"):
+        t = resolve_transport(coord)
+    try:
+        t.publish("x/m", b"payload-bytes")
+        with collective_mod._registry_lock:
+            collective_mod._REGISTRY.pop("x/m")
+        with pytest.raises(TransportUnavailable):
+            t.try_fetch("x/m")
+    finally:
+        t.close()
+
+
+def test_collective_fetch_rejects_digest_mismatch():
+    coord = LocalCoordinator()
+    with knobs.override_transport("collective"):
+        t = resolve_transport(coord)
+    try:
+        t.publish("x/d", b"trust-but-verify")
+        meta = coord.kv_try_get("x/d/xmeta")
+        nparts, ppad, n, _crc, adler = meta.split(":")
+        coord.kv_set("x/d/xmeta", f"{nparts}:{ppad}:{n}:12345:{adler}")
+        with pytest.raises(ValueError):
+            t.try_fetch("x/d")
+    finally:
+        t.close()
+
+
+# ==================================== kv blob orphan-sweep regression
+
+
+def test_kv_publish_blob_reclaims_orphans_on_prefix_reuse():
+    """Regression: a publisher killed between the cleanup path's
+    meta delete and its part deletes used to strand {prefix}/p{i}
+    keys forever.  The next publish under the same prefix must
+    overwrite the live indices AND tail-sweep every contiguous
+    leftover, with transport.swept_parts advancing."""
+    coord = LocalCoordinator()
+    big = b"A" * 4000
+    coord.kv_publish_blob("fan/reuse", big, part_bytes=1000)  # p0..p3
+    # simulate the killed publisher: meta deleted, parts stranded
+    coord.kv_try_delete("fan/reuse/meta")
+    assert coord.kv_try_get("fan/reuse/p3") is not None
+    swept0 = _counter("transport.swept_parts")
+    small = b"B" * 1500
+    coord.kv_publish_blob("fan/reuse", small, part_bytes=1000)  # p0..p1
+    assert _counter("transport.swept_parts") == swept0 + 2  # p2, p3
+    for i in (2, 3):
+        assert coord.kv_try_get(f"fan/reuse/p{i}") is None
+    assert coord.kv_try_fetch_blob("fan/reuse", timeout_s=1.0) == small
+
+
+def test_kv_sweep_blob_full_sweep_deletes_meta_first():
+    coord = LocalCoordinator()
+    coord.kv_publish_blob("fan/gone", b"C" * 2500, part_bytes=1000)
+    swept = coord.kv_sweep_blob("fan/gone")
+    assert swept == 3
+    assert coord.kv_try_get("fan/gone/meta") is None
+    assert coord.kv_try_fetch_blob("fan/gone", timeout_s=0.2) is None
+
+
+# ============================== continuous replication device-move leg
+
+
+def _staged_items(k=3, n=50_000):
+    rng = np.random.default_rng(7)
+    return [
+        (f"replica/part{i}", rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+        for i in range(k)
+    ]
+
+
+def test_buffer_writes_device_move_preserves_bytes():
+    """The peer-replication fabric leg: payloads routed through
+    Transport.device_move land bitwise identical, with
+    transport.device_moves advancing."""
+    reset_namespace("xdev")
+    storage = MemoryStoragePlugin("xdev")
+    with knobs.override_transport("collective"):
+        t = resolve_transport(LocalCoordinator())
+    assert t.engine == "collective"
+    moves0 = _counter("transport.device_moves")
+    items = _staged_items()
+    try:
+        written = sync_execute_buffer_writes(
+            items,
+            storage,
+            memory_budget_bytes=1 << 20,
+            counter_name="continuous.replicated_bytes",
+            transport=t,
+        )
+    finally:
+        t.close()
+    assert written == sum(len(b) for _, b in items)
+    assert _counter("transport.device_moves") >= moves0 + len(items)
+    for path, buf in items:
+        assert bytes(_NAMESPACES["xdev"][path]) == buf
+
+
+def test_buffer_writes_raising_transport_degrades_to_staged_bytes():
+    """A fabric-leg failure costs speed, never the replica: the
+    original staged bytes are written and transport.fallbacks
+    advances once per degraded payload."""
+
+    class _Broken(transport_mod.Transport):
+        engine = "collective"
+
+        def device_move(self, buf):
+            raise RuntimeError("fabric down")
+
+    reset_namespace("xdeg")
+    storage = MemoryStoragePlugin("xdeg")
+    items = _staged_items(k=2)
+    fb0 = _counter("transport.fallbacks")
+    written = sync_execute_buffer_writes(
+        items,
+        storage,
+        memory_budget_bytes=1 << 20,
+        counter_name="continuous.replicated_bytes",
+        transport=_Broken(),
+    )
+    assert written == sum(len(b) for _, b in items)
+    assert _counter("transport.fallbacks") == fb0 + 2
+    for path, buf in items:
+        assert bytes(_NAMESPACES["xdeg"][path]) == buf
+
+
+# ======================================= publish/ subscriber chunk fan-in
+
+
+def test_subscriber_fanin_over_collective_registry(tmp_path):
+    """Two co-resident subscribers with a forced-collective transport:
+    the first durable fetch publishes each chunk into the device
+    registry, the second subscriber's poll consumes from it, and both
+    land bitwise on the published weights."""
+    from torchsnapshot_tpu.publish import Publisher, Subscriber
+
+    root = str(tmp_path / "pub")
+    n = 4096
+    w = np.arange(n, dtype=np.float32)
+    pub = Publisher(root, chunk_size_bytes=1024)
+    coord = LocalCoordinator()
+    s1 = {"app": StateDict(w=np.zeros(n, np.float32))}
+    s2 = {"app": StateDict(w=np.zeros(n, np.float32))}
+    sub1 = Subscriber(root, s1, coordinator=coord, sub_id="sub-one")
+    sub2 = Subscriber(root, s2, coordinator=coord, sub_id="sub-two")
+    try:
+        with knobs.override_transport("collective"):
+            pub.publish_state({"app": StateDict(w=w.copy())}, 1)
+            ops0 = _counter("transport.collective_ops")
+            assert sub1.poll_once() == 1  # durable fetch + fan-in publish
+            assert _counter("transport.collective_ops") > ops0
+            assert sub2.poll_once() == 1  # consumes from the registry
+        assert np.array_equal(s1["app"]["w"], w)
+        assert np.array_equal(s2["app"]["w"], w)
+    finally:
+        sub1.close()
+        sub2.close()
+        pub.close()
+    # content-keyed registry entries are swept at close, not accreted
+    assert collective_mod._REGISTRY == {}
+
+
+# =========================== 4-process jax.distributed acceptance run
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_XACC_WORKER = """
+import json, os, sys, zlib
+sys.path.insert(0, {repo!r})
+import numpy as np
+
+rank = int(sys.argv[1])
+world = int(sys.argv[2])
+
+import jax
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    coordinator_address="localhost:" + str({port}),
+    num_processes=world,
+    process_id=rank,
+)
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, obs
+from torchsnapshot_tpu.coordination import FileCoordinator
+from torchsnapshot_tpu.transport import current_engine
+
+coord = FileCoordinator({kv_dir!r}, rank, world)
+snap_dir = {snap_dir!r}
+K, N = 3, 100_000
+
+state = {{"m": StateDict(**{{
+    f"w{{i}}": np.arange(N, dtype=np.float32) * (i + 1) for i in range(K)
+}})}}
+Snapshot.take(snap_dir, state, replicated=["**"], coordinator=coord)
+
+dest = {{"m": StateDict(**{{
+    f"w{{i}}": np.zeros(N, np.float32) for i in range(K)
+}})}}
+Snapshot(snap_dir, coordinator=coord).restore(dest)
+
+crcs = {{
+    f"w{{i}}": zlib.crc32(np.ascontiguousarray(dest["m"][f"w{{i}}"]))
+    for i in range(K)
+}}
+c = obs.metrics_snapshot()["counters"]
+print("RESULT " + json.dumps({{
+    "rank": rank,
+    "engine": current_engine(),
+    "crcs": crcs,
+    "collective_ops": c.get("transport.collective_ops", 0),
+    "collective_bytes": c.get("transport.collective_bytes", 0),
+    "fallbacks": c.get("transport.fallbacks", 0),
+    "fanout_fallbacks": c.get("topology.fanout_fallbacks", 0),
+    "durable": c.get("topology.fanout_durable_reads", 0),
+}}))
+"""
+
+
+def test_multiprocess_collective_fanout_restore_acceptance(tmp_path):
+    """THE tentpole acceptance test: 4 jax.distributed processes
+    (gloo), topology 2 slices × 2 ranks, TRANSPORT=collective — the
+    fan-out restore moves every redistribution byte over real
+    broadcast collectives (engine=collective on all ranks, zero
+    fallbacks), durable GETs stay K per slice (only the designated
+    readers touch durable storage), every rank restores bitwise the
+    ground-truth bytes, and the KV holds no fan/transport keys after
+    the fleet exits."""
+    port = _free_port()
+    kv_dir = os.path.join(str(tmp_path), "kv")
+    snap_dir = os.path.join(str(tmp_path), "snap")
+    script = os.path.join(str(tmp_path), "xacc_worker.py")
+    with open(script, "w") as f:
+        f.write(
+            textwrap.dedent(
+                _XACC_WORKER.format(
+                    repo=_REPO, port=port, kv_dir=kv_dir, snap_dir=snap_dir
+                )
+            )
+        )
+    K, N = 3, 100_000
+    truth = {
+        f"w{i}": zlib.crc32(
+            np.ascontiguousarray(np.arange(N, dtype=np.float32) * (i + 1))
+        )
+        for i in range(K)
+    }
+    env = {
+        **os.environ,
+        "PYTHONPATH": "",
+        "JAX_PLATFORMS": "cpu",
+        # one device per process: the collective session spans
+        # processes, not a forced virtual mesh
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "TORCHSNAPSHOT_TPU_TOPOLOGY": "0,0,1,1",
+        "TORCHSNAPSHOT_TPU_TRANSPORT": "collective",
+        "TORCHSNAPSHOT_TPU_DISABLE_BATCHING": "1",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, str(r), "4"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for r in range(4)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=240)[0].decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise AssertionError("transport acceptance fleet wedged")
+
+    slice_of = (0, 0, 1, 1)
+    per_slice_gets = {0: 0, 1: 0}
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        res = None
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                res = json.loads(line[len("RESULT "):])
+        assert res is not None, f"no RESULT from rank {r}:\n{out}"
+        assert res["engine"] == "collective", out
+        assert {k: int(v) for k, v in res["crcs"].items()} == truth, (
+            f"rank {r} restored different bytes"
+        )
+        # one collective broadcast per shared object, payload bytes
+        # off the KV
+        assert res["collective_ops"] == K, out
+        assert res["collective_bytes"] >= K * N * 4, out
+        assert res["fallbacks"] == 0 and res["fanout_fallbacks"] == 0, out
+        per_slice_gets[slice_of[r]] += res["durable"]
+    # collectives changed WHERE bytes travel, not the durable contract:
+    # still O(objects) per slice, NOT O(objects × ranks)
+    assert per_slice_gets == {0: K, 1: K}
+    # control-plane hygiene, checked after every worker has exited
+    # (mid-run observation races on gate keys are expected)
+    leftover = [
+        name
+        for name in os.listdir(kv_dir)
+        if "%2Ffan%2F" in name or "%2Fxfan%2F" in name
+    ]
+    assert leftover == [], leftover

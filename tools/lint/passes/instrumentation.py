@@ -116,6 +116,34 @@ TARGETS: Dict[str, Dict[str, Set[str]]] = {
             "pinned", "generation", "step", "current_leaves",
         },
     },
+    "torchsnapshot_tpu/transport/kv.py": {
+        # the KV payload engine's byte movers (publish/try_fetch) carry
+        # spans — the degraded path must stay as attributable as the
+        # collective one it degrades FROM.  cleanup is a pair of
+        # best-effort kv deletes whose latency instrument lives on the
+        # coordinator; a bracket would record teardown noise
+        "KVTransport": {"cleanup"},
+    },
+    "torchsnapshot_tpu/transport/collective.py": {
+        # publish/try_fetch/device_move (the device-fabric byte movers)
+        # carry spans — the FASTEST payload path must not be the least
+        # attributable one.  cleanup/close are best-effort teardown,
+        # and open_fanout_session only constructs the session object
+        # whose worker thread opens the transport/session span itself
+        "CollectiveTransport": {
+            "cleanup", "close", "open_fanout_session",
+        },
+        # consume (where a restore thread actually waits on the
+        # fabric) carries the collective_consume span; the session
+        # thread's whole run is bracketed by transport/session.
+        # covers/offer/decline are sub-millisecond ledger flips under
+        # the session condvar — bracketing them would record one event
+        # per shared object per rank with no I/O behind it — and close
+        # joins the already-spanned worker
+        "CollectiveFanoutSession": {
+            "covers", "offer", "decline", "close",
+        },
+    },
     "torchsnapshot_tpu/publish/record.py": {
         # same discipline as ContinuousStore: single-op delegations to
         # sync storage calls whose latency is already attributed by
@@ -205,6 +233,10 @@ MODULE_FUNCTIONS: Dict[str, Set[str]] = {
     # drain burning the window must be visible post-hoc.
     "torchsnapshot_tpu/continuous/recover.py": {"recover_state"},
     "torchsnapshot_tpu/resilience/preemption.py": {"notify_preemption"},
+    # payload transport (transport/): engine selection decides WHERE
+    # every redistribution byte travels — a restore that silently
+    # resolved the wrong engine must be reconstructible from traces
+    "torchsnapshot_tpu/transport/__init__.py": {"resolve_transport"},
 }
 
 _BRACKET_NAMES = {"log_event", "span"}

@@ -175,6 +175,12 @@ class ContinuousCheckpointer:
         self._target_pool: Optional[ThreadPoolExecutor] = None
         self._io_loop: Any = None  # persistent scheduler._LoopThread
         self._closed = False
+        # payload-transport engine for the peer-delta leg (transport/):
+        # resolved once on the replication worker at first use; None
+        # until then, KVTransport's identity leg when collectives are
+        # unavailable
+        self._transport: Any = None
+        self._transport_resolved = False
         # durable promotion bookkeeping: CONFIRMED-durable keys (the
         # delta basis), the in-flight groups, and step manifests whose
         # local GC is deferred until their promotion settles
@@ -561,6 +567,26 @@ class ContinuousCheckpointer:
                     job.done.set()
                 self._queue.task_done()
 
+    def _transport_for_peers(self) -> Any:
+        """The payload-transport engine for peer-delta writes, resolved
+        once on the replication worker: the collective engine when the
+        runtime supports it (its ``device_move`` routes each delta
+        chunk through the device fabric, digest-verified), else None
+        (the KV engine's fabric leg is the identity — not worth an
+        executor hop per chunk).  ``_init_lock`` covers the handoff
+        with ``close()``, which swaps the engine out from the caller
+        domain."""
+        with self._init_lock:
+            if not self._transport_resolved:
+                self._transport_resolved = True
+                from ..transport import resolve_transport
+
+                t = resolve_transport(
+                    self._coordinator, topology=self._topology
+                )
+                self._transport = t if t.engine == "collective" else None
+            return self._transport
+
     def _run_job(self, job: _StepJob) -> None:
         from ..scheduler import (
             get_process_memory_budget_bytes,
@@ -579,6 +605,7 @@ class ContinuousCheckpointer:
         # resolved BEFORE the concurrent target dispatch: lazily
         # creating it from two pool threads would race
         io_loop = self._ensure_io_loop()
+        transport = self._transport_for_peers()
 
         def _one_target(root: str, items) -> bool:
             store = self._store(root)
@@ -592,6 +619,13 @@ class ContinuousCheckpointer:
                         failpoint_site="continuous.replicate",
                         span_label="continuous/replicate_object",
                         loop_thread=io_loop,
+                        # fabric leg for bytes LEAVING this host only —
+                        # the local store's writes never cross a link
+                        transport=(
+                            transport
+                            if root != self.local_root
+                            else None
+                        ),
                     )
                 store.write_manifest(job.step, job.manifest_payload)
                 store.write_head(job.head_payload)
@@ -985,10 +1019,16 @@ class ContinuousCheckpointer:
             with self._init_lock:
                 pool, self._target_pool = self._target_pool, None
                 io_loop, self._io_loop = self._io_loop, None
+                t, self._transport = self._transport, None
             if pool is not None:
                 pool.shutdown(wait=False)
             if io_loop is not None:
                 io_loop.shutdown()
+            if t is not None:
+                try:
+                    t.close()
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    obs.swallowed_exception("continuous.transport", e)
             for store in self._stores.values():
                 store.sync_close()
             self._stores.clear()

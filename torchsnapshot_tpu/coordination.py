@@ -325,8 +325,19 @@ class Coordinator(abc.ABC):
         fetch side verify the reassembled bytes before trusting them.
         No barrier, no uid counters: safe from any thread, legal under
         rank-conditional branches (only the publisher calls this).
-        ``prefix`` must be unique per blob across the job.  Returns the
-        blob's byte length."""
+        ``prefix`` must be unique per blob across the job (namespace
+        REUSE is the exception the sweep below exists for).  Returns
+        the blob's byte length.
+
+        Leak repair: a publisher killed between the cleanup path's
+        meta-key delete and its part deletes leaves orphaned
+        ``{prefix}/p{i}`` keys (meta gone, parts stranded until the KV
+        itself is torn down).  The next publish under the same prefix
+        reclaims them: indices below the new ``nparts`` are simply
+        overwritten, and after the meta write a tail sweep deletes
+        every contiguous leftover part at/above ``nparts``
+        (``kv_sweep_blob``) — so namespace reuse self-heals instead of
+        accreting dead keys."""
         import zlib
 
         view = memoryview(data).cast("B")
@@ -339,7 +350,31 @@ class Coordinator(abc.ABC):
                 f"{prefix}/p{i}", b64encode(chunk).decode("ascii")
             )
         self.kv_set(f"{prefix}/meta", f"{nparts}:{n}:{zlib.crc32(view)}")
+        self.kv_sweep_blob(prefix, beyond=nparts)
         return n
+
+    def kv_sweep_blob(self, prefix: str, beyond: int = 0) -> int:
+        """Best-effort reclaim of leaked blob part keys under
+        ``prefix``: deletes ``{prefix}/p{i}`` for ``i = beyond,
+        beyond+1, ...`` until the first missing index (parts are
+        written contiguously from 0, so the first gap proves the end).
+        ``beyond=0`` is a full sweep and deletes ``{prefix}/meta``
+        FIRST — preserving the meta-last invariant for any concurrent
+        fetcher (meta present implies every part present).  Returns
+        the number of part keys deleted; never raises past the KV's
+        own best-effort delete semantics."""
+        start = max(0, int(beyond))
+        if start == 0:
+            self.kv_try_delete(f"{prefix}/meta")
+        swept = 0
+        i = start
+        while self.kv_try_get(f"{prefix}/p{i}") is not None:
+            self.kv_try_delete(f"{prefix}/p{i}")
+            swept += 1
+            i += 1
+        if swept:
+            obs.counter(obs.TRANSPORT_SWEPT_PARTS).inc(swept)
+        return swept
 
     def kv_try_fetch_blob(
         self, prefix: str, timeout_s: float = _DEFAULT_TIMEOUT_S

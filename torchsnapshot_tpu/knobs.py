@@ -67,6 +67,9 @@ _TOPOLOGY_HOST_ID = "TOPOLOGY_HOST_ID"
 _FANOUT = "FANOUT"
 _FANOUT_PART_BYTES = "FANOUT_PART_BYTES"
 _FANOUT_TIMEOUT_S = "FANOUT_TIMEOUT_S"
+_TRANSPORT = "TRANSPORT"
+_TRANSPORT_PART_BYTES = "TRANSPORT_PART_BYTES"
+_TRANSPORT_TIMEOUT_S = "TRANSPORT_TIMEOUT_S"
 _CONTINUOUS = "CONTINUOUS"
 _CONTINUOUS_PROMOTE_EVERY_N = "CONTINUOUS_PROMOTE_EVERY_N"
 _CONTINUOUS_GRACE_S = "CONTINUOUS_GRACE_S"
@@ -344,6 +347,27 @@ _DEFAULTS = {
     # publication before falling back to a direct durable read — a dead
     # reader degrades the slice to direct GETs, never wedges it.
     _FANOUT_TIMEOUT_S: 60.0,
+    # Payload-transport engine (transport/): how redistribution bytes
+    # (fan-out restore blobs, continuous peer deltas, publish/ chunk
+    # fan-in) physically move between ranks.  "kv" forces the chunked
+    # base64 coordination-KV path; "collective" forces the
+    # device-collective engine (jax device arrays over the topology's
+    # mesh — ICI/DCN speed, KV demoted to announce/digest control
+    # plane); "auto" probes the runtime per-op and picks collective
+    # only when a multi-process jax session is live, else KV.  Any
+    # collective failure degrades that op to KV (counted in
+    # transport.fallbacks) — the knob selects a preference, never a
+    # correctness mode.
+    _TRANSPORT: "auto",
+    # Device-array chunk size for the collective engine (payload bytes
+    # per broadcast part, before lane padding).  Bounds per-part host
+    # staging the same way FANOUT_PART_BYTES bounds KV values.
+    _TRANSPORT_PART_BYTES: 8 * 1024 * 1024,
+    # How long a collective-transport participant waits on the
+    # control-plane gate (go/no-go key) for one transfer before
+    # treating the transfer as failed and degrading to KV.  Bounds
+    # every wait in the engine — the never-wedge contract.
+    _TRANSPORT_TIMEOUT_S: 30.0,
     # Continuous per-step checkpointing (continuous/): the fleet
     # kill-switch for already-constructed ContinuousCheckpointers.
     # 1 (default) = checkpointers run as constructed; 0 = step() becomes
@@ -776,6 +800,31 @@ def get_fanout_timeout_s() -> float:
     return max(0.0, float(_get_raw(_FANOUT_TIMEOUT_S)))
 
 
+def get_transport() -> str:
+    """Payload-transport engine preference: "auto" | "collective" |
+    "kv" (see _TRANSPORT above).  Unrecognized values degrade to
+    "auto" with a warning — transport selection is a bandwidth
+    optimization resolved per-op, never worth aborting over a typo'd
+    env var."""
+    v = str(_get_raw(_TRANSPORT)).strip().lower()
+    if v in ("collective", "kv"):
+        return v
+    if v != "auto":
+        _logger.warning(
+            "TORCHSNAPSHOT_TPU_TRANSPORT=%r is not auto/collective/kv; "
+            "treating as auto", v,
+        )
+    return "auto"
+
+
+def get_transport_part_bytes() -> int:
+    return max(4096, _get_int(_TRANSPORT_PART_BYTES))
+
+
+def get_transport_timeout_s() -> float:
+    return max(0.0, float(_get_raw(_TRANSPORT_TIMEOUT_S)))
+
+
 def continuous_enabled() -> bool:
     """Fleet kill-switch for continuous per-step checkpointing: when
     off, every ``ContinuousCheckpointer.step`` is a no-op (see
@@ -1067,6 +1116,18 @@ def override_fanout_part_bytes(value: int):
 
 def override_fanout_timeout_s(value: float):
     return _override(_FANOUT_TIMEOUT_S, value)
+
+
+def override_transport(value):
+    return _override(_TRANSPORT, value or "auto")
+
+
+def override_transport_part_bytes(value: int):
+    return _override(_TRANSPORT_PART_BYTES, value)
+
+
+def override_transport_timeout_s(value: float):
+    return _override(_TRANSPORT_TIMEOUT_S, value)
 
 
 def override_continuous(value: bool):

@@ -128,6 +128,15 @@ from .metrics import (  # noqa: F401
     FANOUT_BYTES_REDISTRIBUTED,
     FANOUT_PUBLISHES,
     FANOUT_FALLBACKS,
+    TRANSPORT_COLLECTIVE_OPS,
+    TRANSPORT_COLLECTIVE_BYTES,
+    TRANSPORT_KV_OPS,
+    TRANSPORT_KV_BYTES,
+    TRANSPORT_FALLBACKS,
+    TRANSPORT_DEVICE_MOVES,
+    TRANSPORT_SWEPT_PARTS,
+    TRANSPORT_COLLECTIVE_S,
+    TRANSPORT_KV_S,
     PUBLISH_RECORDS,
     PUBLISH_BYTES_DELTA,
     PUBLISH_CHUNKS_DELTA,

@@ -237,6 +237,41 @@ def _topology_rollup(
     }
 
 
+def _transport_stamp(
+    metric_delta: Dict[str, Any]
+) -> Optional[Dict[str, Any]]:
+    """The payload-transport engine this rank's operation selected plus
+    its per-op byte/fallback deltas, or None when transport was never
+    resolved — never raises (flight-record garnish, same contract as
+    the topology stamp).  The engine name makes per-op selection
+    auditable from the flight record alone: a fleet that silently
+    degraded to KV shows ``engine: kv`` (or a nonzero ``fallbacks``)
+    on the affected ranks."""
+    try:
+        from ..transport import current_engine
+
+        engine = current_engine()
+        if engine is None:
+            return None
+        c = metric_delta.get("counters", {})
+        return {
+            "engine": engine,
+            "collective_ops": int(c.get("transport.collective_ops", 0)),
+            "collective_bytes": int(
+                c.get("transport.collective_bytes", 0)
+            ),
+            "kv_ops": int(c.get("transport.kv_ops", 0)),
+            "kv_bytes": int(c.get("transport.kv_bytes", 0)),
+            "fallbacks": int(c.get("transport.fallbacks", 0)),
+            "device_moves": int(c.get("transport.device_moves", 0)),
+        }
+    except Exception as e:  # noqa: BLE001 — telemetry never fails the op
+        from .. import obs
+
+        obs.swallowed_exception("obs.aggregate.transport_stamp", e)
+        return None
+
+
 def _continuous_stamp() -> Optional[Dict[str, Any]]:
     """The active continuous checkpointer's rollup (continuous/loop.py
     summary_block), or None — never raises (flight-record garnish, same
@@ -282,6 +317,11 @@ def rank_payload(
         cinfo = _continuous_stamp()
         if cinfo is not None:
             out["continuous"] = cinfo
+        # payload-transport stamp (transport/): which engine this op's
+        # redistribution bytes rode, with per-op byte/fallback deltas
+        xinfo = _transport_stamp(m)
+        if xinfo is not None:
+            out["transport"] = xinfo
         return out
     except Exception as e:  # noqa: BLE001 — telemetry never fails the op
         from .. import obs

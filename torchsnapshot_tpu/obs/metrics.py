@@ -182,6 +182,30 @@ FANOUT_DURABLE_GETS_SAVED = "topology.durable_gets_saved"
 FANOUT_BYTES_REDISTRIBUTED = "topology.fanout_bytes_redistributed"
 FANOUT_PUBLISHES = "topology.fanout_publishes"
 FANOUT_FALLBACKS = "topology.fanout_fallbacks"
+# Payload transport (transport/): how redistribution bytes physically
+# moved.  collective_ops/collective_bytes count payload transfers the
+# device-collective engine carried (bytes are pre-padding payload
+# bytes, so KV and collective numbers compare directly);
+# kv_ops/kv_bytes the same for the chunked-KV engine (fan-out blob
+# publishes ride these too once routed through a Transport);
+# fallbacks counts per-op degrades collective→KV (probe said
+# collective but the transfer failed or the runtime lost the mesh) —
+# the never-wedge contract's visible trace; device_moves counts
+# host→device→host payload round-trips the continuous peer-delta leg
+# performed; swept_parts counts leaked blob chunk keys reclaimed by
+# the publish-path sweep (a publisher killed between meta-key and
+# delete leaves parts — the sweep is the regression fix's counter).
+# Latency histograms transport.collective_s / transport.kv_s time one
+# payload transfer end-to-end (publish→consume on the measuring side).
+TRANSPORT_COLLECTIVE_OPS = "transport.collective_ops"
+TRANSPORT_COLLECTIVE_BYTES = "transport.collective_bytes"
+TRANSPORT_KV_OPS = "transport.kv_ops"
+TRANSPORT_KV_BYTES = "transport.kv_bytes"
+TRANSPORT_FALLBACKS = "transport.fallbacks"
+TRANSPORT_DEVICE_MOVES = "transport.device_moves"
+TRANSPORT_SWEPT_PARTS = "transport.swept_parts"
+TRANSPORT_COLLECTIVE_S = "transport.collective_s"
+TRANSPORT_KV_S = "transport.kv_s"
 # Continuous per-step checkpointing (continuous/): every training
 # step's changed chunks replicate to a peer host's RAM.  steps counts
 # step() calls that ran; bytes/chunks replicated vs skipped is the

@@ -102,6 +102,19 @@ class Subscriber:
         self._fetch_storage: Dict[str, StoragePlugin] = {}
         self._bytes_fetched_total = 0
         self._closed = False
+        # chunk fan-in over the payload transport (transport/): the
+        # first co-resident subscriber to durably fetch a chunk
+        # publishes it through the collective engine's device registry
+        # (content-keyed), and its peers consume that instead of
+        # re-fetching — resolved lazily, collective-local engine only
+        # (the KV engine would move payload bytes back ONTO the
+        # coordination service, the exact channel transport demotes)
+        self._transport: Any = None
+        self._transport_resolved = False
+        # (prefix, nparts) this subscriber published last poll; swept
+        # at the next poll / close so content-keyed entries don't
+        # accrete across generations
+        self._transport_pub: List[Tuple[str, int]] = []
 
     # ------------------------------------------------------ inspection
 
@@ -197,9 +210,17 @@ class Subscriber:
         with self._poll_lock:
             storages = list(self._fetch_storage.values())
             self._fetch_storage.clear()
+            transport, self._transport = self._transport, None
+            if transport is not None:
+                self._sweep_transport_pub(transport)
         for storage in storages:
             try:
                 storage.sync_close()
+            except Exception as e:  # noqa: BLE001 — teardown
+                obs.swallowed_exception("publish.subscriber.close", e)
+        if transport is not None:
+            try:
+                transport.close()
             except Exception as e:  # noqa: BLE001 — teardown
                 obs.swallowed_exception("publish.subscriber.close", e)
         self._store.sync_close()
@@ -246,20 +267,71 @@ class Subscriber:
             # malformed: treat as a plain wake-up; HEAD decides
             return
 
+    def _fanin_transport(self) -> Any:
+        """The chunk fan-in transport, or None (no coordinator, or the
+        probe landed on an engine without an in-process device
+        registry).  Resolved once; failures leave fan-in off."""
+        if not self._transport_resolved:
+            self._transport_resolved = True
+            if self._coordinator is not None:
+                from ..transport import resolve_transport
+
+                t = resolve_transport(self._coordinator)
+                if getattr(t, "mode", None) == "local":
+                    self._transport = t
+        return self._transport
+
+    def _fanin_prefix(self, key: str) -> str:
+        # content-keyed: co-resident subscribers converge on the same
+        # prefix for the same chunk regardless of which leaf/step
+        # referenced it
+        return f"{self._ns}/xfan/{key}"
+
+    def _sweep_transport_pub(self, transport: Any) -> None:
+        """Reclaim last poll's fan-in publications (best-effort)."""
+        pub, self._transport_pub = self._transport_pub, []
+        for prefix, nparts in pub:
+            try:
+                transport.cleanup(prefix, nparts)
+            except Exception as e:  # noqa: BLE001 — best-effort sweep
+                obs.swallowed_exception("publish.subscriber.fanin", e)
+
     def _fetch(
         self, record: Dict[str, Any], plan: DeltaPlan
     ) -> Dict[Tuple[str, int], bytes]:
         """Fetch every planned chunk, grouped per base URL, through the
         verified ranged-read engine; returns ``(leaf, leaf_off) →
-        bytes``."""
+        bytes``.
+
+        With a fan-in transport, content-keyed chunks a co-resident
+        subscriber already published are consumed from the device
+        registry first (digest-verified); the rest go through the
+        durable read engine and are then published for the NEXT
+        subscriber's poll.  Every transport anomaly degrades that chunk
+        to the durable path — fan-in saves bytes, never gates them."""
         if not plan.fetches:
             return {}
         from .. import scheduler
 
+        transport = self._fanin_transport()
+        if transport is not None:
+            self._sweep_transport_pub(transport)
         by_base: Dict[str, List[FetchItem]] = {}
-        for item in plan.fetches:
-            by_base.setdefault(item.base, []).append(item)
         fetched: Dict[Tuple[str, int], bytes] = {}
+        for item in plan.fetches:
+            if transport is not None and item.key:
+                try:
+                    blob = transport.try_fetch(
+                        self._fanin_prefix(item.key)
+                    )
+                except Exception as e:  # noqa: BLE001 — registry miss,
+                    # digest mismatch, engine failure: durable path
+                    obs.swallowed_exception("publish.subscriber.fanin", e)
+                    blob = None
+                if blob is not None and len(blob) == int(item.nbytes):
+                    fetched[(item.leaf, item.leaf_off)] = blob
+                    continue
+            by_base.setdefault(item.base, []).append(item)
         announce_path = None
         if self._held_record is None:
             announce_path = "cold"
@@ -281,6 +353,19 @@ class Subscriber:
             )
             for item, blob in zip(items, blobs):
                 fetched[(item.leaf, item.leaf_off)] = blob
+                if transport is not None and item.key:
+                    try:
+                        nparts = transport.publish(
+                            self._fanin_prefix(item.key), blob
+                        )
+                        self._transport_pub.append(
+                            (self._fanin_prefix(item.key), nparts)
+                        )
+                    except Exception as e:  # noqa: BLE001 — fan-in
+                        # publication is pure savings for peers
+                        obs.swallowed_exception(
+                            "publish.subscriber.fanin", e
+                        )
         logger.debug(
             "publish fetch step=%s mode=%s: %d chunks, %d bytes from %d bases",
             record["step"],

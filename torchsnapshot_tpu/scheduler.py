@@ -1017,13 +1017,21 @@ async def _execute_buffer_writes(
     counter_name: str,
     failpoint_site: Optional[str] = None,
     span_label: str = "scheduler/buffer_write",
+    transport: Any = None,
 ) -> int:
     """Write already-staged ``(path, buf)`` pairs to ``dst_storage``,
     admitted under the host-memory budget: the buffers exist either
     way, but admission bounds how many a retrying/backpressured target
     can hold IN FLIGHT at once (each queued write can buffer its
     payload again inside the plugin — temp copies, retry bodies), with
-    the same oversized-item progress rule as the copy pipeline."""
+    the same oversized-item progress rule as the copy pipeline.
+
+    ``transport`` routes each payload through the engine's fabric leg
+    (``Transport.device_move`` — a digest-verified device round-trip on
+    the collective engine, identity on KV) before the write.  Any
+    transport failure degrades THAT payload to the original staged
+    bytes with ``transport.fallbacks`` advancing; correctness never
+    depends on the fabric."""
     m_written = obs_metrics.counter(counter_name)
     sem = asyncio.Semaphore(io_concurrency)
     cond = asyncio.Condition()
@@ -1040,9 +1048,22 @@ async def _execute_buffer_writes(
         try:
             if failpoint_site is not None:
                 failpoint(failpoint_site, path=path)
+            out = buf
+            if transport is not None:
+                from .transport import count_fallback
+
+                loop = asyncio.get_running_loop()
+                try:
+                    out = await loop.run_in_executor(
+                        None, transport.device_move, buf
+                    )
+                except Exception as e:  # noqa: BLE001 — fabric-leg
+                    # failure must cost speed, never the replica
+                    count_fallback("buffer-write", e)
+                    out = buf
             async with sem:
                 with obs_tracer.span(span_label, path=path, bytes=nbytes):
-                    await dst_storage.write(WriteIO(path=path, buf=buf))
+                    await dst_storage.write(WriteIO(path=path, buf=out))
             m_written.inc(nbytes)
             return nbytes
         finally:
@@ -1062,6 +1083,7 @@ def sync_execute_buffer_writes(
     failpoint_site: Optional[str] = None,
     span_label: str = "scheduler/buffer_write",
     loop_thread: Optional[_LoopThread] = None,
+    transport: Any = None,
 ) -> int:
     """Write staged ``(path, buf)`` pairs concurrently under the staging
     memory budget; returns bytes written.  This is the continuous
@@ -1088,6 +1110,7 @@ def sync_execute_buffer_writes(
                 counter_name,
                 failpoint_site,
                 span_label,
+                transport,
             )
         ).result()
     finally:

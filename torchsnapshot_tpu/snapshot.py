@@ -80,6 +80,7 @@ from .stateful import (
 )
 from .storage import url_to_storage_plugin
 from . import topology as topology_mod
+from . import transport as transport_mod
 
 logger = logging.getLogger(__name__)
 
@@ -1964,14 +1965,24 @@ class Snapshot:
                     topo = topology_mod.detect_topology(
                         coordinator, exchange_prefix=f"{abort_uid}/topo"
                     )
+                    transport = None
                     if topology_mod.fanout_enabled(topo):
                         shared = topology_mod.shared_read_locations(
                             metadata.manifest
                         )
                         if shared:
+                            # payload transport (transport/): the
+                            # capability-probed engine the fan-out's
+                            # redistribution bytes ride — collectives
+                            # when the runtime supports them, the KV
+                            # blob path otherwise
+                            transport = transport_mod.resolve_transport(
+                                coordinator, topology=topo
+                            )
                             storage = topology_mod.FanoutReadPlugin(
                                 storage, coordinator, topo,
                                 f"{abort_uid}/fan", shared,
+                                transport=transport,
                             )
                     local_keys = sorted(app_state.keys())
                     if world > 1:
@@ -1987,6 +1998,43 @@ class Snapshot:
                     global_keys.sort(
                         key=lambda k: isinstance(app_state.get(k), RNGState)
                     )
+                    # collective fan-out session: whole shared objects
+                    # move as ordered broadcasts over the live jax
+                    # runtime instead of KV blobs.  Requires a session-
+                    # capable transport, every slice fanning out
+                    # (fanout_world_uniform — the gate protocol needs
+                    # all world ranks), and a FULL restore (a paths
+                    # filter makes "which shared objects get read" a
+                    # per-rank question the pre-agreed schedule cannot
+                    # answer).  The plan rides the global key order so
+                    # the schedule advances with the per-key barriers.
+                    if (
+                        transport is not None
+                        and getattr(transport, "mode", None) == "session"
+                        and isinstance(
+                            storage, topology_mod.FanoutReadPlugin
+                        )
+                        and paths is None
+                        and topology_mod.fanout_world_uniform(topo)
+                    ):
+                        try:
+                            plan_paths = (
+                                topology_mod.ordered_shared_locations(
+                                    metadata.manifest,
+                                    storage.shared_paths,
+                                    global_keys,
+                                )
+                            )
+                            storage.transport_session = (
+                                transport.open_fanout_session(
+                                    topo, f"{abort_uid}/fan", plan_paths
+                                )
+                            )
+                        except Exception as e:  # noqa: BLE001 — the
+                            # restore proceeds on the KV path
+                            transport_mod.count_fallback(
+                                "session-open", e
+                            )
                     for key in global_keys:
                         if key in app_state:
                             self._load_stateful(
@@ -1998,9 +2046,15 @@ class Snapshot:
                             coordinator.barrier()
                     # fan-out blob cleanup: the per-key barriers above
                     # prove every rank is past its reads, so the
-                    # transient KV publications can be reclaimed (a
-                    # restore must not permanently grow the
-                    # coordination service's store)
+                    # transient publications — KV blobs, collective
+                    # session gate keys, device-registry entries — can
+                    # be reclaimed (a restore must not permanently grow
+                    # the coordination service's store)
+                    tsession = getattr(
+                        storage, "transport_session", None
+                    )
+                    if tsession is not None:
+                        tsession.close()
                     cleanup = getattr(storage, "cleanup_published", None)
                     if cleanup is not None:
                         cleanup()
@@ -2030,6 +2084,28 @@ class Snapshot:
                 session.stop()
                 stamp_stripe(restore_event)
                 if storage is not None:
+                    # error-path transport teardown (idempotent after
+                    # the happy path's close above): the session thread
+                    # must not outlive the restore, and the device
+                    # registry must not accrete across restores
+                    tsession = getattr(
+                        storage, "transport_session", None
+                    )
+                    if tsession is not None:
+                        try:
+                            tsession.close()
+                        except Exception as e:  # noqa: BLE001
+                            obs.swallowed_exception(
+                                "restore.transport_close", e
+                            )
+                    transport = getattr(storage, "transport", None)
+                    if transport is not None:
+                        try:
+                            transport.close()
+                        except Exception as e:  # noqa: BLE001
+                            obs.swallowed_exception(
+                                "restore.transport_close", e
+                            )
                     storage.sync_close()
                 self._close_cas_reads(cas_reads)
             obs.maybe_write_metrics_textfile()

@@ -19,7 +19,9 @@ See docs/multislice.md.
 from .fanout import (  # noqa: F401
     FanoutReadPlugin,
     fanout_enabled,
+    fanout_world_uniform,
     fetch_published,
+    ordered_shared_locations,
     publish_object,
     shared_read_locations,
 )
@@ -37,7 +39,9 @@ __all__ = [
     "replica_candidate_order",
     "FanoutReadPlugin",
     "fanout_enabled",
+    "fanout_world_uniform",
     "shared_read_locations",
+    "ordered_shared_locations",
     "publish_object",
     "fetch_published",
 ]

@@ -65,6 +65,7 @@ from ..io_types import (
 )
 from ..resilience.failpoints import failpoint
 from ..storage.hostcache import host_cache_active
+from ..transport import TransportUnavailable, count_fallback
 from .model import Topology
 
 logger = logging.getLogger(__name__)
@@ -105,6 +106,48 @@ def fanout_enabled(topology: Topology) -> bool:
     return True
 
 
+def fanout_world_uniform(topology: Topology) -> bool:
+    """Whether EVERY rank's ``fanout_enabled`` decision comes out True
+    under this process's knobs — the collective fan-out session's
+    precondition.  The session's gate protocol and broadcasts need all
+    world processes participating; a single-member slice (or a
+    single-host slice the shared cache already covers) opts its ranks
+    out of fan-out entirely, and a session would stall waiting for
+    their acks.  Evaluated from global topology state only, so every
+    process computes the same answer (knob parity across the fleet is
+    the same SPMD contract restore already documents)."""
+    mode = knobs.get_fanout()
+    if mode == "off":
+        return False
+    for s in sorted(set(topology.slice_of)):
+        members = topology.ranks_in_slice(s)
+        if len(members) < 2:
+            return False
+        if mode == "auto":
+            if not topology.explicit:
+                return False
+            if host_cache_active() and len(
+                {topology.host_of[r] for r in members}
+            ) == 1:
+                return False
+    return True
+
+
+def _entry_shared_locations(entry: Any) -> Iterable[str]:
+    """The ``replicated/``-namespaced storage locations one manifest
+    entry reads (whole object plus shard/chunk pieces)."""
+    if not getattr(entry, "replicated", False):
+        return
+    loc = getattr(entry, "location", None)
+    if isinstance(loc, str) and loc.startswith(_SHARED_PREFIX):
+        yield loc
+    for attr in ("shards", "chunks"):
+        for piece in getattr(entry, attr, None) or ():
+            ploc = getattr(piece, "location", None)
+            if isinstance(ploc, str) and ploc.startswith(_SHARED_PREFIX):
+                yield ploc
+
+
 def shared_read_locations(manifest: Dict[str, Any]) -> Set[str]:
     """Storage locations every rank reads during a full restore: the
     ``replicated/``-namespaced extents of replicated entries (whole
@@ -114,17 +157,34 @@ def shared_read_locations(manifest: Dict[str, Any]) -> Set[str]:
     designated reader would never publish those."""
     out: Set[str] = set()
     for entry in manifest.values():
-        if not getattr(entry, "replicated", False):
-            continue
-        loc = getattr(entry, "location", None)
-        if isinstance(loc, str) and loc.startswith(_SHARED_PREFIX):
-            out.add(loc)
-        for attr in ("shards", "chunks"):
-            for piece in getattr(entry, attr, None) or ():
-                ploc = getattr(piece, "location", None)
-                if isinstance(ploc, str) and ploc.startswith(_SHARED_PREFIX):
-                    out.add(ploc)
+        out.update(_entry_shared_locations(entry))
     return out
+
+
+def ordered_shared_locations(
+    manifest: Dict[str, Any],
+    shared: Set[str],
+    key_order: Iterable[str],
+) -> list:
+    """``shared`` in restore READ order: grouped by the owning app
+    key's position in the restore's global key order (manifest logical
+    paths lead with the app key), location-sorted within a key.  The
+    collective fan-out session schedules its transfers in this order,
+    so the schedule advances in step with the restore's per-key read
+    phases — a plan sorted any other way would park the session waiting
+    on a later key's object while every rank is still gated behind an
+    earlier key's barrier."""
+    pos = {k: i for i, k in enumerate(key_order)}
+    best: Dict[str, int] = {}
+    for p, entry in manifest.items():
+        i = pos.get(p.split("/", 1)[0])
+        if i is None:
+            continue
+        for loc in _entry_shared_locations(entry):
+            if loc in shared and (loc not in best or i < best[loc]):
+                best[loc] = i
+    tail = sorted(p for p in shared if p not in best)
+    return sorted(best, key=lambda loc: (best[loc], loc)) + tail
 
 
 def _blob_prefix(uid: str, slice_id: int, path: str, byte_range: Any) -> str:
@@ -166,21 +226,49 @@ async def publish_object(
 
 
 async def fetch_published(
-    coordinator: Any, prefix: str, path: str, timeout_s: float
+    coordinator: Any,
+    prefix: str,
+    path: str,
+    timeout_s: float,
+    transport: Any = None,
 ) -> Optional[bytes]:
     """Poll for the designated reader's publication of ``path``; the
     verified bytes, or None when the deadline passes or verification
     fails (the caller falls back to a direct durable read).  Polling
     runs from the event loop (one non-blocking probe per tick) so a
-    host full of waiting siblings never parks scheduler threads."""
+    host full of waiting siblings never parks scheduler threads.
+
+    With a ``transport`` the device-registry announce is probed FIRST
+    each tick (the publisher may have used either engine — its own
+    transport could have degraded mid-publish), then the KV blob.  A
+    ``TransportUnavailable`` from the probe demotes this wait to
+    KV-only; it is not a fallback event (the publisher's engine choice
+    decides where bytes actually travelled)."""
     with obs.span("fanout/fetch", path=path):
         loop = asyncio.get_running_loop()
         deadline = time.monotonic() + timeout_s
         while True:
             try:
-                data = await loop.run_in_executor(
-                    None, coordinator.kv_try_fetch_blob, prefix
-                )
+                data = None
+                if transport is not None:
+                    try:
+                        data = await loop.run_in_executor(
+                            None, transport.try_fetch, prefix
+                        )
+                    except TransportUnavailable:
+                        transport = None
+                if data is None:
+                    data = await loop.run_in_executor(
+                        None, coordinator.kv_try_fetch_blob, prefix
+                    )
+                    if data is not None:
+                        # KV-leg consumption, metered under the same
+                        # instrument family as the collective engine so
+                        # the bench compares engines directly
+                        obs.counter(obs.TRANSPORT_KV_OPS).inc()
+                        obs.counter(obs.TRANSPORT_KV_BYTES).inc(
+                            len(data)
+                        )
             except ValueError as e:
                 # digest/length mismatch: the publication cannot be
                 # trusted — direct read, never corrupt bytes
@@ -210,12 +298,20 @@ class FanoutReadPlugin(StoragePlugin):
         topology: Topology,
         uid: str,
         shared_paths: Iterable[str],
+        transport: Any = None,
     ) -> None:
         self.inner = inner
         self.coordinator = coordinator
         self.topology = topology
         self.uid = uid
         self.shared_paths = set(shared_paths)
+        # engine-selected payload transport (transport/); None keeps
+        # the pre-transport KV-blob behavior bit-for-bit
+        self.transport = transport
+        # a CollectiveFanoutSession once restore derives the read-
+        # ordered plan (attached AFTER construction — the plan needs
+        # the gathered global key order); None = per-op transport only
+        self.transport_session: Any = None
         # capability delegation: non-shared reads (per-rank/sharded
         # state — usually the bulk) keep the inner plugin's zero-copy
         # mmap path and budget exemption.  Shared reads are still
@@ -265,17 +361,71 @@ class FanoutReadPlugin(StoragePlugin):
             self._fallback_paths.add(path)
         self._m_fallbacks.inc()
 
+    def _local_transport(self) -> Any:
+        """The transport, iff it can serve per-op publish/fetch in this
+        process (the collective engine's in-process device-registry
+        mode).  Session mode moves whole objects through the fan-out
+        session instead, and its per-op API raising
+        ``TransportUnavailable`` is by design, not a degrade."""
+        t = self.transport
+        if t is not None and getattr(t, "mode", None) == "local":
+            return t
+        return None
+
+    async def _publish_payload(self, prefix: str, buf: Any, path: str):
+        """Publish one read's bytes over the selected engine; returns
+        the cleanup-ledger entry ``(engine, prefix, nparts)`` or None.
+        A collective-engine failure mid-publish degrades THIS op to the
+        KV blob path (``transport.fallbacks`` advances); the KV leg's
+        own failure stays best-effort as before."""
+        t = self._local_transport()
+        if t is not None:
+            try:
+                loop = asyncio.get_running_loop()
+                nparts = await loop.run_in_executor(
+                    None, t.publish, prefix, buf
+                )
+                obs.counter(obs.FANOUT_PUBLISHES).inc()
+                obs.counter(obs.FANOUT_BYTES_REDISTRIBUTED).inc(
+                    obs.buf_nbytes(buf)
+                )
+                return ("collective", prefix, nparts)
+            except Exception as e:  # noqa: BLE001 — mid-op degrade:
+                # the payload must still reach the siblings
+                count_fallback("fanout-publish", e)
+        nparts = await publish_object(self.coordinator, prefix, buf, path)
+        if nparts:
+            obs.counter(obs.TRANSPORT_KV_OPS).inc()
+            obs.counter(obs.TRANSPORT_KV_BYTES).inc(obs.buf_nbytes(buf))
+            return ("kv", prefix, nparts)
+        return None
+
     async def _read_and_publish(self, read_io: ReadIO, prefix: str) -> None:
         """The designated-reader duty: one durable GET, then publish
         the bytes for the slice's siblings."""
         await self.inner.read(read_io)
         self._m_durable.inc()
-        nparts = await publish_object(
-            self.coordinator, prefix, read_io.buf, read_io.path
+        entry = await self._publish_payload(
+            prefix, read_io.buf, read_io.path
         )
-        if nparts:
+        if entry is not None:
             with self._pub_lock:
-                self._published.append((prefix, nparts))
+                self._published.append(entry)
+
+    def _deliver(self, read_io: ReadIO, data: bytes) -> bool:
+        """Place redistributed bytes into the read's destination; False
+        on a mismatch (the caller falls back to a direct read)."""
+        try:
+            out = resolve_read_destination(read_io.into, len(data))
+            memoryview(out).cast("B")[:] = data
+            read_io.buf = out
+            self._m_saved.inc()
+            return True
+        except Exception as e:  # noqa: BLE001 — delivery mismatch:
+            # e.g. an ``into`` destination sized for a different
+            # extent; the direct read is always correct
+            obs.swallowed_exception("topology.fanout.deliver", e)
+            return False
 
     async def read(self, read_io: ReadIO) -> None:
         path = read_io.path
@@ -285,12 +435,54 @@ class FanoutReadPlugin(StoragePlugin):
         prefix = _blob_prefix(
             self.uid, self.topology.slice_id, path, read_io.byte_range
         )
+        session = self.transport_session
+        skey = (self.topology.slice_id, path)
+        if session is not None and not session.covers(skey):
+            session = None
+        loop = asyncio.get_running_loop()
         if path in self.local_publish_paths:
+            if session is not None:
+                if read_io.byte_range is not None:
+                    # ranged reads (striped/codec extents) ride the KV
+                    # blob path per byte range; tell the session
+                    # promptly so siblings get "skip", not a timeout
+                    session.decline(skey)
+                else:
+                    await self.inner.read(read_io)
+                    self._m_durable.inc()
+                    data = bytes(
+                        memoryview(read_io.buf).cast("B")
+                    )
+                    accepted = await loop.run_in_executor(
+                        None, session.offer, skey, data, prefix
+                    )
+                    if accepted:
+                        # the session owns delivery now: broadcast on
+                        # its schedule, or KV-publish from its drain
+                        # path (its ledger, its cleanup)
+                        return
+                    entry = await self._publish_payload(
+                        prefix, data, path
+                    )
+                    if entry is not None:
+                        with self._pub_lock:
+                            self._published.append(entry)
+                    return
             await self._read_and_publish(read_io, prefix)
             return
         timeout_s = knobs.get_fanout_timeout_s()
+        if session is not None and read_io.byte_range is None:
+            data = await loop.run_in_executor(
+                None, session.consume, skey
+            )
+            if data is not None and self._deliver(read_io, data):
+                return
+            # skipped / degraded / mismatched delivery: fall into the
+            # KV ladder below — the session's drain path (or the
+            # source's inline publish) feeds it
         data = await fetch_published(
-            self.coordinator, prefix, path, timeout_s
+            self.coordinator, prefix, path, timeout_s,
+            transport=self._local_transport(),
         )
         if data is None:
             # designated reader silent past the deadline (dead, hung,
@@ -312,7 +504,8 @@ class FanoutReadPlugin(StoragePlugin):
                 await self._read_and_publish(read_io, prefix)
                 return
             data = await fetch_published(
-                self.coordinator, prefix, path, timeout_s
+                self.coordinator, prefix, path, timeout_s,
+                transport=self._local_transport(),
             )
             if data is None:
                 # both elected readers silent: every sibling reads
@@ -338,35 +531,31 @@ class FanoutReadPlugin(StoragePlugin):
                 self._m_durable.inc()
                 await self.inner.read(read_io)
                 return
-        try:
-            out = resolve_read_destination(read_io.into, len(data))
-            memoryview(out).cast("B")[:] = data
-            read_io.buf = out
-            self._m_saved.inc()
+        if self._deliver(read_io, data):
             return
-        except Exception as e:  # noqa: BLE001 — delivery mismatch:
-            # e.g. an ``into`` destination sized for a different
-            # extent; the direct read below is always correct
-            obs.swallowed_exception("topology.fanout.deliver", e)
         self._count_fallback(path)
         self._m_durable.inc()
         await self.inner.read(read_io)
 
     def cleanup_published(self) -> None:
-        """Delete this rank's blob publications from the coordination
-        KV (meta key first, so a straggler's poll sees clean absence
-        and takes the normal timeout-fallback path).  Called by restore
-        strictly AFTER the last cross-rank barrier — every slice member
-        is past its reads by then, so nothing can still be consuming a
-        blob.  Best-effort: a failed delete leaks one restore's blobs
-        until job teardown, never fails the restore."""
+        """Delete this rank's transient publications — KV blob keys
+        (meta key first, so a straggler's poll sees clean absence and
+        takes the normal timeout-fallback path) and device-registry
+        entries with their announce keys.  Called by restore strictly
+        AFTER the last cross-rank barrier — every slice member is past
+        its reads by then, so nothing can still be consuming a
+        publication.  Best-effort: a failed delete leaks one restore's
+        blobs until job teardown, never fails the restore."""
         with self._pub_lock:
             published, self._published = self._published, []
-        for prefix, nparts in published:
+        for engine, prefix, nparts in published:
             try:
-                self.coordinator.kv_try_delete(f"{prefix}/meta")
-                for i in range(nparts):
-                    self.coordinator.kv_try_delete(f"{prefix}/p{i}")
+                if engine == "collective" and self.transport is not None:
+                    self.transport.cleanup(prefix, nparts)
+                else:
+                    self.coordinator.kv_try_delete(f"{prefix}/meta")
+                    for i in range(nparts):
+                        self.coordinator.kv_try_delete(f"{prefix}/p{i}")
             except Exception as e:  # noqa: BLE001 — best-effort cleanup
                 obs.swallowed_exception("topology.fanout.cleanup", e)
 
